@@ -1,0 +1,41 @@
+"""kubernetes_rescheduling_tpu — a TPU-native communication-aware rescheduling framework.
+
+A brand-new framework with the capabilities of ye0nj00/Kubernetes-Rescheduling
+(CAR — Communication-Aware Rescheduling — plus the spread/binpack/random/
+kube-scheduling baselines, hazard detection, victim selection, and the
+communication-cost / load-deviation evaluation harness), re-designed TPU-first:
+
+- cluster snapshots are fixed-capacity padded JAX arrays (``core.state``),
+- the objectives are jit-able reductions (``objectives``),
+- all five placement policies are one vmapped scoring kernel (``policies``),
+- the multi-round control loop is a ``lax.scan`` (``solver.round_loop``),
+- a batched global assignment solver replaces the one-pod-per-round greedy
+  (``solver.global_solver``), sharding over a device mesh (``parallel``),
+- live-cluster I/O lives in a thin host-side adapter (``backends.k8s``),
+  with a hermetic in-memory simulator (``backends.sim``) for tests.
+
+Reference parity citations use ``file:line`` of the reference repo
+(e.g. ``rescheduling.py:174-218``); see SURVEY.md at the repo root.
+"""
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.core.quantities import (
+    cpu_to_millicores,
+    mem_to_bytes,
+    format_millicores,
+    format_bytes_as_mi,
+)
+from kubernetes_rescheduling_tpu.config import RescheduleConfig
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ClusterState",
+    "CommGraph",
+    "RescheduleConfig",
+    "cpu_to_millicores",
+    "mem_to_bytes",
+    "format_millicores",
+    "format_bytes_as_mi",
+    "__version__",
+]

@@ -1,0 +1,75 @@
+"""Typed configuration for the whole framework.
+
+Replaces the reference's scattered hardcoded constants with one dataclass
+(SURVEY.md §5.6): hazard threshold 30 (reference harzard_detect.py:7), 15 s
+pacing and 10 rounds (reference main.py:27-28), policy name (reference
+main.py:118-125), plus the knobs the reference never had (backend, scale,
+capacity enforcement, solver iterations). Loadable from TOML.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tomllib
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Literal
+
+PolicyName = Literal[
+    "spread", "binpack", "random", "kubescheduling", "communication", "global"
+]
+
+POLICIES: tuple[str, ...] = (
+    "spread",
+    "binpack",
+    "random",
+    "kubescheduling",
+    "communication",
+)
+
+
+@dataclass(frozen=True)
+class RescheduleConfig:
+    """One config object for a rescheduling run."""
+
+    # Policy & loop — reference semantics
+    algorithm: str = "communication"       # reference main.py:118-125 (CLI arg)
+    hazard_threshold_pct: float = 30.0     # reference harzard_detect.py:7
+    max_rounds: int = 10                   # reference main.py:28
+    sleep_after_action_s: float = 15.0     # reference main.py:27 (live backend only)
+    moves_per_round: int = 1               # 1 = reference-faithful (one deployment/round)
+
+    # New capabilities
+    backend: str = "sim"                   # "sim" | "k8s"
+    enforce_capacity: bool = False         # reference never checks capacity
+    global_solver_iters: int = 8           # best-response sweeps per solve
+    balance_weight: float = 0.0            # λ for load-balance term in global solver
+    seed: int = 0
+
+    # Scale (array capacities; 0 = size to the scenario)
+    node_capacity: int = 0
+    pod_capacity: int = 0
+
+    # Live adapter
+    namespace: str = "default"             # reference main.py:68
+    delete_timeout_s: float = 180.0        # reference delete_replaced_pod.py:8
+    delete_poll_interval_s: float = 1.5    # reference delete_replaced_pod.py:8
+
+    def validate(self) -> "RescheduleConfig":
+        valid = set(POLICIES) | {"global"}
+        if self.algorithm not in valid:
+            raise ValueError(
+                f"unknown algorithm {self.algorithm!r}; expected one of {sorted(valid)}"
+            )
+        if self.max_rounds < 0 or self.moves_per_round < 1:
+            raise ValueError("max_rounds must be >= 0 and moves_per_round >= 1")
+        return self
+
+    @classmethod
+    def from_toml(cls, path: str | Path) -> "RescheduleConfig":
+        data = tomllib.loads(Path(path).read_text())
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**data).validate()

@@ -1,0 +1,298 @@
+"""Array-world cluster state — the TPU-native replacement for the reference's
+``cluster_monitoring`` dict (reference podmonitor.py:17-37).
+
+Design notes (TPU-first):
+
+- **Fixed capacity + validity masks.** Pods appear and disappear between
+  rounds; dynamic shapes would retrace every ``jit``. All arrays are padded to
+  static capacities ``N`` (nodes), ``P`` (pods), ``S`` (services) with boolean
+  validity masks, so a single compiled program serves every round.
+- **Assignment vector, not nested dicts.** The reference stores a per-node
+  list of pod dicts; we store ``pod_node: i32[P]`` (and ``pod_service``),
+  which turns every policy question ("how many pods on node n?", "how many
+  related pods on node n?") into a one-hot matmul or segment-sum — MXU food.
+- **Derived, not stored.** Node usage = base (system/background) + sum of
+  tracked pod usage, recomputed on device each round instead of being a
+  second source of truth.
+- **Lexicographic ranks.** The reference breaks ties on node *names*
+  (min name for spread, reference rescheduling.py:101; max name for binpack,
+  reference rescheduling.py:133). Strings don't exist on device, so each node
+  carries ``node_lex_rank`` — its rank in the sorted-name order — computed
+  host-side once at state construction.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+UNASSIGNED = -1  # pod_node value for a pod not placed on any node
+
+
+@struct.dataclass
+class CommGraph:
+    """Service↔service communication graph.
+
+    The undirected closure of the workload's directed call graph — the
+    reference hardcodes this closure as a dict (reference main.py:31-52,
+    duplicated at communicationcost.py:69-88); we derive it from a workmodel
+    file (see ``core.workmodel``) into a dense symmetric adjacency, which is
+    what both the comm-cost objective (a masked quadratic form) and the CAR
+    affinity score (a row gather + matmul) want on TPU.
+
+    Attributes:
+      adj: f32[S, S] symmetric weights; adj[i, j] > 0 iff services i and j
+        communicate. Diagonal is zero.
+      service_valid: bool[S] — padding mask.
+      names: static tuple of service names, index-aligned with ``adj``.
+    """
+
+    adj: jax.Array
+    service_valid: jax.Array
+    names: tuple[str, ...] = struct.field(pytree_node=False, default=())
+
+    @property
+    def num_services(self) -> int:
+        return int(self.adj.shape[0])
+
+    def service_index(self, name: str) -> int:
+        return self.names.index(name)
+
+    @classmethod
+    def from_relation(
+        cls,
+        relation: Mapping[str, Sequence[str]],
+        *,
+        capacity: int | None = None,
+        names: Sequence[str] | None = None,
+    ) -> "CommGraph":
+        """Build from a ``{service: [related services]}`` dict.
+
+        Symmetrizes (undirected closure — matches how reference main.py:31-52
+        closes workmodelC.json's directed edges) and pads to ``capacity``.
+        """
+        if names is None:
+            seen: dict[str, None] = {}
+            for k, vs in relation.items():
+                seen.setdefault(k)
+                for v in vs:
+                    seen.setdefault(v)
+            names = list(seen)
+        n = len(names)
+        cap = capacity or n
+        if cap < n:
+            raise ValueError(f"capacity {cap} < number of services {n}")
+        index = {name: i for i, name in enumerate(names)}
+        adj = np.zeros((cap, cap), dtype=np.float32)
+        for src, dsts in relation.items():
+            i = index[src]
+            for dst in dsts:
+                j = index[dst]
+                if i != j:
+                    adj[i, j] = 1.0
+                    adj[j, i] = 1.0
+        valid = np.zeros((cap,), dtype=bool)
+        valid[:n] = True
+        return cls(adj=jnp.asarray(adj), service_valid=jnp.asarray(valid), names=tuple(names))
+
+    def to_relation(self) -> dict[str, list[str]]:
+        """Back to the reference's dict form (for oracles and live adapters)."""
+        adj = np.asarray(self.adj)
+        valid = np.asarray(self.service_valid)
+        out: dict[str, list[str]] = {}
+        for i, name in enumerate(self.names):
+            if not valid[i]:
+                continue
+            out[name] = [
+                self.names[j]
+                for j in range(len(self.names))
+                if valid[j] and adj[i, j] > 0
+            ]
+        return out
+
+
+@struct.dataclass
+class ClusterState:
+    """Padded array snapshot of a cluster.
+
+    Same information content as the reference's ``cluster_monitoring`` dict
+    (reference podmonitor.py:17-37: per-node cpu/mem capacity+usage+pct and
+    per-node pod list with per-pod usage and owning deployment), laid out as
+    flat arrays keyed by node index and pod index.
+
+    Units follow the reference: CPU in millicores, memory in bytes
+    (reference unit_convertion.py:1-32).
+
+    Attributes:
+      node_cpu_cap:  f32[N] millicores     (reference get_resource_usage.py:5-16)
+      node_mem_cap:  f32[N] bytes
+      node_base_cpu: f32[N] millicores of background usage not attributable to
+        tracked pods (system daemons; lets derived node usage match a
+        metrics-server reading).
+      node_base_mem: f32[N] bytes
+      node_valid:    bool[N]
+      node_lex_rank: i32[N] rank of the node's name in sorted order
+        (tie-break parity, see module docstring).
+      pod_node:      i32[P] node index or UNASSIGNED.
+      pod_service:   i32[P] service index into a CommGraph.
+      pod_cpu:       f32[P] millicores    (reference get_resource_usage.py:48-68)
+      pod_mem:       f32[P] bytes
+      pod_valid:     bool[P]
+      node_names / pod_names: static name tuples (host-side bookkeeping only).
+    """
+
+    node_cpu_cap: jax.Array
+    node_mem_cap: jax.Array
+    node_base_cpu: jax.Array
+    node_base_mem: jax.Array
+    node_valid: jax.Array
+    node_lex_rank: jax.Array
+    pod_node: jax.Array
+    pod_service: jax.Array
+    pod_cpu: jax.Array
+    pod_mem: jax.Array
+    pod_valid: jax.Array
+    node_names: tuple[str, ...] = struct.field(pytree_node=False, default=())
+    pod_names: tuple[str, ...] = struct.field(pytree_node=False, default=())
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.node_cpu_cap.shape[0])
+
+    @property
+    def num_pods(self) -> int:
+        return int(self.pod_node.shape[0])
+
+    # ---- derived quantities (all jit-able) ----
+
+    def pod_on_node(self) -> jax.Array:
+        """bool[P, N] — one-hot of assignment, masked by pod validity."""
+        n = self.num_nodes
+        return (
+            jax.nn.one_hot(self.pod_node, n, dtype=jnp.float32)
+            * self.pod_valid[:, None]
+        )
+
+    def node_pod_count(self) -> jax.Array:
+        """f32[N] — number of valid pods per node (len of the reference's
+        per-node pod list, reference rescheduling.py:95)."""
+        assign = jnp.where(self.pod_valid, self.pod_node, self.num_nodes)
+        counts = jnp.zeros((self.num_nodes + 1,), jnp.float32).at[assign].add(1.0)
+        return counts[: self.num_nodes]
+
+    def node_cpu_used(self) -> jax.Array:
+        """f32[N] millicores — base + sum of tracked pod CPU."""
+        assign = jnp.where(self.pod_valid, self.pod_node, self.num_nodes)
+        used = (
+            jnp.zeros((self.num_nodes + 1,), jnp.float32)
+            .at[assign]
+            .add(jnp.where(self.pod_valid, self.pod_cpu, 0.0))
+        )
+        return self.node_base_cpu + used[: self.num_nodes]
+
+    def node_mem_used(self) -> jax.Array:
+        assign = jnp.where(self.pod_valid, self.pod_node, self.num_nodes)
+        used = (
+            jnp.zeros((self.num_nodes + 1,), jnp.float32)
+            .at[assign]
+            .add(jnp.where(self.pod_valid, self.pod_mem, 0.0))
+        )
+        return self.node_base_mem + used[: self.num_nodes]
+
+    def node_cpu_pct(self) -> jax.Array:
+        """f32[N] — CPU usage percent, 0 for invalid/zero-cap nodes
+        (reference get_resource_usage.py:37)."""
+        cap = jnp.where(self.node_cpu_cap > 0, self.node_cpu_cap, 1.0)
+        pct = self.node_cpu_used() / cap * 100.0
+        return jnp.where(self.node_valid & (self.node_cpu_cap > 0), pct, 0.0)
+
+    def node_mem_pct(self) -> jax.Array:
+        cap = jnp.where(self.node_mem_cap > 0, self.node_mem_cap, 1.0)
+        pct = self.node_mem_used() / cap * 100.0
+        return jnp.where(self.node_valid & (self.node_mem_cap > 0), pct, 0.0)
+
+    def node_cpu_free(self) -> jax.Array:
+        """f32[N] millicores remaining — the CAR tie-break quantity
+        (reference rescheduling.py:206-208)."""
+        return self.node_cpu_cap - self.node_cpu_used()
+
+    def service_node_counts(self, num_services: int) -> jax.Array:
+        """f32[S, N] — occupancy matrix: pods of service s on node n.
+
+        The core data structure of the batched solver: built by scatter-add,
+        consumed by the affinity matmul ``adj @ occ``.
+        """
+        n = self.num_nodes
+        svc = jnp.where(self.pod_valid, self.pod_service, num_services)
+        node = jnp.clip(jnp.where(self.pod_valid, self.pod_node, n), -1, n)
+        occ = (
+            jnp.zeros((num_services + 1, n + 1), jnp.float32)
+            .at[svc, node]
+            .add(1.0)
+        )
+        return occ[:num_services, :n]
+
+    # ---- host-side constructors ----
+
+    @classmethod
+    def build(
+        cls,
+        *,
+        node_names: Sequence[str],
+        node_cpu_cap: Sequence[float],
+        node_mem_cap: Sequence[float],
+        pod_services: Sequence[int],
+        pod_nodes: Sequence[int],
+        pod_cpu: Sequence[float],
+        pod_mem: Sequence[float],
+        pod_names: Sequence[str] | None = None,
+        node_base_cpu: Sequence[float] | None = None,
+        node_base_mem: Sequence[float] | None = None,
+        node_capacity: int | None = None,
+        pod_capacity: int | None = None,
+    ) -> "ClusterState":
+        """Build a padded state from host lists (the adapter's entry point)."""
+        n_real = len(node_names)
+        p_real = len(pod_services)
+        n_cap = node_capacity or n_real
+        p_cap = pod_capacity or p_real
+        if n_cap < n_real or p_cap < p_real:
+            raise ValueError("capacity smaller than real counts")
+
+        def pad(x, cap, fill=0.0, dtype=np.float32):
+            a = np.full((cap,), fill, dtype=dtype)
+            a[: len(x)] = np.asarray(x, dtype=dtype)
+            return a
+
+        order = np.argsort(np.asarray(node_names, dtype=object))
+        lex_rank = np.zeros((n_cap,), dtype=np.int32)
+        lex_rank[order] = np.arange(n_real, dtype=np.int32)
+
+        node_valid = np.zeros((n_cap,), dtype=bool)
+        node_valid[:n_real] = True
+        pod_valid = np.zeros((p_cap,), dtype=bool)
+        pod_valid[:p_real] = True
+
+        return cls(
+            node_cpu_cap=jnp.asarray(pad(node_cpu_cap, n_cap)),
+            node_mem_cap=jnp.asarray(pad(node_mem_cap, n_cap)),
+            node_base_cpu=jnp.asarray(
+                pad(node_base_cpu if node_base_cpu is not None else [0.0] * n_real, n_cap)
+            ),
+            node_base_mem=jnp.asarray(
+                pad(node_base_mem if node_base_mem is not None else [0.0] * n_real, n_cap)
+            ),
+            node_valid=jnp.asarray(node_valid),
+            node_lex_rank=jnp.asarray(lex_rank),
+            pod_node=jnp.asarray(pad(pod_nodes, p_cap, fill=UNASSIGNED, dtype=np.int32)),
+            pod_service=jnp.asarray(pad(pod_services, p_cap, fill=0, dtype=np.int32)),
+            pod_cpu=jnp.asarray(pad(pod_cpu, p_cap)),
+            pod_mem=jnp.asarray(pad(pod_mem, p_cap)),
+            pod_valid=jnp.asarray(pod_valid),
+            node_names=tuple(node_names),
+            pod_names=tuple(pod_names) if pod_names is not None else tuple(f"pod{i}" for i in range(p_real)),
+        )

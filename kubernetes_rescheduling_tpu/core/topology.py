@@ -1,0 +1,216 @@
+"""Synthetic topologies and initial cluster states.
+
+Covers the five benchmark configs from BASELINE.json:
+  1. µBench workmodelC (s0–s19, 3 worker nodes) — reference-faithful,
+  2. dense 200-pod / 20-node random service mesh,
+  3. 2k-pod / 200-node power-law microservice DAG,
+  4. 10k-pod / 1k-node CPU+mem-constrained bin-packing,
+  5. Bookinfo-style trace replay (see ``bench.trace``).
+
+Also provides the **imbalance injector**: the reference creates its "Before"
+state by cordoning workers 2–3 so every pod starts on worker1
+(reference auto_full_pipeline_repeat.sh:48-51); ``inject_imbalance`` does the
+same to an array state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, mubench_workmodel_c
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """A ready-to-run benchmark scenario: state + communication graph."""
+
+    name: str
+    state: ClusterState
+    graph: CommGraph
+
+
+def state_from_workmodel(
+    wm: Workmodel,
+    *,
+    node_names: list[str] | None = None,
+    node_cpu_cap_m: float = 20_000.0,
+    node_mem_cap_b: float = 32 * 1024**3,
+    pod_cpu_m: float | None = None,
+    all_on_node: int | None = None,
+    seed: int = 0,
+    node_capacity: int | None = None,
+    pod_capacity: int | None = None,
+) -> ClusterState:
+    """Instantiate a cluster state from a workmodel.
+
+    Each service contributes ``replicas`` pods. Placement: uniform random by
+    default, or all on one node when ``all_on_node`` is given (the cordon
+    trick, reference auto_full_pipeline_repeat.sh:48-51).
+    """
+    node_names = node_names or ["worker1", "worker2", "worker3"]
+    rng = np.random.default_rng(seed)
+    services: list[int] = []
+    cpus: list[float] = []
+    mems: list[float] = []
+    pnames: list[str] = []
+    for idx, svc in enumerate(wm.services):
+        for r in range(svc.replicas):
+            services.append(idx)
+            cpus.append(float(pod_cpu_m if pod_cpu_m is not None else svc.cpu_request_millicores))
+            mems.append(float(svc.mem_request_bytes))
+            pnames.append(f"{svc.name}-{r}")
+    n_pods = len(services)
+    if all_on_node is not None:
+        nodes = [all_on_node] * n_pods
+    else:
+        nodes = rng.integers(0, len(node_names), size=n_pods).tolist()
+    return ClusterState.build(
+        node_names=node_names,
+        node_cpu_cap=[node_cpu_cap_m] * len(node_names),
+        node_mem_cap=[node_mem_cap_b] * len(node_names),
+        pod_services=services,
+        pod_nodes=nodes,
+        pod_cpu=cpus,
+        pod_mem=mems,
+        pod_names=pnames,
+        node_capacity=node_capacity,
+        pod_capacity=pod_capacity,
+    )
+
+
+def inject_imbalance(state: ClusterState, node_index: int = 0) -> ClusterState:
+    """Move every valid pod onto one node — the reference's cordon-induced
+    'Before' state (reference auto_full_pipeline_repeat.sh:48-51)."""
+    import jax.numpy as jnp
+
+    return state.replace(
+        pod_node=jnp.where(state.pod_valid, node_index, state.pod_node)
+    )
+
+
+def mubench_scenario(*, imbalanced: bool = True, seed: int = 0) -> Scenario:
+    """Config 1: the reference's own setup — 20 µBench services, 3 workers,
+    everything initially on worker1."""
+    wm = mubench_workmodel_c()
+    state = state_from_workmodel(
+        wm,
+        all_on_node=0 if imbalanced else None,
+        seed=seed,
+        # i9-10900K: 20 hyperthreads → 20000m; 32 GB RAM (reference README.md:44-46)
+        node_cpu_cap_m=20_000.0,
+        node_mem_cap_b=32 * 1024**3,
+    )
+    return Scenario(name="mubench-workmodelC", state=state, graph=wm.comm_graph())
+
+
+def _random_workmodel(
+    n_services: int,
+    rng: np.random.Generator,
+    *,
+    powerlaw: bool,
+    mean_degree: float = 4.0,
+    replicas: int = 1,
+    cpu_m: int = 100,
+) -> Workmodel:
+    from kubernetes_rescheduling_tpu.core.workmodel import ServiceSpec
+
+    if powerlaw:
+        # Barabási–Albert-style preferential attachment → power-law degree DAG.
+        m = max(1, int(round(mean_degree / 2)))
+        targets: list[list[str]] = [[] for _ in range(n_services)]
+        degree = np.ones(n_services)
+        for i in range(1, n_services):
+            k = min(i, m)
+            probs = degree[:i] / degree[:i].sum()
+            picks = rng.choice(i, size=k, replace=False, p=probs)
+            for j in picks:
+                targets[i].append(f"s{j}")
+                degree[j] += 1
+                degree[i] += 1
+    else:
+        # Dense Erdős–Rényi mesh.
+        p = min(1.0, mean_degree / max(1, n_services - 1))
+        targets = [[] for _ in range(n_services)]
+        for i in range(n_services):
+            for j in range(i):
+                if rng.random() < p:
+                    targets[i].append(f"s{j}")
+    services = tuple(
+        ServiceSpec(
+            name=f"s{i}",
+            callees=tuple(targets[i]),
+            cpu_request_millicores=cpu_m,
+            replicas=replicas,
+        )
+        for i in range(n_services)
+    )
+    return Workmodel(services=services, source="synthetic")
+
+
+def synthetic_scenario(
+    *,
+    n_pods: int,
+    n_nodes: int,
+    powerlaw: bool = False,
+    replicas: int = 1,
+    mean_degree: float = 6.0,
+    seed: int = 0,
+    imbalance_frac: float = 0.25,
+    node_cpu_cap_m: float = 20_000.0,
+) -> Scenario:
+    """Configs 2–4: synthetic service meshes at increasing scale.
+
+    ``n_pods = n_services * replicas``. Initial placement is random but
+    skewed: a fraction of pods is piled on the first node so hazard
+    detection has something to do.
+    """
+    if n_pods % replicas:
+        raise ValueError("n_pods must be divisible by replicas")
+    n_services = n_pods // replicas
+    rng = np.random.default_rng(seed)
+    wm = _random_workmodel(
+        n_services, rng, powerlaw=powerlaw, mean_degree=mean_degree, replicas=replicas
+    )
+    node_names = [f"worker{i:04d}" for i in range(n_nodes)]
+    state = state_from_workmodel(
+        wm,
+        node_names=node_names,
+        node_cpu_cap_m=node_cpu_cap_m,
+        seed=seed,
+    )
+    if imbalance_frac > 0:
+        import jax.numpy as jnp
+
+        k = int(n_pods * imbalance_frac)
+        mask = np.zeros(state.num_pods, dtype=bool)
+        mask[:k] = True
+        state = state.replace(
+            pod_node=jnp.where(jnp.asarray(mask), 0, state.pod_node)
+        )
+    kind = "powerlaw" if powerlaw else "dense"
+    return Scenario(name=f"synthetic-{kind}-{n_pods}x{n_nodes}", state=state, graph=wm.comm_graph())
+
+
+def dense_200x20(seed: int = 0) -> Scenario:
+    return synthetic_scenario(n_pods=200, n_nodes=20, powerlaw=False, mean_degree=8.0, seed=seed)
+
+
+def powerlaw_2000x200(seed: int = 0) -> Scenario:
+    return synthetic_scenario(n_pods=2000, n_nodes=200, powerlaw=True, mean_degree=4.0, seed=seed)
+
+
+def large_10000x1000(seed: int = 0) -> Scenario:
+    """Config 4: the north-star scale — 10k pods / 1k nodes with CPU+mem
+    headroom tight enough that capacity constraints bind."""
+    return synthetic_scenario(
+        n_pods=10_000,
+        n_nodes=1_000,
+        powerlaw=True,
+        mean_degree=4.0,
+        seed=seed,
+        # ~10 pods/node avg at 100m each; 2000m caps keep feasibility tight.
+        node_cpu_cap_m=2_000.0,
+    )

@@ -1,0 +1,142 @@
+"""µBench workmodel parsing.
+
+The reference consumes a µBench ``workmodelC.json`` (20 services s0–s19, each
+with an ``external_services`` list of downstream callees and a ``cpu-requests``
+quantity) but then *hardcodes* the undirected closure of its call graph in two
+places (reference main.py:31-52, communicationcost.py:69-88). Here the
+workmodel file is the single source of truth: we parse it into a
+:class:`~kubernetes_rescheduling_tpu.core.state.CommGraph` (undirected
+closure) plus per-service resource demands.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping
+
+from kubernetes_rescheduling_tpu.core.quantities import cpu_to_millicores, mem_to_bytes
+from kubernetes_rescheduling_tpu.core.state import CommGraph
+
+
+@dataclass(frozen=True)
+class ServiceSpec:
+    """One service from a workmodel: name, callees, resource requests, replicas."""
+
+    name: str
+    callees: tuple[str, ...] = ()
+    cpu_request_millicores: int = 100
+    mem_request_bytes: int = 0
+    replicas: int = 1
+
+
+@dataclass(frozen=True)
+class Workmodel:
+    """Parsed workmodel: ordered services + derived communication graph."""
+
+    services: tuple[ServiceSpec, ...]
+    source: str = "<memory>"
+    _index: dict[str, int] = field(default_factory=dict, repr=False)
+
+    def __post_init__(self) -> None:
+        object.__setattr__(
+            self, "_index", {s.name: i for i, s in enumerate(self.services)}
+        )
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(s.name for s in self.services)
+
+    def directed_relation(self) -> dict[str, list[str]]:
+        """The raw (directed) call graph: ``{caller: [callees]}``."""
+        return {s.name: list(s.callees) for s in self.services}
+
+    def relation(self) -> dict[str, list[str]]:
+        """Undirected closure of the call graph.
+
+        Matches how reference main.py:31-52 closes workmodelC.json's directed
+        edges (e.g. the JSON has s0→s1; the dict also lists s0 under s1), with
+        each neighbor list ordered by global service index — the ordering of
+        the hand-written reference dict.
+        """
+        rel: dict[str, set[str]] = {s.name: set(s.callees) for s in self.services}
+        for s in self.services:
+            for callee in s.callees:
+                rel.setdefault(callee, set()).add(s.name)
+        order = {name: i for i, name in enumerate(self.names)}
+        return {
+            name: sorted(rel.get(name, ()), key=lambda n: order.get(n, len(order)))
+            for name in self.names
+        }
+
+    def comm_graph(self, capacity: int | None = None) -> CommGraph:
+        return CommGraph.from_relation(
+            self.relation(), capacity=capacity, names=list(self.names)
+        )
+
+    @classmethod
+    def from_dict(cls, data: Mapping[str, Any], source: str = "<memory>") -> "Workmodel":
+        """Parse a µBench workmodel dict.
+
+        Grammar (observed in reference workmodelC.json): top level maps
+        service name → stanza; ``external_services`` is a list of groups,
+        each with a ``services`` list of callee names; ``cpu-requests`` /
+        ``memory-requests`` are Kubernetes quantities; ``replicas`` optional.
+        """
+        services = []
+        for name, stanza in data.items():
+            if not isinstance(stanza, Mapping):
+                continue
+            callees: list[str] = []
+            for group in stanza.get("external_services", []) or []:
+                for callee in group.get("services", []) or []:
+                    if callee != name and callee not in callees:
+                        callees.append(callee)
+            cpu = stanza.get("cpu-requests", "100m")
+            mem = stanza.get("memory-requests", "0")
+            services.append(
+                ServiceSpec(
+                    name=name,
+                    callees=tuple(callees),
+                    cpu_request_millicores=cpu_to_millicores(cpu),
+                    mem_request_bytes=mem_to_bytes(mem),
+                    replicas=int(stanza.get("replicas", 1)),
+                )
+            )
+        return cls(services=tuple(services), source=source)
+
+    @classmethod
+    def from_file(cls, path: str | Path) -> "Workmodel":
+        p = Path(path)
+        return cls.from_dict(json.loads(p.read_text()), source=str(p))
+
+
+def mubench_workmodel_c() -> Workmodel:
+    """The reference's s0–s19 topology, reconstructed from its call graph.
+
+    This is the *directed* graph whose undirected closure is the dict at
+    reference main.py:31-52 (derived from workmodelC.json
+    ``external_services``): s0→{s1,s3,s7,s16}, s1→{s2,s4,s13,s15},
+    s3→{s5,s6,s8,s9,s12}, s5→s14, s6→{s10,s17}, s7→s19, s9→s11, s15→s18.
+    Every service requests 100m CPU (workmodelC.json ``cpu-requests``).
+    """
+    edges: dict[str, tuple[str, ...]] = {
+        "s0": ("s1", "s3", "s7", "s16"),
+        "s1": ("s2", "s4", "s13", "s15"),
+        "s3": ("s5", "s6", "s8", "s9", "s12"),
+        "s5": ("s14",),
+        "s6": ("s10", "s17"),
+        "s7": ("s19",),
+        "s9": ("s11",),
+        "s15": ("s18",),
+    }
+    services = tuple(
+        ServiceSpec(
+            name=f"s{i}",
+            callees=edges.get(f"s{i}", ()),
+            cpu_request_millicores=100,
+        )
+        for i in range(20)
+    )
+    return Workmodel(services=services, source="builtin:workmodelC")

@@ -1,0 +1,17 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; all sharding tests run on a
+virtual 8-device CPU mesh. Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+flags = os.environ["XLA_FLAGS"]
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,74 @@
+"""Quantity-parsing semantics (reference unit_convertion.py:1-39)."""
+
+import pytest
+
+from kubernetes_rescheduling_tpu.core.quantities import (
+    cpu_to_millicores,
+    format_bytes_as_mi,
+    format_millicores,
+    mem_to_bytes,
+)
+
+
+class TestCpu:
+    def test_millicores_pass_through(self):
+        assert cpu_to_millicores("53m") == 53
+
+    def test_millicores_truncate(self):
+        # reference unit_convertion.py:5 uses int(float(...)) — truncation
+        assert cpu_to_millicores("53.9m") == 53
+
+    def test_nanocores(self):
+        assert cpu_to_millicores("1000000n") == 1
+        assert cpu_to_millicores("1500000n") == 2  # rounds
+
+    def test_microcores(self):
+        assert cpu_to_millicores("1500u") == 2
+
+    def test_bare_cores(self):
+        assert cpu_to_millicores("2") == 2000
+        assert cpu_to_millicores("0.5") == 500
+        assert cpu_to_millicores(4) == 4000
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            cpu_to_millicores("")
+
+
+class TestMem:
+    @pytest.mark.parametrize(
+        "q,expected",
+        [
+            ("1Ki", 1024),
+            ("536Mi", 536 * 1024**2),
+            ("2Gi", 2 * 1024**3),
+            ("1Ti", 1024**4),
+            ("1Pi", 1024**5),
+            ("1Ei", 1024**6),
+        ],
+    )
+    def test_binary_suffixes(self, q, expected):
+        assert mem_to_bytes(q) == expected
+
+    def test_bare_bytes(self):
+        assert mem_to_bytes("12345678") == 12345678
+
+    def test_decimal_suffixes(self):
+        assert mem_to_bytes("1k") == 1000
+        assert mem_to_bytes("5M") == 5_000_000
+        assert mem_to_bytes("2G") == 2_000_000_000
+
+    def test_exponent_notation(self):
+        assert mem_to_bytes("1e6") == 1_000_000
+
+    def test_fractional_binary(self):
+        assert mem_to_bytes("1.5Ki") == 1536
+
+
+class TestFormat:
+    def test_millicores(self):
+        assert format_millicores(1234) == "1234m"
+
+    def test_bytes_as_mi(self):
+        assert format_bytes_as_mi(536 * 1024**2) == "536Mi"
+        assert format_bytes_as_mi(1024**2 + 524288) == "2Mi"  # rounds

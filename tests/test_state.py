@@ -1,0 +1,110 @@
+"""ClusterState / CommGraph construction and derived quantities."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.core.state import UNASSIGNED, ClusterState, CommGraph
+
+
+def small_state(**kw):
+    return ClusterState.build(
+        node_names=["worker1", "worker2", "worker3"],
+        node_cpu_cap=[4000.0, 4000.0, 4000.0],
+        node_mem_cap=[8e9, 8e9, 8e9],
+        pod_services=[0, 1, 2, 0],
+        pod_nodes=[0, 0, 1, 2],
+        pod_cpu=[100.0, 200.0, 300.0, 50.0],
+        pod_mem=[1e6, 2e6, 3e6, 5e5],
+        **kw,
+    )
+
+
+class TestBuild:
+    def test_shapes_and_masks(self):
+        s = small_state(node_capacity=5, pod_capacity=8)
+        assert s.num_nodes == 5 and s.num_pods == 8
+        assert np.asarray(s.node_valid).sum() == 3
+        assert np.asarray(s.pod_valid).sum() == 4
+        # padding pods are unassigned
+        assert np.all(np.asarray(s.pod_node)[4:] == UNASSIGNED)
+
+    def test_capacity_too_small_raises(self):
+        with pytest.raises(ValueError):
+            small_state(node_capacity=2)
+
+    def test_lex_rank(self):
+        s = ClusterState.build(
+            node_names=["worker2", "worker10", "worker1"],
+            node_cpu_cap=[1.0, 1.0, 1.0],
+            node_mem_cap=[1.0, 1.0, 1.0],
+            pod_services=[],
+            pod_nodes=[],
+            pod_cpu=[],
+            pod_mem=[],
+        )
+        # lexicographic: worker1 < worker10 < worker2
+        assert np.asarray(s.node_lex_rank).tolist() == [2, 1, 0]
+
+
+class TestDerived:
+    def test_pod_count(self):
+        s = small_state(node_capacity=4, pod_capacity=6)
+        assert np.asarray(s.node_pod_count()).tolist() == [2.0, 1.0, 1.0, 0.0]
+
+    def test_cpu_used_and_pct(self):
+        s = small_state()
+        assert np.asarray(s.node_cpu_used()).tolist() == [300.0, 300.0, 50.0]
+        np.testing.assert_allclose(
+            np.asarray(s.node_cpu_pct()), [7.5, 7.5, 1.25]
+        )
+
+    def test_base_usage_added(self):
+        s = small_state().replace(node_base_cpu=jnp.asarray([1000.0, 0.0, 0.0]))
+        assert float(s.node_cpu_used()[0]) == 1300.0
+
+    def test_unassigned_pod_not_counted(self):
+        s = small_state()
+        s = s.replace(pod_node=s.pod_node.at[0].set(UNASSIGNED))
+        assert np.asarray(s.node_cpu_used()).tolist() == [200.0, 300.0, 50.0]
+
+    def test_invalid_pod_not_counted(self):
+        s = small_state(pod_capacity=6)
+        counts = s.node_pod_count()
+        assert float(counts.sum()) == 4.0
+
+    def test_service_node_counts(self):
+        s = small_state()
+        occ = np.asarray(s.service_node_counts(3))
+        assert occ.shape == (3, 3)
+        assert occ[0].tolist() == [1.0, 0.0, 1.0]  # service 0 on nodes 0 and 2
+        assert occ[1].tolist() == [1.0, 0.0, 0.0]
+        assert occ[2].tolist() == [0.0, 1.0, 0.0]
+
+    def test_mem_used(self):
+        s = small_state()
+        assert np.asarray(s.node_mem_used()).tolist() == [3e6, 3e6, 5e5]
+
+    def test_cpu_free(self):
+        s = small_state()
+        assert np.asarray(s.node_cpu_free()).tolist() == [3700.0, 3700.0, 3950.0]
+
+
+class TestCommGraph:
+    def test_from_relation_symmetrizes(self):
+        g = CommGraph.from_relation({"a": ["b"], "b": [], "c": ["a"]})
+        adj = np.asarray(g.adj)
+        assert adj[0, 1] == adj[1, 0] == 1.0
+        assert adj[0, 2] == adj[2, 0] == 1.0
+        assert adj[1, 2] == 0.0
+        assert np.all(np.diag(adj) == 0)
+
+    def test_padding(self):
+        g = CommGraph.from_relation({"a": ["b"], "b": []}, capacity=5)
+        assert g.adj.shape == (5, 5)
+        assert np.asarray(g.service_valid).tolist() == [True, True, False, False, False]
+
+    def test_roundtrip_to_relation(self):
+        rel = {"a": ["b", "c"], "b": ["a"], "c": ["a"]}
+        g = CommGraph.from_relation(rel)
+        assert g.to_relation() == rel

@@ -1,0 +1,115 @@
+"""Workmodel parsing and the builtin µBench s0–s19 topology."""
+
+import json
+
+import numpy as np
+
+from kubernetes_rescheduling_tpu.core.topology import (
+    dense_200x20,
+    inject_imbalance,
+    mubench_scenario,
+    synthetic_scenario,
+)
+from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, mubench_workmodel_c
+
+# The undirected closure the reference hardcodes (reference main.py:31-52).
+REFERENCE_RELATION = {
+    "s0": ["s1", "s3", "s7", "s16"],
+    "s1": ["s0", "s2", "s4", "s13", "s15"],
+    "s2": ["s1"],
+    "s3": ["s0", "s5", "s6", "s8", "s9", "s12"],
+    "s4": ["s1"],
+    "s5": ["s3", "s14"],
+    "s6": ["s3", "s10", "s17"],
+    "s7": ["s0", "s19"],
+    "s8": ["s3"],
+    "s9": ["s3", "s11"],
+    "s10": ["s6"],
+    "s11": ["s9"],
+    "s12": ["s3"],
+    "s13": ["s1"],
+    "s14": ["s5"],
+    "s15": ["s1", "s18"],
+    "s16": ["s0"],
+    "s17": ["s6"],
+    "s18": ["s15"],
+    "s19": ["s7"],
+}
+
+
+class TestMubenchWorkmodel:
+    def test_relation_matches_reference_dict(self):
+        wm = mubench_workmodel_c()
+        assert wm.relation() == REFERENCE_RELATION
+
+    def test_graph_symmetric(self):
+        g = mubench_workmodel_c().comm_graph()
+        adj = np.asarray(g.adj)
+        assert np.array_equal(adj, adj.T)
+        # 19 undirected edges in workmodelC (tree plus none extra)
+        assert adj.sum() / 2 == 19
+
+    def test_cpu_requests(self):
+        wm = mubench_workmodel_c()
+        assert all(s.cpu_request_millicores == 100 for s in wm.services)
+
+
+class TestFromDict:
+    def test_parse_mubench_grammar(self, tmp_path):
+        data = {
+            "s0": {
+                "external_services": [{"seq_len": 1, "services": ["s1", "s2"]}],
+                "cpu-requests": "250m",
+                "replicas": 2,
+            },
+            "s1": {"external_services": [], "cpu-requests": "100m"},
+            "s2": {"external_services": [{"services": ["s1"]}]},
+        }
+        p = tmp_path / "wm.json"
+        p.write_text(json.dumps(data))
+        wm = Workmodel.from_file(p)
+        assert wm.names == ("s0", "s1", "s2")
+        assert wm.services[0].cpu_request_millicores == 250
+        assert wm.services[0].replicas == 2
+        assert wm.relation() == {
+            "s0": ["s1", "s2"],
+            "s1": ["s0", "s2"],
+            "s2": ["s0", "s1"],
+        }
+
+    def test_self_edge_dropped(self):
+        wm = Workmodel.from_dict(
+            {"s0": {"external_services": [{"services": ["s0", "s1"]}]}, "s1": {}}
+        )
+        assert wm.services[0].callees == ("s1",)
+
+
+class TestScenarios:
+    def test_mubench_imbalanced(self):
+        sc = mubench_scenario()
+        pod_node = np.asarray(sc.state.pod_node)
+        valid = np.asarray(sc.state.pod_valid)
+        assert np.all(pod_node[valid] == 0)
+        assert sc.state.num_pods == 20
+
+    def test_inject_imbalance(self):
+        sc = mubench_scenario(imbalanced=False, seed=1)
+        s2 = inject_imbalance(sc.state, node_index=2)
+        assert np.all(np.asarray(s2.pod_node)[np.asarray(s2.pod_valid)] == 2)
+
+    def test_dense_200x20(self):
+        sc = dense_200x20()
+        assert sc.state.num_pods == 200
+        assert sc.state.num_nodes == 20
+        assert sc.graph.adj.shape[0] == 200
+
+    def test_synthetic_deterministic(self):
+        a = synthetic_scenario(n_pods=50, n_nodes=5, seed=7)
+        b = synthetic_scenario(n_pods=50, n_nodes=5, seed=7)
+        assert np.array_equal(np.asarray(a.state.pod_node), np.asarray(b.state.pod_node))
+        assert np.array_equal(np.asarray(a.graph.adj), np.asarray(b.graph.adj))
+
+    def test_powerlaw_has_hubs(self):
+        sc = synthetic_scenario(n_pods=500, n_nodes=20, powerlaw=True, seed=3)
+        deg = np.asarray(sc.graph.adj).sum(axis=0)
+        assert deg.max() >= 4 * np.median(deg[deg > 0])

@@ -1,0 +1,3 @@
+from kubernetes_rescheduling_tpu.cli import main
+
+raise SystemExit(main())

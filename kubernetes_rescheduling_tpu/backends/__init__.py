@@ -1,0 +1,23 @@
+"""Cluster backends.
+
+- ``SimBackend`` — hermetic in-memory cluster with µBench-like load dynamics
+  and fault injection; what the reference validates only on live hardware
+  (SURVEY.md §4) runs here deterministically.
+- ``K8sBackend`` — thin host-side adapter with the reference's reconcile
+  semantics (foreground delete + wait-404, anti-affinity patch, pinned
+  re-create). Never traced; works against any object implementing the small
+  client protocol (the real ``kubernetes`` package or a fake).
+"""
+
+from kubernetes_rescheduling_tpu.backends.base import Backend, MoveRequest
+from kubernetes_rescheduling_tpu.backends.sim import LoadModel, SimBackend
+from kubernetes_rescheduling_tpu.backends.k8s import K8sBackend, PlacementMechanism
+
+__all__ = [
+    "Backend",
+    "MoveRequest",
+    "LoadModel",
+    "SimBackend",
+    "K8sBackend",
+    "PlacementMechanism",
+]

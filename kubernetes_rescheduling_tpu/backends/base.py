@@ -1,0 +1,47 @@
+"""Backend protocol: the host-side boundary of the framework.
+
+Everything above this line is pure JAX; everything below talks to a cluster
+(real or simulated). The protocol mirrors the reference's control-loop
+surface: snapshot (podmonitor.py:7-125), deployment teardown
+(delete_replaced_pod.py:144-185), and pinned re-creation
+(rescheduling.py:57-73).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+
+
+@dataclass(frozen=True)
+class MoveRequest:
+    """Move one service's Deployment to a target node."""
+
+    service: str
+    target_node: str
+    hazard_nodes: tuple[str, ...] = ()
+    mechanism: str = "nodeName"  # nodeName | nodeSelector | affinityOnly
+
+
+class Backend(Protocol):
+    """What a cluster must provide to the controller."""
+
+    def monitor(self) -> ClusterState:
+        """Fresh padded snapshot of the cluster."""
+        ...
+
+    def comm_graph(self) -> CommGraph:
+        """The service communication graph."""
+        ...
+
+    def apply_move(self, move: MoveRequest) -> bool:
+        """Tear down the service's Deployment and re-create it pinned/steered
+        to the target node. Returns False if the move failed (the round is
+        then treated as a skip, reference main.py:103-107)."""
+        ...
+
+    def advance(self, seconds: float) -> None:
+        """Let time pass (pacing between rounds, reference main.py:27,100)."""
+        ...

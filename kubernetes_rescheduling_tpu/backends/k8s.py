@@ -1,0 +1,392 @@
+"""Live-Kubernetes adapter — the thin host-side shell around the TPU core.
+
+Clean-room implementation of the reference's cluster I/O semantics
+(SURVEY.md §5.3, §2):
+
+- snapshot: node list (control-plane excluded), node capacity + usage from
+  ``metrics.k8s.io/v1beta1``, per-pod usage with containers summed, and the
+  Pod→ReplicaSet→Deployment owner-chain walk
+  (reference podmonitor.py:7-125, get_resource_usage.py:5-68,
+  delete_replaced_pod.py:25-38);
+- teardown: foreground cascade delete then poll for the 404 up to 180 s at
+  1.5 s (reference delete_replaced_pod.py:8-22, 173-177);
+- re-create: a minimal re-deployable spec (kept container keys, forced
+  ``imagePullPolicy: IfNotPresent``, ``schedulerName: default-scheduler`` —
+  reference delete_replaced_pod.py:64-142), patched with a NodeAffinity
+  ``NotIn <hazard nodes>`` rule (reference rescheduling.py:42-55) and pinned
+  per the policy's mechanism: ``nodeSelector`` for spread/binpack
+  (rescheduling.py:103,135), ``nodeName`` for random/CAR
+  (rescheduling.py:155,216), affinity-only for kubescheduling
+  (rescheduling.py:167-171).
+
+The adapter never imports jax and is never traced. It works against any
+object exposing the small slice of the Kubernetes client API it touches, so
+tests run with fakes and production runs with the real ``kubernetes``
+package (constructed lazily — the package is optional).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+from kubernetes_rescheduling_tpu.core.quantities import cpu_to_millicores, mem_to_bytes
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph, UNASSIGNED
+from kubernetes_rescheduling_tpu.core.workmodel import Workmodel
+
+# policy name -> how the reference pins the re-created Deployment
+PlacementMechanism: dict[str, str] = {
+    "spread": "nodeSelector",
+    "binpack": "nodeSelector",
+    "random": "nodeName",
+    "communication": "nodeName",
+    "kubescheduling": "affinityOnly",
+    "global": "nodeName",
+}
+
+
+def _get(obj: Any, *names: str, default=None):
+    """Attribute-or-key access tolerant of client models and plain dicts."""
+    for name in names:
+        if obj is None:
+            return default
+        if isinstance(obj, dict):
+            if name in obj:
+                obj = obj[name]
+                continue
+            return default
+        if hasattr(obj, name):
+            obj = getattr(obj, name)
+            continue
+        return default
+    return obj if obj is not None else default
+
+
+def exclude_hazard_affinity(hazard_nodes: list[str]) -> dict:
+    """NodeAffinity NotIn rule (reference rescheduling.py:42-55)."""
+    return {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {
+                        "matchExpressions": [
+                            {
+                                "key": "kubernetes.io/hostname",
+                                "operator": "NotIn",
+                                "values": list(hazard_nodes),
+                            }
+                        ]
+                    }
+                ]
+            }
+        }
+    }
+
+
+def merge_affinity(orig: dict | None, patch: dict) -> dict:
+    """Deep-merge an affinity patch, extending lists at the leaf level
+    (semantics of reference rescheduling.py:21-40)."""
+    import copy
+
+    out = copy.deepcopy(orig) if orig else {}
+    for k, v in patch.items():
+        if k not in out or not isinstance(out.get(k), dict) or not isinstance(v, dict):
+            out[k] = v
+            continue
+        for kk, vv in v.items():
+            if kk not in out[k]:
+                out[k][kk] = vv
+            elif isinstance(vv, dict) and isinstance(out[k][kk], dict):
+                for kkk, vvv in vv.items():
+                    if isinstance(vvv, list) and isinstance(out[k][kk].get(kkk), list):
+                        out[k][kk][kkk] = out[k][kk][kkk] + list(vvv)
+                    else:
+                        out[k][kk][kkk] = vvv
+            else:
+                out[k][kk] = vv
+    return out
+
+
+_KEPT_CONTAINER_KEYS = (
+    "name",
+    "image",
+    "imagePullPolicy",
+    "ports",
+    "env",
+    "resources",
+    "volumeMounts",
+)
+
+
+def extract_redeployable_spec(dep: dict) -> dict:
+    """Minimal dict body that re-creates a Deployment (reference
+    delete_replaced_pod.py:64-142). Input must be dict-shaped (the real
+    client's ``sanitize_for_serialization`` output)."""
+    meta = dep.get("metadata", {}) or {}
+    spec = dep.get("spec", {}) or {}
+    tmpl = spec.get("template", {}) or {}
+    tmpl_meta = tmpl.get("metadata", {}) or {}
+    tmpl_spec = tmpl.get("spec", {}) or {}
+    containers = []
+    for c in tmpl_spec.get("containers", []) or []:
+        kept = {k: v for k, v in c.items() if k in _KEPT_CONTAINER_KEYS}
+        kept["imagePullPolicy"] = "IfNotPresent"
+        containers.append(kept)
+    return {
+        "apiVersion": dep.get("apiVersion", "apps/v1"),
+        "kind": dep.get("kind", "Deployment"),
+        "metadata": {
+            "name": meta.get("name"),
+            "namespace": meta.get("namespace", "default"),
+            "labels": dict(meta.get("labels") or {}),
+        },
+        "spec": {
+            "replicas": spec.get("replicas", 1),
+            "selector": spec.get("selector"),
+            "strategy": spec.get("strategy"),
+            "template": {
+                "metadata": {
+                    "labels": dict(tmpl_meta.get("labels") or {}),
+                    "annotations": dict(tmpl_meta.get("annotations") or {}),
+                },
+                "spec": {
+                    "containers": containers,
+                    "volumes": tmpl_spec.get("volumes") or None,
+                    "restartPolicy": "Always",
+                    "terminationGracePeriodSeconds": tmpl_spec.get(
+                        "terminationGracePeriodSeconds"
+                    ),
+                    "dnsPolicy": "ClusterFirst",
+                    "nodeSelector": tmpl_spec.get("nodeSelector") or None,
+                    "affinity": tmpl_spec.get("affinity"),
+                    "schedulerName": "default-scheduler",
+                },
+            },
+        },
+    }
+
+
+@dataclass
+class K8sBackend:
+    """Adapter over a live cluster (or a fake implementing the same calls)."""
+
+    workmodel: Workmodel
+    core_api: Any = None
+    apps_api: Any = None
+    custom_api: Any = None
+    namespace: str = "default"
+    control_plane_names: tuple[str, ...] = ("master",)  # reference podmonitor.py:45
+    delete_timeout_s: float = 180.0
+    delete_poll_interval_s: float = 1.5
+    node_capacity: int | None = None
+    pod_capacity: int | None = None
+    sleeper: Callable[[float], None] = field(default=time.sleep)
+
+    def __post_init__(self) -> None:
+        if self.core_api is None or self.apps_api is None or self.custom_api is None:
+            # lazy: only needed for a real cluster
+            from kubernetes import client, config  # type: ignore
+
+            config.load_kube_config()
+            self.core_api = self.core_api or client.CoreV1Api()
+            self.apps_api = self.apps_api or client.AppsV1Api()
+            self.custom_api = self.custom_api or client.CustomObjectsApi()
+        self._graph = self.workmodel.comm_graph()
+        self._svc_index = {n: i for i, n in enumerate(self.workmodel.names)}
+
+    def comm_graph(self) -> CommGraph:
+        return self._graph
+
+    # ---- snapshot ----
+
+    def _deployment_for_pod(self, pod: Any) -> str | None:
+        """Pod→ReplicaSet→Deployment owner walk (reference
+        delete_replaced_pod.py:25-38)."""
+        owners = _get(pod, "metadata", "owner_references") or _get(
+            pod, "metadata", "ownerReferences", default=[]
+        ) or []
+        for o in owners:
+            kind = _get(o, "kind")
+            if kind == "Deployment":
+                return _get(o, "name")
+            if kind == "ReplicaSet":
+                rs = self.apps_api.read_namespaced_replica_set(
+                    _get(o, "name"), self.namespace
+                )
+                for ro in (
+                    _get(rs, "metadata", "owner_references")
+                    or _get(rs, "metadata", "ownerReferences", default=[])
+                    or []
+                ):
+                    if _get(ro, "kind") == "Deployment":
+                        return _get(ro, "name")
+        return None
+
+    def monitor(self) -> ClusterState:
+        """Build the padded snapshot (reference podmonitor.py:7-125)."""
+        nodes = self.core_api.list_node(watch=False)
+        node_names = [
+            _get(n, "metadata", "name")
+            for n in _get(nodes, "items", default=[])
+            if _get(n, "metadata", "name") not in self.control_plane_names
+        ]
+        cap_cpu: dict[str, float] = {}
+        cap_mem: dict[str, float] = {}
+        for n in _get(nodes, "items", default=[]):
+            name = _get(n, "metadata", "name")
+            capacity = _get(n, "status", "capacity", default={}) or {}
+            cap_cpu[name] = float(cpu_to_millicores(str(capacity.get("cpu", "0"))))
+            cap_mem[name] = float(mem_to_bytes(str(capacity.get("memory", "0"))))
+
+        # node usage (metrics-server) — used to derive per-node base load
+        node_used: dict[str, float] = {}
+        node_used_mem: dict[str, float] = {}
+        try:
+            res = self.custom_api.list_cluster_custom_object(
+                "metrics.k8s.io", "v1beta1", "nodes"
+            )
+            for item in res.get("items", []):
+                name = item["metadata"]["name"]
+                node_used[name] = float(cpu_to_millicores(item["usage"]["cpu"]))
+                node_used_mem[name] = float(mem_to_bytes(item["usage"]["memory"]))
+        except Exception:
+            pass  # metrics-server absent → usage stays 0 (reference podmonitor.py:86-87)
+
+        # pod usage, containers summed (reference get_resource_usage.py:48-68)
+        pod_usage: dict[str, tuple[float, float]] = {}
+        try:
+            res = self.custom_api.list_namespaced_custom_object(
+                "metrics.k8s.io", "v1beta1", self.namespace, "pods"
+            )
+            for item in res.get("items", []):
+                cpu = sum(
+                    cpu_to_millicores(c["usage"]["cpu"])
+                    for c in item.get("containers", [])
+                )
+                mem = sum(
+                    mem_to_bytes(c["usage"]["memory"])
+                    for c in item.get("containers", [])
+                )
+                pod_usage[item["metadata"]["name"]] = (float(cpu), float(mem))
+        except Exception:
+            pass
+
+        pods = self.core_api.list_pod_for_all_namespaces(watch=False)
+        services, pod_nodes, pod_cpu, pod_mem, pod_names = [], [], [], [], []
+        tracked_cpu = {n: 0.0 for n in node_names}
+        tracked_mem = {n: 0.0 for n in node_names}
+        for p in _get(pods, "items", default=[]):
+            if _get(p, "metadata", "namespace") != self.namespace:
+                continue
+            dep = self._deployment_for_pod(p)
+            if dep is None or dep not in self._svc_index:
+                continue
+            name = _get(p, "metadata", "name")
+            node = _get(p, "spec", "node_name") or _get(p, "spec", "nodeName")
+            cpu, mem = pod_usage.get(name, (0.0, 0.0))
+            services.append(self._svc_index[dep])
+            pod_nodes.append(node_names.index(node) if node in node_names else UNASSIGNED)
+            pod_cpu.append(cpu)
+            pod_mem.append(mem)
+            pod_names.append(name)
+            if node in tracked_cpu:
+                tracked_cpu[node] += cpu
+                tracked_mem[node] += mem
+
+        # base = measured node usage minus tracked pod usage (system daemons)
+        base_cpu = [
+            max(node_used.get(n, 0.0) - tracked_cpu[n], 0.0) for n in node_names
+        ]
+        base_mem = [
+            max(node_used_mem.get(n, 0.0) - tracked_mem[n], 0.0) for n in node_names
+        ]
+        return ClusterState.build(
+            node_names=node_names,
+            node_cpu_cap=[cap_cpu.get(n, 0.0) for n in node_names],
+            node_mem_cap=[cap_mem.get(n, 0.0) for n in node_names],
+            pod_services=services,
+            pod_nodes=pod_nodes,
+            pod_cpu=pod_cpu,
+            pod_mem=pod_mem,
+            pod_names=pod_names,
+            node_base_cpu=base_cpu,
+            node_base_mem=base_mem,
+            node_capacity=self.node_capacity,
+            pod_capacity=self.pod_capacity,
+        )
+
+    # ---- reconcile ----
+
+    def _wait_deleted(self, name: str) -> bool:
+        """Poll for the 404 (reference delete_replaced_pod.py:8-22).
+
+        Transient non-404 errors are retried until the deadline instead of
+        raised: at this point the Deployment has already been foreground-
+        deleted, and crashing the controller here would lose the workload —
+        the exact reference flaw the round loop is built to avoid.
+        """
+        deadline = time.monotonic() + self.delete_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                self.apps_api.read_namespaced_deployment(
+                    name=name, namespace=self.namespace
+                )
+            except Exception as e:
+                if getattr(e, "status", None) == 404:
+                    return True
+                # transient API failure: keep polling
+            self.sleeper(self.delete_poll_interval_s)
+        return False
+
+    def apply_move(self, move: MoveRequest) -> bool:
+        """Foreground delete + pinned re-create (reference
+        delete_replaced_pod.py:144-185 + rescheduling.py:57-73)."""
+        name = move.service
+        try:
+            dep = self.apps_api.read_namespaced_deployment(
+                name=name, namespace=self.namespace
+            )
+        except Exception:
+            return False
+        if not isinstance(dep, dict):
+            # real client model → plain dict
+            from kubernetes.client import ApiClient  # type: ignore
+
+            dep = ApiClient().sanitize_for_serialization(dep)
+        body = extract_redeployable_spec(dep)
+
+        tmpl_spec = body["spec"]["template"]["spec"]
+        if move.hazard_nodes:
+            tmpl_spec["affinity"] = merge_affinity(
+                tmpl_spec.get("affinity"), exclude_hazard_affinity(list(move.hazard_nodes))
+            )
+        if move.mechanism == "nodeSelector":
+            tmpl_spec["nodeSelector"] = {"kubernetes.io/hostname": move.target_node}
+        elif move.mechanism == "nodeName":
+            tmpl_spec["nodeName"] = move.target_node
+        elif move.mechanism != "affinityOnly":
+            raise ValueError(f"unknown mechanism {move.mechanism!r}")
+
+        try:
+            self.apps_api.delete_namespaced_deployment(
+                name=name,
+                namespace=self.namespace,
+                body={"propagationPolicy": "Foreground"},
+            )
+        except Exception as e:
+            if getattr(e, "status", None) != 404:  # already gone = fine
+                return False  # transient failure: skip the round, keep the loop alive
+        if not self._wait_deleted(name):
+            return False  # timeout → skip round (reference delete_replaced_pod.py:178-180)
+        try:
+            self.apps_api.create_namespaced_deployment(
+                namespace=self.namespace, body=body
+            )
+            return True
+        except Exception:
+            return False
+
+    def advance(self, seconds: float) -> None:
+        self.sleeper(seconds)

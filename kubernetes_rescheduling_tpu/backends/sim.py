@@ -1,0 +1,244 @@
+"""Hermetic cluster simulator.
+
+The reference has **no** offline backend — every experiment needs a live
+4-node cluster plus the µBench deployer and ~1000 curl clients
+(SURVEY.md §4). This simulator reproduces that environment's dynamics so the
+whole experiment matrix runs deterministically in-process:
+
+- **Load model**: requests enter at an entry service (µBench's ``s0`` behind
+  the NodePort, reference release1.sh:7) and fan out along the *directed*
+  call graph — every request to a service triggers one request to each of
+  its callees (µBench ``external_services`` semantics, workmodelC.json).
+  Per-pod CPU = idle + (service rps / replicas) · per-request cost, plus
+  optional noise — so hazard detection sees realistic, load-dependent usage.
+- **Fault injection**: the cordon-induced imbalance the reference uses as its
+  "Before" state (auto_full_pipeline_repeat.sh:48-51) plus node kill, CPU
+  spike, and pod churn — the failure-detection surface of SURVEY.md §5.3.
+- **Reconcile model**: deployment teardown takes simulated time (the
+  reference polls up to 180 s for the 404, delete_replaced_pod.py:8-22);
+  ``advance`` moves the simulated clock, never the wall clock.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph, UNASSIGNED
+from kubernetes_rescheduling_tpu.core.workmodel import Workmodel
+
+
+@dataclass
+class LoadModel:
+    """Deterministic µBench-like load propagation."""
+
+    entry_service: str = "s0"
+    entry_rps: float = 100.0          # ~1000 concurrent curl clients (release1.sh:9)
+    cost_per_req_m: float = 2.0       # millicores per request/s (cpu_stress, workmodelC.json)
+    idle_m: float = 20.0              # baseline per-pod usage
+    noise_frac: float = 0.0           # gaussian noise on per-pod usage
+
+    def service_rps(self, wm: Workmodel) -> dict[str, float]:
+        """Propagate entry rps through the directed call graph: each request
+        to a service triggers one request to each of its callees.
+
+        Processed in topological (Kahn) order so every upstream contribution
+        has accumulated before a service's outgoing edges fire — a BFS with
+        visit-once edges understates load on any multi-parent call graph.
+        Edges that close a cycle are dropped (visit-once on the *node* at
+        pop time), bounding flow in cyclic meshes.
+        """
+        rps = {name: 0.0 for name in wm.names}
+        if self.entry_service not in rps:
+            return rps
+        rps[self.entry_service] = self.entry_rps
+        callees = wm.directed_relation()
+        indeg = {name: 0 for name in wm.names}
+        for src, dsts in callees.items():
+            for d in dsts:
+                if d in indeg:
+                    indeg[d] += 1
+        ready = [n for n in wm.names if indeg[n] == 0]
+        done: set[str] = set()
+        while ready:
+            svc = ready.pop()
+            if svc in done:
+                continue
+            done.add(svc)
+            for callee in callees.get(svc, []):
+                if callee not in indeg or callee in done:
+                    continue  # cycle-closing edge: drop
+                rps[callee] += rps[svc]
+                indeg[callee] -= 1
+                if indeg[callee] == 0:
+                    ready.append(callee)
+        # cyclic remainder (indeg never hit 0): process in name order once
+        for svc in wm.names:
+            if svc in done:
+                continue
+            done.add(svc)
+            for callee in callees.get(svc, []):
+                if callee in indeg and callee not in done:
+                    rps[callee] += rps[svc]
+        return rps
+
+
+@dataclass
+class SimBackend:
+    """In-memory cluster with dynamics. All mutation host-side numpy; the
+    ``monitor`` snapshot is a fresh padded ``ClusterState``."""
+
+    workmodel: Workmodel
+    node_names: list[str]
+    node_cpu_cap_m: float = 20_000.0
+    node_mem_cap_b: float = 32 * 1024**3
+    load: LoadModel = field(default_factory=LoadModel)
+    seed: int = 0
+    node_capacity: int | None = None
+    pod_capacity: int | None = None
+    reconcile_delay_s: float = 3.0     # simulated teardown+recreate latency
+    pacing_s: float = 15.0             # reference main.py:27
+
+    def __post_init__(self) -> None:
+        self._rng = np.random.default_rng(self.seed)
+        self._graph = self.workmodel.comm_graph()
+        self._svc_index = {n: i for i, n in enumerate(self.workmodel.names)}
+        self.clock_s = 0.0
+        self.events: list[dict] = []
+        n = len(self.node_names)
+        self._node_alive = np.ones(n, dtype=bool)
+        self._cpu_spike: dict[str, float] = {}
+        # pod table: (service_idx, node_idx, name); deployment = service
+        self._pods: list[list] = []
+        for idx, svc in enumerate(self.workmodel.services):
+            for r in range(svc.replicas):
+                node = int(self._rng.integers(0, n))
+                self._pods.append([idx, node, f"{svc.name}-{r}"])
+
+    # ---- Backend protocol ----
+
+    def comm_graph(self) -> CommGraph:
+        return self._graph
+
+    def monitor(self) -> ClusterState:
+        """Snapshot with load-model CPU usage (reference podmonitor.monitor)."""
+        rps = self.load.service_rps(self.workmodel)
+        replicas = {s.name: max(1, s.replicas) for s in self.workmodel.services}
+        services, nodes, cpus, mems, names = [], [], [], [], []
+        for svc_idx, node, name in self._pods:
+            spec = self.workmodel.services[svc_idx]
+            per_pod = (
+                self.load.idle_m
+                + rps.get(spec.name, 0.0) / replicas[spec.name] * self.load.cost_per_req_m
+            )
+            per_pod *= self._cpu_spike.get(spec.name, 1.0)
+            if self.load.noise_frac > 0:
+                per_pod *= 1.0 + self._rng.normal(0.0, self.load.noise_frac)
+            services.append(svc_idx)
+            nodes.append(node if (node >= 0 and self._node_alive[node]) else UNASSIGNED)
+            cpus.append(max(per_pod, 0.0))
+            mems.append(float(spec.mem_request_bytes))
+            names.append(name)
+        return ClusterState.build(
+            node_names=self.node_names,
+            node_cpu_cap=[
+                self.node_cpu_cap_m if a else 0.0 for a in self._node_alive
+            ],
+            node_mem_cap=[self.node_mem_cap_b] * len(self.node_names),
+            node_alive=self._node_alive.tolist(),
+            pod_services=services,
+            pod_nodes=nodes,
+            pod_cpu=cpus,
+            pod_mem=mems,
+            pod_names=names,
+            node_capacity=self.node_capacity,
+            pod_capacity=self.pod_capacity,
+        )
+
+    def apply_move(self, move: MoveRequest) -> bool:
+        """Foreground delete + pinned re-create of one service's Deployment
+        (reference delete_replaced_pod.py:173-177 + rescheduling.py:57-73)."""
+        if move.service not in self._svc_index:
+            return False
+        if move.target_node not in self.node_names:
+            return False
+        target = self.node_names.index(move.target_node)
+        if not self._node_alive[target]:
+            return False
+        svc_idx = self._svc_index[move.service]
+        moved = 0
+        for pod in self._pods:
+            if pod[0] == svc_idx:
+                pod[1] = target
+                moved += 1
+        self.clock_s += self.reconcile_delay_s
+        self.events.append(
+            {
+                "t": self.clock_s,
+                "event": "move",
+                "service": move.service,
+                "target": move.target_node,
+                "pods": moved,
+                "mechanism": move.mechanism,
+            }
+        )
+        return moved > 0
+
+    def advance(self, seconds: float) -> None:
+        self.clock_s += seconds
+
+    # ---- fault injection (SURVEY.md §5.3) ----
+
+    def inject_imbalance(self, node: str) -> None:
+        """The cordon trick: pile every pod onto one node
+        (reference auto_full_pipeline_repeat.sh:48-51)."""
+        idx = self.node_names.index(node)
+        for pod in self._pods:
+            pod[1] = idx
+        self.events.append({"t": self.clock_s, "event": "imbalance", "node": node})
+
+    def kill_node(self, node: str) -> None:
+        """Node failure: capacity gone, its pods evicted to pending."""
+        idx = self.node_names.index(node)
+        self._node_alive[idx] = False
+        for pod in self._pods:
+            if pod[1] == idx:
+                pod[1] = UNASSIGNED
+        self.events.append({"t": self.clock_s, "event": "node_kill", "node": node})
+
+    def revive_node(self, node: str) -> None:
+        self._node_alive[self.node_names.index(node)] = True
+        self.events.append({"t": self.clock_s, "event": "node_revive", "node": node})
+
+    def cpu_spike(self, service: str, factor: float) -> None:
+        """Multiply one service's CPU usage (hot-spot injection)."""
+        self._cpu_spike[service] = factor
+        self.events.append(
+            {"t": self.clock_s, "event": "cpu_spike", "service": service, "factor": factor}
+        )
+
+    def churn(self, n_restarts: int) -> None:
+        """Random pod restarts onto random nodes (background churn)."""
+        alive = np.flatnonzero(self._node_alive)
+        for _ in range(n_restarts):
+            pod = self._pods[int(self._rng.integers(len(self._pods)))]
+            pod[1] = int(self._rng.choice(alive))
+        self.events.append({"t": self.clock_s, "event": "churn", "n": n_restarts})
+
+    def schedule_pending(self) -> int:
+        """Place UNASSIGNED pods on the least-loaded alive node (what
+        kube-scheduler would do for evicted pods)."""
+        counts = np.zeros(len(self.node_names))
+        for pod in self._pods:
+            if pod[1] >= 0:
+                counts[pod[1]] += 1
+        counts[~self._node_alive] = np.inf
+        placed = 0
+        for pod in self._pods:
+            if pod[1] == UNASSIGNED:
+                pod[1] = int(np.argmin(counts))
+                counts[pod[1]] += 1
+                placed += 1
+        return placed

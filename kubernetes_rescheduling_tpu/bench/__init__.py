@@ -1,0 +1,22 @@
+"""Experiment harness — the reference's Bash pipeline, rebuilt.
+
+- ``controller`` — drives any Backend round by round (the live analogue of
+  ``solver.run_rounds``), with decision-latency measurement.
+- ``sinks`` — CSV metric files compatible with the reference's
+  ``node_std.csv`` / ``communication_cost.csv`` plus structured JSONL.
+- ``harness`` — the algorithm × repeat experiment matrix with per-session
+  result directories (reference auto_full_pipeline_repeat.sh).
+"""
+
+from kubernetes_rescheduling_tpu.bench.controller import ControllerResult, run_controller
+from kubernetes_rescheduling_tpu.bench.sinks import CsvSink, JsonlSink
+from kubernetes_rescheduling_tpu.bench.harness import ExperimentConfig, run_experiment
+
+__all__ = [
+    "ControllerResult",
+    "run_controller",
+    "CsvSink",
+    "JsonlSink",
+    "ExperimentConfig",
+    "run_experiment",
+]

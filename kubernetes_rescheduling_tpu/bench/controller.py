@@ -1,0 +1,173 @@
+"""Backend-driven control loop.
+
+The live counterpart of ``solver.run_rounds``: the same device kernels
+(detect → victim → choose) run one round at a time, with cluster I/O between
+rounds going through a ``Backend``. This is the loop the reference runs
+against a real cluster (main.py:56-112); here it works identically against
+the simulator — which is how the whole experiment matrix becomes hermetic.
+
+The ``global`` algorithm routes through the batched solver instead of the
+one-deployment greedy: one solve, then every service whose node changed is
+moved (SURVEY.md §7 '--moves-per-round all' mode).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_rescheduling_tpu.backends.base import Backend, MoveRequest
+from kubernetes_rescheduling_tpu.backends.k8s import PlacementMechanism
+from kubernetes_rescheduling_tpu.config import RescheduleConfig
+from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    global_assign,
+)
+from kubernetes_rescheduling_tpu.solver.round_loop import decide
+
+
+@dataclass
+class RoundRecord:
+    round: int
+    moved: bool
+    most_hazard: str | None
+    service: str | None
+    target: str | None
+    communication_cost: float
+    load_std: float
+    decision_latency_s: float  # device-side decision time (no cluster I/O)
+
+
+@dataclass
+class ControllerResult:
+    rounds: list[RoundRecord] = field(default_factory=list)
+
+    @property
+    def decisions_per_sec(self) -> float:
+        lat = [r.decision_latency_s for r in self.rounds if r.decision_latency_s > 0]
+        return 1.0 / (sum(lat) / len(lat)) if lat else 0.0
+
+    @property
+    def moves(self) -> int:
+        return sum(1 for r in self.rounds if r.moved)
+
+
+# the same decision kernel the scanned loop uses (solver.round_loop.decide),
+# jitted for one-round-at-a-time use against a live backend
+_decide = jax.jit(decide)
+
+
+def run_controller(
+    backend: Backend,
+    config: RescheduleConfig,
+    *,
+    key: jax.Array | None = None,
+) -> ControllerResult:
+    """Run ``config.max_rounds`` rounds against a backend."""
+    config = config.validate()
+    key = key if key is not None else jax.random.PRNGKey(config.seed)
+    graph = backend.comm_graph()
+    result = ControllerResult()
+
+    # one snapshot per round: the post-move snapshot provides this round's
+    # metrics AND the next round's state (a live monitor() is 4 cluster-wide
+    # API calls — doubling it per round doubles API-server load)
+    state = backend.monitor()
+    for rnd in range(1, config.max_rounds + 1):
+        key, sub = jax.random.split(key)
+
+        if config.algorithm == "global":
+            record = _global_round(backend, state, graph, config, sub, rnd)
+        else:
+            record = _greedy_round(backend, state, graph, config, sub, rnd)
+        backend.advance(config.sleep_after_action_s)
+        state = backend.monitor()
+        record.communication_cost = float(communication_cost(state, graph))
+        record.load_std = float(load_std(state))
+        result.rounds.append(record)
+    return result
+
+
+def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
+    pid = jnp.asarray(POLICY_IDS[config.algorithm])
+    t0 = time.perf_counter()
+    most, hazard_mask, victim, svc, target = jax.block_until_ready(
+        _decide(state, graph, pid, jnp.asarray(config.hazard_threshold_pct), key)
+    )
+    latency = time.perf_counter() - t0
+
+    moved = False
+    most_i, victim_i, target_i = int(most), int(victim), int(target)
+    service_name = graph.names[int(svc)] if victim_i >= 0 else None
+    target_name = state.node_names[target_i] if target_i >= 0 else None
+    if most_i >= 0 and victim_i >= 0 and target_i >= 0:
+        hazard_names = tuple(
+            state.node_names[i]
+            for i in range(state.num_nodes)
+            if bool(hazard_mask[i])
+        )
+        moved = backend.apply_move(
+            MoveRequest(
+                service=service_name,
+                target_node=target_name,
+                hazard_nodes=hazard_names,
+                mechanism=PlacementMechanism[config.algorithm],
+            )
+        )
+    return RoundRecord(
+        round=rnd,
+        moved=moved,
+        most_hazard=state.node_names[most_i] if most_i >= 0 else None,
+        service=service_name if moved else None,
+        target=target_name if moved else None,
+        communication_cost=0.0,  # filled by run_controller from the post-move snapshot
+        load_std=0.0,
+        decision_latency_s=latency,
+    )
+
+
+def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
+    cfg = GlobalSolverConfig(
+        sweeps=config.global_solver_iters,
+        balance_weight=config.balance_weight,
+        enforce_capacity=config.enforce_capacity,
+    )
+    t0 = time.perf_counter()
+    new_state, info = jax.block_until_ready(global_assign(state, graph, key, cfg))
+    latency = time.perf_counter() - t0
+
+    old_nodes = np.asarray(state.pod_node)
+    new_nodes = np.asarray(new_state.pod_node)
+    valid = np.asarray(state.pod_valid)
+    svc_arr = np.asarray(state.pod_service)
+    moved_any = False
+    seen: set[int] = set()
+    for i in np.flatnonzero(valid & (old_nodes != new_nodes)):
+        s = int(svc_arr[i])
+        if s in seen:
+            continue
+        seen.add(s)
+        ok = backend.apply_move(
+            MoveRequest(
+                service=graph.names[s],
+                target_node=new_state.node_names[int(new_nodes[i])],
+                mechanism=PlacementMechanism["global"],
+            )
+        )
+        moved_any = moved_any or ok
+    return RoundRecord(
+        round=rnd,
+        moved=moved_any,
+        most_hazard=None,
+        service=None,
+        target=None,
+        communication_cost=0.0,  # filled by run_controller from the post-move snapshot
+        load_std=0.0,
+        decision_latency_s=latency,
+    )

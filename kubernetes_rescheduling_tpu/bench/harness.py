@@ -1,0 +1,204 @@
+"""The experiment matrix — the reference's ``auto_full_pipeline_repeat.sh``
+(5 algorithms × 5 repeats, cordon-induced imbalance, three measurement
+phases) rebuilt as a hermetic, seed-reproducible harness over the simulator.
+
+Per (algorithm, run): a fresh seeded ``SimBackend``, the imbalance injection
+(reference auto_full_pipeline_repeat.sh:48-51), a "before" measurement
+(phase r1 = release1.sh), the rescheduling loop under measurement (phase r2 =
+release2.sh + main.py), and an "after" measurement (phase r3). Results land
+in ``<out>/session_<ts>/<algo>/run_<n>/`` (reference
+auto_full_pipeline_repeat.sh:13-16, 32-45) with the reference's CSV schemas
+plus structured JSONL and a machine-readable ``summary.json``.
+
+Response time is modeled, not curl-measured: every cross-node call edge pays
+a network penalty and overloaded nodes pay a queueing penalty — the two
+effects the reference's experiments attribute response-time differences to
+(README.md:55-59).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from kubernetes_rescheduling_tpu.backends.sim import LoadModel, SimBackend
+from kubernetes_rescheduling_tpu.bench.controller import run_controller
+from kubernetes_rescheduling_tpu.bench.sinks import (
+    JsonlSink,
+    communication_cost_sink,
+    node_std_sink,
+)
+from kubernetes_rescheduling_tpu.config import RescheduleConfig
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.core.topology import _random_workmodel
+from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, mubench_workmodel_c
+from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    algorithms: tuple[str, ...] = (
+        "spread",
+        "binpack",
+        "random",
+        "kubescheduling",
+        "communication",
+        "global",
+    )
+    repeats: int = 5                   # reference auto_full_pipeline_repeat.sh:10
+    rounds: int = 10                   # reference main.py:28
+    scenario: str = "mubench"          # mubench | dense | powerlaw | large
+    out_dir: str = "result"
+    seed: int = 0
+    hazard_threshold_pct: float = 30.0
+    inject_imbalance: bool = True      # the cordon trick
+
+
+# response-time model constants (documented, not measured)
+_RESP_BASE_MS = 20.0      # in-node call path
+_RESP_NET_MS = 25.0       # added per fully-remote call graph
+_RESP_OVERLOAD_MS = 200.0 # added at 100% average overload
+
+
+def modeled_response_time_ms(state: ClusterState, graph: CommGraph) -> float:
+    """base + net·(cross-node edge fraction) + queueing·(mean excess load)."""
+    adj = np.asarray(graph.adj)
+    valid = np.asarray(graph.service_valid)
+    total_edges = adj[valid][:, valid].sum() / 2
+    cost = float(communication_cost(state, graph))
+    cross_frac = cost / total_edges if total_edges else 0.0
+    pct = np.asarray(state.node_cpu_pct())
+    nv = np.asarray(state.node_valid)
+    excess = np.clip(pct[nv] - 100.0, 0.0, None).mean() / 100.0 if nv.any() else 0.0
+    return _RESP_BASE_MS + _RESP_NET_MS * cross_frac + _RESP_OVERLOAD_MS * excess
+
+
+def make_backend(scenario: str, seed: int) -> SimBackend:
+    """Scenario factory covering the BASELINE.md benchmark configs."""
+    rng = np.random.default_rng(seed)
+    if scenario == "mubench":
+        # reference cluster: 3 workers, i9-10900K = 20 threads (README.md:44-46)
+        return SimBackend(
+            workmodel=mubench_workmodel_c(),
+            node_names=["worker1", "worker2", "worker3"],
+            node_cpu_cap_m=20_000.0,
+            seed=seed,
+            load=LoadModel(entry_rps=100.0, cost_per_req_m=4.0, idle_m=50.0),
+        )
+    if scenario == "dense":
+        wm = _random_workmodel(200, rng, powerlaw=False, mean_degree=8.0)
+        return SimBackend(
+            workmodel=wm,
+            node_names=[f"worker{i:04d}" for i in range(20)],
+            node_cpu_cap_m=20_000.0,
+            seed=seed,
+        )
+    if scenario == "powerlaw":
+        wm = _random_workmodel(2000, rng, powerlaw=True, mean_degree=4.0)
+        return SimBackend(
+            workmodel=wm,
+            node_names=[f"worker{i:04d}" for i in range(200)],
+            node_cpu_cap_m=20_000.0,
+            seed=seed,
+        )
+    if scenario == "large":
+        wm = _random_workmodel(10_000, rng, powerlaw=True, mean_degree=4.0)
+        return SimBackend(
+            workmodel=wm,
+            node_names=[f"worker{i:04d}" for i in range(1000)],
+            node_cpu_cap_m=2_000.0,
+            seed=seed,
+            load=LoadModel(entry_rps=10.0, cost_per_req_m=0.1, idle_m=50.0),
+        )
+    raise ValueError(f"unknown scenario {scenario!r}")
+
+
+def run_experiment(cfg: ExperimentConfig) -> dict:
+    """Run the full matrix; returns (and writes) the summary."""
+    session = Path(cfg.out_dir) / f"session_{time.strftime('%Y%m%d_%H%M%S')}"
+    summary: dict = {"config": cfg.__dict__ | {"algorithms": list(cfg.algorithms)}, "runs": []}
+
+    for algo in cfg.algorithms:
+        for run_i in range(1, cfg.repeats + 1):
+            run_dir = session / algo / f"run_{run_i}"
+            run_dir.mkdir(parents=True, exist_ok=True)
+            seed = cfg.seed * 1000 + run_i
+            backend = make_backend(cfg.scenario, seed)
+            if cfg.inject_imbalance:
+                backend.inject_imbalance(backend.node_names[0])
+
+            graph = backend.comm_graph()
+            std_sink = node_std_sink(run_dir)
+            cost_sink = communication_cost_sink(run_dir)
+            rounds_sink = JsonlSink(run_dir / "rounds.jsonl")
+
+            before = backend.monitor()
+            before_metrics = {
+                "communication_cost": float(communication_cost(before, graph)),
+                "load_std": float(load_std(before)),
+                "response_time_ms": modeled_response_time_ms(before, graph),
+            }
+            std_sink.append(before_metrics["load_std"])
+
+            rcfg = RescheduleConfig(
+                algorithm=algo,
+                max_rounds=cfg.rounds,
+                hazard_threshold_pct=cfg.hazard_threshold_pct,
+                sleep_after_action_s=0.0,  # simulated pacing only
+                seed=seed,
+            )
+            t0 = time.perf_counter()
+            result = run_controller(backend, rcfg, key=jax.random.PRNGKey(seed))
+            wall_s = time.perf_counter() - t0
+            for rec in result.rounds:
+                std_sink.append(rec.load_std)
+                rounds_sink.append(rec.__dict__)
+
+            after = backend.monitor()
+            after_metrics = {
+                "communication_cost": float(communication_cost(after, graph)),
+                "load_std": float(load_std(after)),
+                "response_time_ms": modeled_response_time_ms(after, graph),
+            }
+            cost_sink.append(after_metrics["communication_cost"])
+
+            summary["runs"].append(
+                {
+                    "algorithm": algo,
+                    "run": run_i,
+                    "seed": seed,
+                    "before": before_metrics,
+                    "after": after_metrics,
+                    "moves": result.moves,
+                    "decisions_per_sec": result.decisions_per_sec,
+                    "wall_s": wall_s,
+                    "sim_clock_s": backend.clock_s,
+                }
+            )
+
+    # per-algorithm aggregates (mean over runs)
+    agg: dict[str, dict] = {}
+    for algo in cfg.algorithms:
+        runs = [r for r in summary["runs"] if r["algorithm"] == algo]
+        agg[algo] = {
+            "communication_cost": float(
+                np.mean([r["after"]["communication_cost"] for r in runs])
+            ),
+            "load_std": float(np.mean([r["after"]["load_std"] for r in runs])),
+            "response_time_ms": float(
+                np.mean([r["after"]["response_time_ms"] for r in runs])
+            ),
+            "decisions_per_sec": float(
+                np.mean([r["decisions_per_sec"] for r in runs])
+            ),
+        }
+    summary["aggregate"] = agg
+
+    session.mkdir(parents=True, exist_ok=True)
+    (session / "summary.json").write_text(json.dumps(summary, indent=2, default=float))
+    return summary

@@ -1,0 +1,149 @@
+"""Command-line interface.
+
+Fixes the reference's CLI mismatch (README.md:68 documents
+``--algorithm CAR`` but main.py:118-125 takes a positional name and calls
+CAR ``communication`` — SURVEY.md §2 quirk 6): flags are explicit, ``car``
+is accepted as an alias, and the backend/scenario/device are selectable.
+
+Subcommands:
+  reschedule  run the control loop once (reference ``python3 main.py <algo>``)
+  bench       run the experiment matrix (reference auto_full_pipeline_repeat.sh)
+  solve       one-shot global solve on a scenario, printing objectives
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+ALGO_ALIASES = {"car": "communication"}
+
+
+def _norm_algo(name: str) -> str:
+    name = name.strip().lower()
+    return ALGO_ALIASES.get(name, name)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubernetes_rescheduling_tpu",
+        description="TPU-native communication-aware rescheduling",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    r = sub.add_parser("reschedule", help="run the rescheduling control loop")
+    r.add_argument("--algorithm", default="communication",
+                   help="spread|binpack|random|kubescheduling|communication|car|global")
+    r.add_argument("--backend", default="sim", choices=["sim", "k8s"])
+    r.add_argument("--scenario", default="mubench",
+                   choices=["mubench", "dense", "powerlaw", "large"])
+    r.add_argument("--rounds", type=int, default=10)
+    r.add_argument("--threshold", type=float, default=30.0)
+    r.add_argument("--seed", type=int, default=0)
+    r.add_argument("--imbalance", action="store_true",
+                   help="inject the cordon-style imbalance before starting")
+    r.add_argument("--namespace", default="default")
+
+    b = sub.add_parser("bench", help="run the experiment matrix")
+    b.add_argument("--scenario", default="mubench",
+                   choices=["mubench", "dense", "powerlaw", "large"])
+    b.add_argument("--algorithms", default="spread,binpack,random,kubescheduling,communication,global")
+    b.add_argument("--repeats", type=int, default=5)
+    b.add_argument("--rounds", type=int, default=10)
+    b.add_argument("--out", default="result")
+    b.add_argument("--seed", type=int, default=0)
+
+    s = sub.add_parser("solve", help="one-shot global solve")
+    s.add_argument("--scenario", default="mubench",
+                   choices=["mubench", "dense", "powerlaw", "large"])
+    s.add_argument("--sweeps", type=int, default=8)
+    s.add_argument("--balance-weight", type=float, default=0.0)
+    s.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def cmd_reschedule(args) -> dict:
+    import jax
+
+    from kubernetes_rescheduling_tpu.bench.controller import run_controller
+    from kubernetes_rescheduling_tpu.bench.harness import make_backend
+    from kubernetes_rescheduling_tpu.config import RescheduleConfig
+
+    algo = _norm_algo(args.algorithm)
+    if args.backend == "k8s":
+        from kubernetes_rescheduling_tpu.backends.k8s import K8sBackend
+        from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+
+        backend = K8sBackend(workmodel=mubench_workmodel_c(), namespace=args.namespace)
+    else:
+        backend = make_backend(args.scenario, args.seed)
+        if args.imbalance:
+            backend.inject_imbalance(backend.node_names[0])
+    cfg = RescheduleConfig(
+        algorithm=algo,
+        max_rounds=args.rounds,
+        hazard_threshold_pct=args.threshold,
+        sleep_after_action_s=0.0 if args.backend == "sim" else 15.0,
+        seed=args.seed,
+    )
+    result = run_controller(backend, cfg, key=jax.random.PRNGKey(args.seed))
+    return {
+        "algorithm": algo,
+        "rounds": [rec.__dict__ for rec in result.rounds],
+        "moves": result.moves,
+        "decisions_per_sec": result.decisions_per_sec,
+    }
+
+
+def cmd_bench(args) -> dict:
+    from kubernetes_rescheduling_tpu.bench.harness import ExperimentConfig, run_experiment
+
+    cfg = ExperimentConfig(
+        algorithms=tuple(_norm_algo(a) for a in args.algorithms.split(",") if a),
+        repeats=args.repeats,
+        rounds=args.rounds,
+        scenario=args.scenario,
+        out_dir=args.out,
+        seed=args.seed,
+    )
+    return run_experiment(cfg)
+
+
+def cmd_solve(args) -> dict:
+    import jax
+
+    from kubernetes_rescheduling_tpu.bench.harness import make_backend
+    from kubernetes_rescheduling_tpu.objectives import communication_cost, load_std
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+
+    backend = make_backend(args.scenario, args.seed)
+    state = backend.monitor()
+    graph = backend.comm_graph()
+    cfg = GlobalSolverConfig(sweeps=args.sweeps, balance_weight=args.balance_weight)
+    new_state, info = global_assign(state, graph, jax.random.PRNGKey(args.seed), cfg)
+    return {
+        "scenario": args.scenario,
+        "communication_cost_before": float(communication_cost(state, graph)),
+        "communication_cost_after": float(communication_cost(new_state, graph)),
+        "load_std_before": float(load_std(state)),
+        "load_std_after": float(load_std(new_state)),
+        "moves_per_sweep": [int(m) for m in info["moves_per_sweep"]],
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    handler = {
+        "reschedule": cmd_reschedule,
+        "bench": cmd_bench,
+        "solve": cmd_solve,
+    }[args.command]
+    out = handler(args)
+    json.dump(out, sys.stdout, indent=2, default=float)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

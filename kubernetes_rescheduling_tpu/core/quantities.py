@@ -73,6 +73,14 @@ def mem_to_bytes(mem: str | int | float) -> int:
     unit1 = s[-1:]
     if unit1 in _DECIMAL_UNITS and not s[-1].isdigit():
         return int(float(s[:-1]) * _DECIMAL_UNITS[unit1])
+    # metrics-server is known to emit milli/micro-byte quantities for memory
+    # (e.g. "3988799488m"); round up to whole bytes.
+    if unit1 == "m":
+        return int(round(float(s[:-1]) / 1_000))
+    if unit1 == "u":
+        return int(round(float(s[:-1]) / 1_000_000))
+    if unit1 == "n":
+        return int(round(float(s[:-1]) / 1_000_000_000))
     return int(float(s))
 
 

@@ -89,8 +89,16 @@ class CommGraph:
         index = {name: i for i, name in enumerate(names)}
         adj = np.zeros((cap, cap), dtype=np.float32)
         for src, dsts in relation.items():
+            if src not in index:
+                raise ValueError(
+                    f"relation source {src!r} not in service names {names[:8]}..."
+                )
             i = index[src]
             for dst in dsts:
+                if dst not in index:
+                    # callee with no service of its own (external endpoint):
+                    # not placeable, so it cannot contribute to placement cost
+                    continue
                 j = index[dst]
                 if i != j:
                     adj[i, j] = 1.0
@@ -252,6 +260,7 @@ class ClusterState:
         pod_names: Sequence[str] | None = None,
         node_base_cpu: Sequence[float] | None = None,
         node_base_mem: Sequence[float] | None = None,
+        node_alive: Sequence[bool] | None = None,
         node_capacity: int | None = None,
         pod_capacity: int | None = None,
     ) -> "ClusterState":
@@ -273,7 +282,10 @@ class ClusterState:
         lex_rank[order] = np.arange(n_real, dtype=np.int32)
 
         node_valid = np.zeros((n_cap,), dtype=bool)
-        node_valid[:n_real] = True
+        # a known-but-dead node (failed/cordoned) is not a placement candidate
+        node_valid[:n_real] = (
+            np.asarray(node_alive, dtype=bool) if node_alive is not None else True
+        )
         pod_valid = np.zeros((p_cap,), dtype=bool)
         pod_valid[:p_real] = True
 

@@ -12,7 +12,7 @@ closure) plus per-service resource demands.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Mapping
 
@@ -37,12 +37,6 @@ class Workmodel:
 
     services: tuple[ServiceSpec, ...]
     source: str = "<memory>"
-    _index: dict[str, int] = field(default_factory=dict, repr=False)
-
-    def __post_init__(self) -> None:
-        object.__setattr__(
-            self, "_index", {s.name: i for i, s in enumerate(self.services)}
-        )
 
     @property
     def names(self) -> tuple[str, ...]:

@@ -1,0 +1,24 @@
+"""Pure, jit-able objective functions — the metrics the reference collects
+offline (communicationcost.py, nodemonitor.py) recast as on-device reductions.
+
+They serve double duty: test oracles for parity with the reference, and score
+terms inside the batched solver.
+"""
+
+from kubernetes_rescheduling_tpu.objectives.metrics import (
+    communication_cost,
+    communication_cost_deployment,
+    load_std,
+    node_cpu_pct_rounded,
+    capacity_violation,
+    objective_summary,
+)
+
+__all__ = [
+    "communication_cost",
+    "communication_cost_deployment",
+    "load_std",
+    "node_cpu_pct_rounded",
+    "capacity_violation",
+    "objective_summary",
+]

@@ -1,0 +1,115 @@
+"""Objective metrics as jit-able array reductions.
+
+Reference parity (semantics, not code — see SURVEY.md §5.5, §6):
+
+- ``communication_cost``: the reference walks every default-namespace pod,
+  maps its Deployment to a node, then counts cross-node edges of the relation
+  dict and halves the double count (reference communicationcost.py:40-45).
+  Here the same quantity is a masked quadratic form over the service×node
+  occupancy matrix — one matmul, MXU-friendly, and it generalizes cleanly to
+  multi-replica deployments (the reference's dict collapses a Deployment to a
+  single node, last pod wins — communicationcost.py:37).
+- ``load_std``: population standard deviation of per-node CPU-usage percent
+  over valid worker nodes (reference nodemonitor.py:37-46, ``numpy.std``).
+- ``node_cpu_pct_rounded``: the monitor stores ``int(round(pct))`` (reference
+  get_resource_usage.py:37) and hazard detection compares that rounded value
+  against the threshold (reference harzard_detect.py:12) — so the rounded
+  variant exists as its own function for exact detection parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+
+
+def communication_cost(state: ClusterState, graph: CommGraph) -> jax.Array:
+    """Cross-node communicating pod pairs, weighted by the comm graph.
+
+    cost = 1/2 · Σ_{i,j} adj[i,j] · (#cross-node pod pairs of services i,j)
+
+    With one replica per service this equals the reference's
+    cross-node-edges/2 (reference communicationcost.py:40-45): each edge
+    contributes 1 iff its two services sit on different nodes.
+    """
+    num_s = graph.num_services
+    occ = state.service_node_counts(num_s)          # f32[S, N]
+    tot = occ.sum(axis=1)                           # f32[S]
+    same_node_pairs = occ @ occ.T                   # f32[S, S]
+    all_pairs = tot[:, None] * tot[None, :]
+    cross = all_pairs - same_node_pairs
+    adj = graph.adj * graph.service_valid[:, None] * graph.service_valid[None, :]
+    return 0.5 * jnp.sum(adj * cross)
+
+
+def communication_cost_deployment(state: ClusterState, graph: CommGraph) -> jax.Array:
+    """Deployment-level cost, exactly the reference's accounting.
+
+    The reference collapses each Deployment to ONE node — the node of
+    whichever of its pods was listed last (communicationcost.py:22-37) — then
+    counts cross-node relation edges / 2. Here: a service's node is the node
+    of its highest-indexed valid pod.
+    """
+    num_s = graph.num_services
+    p = state.num_pods
+    # highest-indexed valid pod per service ("last pod wins")
+    pod_idx = jnp.arange(p)
+    svc = jnp.where(state.pod_valid, state.pod_service, num_s)
+    last = (
+        jnp.full((num_s + 1,), -1, jnp.int32)
+        .at[svc]
+        .max(jnp.where(state.pod_valid, pod_idx, -1).astype(jnp.int32))
+    )[:num_s]
+    has_pod = last >= 0
+    svc_node = jnp.where(has_pod, state.pod_node[jnp.clip(last, 0, p - 1)], -1)
+    diff = svc_node[:, None] != svc_node[None, :]
+    present = has_pod[:, None] & has_pod[None, :]
+    adj = graph.adj * graph.service_valid[:, None] * graph.service_valid[None, :]
+    # reference counts an edge as cross-node also when the peer is absent
+    # (inf.get(rel) is None != node — communicationcost.py:42-43)
+    absent_peer = has_pod[:, None] & ~has_pod[None, :]
+    return 0.5 * jnp.sum(adj * ((diff & present) | absent_peer))
+
+
+def load_std(state: ClusterState) -> jax.Array:
+    """Population std-dev of CPU-usage % over valid nodes with cap > 0
+    (reference nodemonitor.py:37-46)."""
+    pct = state.node_cpu_pct()
+    mask = state.node_valid & (state.node_cpu_cap > 0)
+    n = jnp.maximum(mask.sum(), 1)
+    mean = jnp.sum(jnp.where(mask, pct, 0.0)) / n
+    var = jnp.sum(jnp.where(mask, (pct - mean) ** 2, 0.0)) / n
+    return jnp.sqrt(var)
+
+
+def node_cpu_pct_rounded(state: ClusterState) -> jax.Array:
+    """i32[N] — ``int(round(pct))`` per node, -1 for zero-capacity nodes
+    (reference get_resource_usage.py:37). Hazard detection compares this."""
+    pct = state.node_cpu_pct()
+    # jnp.round is round-half-to-even like Python's round() on .5 — parity.
+    rounded = jnp.round(pct).astype(jnp.int32)
+    return jnp.where(state.node_valid & (state.node_cpu_cap > 0), rounded, -1)
+
+
+def capacity_violation(state: ClusterState) -> jax.Array:
+    """Total millicores of CPU over-subscription (0 when feasible).
+
+    The reference never checks capacity (pods are pinned via nodeName even
+    onto full nodes); the solver uses this as a feasibility term.
+    """
+    over = jnp.maximum(state.node_cpu_used() - state.node_cpu_cap, 0.0)
+    return jnp.sum(jnp.where(state.node_valid, over, 0.0))
+
+
+def objective_summary(state: ClusterState, graph: CommGraph) -> dict[str, jax.Array]:
+    """All objectives at once (single fused evaluation for telemetry)."""
+    return {
+        "communication_cost": communication_cost(state, graph),
+        "load_std": load_std(state),
+        "capacity_violation": capacity_violation(state),
+        "max_cpu_pct": jnp.max(
+            jnp.where(state.node_valid, state.node_cpu_pct(), -jnp.inf)
+        ),
+    }

@@ -1,0 +1,33 @@
+"""Pure-Python oracle implementing the reference's decision semantics.
+
+Used only in tests: the TPU kernels must match these functions
+decision-for-decision (SURVEY.md §4 "metric-parity tests").
+"""
+
+from kubernetes_rescheduling_tpu.oracle.reference_oracle import (
+    Snapshot,
+    to_snapshot,
+    detection,
+    pick_max_pod,
+    choose_spread,
+    choose_binpack,
+    choose_random,
+    choose_kubescheduling,
+    choose_communication,
+    communication_cost,
+    node_std,
+)
+
+__all__ = [
+    "Snapshot",
+    "to_snapshot",
+    "detection",
+    "pick_max_pod",
+    "choose_spread",
+    "choose_binpack",
+    "choose_random",
+    "choose_kubescheduling",
+    "choose_communication",
+    "communication_cost",
+    "node_std",
+]

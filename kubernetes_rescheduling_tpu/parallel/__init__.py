@@ -1,0 +1,21 @@
+"""Device-mesh parallelism.
+
+The reference's "distributed backend" is the Kubernetes REST API plus
+pod-to-pod REST over Calico (SURVEY.md §5.8) — there is nothing to port.
+TPU-natively, the solver's collectives ride ICI via XLA:
+
+- ``make_mesh`` — build a ``jax.sharding.Mesh`` over available devices
+  (dp = restarts/services, tp = nodes).
+- ``parallel_restarts`` — data-parallel multi-restart global solve: R
+  restarts sharded over dp, best result selected on device.
+- ``sharded_choose_node`` — the policy kernel with the node axis sharded
+  over tp: per-shard lexicographic maxima combined with all-gather.
+"""
+
+from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh
+from kubernetes_rescheduling_tpu.parallel.sharded import (
+    parallel_restarts,
+    sharded_choose_node,
+)
+
+__all__ = ["make_mesh", "parallel_restarts", "sharded_choose_node"]

@@ -1,0 +1,32 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    axis_names: tuple[str, ...] = ("dp", "tp"),
+    shape: tuple[int, ...] | None = None,
+) -> Mesh:
+    """Build a mesh over the first ``n_devices`` devices.
+
+    Default shape puts everything on ``dp`` (restart/data parallelism) with
+    ``tp`` (node-axis sharding) of 1; pass ``shape`` for a custom split.
+    On a single chip this degenerates to a 1×1 mesh, so the same pjit'd
+    program runs anywhere.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, only {len(devices)} available")
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    return Mesh(np.asarray(devices[:n]).reshape(shape), axis_names)

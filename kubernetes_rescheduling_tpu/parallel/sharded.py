@@ -1,0 +1,146 @@
+"""Sharded solver entry points.
+
+Two parallelism axes, mapped to the domain:
+
+- **dp (restarts)**: local search is embarrassingly parallel across random
+  restarts; ``parallel_restarts`` shards R independent ``global_assign``
+  solves over dp and argmin-selects the best objective on device.
+- **tp (nodes)**: at 1k+ nodes the per-(service, node) score matrix shards
+  cleanly along the node axis; ``sharded_choose_node`` runs the policy
+  kernel under ``shard_map`` with per-shard lexicographic maxima combined
+  by all-gather — the collective rides ICI, never the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.policies.scoring import node_features
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    global_assign,
+)
+
+
+def parallel_restarts(
+    state: ClusterState,
+    graph: CommGraph,
+    key: jax.Array,
+    mesh: Mesh,
+    *,
+    n_restarts: int | None = None,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """Run ``n_restarts`` independent global solves sharded over the mesh's
+    ``dp`` axis and return the best (lowest-objective) result.
+
+    Each restart differs only by PRNG key (random per-sweep chunk
+    composition), so results are bitwise-reproducible for a fixed key and
+    mesh. Defaults to one restart per dp slice.
+    """
+    dp = mesh.shape["dp"]
+    r = n_restarts or dp
+    if r % dp:
+        raise ValueError(f"n_restarts {r} must be a multiple of dp={dp}")
+    keys = jax.random.split(key, r)
+
+    @partial(jax.jit, static_argnames=())
+    def solve_one(k):
+        new_state, info = global_assign(state, graph, k, config)
+        return new_state.pod_node, info["objective_after"]
+
+    keys_sharded = jax.device_put(keys, NamedSharding(mesh, P("dp")))
+    pod_nodes, objs = jax.jit(jax.vmap(solve_one))(keys_sharded)
+    best = jnp.argmin(objs)
+    best_state = state.replace(pod_node=pod_nodes[best])
+    info = {
+        "objective_after": objs[best],
+        "restart_objectives": objs,
+        "best_restart": best,
+    }
+    return best_state, info
+
+
+def sharded_choose_node(
+    policy_id: jax.Array,
+    state: ClusterState,
+    graph: CommGraph,
+    service_idx: jax.Array,
+    hazard_mask: jax.Array,
+    key: jax.Array,
+    mesh: Mesh,
+) -> jax.Array:
+    """`policies.choose_node` with the node axis sharded over ``tp``.
+
+    Each shard computes its local feature block and lexicographic key tuple;
+    a global argmax over (keys..., -index) is taken after an all-gather of
+    one scalar tuple per shard — O(tp) bytes over ICI, independent of N.
+    """
+    tp = mesh.shape["tp"]
+    n = state.num_nodes
+    if n % tp:
+        raise ValueError(f"num_nodes {n} must be a multiple of tp={tp}")
+
+    f = node_features(state, graph, service_idx)
+    keys_by_policy = _policy_keys(policy_id, f, state, key)
+    cand = state.node_valid & ~hazard_mask
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, "tp"), P("tp")),
+        out_specs=P(),
+        # outputs are replicated by construction (post-all_gather reduction);
+        # the static VMA check can't see that through the loop
+        check_vma=False,
+    )
+    def pick(keys_block, cand_block):
+        # local lexicographic winner within this shard
+        winners = cand_block
+        for i in range(keys_block.shape[0]):
+            k = keys_block[i]
+            best = jnp.max(jnp.where(winners, k, -jnp.inf))
+            winners = winners & (k == best)
+        local_idx = jnp.argmax(winners).astype(jnp.int32)
+        shard = jax.lax.axis_index("tp")
+        global_idx = shard * cand_block.shape[0] + local_idx
+        local_keys = jnp.where(
+            jnp.any(winners), keys_block[:, local_idx], -jnp.inf
+        )
+        # gather one (keys, idx) tuple per shard, reduce lexicographically
+        all_keys = jax.lax.all_gather(local_keys, "tp")      # [tp, K]
+        all_idx = jax.lax.all_gather(global_idx, "tp")       # [tp]
+        winners2 = jnp.ones((all_keys.shape[0],), bool)
+        for i in range(all_keys.shape[1]):
+            k = all_keys[:, i]
+            best = jnp.max(jnp.where(winners2, k, -jnp.inf))
+            winners2 = winners2 & (k == best)
+        # lowest global index among tied shards (first-max parity)
+        tie_idx = jnp.where(winners2, all_idx, jnp.iinfo(jnp.int32).max)
+        chosen = jnp.min(tie_idx)
+        any_cand = jnp.any(all_keys[:, 0] > -jnp.inf)
+        return jnp.where(any_cand, chosen, -1)
+
+    keys_stack = jnp.stack(keys_by_policy)  # [K, N]
+    return jax.jit(pick)(keys_stack, cand)
+
+
+def _policy_keys(policy_id, f, state, key):
+    """The lexicographic key list for each policy (same table as
+    policies.scoring.choose_node), selected by traced policy id."""
+    g = jax.random.gumbel(key, (state.num_nodes,))
+    zero = jnp.zeros_like(g)
+    k1 = jnp.stack(
+        [-f["pod_count"], f["cpu_pct_rounded"], g, f["free_frac"], f["affinity"]]
+    )
+    k2 = jnp.stack(
+        [-f["lex_rank"], f["lex_rank"], zero, zero, f["cpu_free"]]
+    )
+    pid = jnp.clip(policy_id, 0, 4)
+    return [k1[pid], k2[pid]]

@@ -1,0 +1,28 @@
+"""Placement policies as jit-able kernels.
+
+The reference implements five placement strategies as separate Python
+functions over dict snapshots (reference rescheduling.py:77-218); here they
+are branches of one unified scoring kernel (`choose_node`) driven by
+masked lexicographic argmax, plus hazard detection and victim selection.
+"""
+
+from kubernetes_rescheduling_tpu.policies.hazard import detect_hazard
+from kubernetes_rescheduling_tpu.policies.victim import pick_victim, deployment_group
+from kubernetes_rescheduling_tpu.policies.scoring import (
+    POLICY_IDS,
+    POLICY_NAMES,
+    choose_node,
+    lex_argmax,
+    node_features,
+)
+
+__all__ = [
+    "detect_hazard",
+    "pick_victim",
+    "deployment_group",
+    "POLICY_IDS",
+    "POLICY_NAMES",
+    "choose_node",
+    "lex_argmax",
+    "node_features",
+]

@@ -1,0 +1,37 @@
+"""Hazard (overload) detection.
+
+Reference semantics (harzard_detect.py:3-27): a node is hazardous when the
+monitor's **rounded** CPU percent (reference get_resource_usage.py:37) is
+>= threshold (default 30); the "most hazardous" node is the first max in
+node order (Python ``max`` over a dict preserves insertion order on ties).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+from kubernetes_rescheduling_tpu.objectives.metrics import node_cpu_pct_rounded
+
+
+def detect_hazard(
+    state: ClusterState, threshold: float = 30.0
+) -> tuple[jax.Array, jax.Array]:
+    """Returns ``(most_hazard, hazard_mask)``.
+
+    most_hazard: i32 scalar node index, -1 when no node is hazardous.
+    hazard_mask: bool[N], True for every node at/over the threshold.
+
+    ``jnp.argmax`` picks the first max — same tie-break as the reference's
+    ``max`` over the hazard dict (harzard_detect.py:24).
+    """
+    pct = node_cpu_pct_rounded(state)  # i32[N], -1 for invalid/zero-cap
+    # compare in float so a fractional threshold (30.9) is not truncated to 30
+    hazard_mask = state.node_valid & (
+        pct.astype(jnp.float32) >= jnp.asarray(threshold, jnp.float32)
+    )
+    any_hazard = jnp.any(hazard_mask)
+    masked = jnp.where(hazard_mask, pct, jnp.iinfo(jnp.int32).min)
+    most = jnp.where(any_hazard, jnp.argmax(masked).astype(jnp.int32), -1)
+    return most, hazard_mask

@@ -1,0 +1,122 @@
+"""The unified placement-scoring kernel.
+
+All five reference strategies (reference rescheduling.py:77-218) are branches
+of one jit-able function: compute per-node features once, then pick a node by
+masked **lexicographic argmax** over policy-specific keys, reproducing each
+strategy's exact tie-break:
+
+| policy          | keys (maximize, in order)            | reference         |
+|-----------------|--------------------------------------|-------------------|
+| spread          | -pod_count, -lex_rank                | rescheduling.py:101 (min by (count, name)) |
+| binpack         | rounded cpu_pct, +lex_rank           | rescheduling.py:133 (max by (pct, name))   |
+| random          | Gumbel noise (uniform over cands)    | rescheduling.py:153 (rd.choice; parity is distribution-level, SURVEY.md §7) |
+| kubescheduling  | free-CPU fraction (least-allocated)  | rescheduling.py:159-171 delegates to kube-scheduler; this is OUR model of its default NodeResourcesFit scoring |
+| communication   | related-pod count, remaining CPU     | rescheduling.py:188-214 (tie → max remaining CPU, first max wins) |
+
+Every policy first excludes hazard nodes — the reference patches a NodeAffinity
+``NotIn <hazard nodes>`` rule into the re-created Deployment
+(rescheduling.py:42-55, 86-87) or skips them in its scoring loop
+(rescheduling.py:92-93, 189-190).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.objectives.metrics import node_cpu_pct_rounded
+
+POLICY_NAMES: tuple[str, ...] = (
+    "spread",
+    "binpack",
+    "random",
+    "kubescheduling",
+    "communication",
+)
+POLICY_IDS: dict[str, int] = {name: i for i, name in enumerate(POLICY_NAMES)}
+
+
+def lex_argmax(keys: Sequence[jax.Array], mask: jax.Array) -> jax.Array:
+    """Index of the masked lexicographic maximum of ``keys``.
+
+    Ties after the last key resolve to the lowest index — matching Python's
+    first-max-wins iteration order in the reference's scoring loops.
+    Returns -1 when the mask is empty.
+    """
+    winners = mask
+    for k in keys:
+        kf = k.astype(jnp.float32)
+        best = jnp.max(jnp.where(winners, kf, -jnp.inf))
+        winners = winners & (kf == best)
+    idx = jnp.argmax(winners).astype(jnp.int32)
+    return jnp.where(jnp.any(mask), idx, -1)
+
+
+def node_features(
+    state: ClusterState, graph: CommGraph, service_idx: jax.Array
+) -> dict[str, jax.Array]:
+    """All per-node features any policy needs, computed in one pass.
+
+    ``affinity`` is CAR's score: the number of pods on each node whose service
+    communicates with ``service_idx`` (reference rescheduling.py:188-195) —
+    here a single row-gather + matvec against the occupancy matrix.
+    """
+    occ = state.service_node_counts(graph.num_services)          # f32[S, N]
+    rel_row = (graph.adj[service_idx] > 0).astype(jnp.float32)   # f32[S]
+    return {
+        "pod_count": state.node_pod_count(),
+        "cpu_pct_rounded": node_cpu_pct_rounded(state).astype(jnp.float32),
+        "cpu_free": state.node_cpu_free(),
+        "free_frac": jnp.where(
+            state.node_cpu_cap > 0,
+            state.node_cpu_free() / jnp.where(state.node_cpu_cap > 0, state.node_cpu_cap, 1.0),
+            0.0,
+        ),
+        "affinity": rel_row @ occ,
+        "lex_rank": state.node_lex_rank.astype(jnp.float32),
+    }
+
+
+def choose_node(
+    policy_id: jax.Array,
+    state: ClusterState,
+    graph: CommGraph,
+    service_idx: jax.Array,
+    hazard_mask: jax.Array,
+    key: jax.Array,
+) -> jax.Array:
+    """i32 scalar — the chosen target node for ``service_idx``'s Deployment.
+
+    ``policy_id`` may be traced (``lax.switch``), so a whole batch of
+    policies can be evaluated under one compilation. Returns -1 when every
+    valid node is hazardous (the reference raises RuntimeError there,
+    rescheduling.py:98-99; the caller decides whether to skip or fail).
+    """
+    f = node_features(state, graph, service_idx)
+    cand = state.node_valid & ~hazard_mask
+
+    def spread(_):
+        return lex_argmax([-f["pod_count"], -f["lex_rank"]], cand)
+
+    def binpack(_):
+        return lex_argmax([f["cpu_pct_rounded"], f["lex_rank"]], cand)
+
+    def random(_):
+        g = jax.random.gumbel(key, (state.num_nodes,))
+        return lex_argmax([g], cand)
+
+    def kubescheduling(_):
+        return lex_argmax([f["free_frac"]], cand)
+
+    def communication(_):
+        return lex_argmax([f["affinity"], f["cpu_free"]], cand)
+
+    return lax.switch(
+        jnp.clip(policy_id, 0, len(POLICY_NAMES) - 1),
+        [spread, binpack, random, kubescheduling, communication],
+        None,
+    )

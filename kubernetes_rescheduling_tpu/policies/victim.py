@@ -1,0 +1,37 @@
+"""Victim selection: which pod (and hence which Deployment) gets moved.
+
+Reference semantics (delete_replaced_pod.py:41-61, 144-185): pick the
+max-CPU pod on the hazard node (strict ``>`` → first max in pod order),
+then delete its whole Deployment — every replica of that service moves
+together when it is re-created.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+
+
+def pick_victim(state: ClusterState, node_idx: jax.Array) -> jax.Array:
+    """i32 scalar — index of the max-CPU valid pod on ``node_idx``; -1 when the
+    node has no pods (reference returns None → round skipped, main.py:103-107).
+    """
+    on_node = state.pod_valid & (state.pod_node == node_idx)
+    masked = jnp.where(on_node, state.pod_cpu, -jnp.inf)
+    victim = jnp.argmax(masked).astype(jnp.int32)
+    return jnp.where(jnp.any(on_node), victim, -1)
+
+
+def deployment_group(state: ClusterState, pod_idx: jax.Array) -> jax.Array:
+    """bool[P] — all valid pods of the same service as ``pod_idx``.
+
+    Deleting a pod's Deployment tears down every replica (foreground cascade,
+    reference delete_replaced_pod.py:173-174), and re-creation places them all
+    on the chosen node; the group is therefore the unit of movement.
+    A pod_idx of -1 yields an empty group.
+    """
+    svc = state.pod_service[jnp.clip(pod_idx, 0, state.num_pods - 1)]
+    group = state.pod_valid & (state.pod_service == svc)
+    return jnp.where(pod_idx >= 0, group, jnp.zeros_like(group))

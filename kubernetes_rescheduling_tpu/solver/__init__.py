@@ -1,0 +1,27 @@
+"""Rescheduling solvers.
+
+- ``round_loop``: the reference's monitor→detect→delete→place control loop
+  (reference main.py:56-112) as a single ``lax.scan`` — one compiled program
+  runs all rounds on device.
+- ``global_solver``: the new capability — batched iterated best-response
+  assignment over the full service×node score matrix, of which the greedy
+  one-deployment-per-round loop is a special case.
+"""
+
+from kubernetes_rescheduling_tpu.solver.round_loop import (
+    RoundTelemetry,
+    round_step,
+    run_rounds,
+)
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    global_assign,
+)
+
+__all__ = [
+    "RoundTelemetry",
+    "round_step",
+    "run_rounds",
+    "GlobalSolverConfig",
+    "global_assign",
+]

@@ -1,0 +1,271 @@
+"""Batched global assignment solver — the north-star capability.
+
+The reference moves ONE deployment per round, chosen greedily
+(delete_replaced_pod.py:154 + rescheduling.py:174-218). This solver instead
+optimizes the placement of EVERY service at once:
+
+    minimize  0.5 · Σ_{i,j} W[i,j] · [node(i) != node(j)]
+              + λ · load-imbalance
+    s.t.      per-node CPU and memory capacity
+
+where ``W = adj · replicas_i · replicas_j`` is the pairwise communication
+weight (cross-node pod pairs — the generalization of the reference's
+cross-node-edges/2 objective, communicationcost.py:40-45). Services are the
+decision unit because a Deployment's replicas always move together
+(foreground cascade delete + pinned re-create, delete_replaced_pod.py:173,
+rescheduling.py:216).
+
+Method: **chunked synchronous best-response** — TPU-shaped local search.
+Each sweep:
+  1. neighbor-mass matmul ``M = W[chunk] @ X`` (C×S · S×N — MXU work),
+  2. score each (service, node): kept-local comm weight − λ·projected load%,
+  3. every service in the chunk proposes its argmax feasible node,
+  4. within-chunk capacity races resolve by gain order (sorted prefix-sum
+     admission), improving moves commit, loads update incrementally,
+then scan to the next chunk. The best state seen across all sweeps (by true
+objective) is returned, so oscillation can never make the answer worse than
+the initial placement. Everything is static-shaped — service arrays are
+padded to a chunk multiple, so one compilation serves every round at a given
+(S, N) capacity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+
+
+@struct.dataclass
+class GlobalSolverConfig:
+    sweeps: int = struct.field(pytree_node=False, default=8)
+    # 0 = auto: ~S/10, clamped to [1, 512]. Small chunks make the sweep more
+    # Gauss-Seidel (each chunk sees the previous chunks' moves), which local
+    # search needs to converge; large chunks amortize kernel launches and
+    # feed the MXU. ~10% of the services per chunk balances both; the 512 cap
+    # keeps the sweep <6% synchronous at 10k services while holding the
+    # sequential chunk count (the launch-overhead driver) at ~20.
+    chunk_size: int = struct.field(pytree_node=False, default=0)
+    balance_weight: float = struct.field(pytree_node=False, default=0.0)
+    enforce_capacity: bool = struct.field(pytree_node=False, default=True)
+    # Annealing: Gumbel noise added to move scores, linearly decayed to zero
+    # over the sweeps. Lets the search climb out of local optima of the
+    # partition objective; the best-seen tracking below means noise can only
+    # ever improve the returned solution. Units = comm-weight (pod pairs).
+    noise_temp: float = struct.field(pytree_node=False, default=1.0)
+
+
+def _service_aggregates(state: ClusterState, num_services: int):
+    """Per-service totals: replica count, CPU, memory; and a current node
+    (the node of the service's first valid pod; -1 if absent)."""
+    p = state.num_pods
+    svc = jnp.where(state.pod_valid, state.pod_service, num_services)
+    ones = jnp.where(state.pod_valid, 1.0, 0.0)
+    replicas = jnp.zeros((num_services + 1,), jnp.float32).at[svc].add(ones)[:num_services]
+    cpu = (
+        jnp.zeros((num_services + 1,), jnp.float32)
+        .at[svc]
+        .add(jnp.where(state.pod_valid, state.pod_cpu, 0.0))[:num_services]
+    )
+    mem = (
+        jnp.zeros((num_services + 1,), jnp.float32)
+        .at[svc]
+        .add(jnp.where(state.pod_valid, state.pod_mem, 0.0))[:num_services]
+    )
+    first = (
+        jnp.full((num_services + 1,), p, jnp.int32)
+        .at[svc]
+        .min(jnp.where(state.pod_valid, jnp.arange(p), p).astype(jnp.int32))[:num_services]
+    )
+    has = first < p
+    cur_node = jnp.where(has, state.pod_node[jnp.clip(first, 0, p - 1)], -1)
+    return replicas, cpu, mem, cur_node, has
+
+
+def _pad_to(x: jax.Array, size: int, fill=0):
+    pad = size - x.shape[0]
+    return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1), constant_values=fill)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def global_assign(
+    state: ClusterState,
+    graph: CommGraph,
+    key: jax.Array,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """Re-place every service; returns the new state and solve info.
+
+    The initial point is the CURRENT placement, and only configurations that
+    improve the true objective are ever adopted — the result is never worse
+    than the input.
+    """
+    S = graph.num_services
+    N = state.num_nodes
+    C = config.chunk_size or max(1, min(512, S // 10))
+    C = min(C, S)
+    n_chunks = -(-S // C)
+    SP = n_chunks * C  # padded service count
+
+    replicas, svc_cpu, svc_mem, cur_node, has_pods = _service_aggregates(state, S)
+    svc_valid = graph.service_valid & has_pods
+
+    # All service-level arrays padded to SP so chunk ids never alias.
+    svc_valid = _pad_to(svc_valid, SP, False)
+    svc_cpu = _pad_to(svc_cpu, SP)
+    svc_mem = _pad_to(svc_mem, SP)
+    replicas = _pad_to(replicas, SP)
+    cur_node = _pad_to(cur_node, SP, -1)
+
+    W = graph.adj * replicas[:S, None] * replicas[None, :S]
+    W = jnp.pad(W, ((0, SP - S), (0, SP - S)))
+    W = W * svc_valid[:, None] * svc_valid[None, :]
+
+    cpu_cap = jnp.where(state.node_valid, state.node_cpu_cap, 0.0)
+    mem_cap_raw = jnp.where(state.node_valid, state.node_mem_cap, 0.0)
+    mem_cap = jnp.where(mem_cap_raw > 0, mem_cap_raw, jnp.inf)
+    cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0)
+    base_cpu = state.node_base_cpu
+    base_mem = state.node_base_mem
+
+    assign0 = jnp.where(svc_valid, jnp.clip(cur_node, 0, N - 1), 0)
+
+    def loads(assign):
+        oh = jax.nn.one_hot(assign, N, dtype=jnp.float32) * svc_valid[:, None]
+        return base_cpu + svc_cpu @ oh, base_mem + svc_mem @ oh
+
+    def objective(assign):
+        same = assign[:, None] == assign[None, :]
+        comm = 0.5 * jnp.sum(W * (1.0 - same.astype(jnp.float32)))
+        cpu_load, _ = loads(assign)
+        pct = jnp.where(state.node_valid, cpu_load / cap * 100.0, 0.0)
+        nvalid = jnp.maximum(jnp.sum(state.node_valid), 1)
+        mean = jnp.sum(pct) / nvalid
+        var = jnp.sum(jnp.where(state.node_valid, (pct - mean) ** 2, 0.0)) / nvalid
+        return comm + config.balance_weight * jnp.sqrt(var)
+
+    def sweep(carry, xs):
+        sweep_key, temp = xs
+        assign, best_assign, best_obj = carry
+        # Random chunk composition per sweep: which services get to move
+        # together varies, so repeated sweeps (and parallel restarts with
+        # different keys) explore different neighborhoods of the search space.
+        perm_key, noise_key = jax.random.split(sweep_key)
+        chunk_ids = jax.random.permutation(perm_key, SP).reshape(n_chunks, C)
+        chunk_keys = jax.random.split(noise_key, n_chunks)
+
+        def chunk_step(inner, xs_c):
+            ids, chunk_key = xs_c
+            assign, X, cpu_load, mem_load = inner
+            valid_c = svc_valid[ids]
+
+            M = W[ids] @ X                                    # f32[C, N] kept-local mass
+            c_cpu = svc_cpu[ids]
+            c_mem = svc_mem[ids]
+            cur = assign[ids]
+            cur_oh = jax.nn.one_hot(cur, N, dtype=jnp.float32)
+            # projected CPU load% if the service lands on each node
+            proj_cpu = cpu_load[None, :] - cur_oh * c_cpu[:, None] + c_cpu[:, None]
+            proj_mem = mem_load[None, :] - cur_oh * c_mem[:, None] + c_mem[:, None]
+            score = M - config.balance_weight * (proj_cpu / cap[None, :]) * 100.0
+            if config.noise_temp > 0:
+                score = score + temp * jax.random.gumbel(chunk_key, score.shape)
+
+            if config.enforce_capacity:
+                fits = (proj_cpu <= cap[None, :]) & (proj_mem <= mem_cap[None, :])
+                feasible = (fits | cur_oh.astype(bool)) & state.node_valid[None, :]
+            else:
+                feasible = jnp.broadcast_to(state.node_valid[None, :], score.shape)
+
+            masked = jnp.where(feasible, score, -jnp.inf)
+            prop = jnp.argmax(masked, axis=1).astype(jnp.int32)
+            prop_score = jnp.take_along_axis(masked, prop[:, None], axis=1)[:, 0]
+            cur_score = jnp.take_along_axis(score, cur[:, None], axis=1)[:, 0]
+            gain = prop_score - cur_score
+            wants = valid_c & (gain > 0) & (prop != cur)
+
+            # within-chunk capacity race: admit by gain order via prefix sums
+            order = jnp.argsort(-jnp.where(wants, gain, -jnp.inf))
+            o_prop = prop[order]
+            o_cpu = jnp.where(wants[order], c_cpu[order], 0.0)
+            o_mem = jnp.where(wants[order], c_mem[order], 0.0)
+            oh_prop = jax.nn.one_hot(o_prop, N, dtype=jnp.float32)
+            prefix_cpu = jnp.cumsum(oh_prop * o_cpu[:, None], axis=0) - oh_prop * o_cpu[:, None]
+            prefix_mem = jnp.cumsum(oh_prop * o_mem[:, None], axis=0) - oh_prop * o_mem[:, None]
+            land_cpu = jnp.take_along_axis(prefix_cpu, o_prop[:, None], axis=1)[:, 0]
+            land_mem = jnp.take_along_axis(prefix_mem, o_prop[:, None], axis=1)[:, 0]
+            if config.enforce_capacity:
+                ok = (cpu_load[o_prop] + land_cpu + o_cpu <= cap[o_prop]) & (
+                    mem_load[o_prop] + land_mem + o_mem <= mem_cap[o_prop]
+                )
+            else:
+                ok = jnp.ones_like(land_cpu, bool)
+            admitted_sorted = wants[order] & ok
+            admitted = jnp.zeros_like(wants).at[order].set(admitted_sorted)
+
+            new_node = jnp.where(admitted, prop, cur)
+            new_assign = assign.at[ids].set(new_node)
+            # incremental occupancy update: only the chunk's rows change
+            X = X.at[ids].set(
+                jax.nn.one_hot(new_node, N, dtype=jnp.float32) * valid_c[:, None]
+            )
+            d_cpu = jnp.where(admitted, c_cpu, 0.0)
+            d_mem = jnp.where(admitted, c_mem, 0.0)
+            cpu_load = cpu_load.at[prop].add(d_cpu).at[cur].add(-d_cpu)
+            mem_load = mem_load.at[prop].add(d_mem).at[cur].add(-d_mem)
+            return (new_assign, X, cpu_load, mem_load), jnp.sum(admitted)
+
+        X0 = jax.nn.one_hot(assign, N, dtype=jnp.float32) * svc_valid[:, None]
+        cpu_load, mem_load = loads(assign)
+        (assign, _, _, _), moves = lax.scan(
+            chunk_step, (assign, X0, cpu_load, mem_load), (chunk_ids, chunk_keys)
+        )
+        obj = objective(assign)
+        better = obj < best_obj
+        best_assign = jnp.where(better, assign, best_assign)
+        best_obj = jnp.where(better, obj, best_obj)
+        return (assign, best_assign, best_obj), jnp.sum(moves)
+
+    # True objective of the INPUT placement (which may have a service's
+    # replicas split across nodes — not representable as a service-level
+    # assignment). The solver's result only replaces the input when it beats
+    # this, so "never worse than the input" holds even though assign0
+    # (first-pod's-node collapse) may itself be worse than the input.
+    obj_true0 = communication_cost(state, graph) + config.balance_weight * load_std(
+        state
+    )
+    obj0 = objective(assign0)
+    keys = jax.random.split(key, config.sweeps)
+    # linear decay to zero: the last sweeps polish greedily
+    temps = config.noise_temp * (
+        1.0 - jnp.arange(config.sweeps, dtype=jnp.float32) / max(config.sweeps - 1, 1)
+    )
+    (_, best_assign, best_obj), moves_per_sweep = lax.scan(
+        sweep, (assign0, assign0, obj0), (keys, temps)
+    )
+
+    # scatter service assignment back to pods — but only when the solve
+    # strictly beats the true input placement; otherwise keep the input
+    # (prevents pointless cluster churn when no improvement was found).
+    improved = best_obj < obj_true0
+    new_pod_node = jnp.where(
+        improved & state.pod_valid,
+        best_assign[jnp.clip(state.pod_service, 0, SP - 1)],
+        state.pod_node,
+    )
+    new_state = state.replace(pod_node=new_pod_node)
+    info = {
+        "objective_before": obj_true0,
+        "objective_after": jnp.minimum(best_obj, obj_true0),
+        "improved": improved,
+        "moves_per_sweep": moves_per_sweep,
+        "communication_cost": communication_cost(new_state, graph),
+        "load_std": load_std(new_state),
+    }
+    return new_state, info

@@ -1,0 +1,30 @@
+"""Cross-cutting utilities: structured logging, profiling, checkpointing.
+
+The reference logs with bare ``print`` (SURVEY.md §5.5), has no profiler, and
+persists nothing but append-only CSVs (§5.4) — a crashed experiment restarts
+from round 1. Here: JSONL structured logs, per-round decision-latency
+histograms + a ``jax.profiler`` wrapper, and array-native checkpoint/resume.
+"""
+
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger, get_logger
+from kubernetes_rescheduling_tpu.utils.profiling import (
+    LatencyHistogram,
+    Timer,
+    trace_to,
+)
+from kubernetes_rescheduling_tpu.utils.checkpoint import (
+    load_state,
+    save_state,
+    CheckpointManager,
+)
+
+__all__ = [
+    "StructuredLogger",
+    "get_logger",
+    "LatencyHistogram",
+    "Timer",
+    "trace_to",
+    "load_state",
+    "save_state",
+    "CheckpointManager",
+]

@@ -1,0 +1,101 @@
+"""Checkpoint/resume for multi-round experiments.
+
+The reference persists nothing but CSVs — a crashed run restarts from
+round 1 (SURVEY.md §5.4). A ``ClusterState`` is a handful of flat arrays, so
+a checkpoint is one ``.npz`` plus a JSON sidecar for the static name tuples;
+``CheckpointManager`` keeps per-round checkpoints and resumes from the
+latest one.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+
+_ARRAY_FIELDS = (
+    "node_cpu_cap",
+    "node_mem_cap",
+    "node_base_cpu",
+    "node_base_mem",
+    "node_valid",
+    "node_lex_rank",
+    "pod_node",
+    "pod_service",
+    "pod_cpu",
+    "pod_mem",
+    "pod_valid",
+)
+
+
+def save_state(state: ClusterState, path: str | Path, extra: dict | None = None) -> None:
+    """Write ``<path>.npz`` (arrays) + ``<path>.json`` (names, extra)."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    np.savez_compressed(
+        p.with_suffix(".npz"),
+        **{f: np.asarray(getattr(state, f)) for f in _ARRAY_FIELDS},
+    )
+    meta = {
+        "node_names": list(state.node_names),
+        "pod_names": list(state.pod_names),
+        "extra": extra or {},
+    }
+    p.with_suffix(".json").write_text(json.dumps(meta, default=float))
+
+
+def load_state(path: str | Path) -> tuple[ClusterState, dict]:
+    """Inverse of :func:`save_state`; returns ``(state, extra)``."""
+    p = Path(path)
+    arrays = np.load(p.with_suffix(".npz"))
+    meta = json.loads(p.with_suffix(".json").read_text())
+    state = ClusterState(
+        **{f: jnp.asarray(arrays[f]) for f in _ARRAY_FIELDS},
+        node_names=tuple(meta["node_names"]),
+        pod_names=tuple(meta["pod_names"]),
+    )
+    return state, meta.get("extra", {})
+
+
+@dataclass
+class CheckpointManager:
+    """Per-round checkpoints with latest-resume."""
+
+    directory: str | Path
+    keep: int = 5
+
+    def save(self, round_num: int, state: ClusterState, extra: dict | None = None) -> Path:
+        d = Path(self.directory)
+        d.mkdir(parents=True, exist_ok=True)
+        path = d / f"round_{round_num:06d}"
+        save_state(state, path, extra={"round": round_num, **(extra or {})})
+        self._gc()
+        return path
+
+    def latest(self) -> tuple[int, ClusterState, dict] | None:
+        """Most recent checkpoint, or None (start from round 1)."""
+        rounds = self._rounds()
+        if not rounds:
+            return None
+        r = rounds[-1]
+        state, extra = load_state(Path(self.directory) / f"round_{r:06d}")
+        return r, state, extra
+
+    def _rounds(self) -> list[int]:
+        d = Path(self.directory)
+        if not d.is_dir():
+            return []
+        return sorted(
+            int(f.stem.split("_")[1]) for f in d.glob("round_*.npz")
+        )
+
+    def _gc(self) -> None:
+        rounds = self._rounds()
+        for r in rounds[: -self.keep] if self.keep > 0 else []:
+            for suffix in (".npz", ".json"):
+                (Path(self.directory) / f"round_{r:06d}{suffix}").unlink(missing_ok=True)

@@ -1,0 +1,72 @@
+"""Structured logging (replaces the reference's ad-hoc prints,
+e.g. main.py:54-115, rescheduling.py:65-68)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, IO
+
+_LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
+
+
+@dataclass
+class StructuredLogger:
+    """JSONL event logger with optional human-readable echo."""
+
+    name: str = "krt"
+    path: str | Path | None = None
+    stream: IO | None = None
+    level: str = "info"
+    echo: bool = False
+
+    _records: list[dict] = field(default_factory=list, repr=False)
+
+    def log(self, level: str, event: str, **fields: Any) -> None:
+        if _LEVELS.get(level, 20) < _LEVELS.get(self.level, 20):
+            return
+        rec = {
+            "ts": time.time(),
+            "level": level,
+            "logger": self.name,
+            "event": event,
+            **fields,
+        }
+        self._records.append(rec)
+        line = json.dumps(rec, default=float)
+        if self.path is not None:
+            p = Path(self.path)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            with p.open("a") as f:
+                f.write(line + "\n")
+        out = self.stream or (sys.stderr if self.echo else None)
+        if out is not None:
+            out.write(line + "\n")
+
+    def debug(self, event: str, **fields: Any) -> None:
+        self.log("debug", event, **fields)
+
+    def info(self, event: str, **fields: Any) -> None:
+        self.log("info", event, **fields)
+
+    def warn(self, event: str, **fields: Any) -> None:
+        self.log("warn", event, **fields)
+
+    def error(self, event: str, **fields: Any) -> None:
+        self.log("error", event, **fields)
+
+    @property
+    def records(self) -> list[dict]:
+        return list(self._records)
+
+
+_loggers: dict[str, StructuredLogger] = {}
+
+
+def get_logger(name: str = "krt", **kwargs: Any) -> StructuredLogger:
+    if name not in _loggers:
+        _loggers[name] = StructuredLogger(name=name, **kwargs)
+    return _loggers[name]

@@ -1,0 +1,66 @@
+"""Profiling: wall-clock timers, decision-latency histograms, device traces.
+
+The north-star metric is rescheduling decisions/sec (BASELINE.md); the
+reference measures only whole-run wall time (main.py:126-135). Here every
+decision gets a latency sample and the distribution is inspectable; for
+device-level analysis ``trace_to`` wraps ``jax.profiler.trace`` so a block
+can be profiled under TensorBoard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class Timer:
+    """``with Timer() as t: ...; t.elapsed_s``"""
+
+    elapsed_s: float = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed_s = time.perf_counter() - self._t0
+
+
+@dataclass
+class LatencyHistogram:
+    """Streaming latency stats for decision rounds."""
+
+    samples_s: list[float] = field(default_factory=list)
+
+    def add(self, seconds: float) -> None:
+        self.samples_s.append(seconds)
+
+    def summary(self) -> dict[str, float]:
+        if not self.samples_s:
+            return {"count": 0}
+        a = np.asarray(self.samples_s)
+        return {
+            "count": int(a.size),
+            "mean_ms": float(a.mean() * 1e3),
+            "p50_ms": float(np.percentile(a, 50) * 1e3),
+            "p90_ms": float(np.percentile(a, 90) * 1e3),
+            "p99_ms": float(np.percentile(a, 99) * 1e3),
+            "max_ms": float(a.max() * 1e3),
+            "decisions_per_sec": float(1.0 / a.mean()),
+        }
+
+
+@contextlib.contextmanager
+def trace_to(log_dir: str | None):
+    """``jax.profiler.trace`` when a directory is given, no-op otherwise."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,17 +1,25 @@
 """Test harness: force an 8-device virtual CPU platform.
 
 Multi-chip TPU hardware is not available in CI; all sharding tests run on a
-virtual 8-device CPU mesh. Must run before jax is imported anywhere.
+virtual 8-device CPU mesh. The environment may pre-import jax and pin an
+accelerator platform (e.g. a tunneled TPU) via sitecustomize, so the env-var
+route alone is not enough — we also override through jax.config, which takes
+effect as long as no backend has been initialized yet.
 """
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-flags = os.environ["XLA_FLAGS"]
+flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh; a device backend was already "
+    f"initialized: {jax.devices()}"
+)

@@ -1,0 +1,396 @@
+"""SimBackend dynamics/faults and K8sBackend against fake client objects."""
+
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends import (
+    K8sBackend,
+    LoadModel,
+    MoveRequest,
+    SimBackend,
+)
+from kubernetes_rescheduling_tpu.backends.k8s import (
+    PlacementMechanism,
+    exclude_hazard_affinity,
+    extract_redeployable_spec,
+    merge_affinity,
+)
+from kubernetes_rescheduling_tpu.core.state import UNASSIGNED
+from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+
+
+def make_sim(**kw):
+    return SimBackend(
+        workmodel=mubench_workmodel_c(),
+        node_names=["worker1", "worker2", "worker3"],
+        **kw,
+    )
+
+
+class TestSimBackend:
+    def test_load_propagation(self):
+        sim = make_sim()
+        rps = sim.load.service_rps(sim.workmodel)
+        # s0 is the entry; s1 is called by s0; s2 by s1; leaves get flow too
+        assert rps["s0"] == sim.load.entry_rps
+        assert rps["s1"] == sim.load.entry_rps
+        assert rps["s2"] == sim.load.entry_rps
+        # s16 called only by s0
+        assert rps["s16"] == sim.load.entry_rps
+
+    def test_monitor_snapshot_shapes(self):
+        sim = make_sim()
+        state = sim.monitor()
+        assert state.num_nodes == 3
+        assert int(np.asarray(state.pod_valid).sum()) == 20
+        assert float(np.asarray(state.pod_cpu).max()) > 0
+
+    def test_apply_move_moves_all_replicas(self):
+        sim = make_sim()
+        ok = sim.apply_move(MoveRequest(service="s3", target_node="worker2"))
+        assert ok
+        state = sim.monitor()
+        svc3 = [
+            i
+            for i in range(state.num_pods)
+            if bool(state.pod_valid[i]) and int(state.pod_service[i]) == 3
+        ]
+        assert all(int(state.pod_node[i]) == 1 for i in svc3)
+        assert sim.clock_s == sim.reconcile_delay_s
+
+    def test_apply_move_unknown(self):
+        sim = make_sim()
+        assert not sim.apply_move(MoveRequest(service="nope", target_node="worker1"))
+        assert not sim.apply_move(MoveRequest(service="s0", target_node="nope"))
+
+    def test_imbalance_injection(self):
+        sim = make_sim()
+        sim.inject_imbalance("worker1")
+        state = sim.monitor()
+        nodes = np.asarray(state.pod_node)[np.asarray(state.pod_valid)]
+        assert (nodes == 0).all()
+
+    def test_node_kill_and_reschedule(self):
+        sim = make_sim()
+        sim.inject_imbalance("worker1")
+        sim.kill_node("worker1")
+        state = sim.monitor()
+        nodes = np.asarray(state.pod_node)[np.asarray(state.pod_valid)]
+        assert (nodes == UNASSIGNED).all()
+        assert float(state.node_cpu_cap[0]) == 0.0
+        placed = sim.schedule_pending()
+        assert placed == 20
+        state = sim.monitor()
+        nodes = np.asarray(state.pod_node)[np.asarray(state.pod_valid)]
+        assert set(nodes.tolist()) <= {1, 2}
+
+    def test_cpu_spike_detected(self):
+        sim = make_sim(node_cpu_cap_m=100_000.0)
+        base = sim.monitor()
+        sim.cpu_spike("s0", 50.0)
+        spiked = sim.monitor()
+        s0 = next(
+            i for i in range(base.num_pods)
+            if bool(base.pod_valid[i]) and int(base.pod_service[i]) == 0
+        )
+        assert float(spiked.pod_cpu[s0]) > float(base.pod_cpu[s0]) * 10
+
+    def test_churn_deterministic(self):
+        a, b = make_sim(seed=5), make_sim(seed=5)
+        a.churn(10)
+        b.churn(10)
+        np.testing.assert_array_equal(
+            np.asarray(a.monitor().pod_node), np.asarray(b.monitor().pod_node)
+        )
+
+
+# ---- fakes for the k8s adapter ----
+
+
+class ApiError(Exception):
+    def __init__(self, status):
+        self.status = status
+
+
+class FakeCluster:
+    """Dict-world cluster implementing the client calls the adapter makes."""
+
+    def __init__(self, wm, nodes=("master", "worker1", "worker2")):
+        self.wm = wm
+        self.nodes = list(nodes)
+        self.deployments = {}
+        self.pods = {}
+        self.deleted_gen = 0
+        for i, name in enumerate(wm.names):
+            node = self.nodes[1 + i % (len(self.nodes) - 1)]
+            self.deployments[name] = self._dep_body(name)
+            self.pods[f"{name}-pod"] = {"deployment": name, "node": node}
+
+    def _dep_body(self, name):
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default", "labels": {"app": name}},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": name,
+                                "image": f"img/{name}:latest",
+                                "imagePullPolicy": "Always",
+                                "livenessProbe": {"drop": "me"},
+                            }
+                        ]
+                    },
+                },
+            },
+        }
+
+    # CoreV1-ish
+    def list_node(self, watch=False):
+        return {
+            "items": [
+                {
+                    "metadata": {"name": n},
+                    "status": {"capacity": {"cpu": "8", "memory": "16Gi"}},
+                }
+                for n in self.nodes
+            ]
+        }
+
+    def list_pod_for_all_namespaces(self, watch=False):
+        return {
+            "items": [
+                {
+                    "metadata": {
+                        "name": pname,
+                        "namespace": "default",
+                        "ownerReferences": [
+                            {"kind": "ReplicaSet", "name": f"{info['deployment']}-rs"}
+                        ],
+                    },
+                    "spec": {"nodeName": info["node"]},
+                }
+                for pname, info in self.pods.items()
+            ]
+        }
+
+    # AppsV1-ish
+    def read_namespaced_replica_set(self, name, namespace):
+        dep = name[: -len("-rs")]
+        return {
+            "metadata": {"ownerReferences": [{"kind": "Deployment", "name": dep}]}
+        }
+
+    def read_namespaced_deployment(self, name, namespace):
+        if name not in self.deployments:
+            raise ApiError(404)
+        return self.deployments[name]
+
+    def delete_namespaced_deployment(self, name, namespace, body=None):
+        self.deployments.pop(name, None)
+        for pname in [p for p, i in self.pods.items() if i["deployment"] == name]:
+            del self.pods[pname]
+        self.deleted_gen += 1
+
+    def create_namespaced_deployment(self, namespace, body):
+        name = body["metadata"]["name"]
+        self.deployments[name] = body
+        spec = body["spec"]["template"]["spec"]
+        node = spec.get("nodeName") or (spec.get("nodeSelector") or {}).get(
+            "kubernetes.io/hostname"
+        )
+        self.pods[f"{name}-pod"] = {"deployment": name, "node": node}
+
+    # CustomObjects-ish
+    def list_cluster_custom_object(self, group, version, plural):
+        return {
+            "items": [
+                {"metadata": {"name": n}, "usage": {"cpu": "2000m", "memory": "4Gi"}}
+                for n in self.nodes
+            ]
+        }
+
+    def list_namespaced_custom_object(self, group, version, namespace, plural):
+        return {
+            "items": [
+                {
+                    "metadata": {"name": pname},
+                    "containers": [{"usage": {"cpu": "150m", "memory": "100Mi"}}],
+                }
+                for pname in self.pods
+            ]
+        }
+
+
+@pytest.fixture
+def fake_backend():
+    wm = mubench_workmodel_c()
+    fc = FakeCluster(wm)
+    backend = K8sBackend(
+        workmodel=wm,
+        core_api=fc,
+        apps_api=fc,
+        custom_api=fc,
+        sleeper=lambda s: None,
+    )
+    return backend, fc
+
+
+class TestK8sBackend:
+    def test_monitor(self, fake_backend):
+        backend, fc = fake_backend
+        state = backend.monitor()
+        # master excluded (reference podmonitor.py:45)
+        assert "master" not in state.node_names
+        assert state.num_nodes == 2
+        assert int(np.asarray(state.pod_valid).sum()) == 20
+        # capacities parsed: 8 cores = 8000m
+        assert float(state.node_cpu_cap[0]) == 8000.0
+        # per-pod usage parsed: 150m
+        assert float(state.pod_cpu[0]) == 150.0
+        # base = node usage - tracked pods
+        tracked0 = sum(
+            150.0
+            for i in range(state.num_pods)
+            if bool(state.pod_valid[i]) and int(state.pod_node[i]) == 0
+        )
+        assert float(state.node_base_cpu[0]) == pytest.approx(2000.0 - tracked0)
+
+    def test_apply_move_nodename(self, fake_backend):
+        backend, fc = fake_backend
+        ok = backend.apply_move(
+            MoveRequest(
+                service="s3",
+                target_node="worker2",
+                hazard_nodes=("worker1",),
+                mechanism="nodeName",
+            )
+        )
+        assert ok
+        body = fc.deployments["s3"]
+        spec = body["spec"]["template"]["spec"]
+        assert spec["nodeName"] == "worker2"
+        assert spec["schedulerName"] == "default-scheduler"
+        c = spec["containers"][0]
+        assert c["imagePullPolicy"] == "IfNotPresent"
+        assert "livenessProbe" not in c  # only kept keys survive
+        values = spec["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"][0]["matchExpressions"][0]["values"]
+        assert values == ["worker1"]
+        assert fc.pods["s3-pod"]["node"] == "worker2"
+
+    def test_apply_move_nodeselector(self, fake_backend):
+        backend, fc = fake_backend
+        assert backend.apply_move(
+            MoveRequest(service="s1", target_node="worker1", mechanism="nodeSelector")
+        )
+        spec = fc.deployments["s1"]["spec"]["template"]["spec"]
+        assert spec["nodeSelector"] == {"kubernetes.io/hostname": "worker1"}
+        assert "nodeName" not in spec or spec.get("nodeName") is None
+
+    def test_apply_move_missing_deployment(self, fake_backend):
+        backend, _ = fake_backend
+        assert not backend.apply_move(
+            MoveRequest(service="nope", target_node="worker1")
+        )
+
+    def test_mechanism_table_matches_reference(self):
+        # reference rescheduling.py:103,135 (nodeSelector), :155,:216 (nodeName),
+        # :167-171 (affinity only)
+        assert PlacementMechanism["spread"] == "nodeSelector"
+        assert PlacementMechanism["binpack"] == "nodeSelector"
+        assert PlacementMechanism["random"] == "nodeName"
+        assert PlacementMechanism["communication"] == "nodeName"
+        assert PlacementMechanism["kubescheduling"] == "affinityOnly"
+
+
+def test_merge_affinity_extends_lists():
+    base = exclude_hazard_affinity(["w1"])
+    merged = merge_affinity(base, exclude_hazard_affinity(["w2"]))
+    terms = merged["nodeAffinity"]["requiredDuringSchedulingIgnoredDuringExecution"][
+        "nodeSelectorTerms"
+    ]
+    assert len(terms) == 2
+
+
+def test_extract_spec_defaults():
+    body = extract_redeployable_spec({"metadata": {"name": "x"}, "spec": {}})
+    assert body["metadata"]["name"] == "x"
+    assert body["spec"]["template"]["spec"]["restartPolicy"] == "Always"
+    assert body["spec"]["template"]["spec"]["dnsPolicy"] == "ClusterFirst"
+
+
+class TestRegressionFixes:
+    def test_rps_multi_parent_propagation(self):
+        # s0->{x,a}, a->b, b->x, x->y: y must see BOTH paths' flow through x
+        from kubernetes_rescheduling_tpu.core.workmodel import ServiceSpec, Workmodel
+
+        wm = Workmodel(
+            services=(
+                ServiceSpec(name="s0", callees=("x", "a")),
+                ServiceSpec(name="a", callees=("b",)),
+                ServiceSpec(name="b", callees=("x",)),
+                ServiceSpec(name="x", callees=("y",)),
+                ServiceSpec(name="y"),
+            )
+        )
+        rps = LoadModel(entry_service="s0", entry_rps=100.0).service_rps(wm)
+        assert rps["x"] == 200.0
+        assert rps["y"] == 200.0
+
+    def test_dead_node_not_a_candidate(self):
+        import jax
+        import jax.numpy as jnp
+        from kubernetes_rescheduling_tpu.policies import POLICY_IDS, choose_node
+
+        sim = make_sim()
+        sim.inject_imbalance("worker2")
+        sim.kill_node("worker1")
+        state = sim.monitor()
+        assert not bool(state.node_valid[0])  # dead node invalid in snapshot
+        got = choose_node(
+            jnp.asarray(POLICY_IDS["spread"]),
+            state,
+            sim.comm_graph(),
+            jnp.asarray(0),
+            jnp.zeros((state.num_nodes,), bool),
+            jax.random.PRNGKey(0),
+        )
+        # spread's lex-min tie-break must not pick the dead worker1
+        assert state.node_names[int(got)] != "worker1"
+
+    def test_unknown_callee_skipped(self):
+        from kubernetes_rescheduling_tpu.core.workmodel import Workmodel
+
+        wm = Workmodel.from_dict(
+            {
+                "s0": {"external_services": [{"services": ["db-external"]}]},
+            }
+        )
+        graph = wm.comm_graph()  # must not raise
+        assert graph.names == ("s0",)
+
+    def test_fractional_threshold(self):
+        import jax.numpy as jnp
+        from kubernetes_rescheduling_tpu.core.state import ClusterState
+        from kubernetes_rescheduling_tpu.policies import detect_hazard
+
+        state = ClusterState.build(
+            node_names=["n0"],
+            node_cpu_cap=[1000],
+            node_mem_cap=[1e9],
+            pod_services=[0],
+            pod_nodes=[0],
+            pod_cpu=[300],  # exactly 30%
+            pod_mem=[0],
+        )
+        most, mask = detect_hazard(state, threshold=30.9)
+        assert int(most) == -1  # 30 < 30.9 — must not truncate to 30
+        most2, _ = detect_hazard(state, threshold=30.0)
+        assert int(most2) == 0

@@ -1,0 +1,108 @@
+"""Objectives vs the pure-Python oracle (SURVEY.md §4 metric-parity tests)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_rescheduling_tpu.core.topology import (
+    mubench_scenario,
+    state_from_workmodel,
+    synthetic_scenario,
+)
+from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+from kubernetes_rescheduling_tpu import oracle
+from kubernetes_rescheduling_tpu.objectives import (
+    communication_cost,
+    communication_cost_deployment,
+    load_std,
+    capacity_violation,
+    objective_summary,
+)
+
+
+def random_mubench_state(seed):
+    wm = mubench_workmodel_c()
+    return state_from_workmodel(wm, seed=seed), wm
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_comm_cost_matches_oracle_single_replica(seed):
+    state, wm = random_mubench_state(seed)
+    graph = wm.comm_graph()
+    snap = oracle.to_snapshot(state, graph)
+    expected = oracle.communication_cost(snap, wm.relation())
+    got_pairs = float(communication_cost(state, graph))
+    got_dep = float(communication_cost_deployment(state, graph))
+    assert got_pairs == pytest.approx(expected)
+    assert got_dep == pytest.approx(expected)
+
+
+def test_comm_cost_zero_when_colocated():
+    scn = mubench_scenario(imbalanced=True)
+    assert float(communication_cost(scn.state, scn.graph)) == 0.0
+    assert float(communication_cost_deployment(scn.state, scn.graph)) == 0.0
+
+
+def test_comm_cost_counts_cross_node_edges():
+    # two communicating services on different nodes -> one edge -> cost 1
+    from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+
+    graph = CommGraph.from_relation({"a": ["b"], "b": ["a"]})
+    state = ClusterState.build(
+        node_names=["n0", "n1"],
+        node_cpu_cap=[1000, 1000],
+        node_mem_cap=[1e9, 1e9],
+        pod_services=[0, 1],
+        pod_nodes=[0, 1],
+        pod_cpu=[100, 100],
+        pod_mem=[0, 0],
+    )
+    assert float(communication_cost(state, graph)) == 1.0
+    assert float(communication_cost_deployment(state, graph)) == 1.0
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_load_std_matches_oracle(seed):
+    state, wm = random_mubench_state(seed)
+    graph = wm.comm_graph()
+    snap = oracle.to_snapshot(state, graph)
+    assert float(load_std(state)) == pytest.approx(oracle.node_std(snap), rel=1e-5)
+
+
+def test_capacity_violation():
+    from kubernetes_rescheduling_tpu.core.state import ClusterState
+
+    state = ClusterState.build(
+        node_names=["n0", "n1"],
+        node_cpu_cap=[100, 1000],
+        node_mem_cap=[1e9, 1e9],
+        pod_services=[0, 0],
+        pod_nodes=[0, 0],
+        pod_cpu=[150, 50],
+        pod_mem=[0, 0],
+    )
+    assert float(capacity_violation(state)) == pytest.approx(100.0)
+
+
+def test_objective_summary_padded_scenario():
+    scn = synthetic_scenario(n_pods=50, n_nodes=5, seed=1)
+    s = objective_summary(scn.state, scn.graph)
+    assert set(s) == {
+        "communication_cost",
+        "load_std",
+        "capacity_violation",
+        "max_cpu_pct",
+    }
+    assert float(s["communication_cost"]) >= 0.0
+
+
+def test_padding_does_not_change_metrics():
+    wm = mubench_workmodel_c()
+    a = state_from_workmodel(wm, seed=3)
+    b = state_from_workmodel(wm, seed=3, node_capacity=8, pod_capacity=64)
+    ga = wm.comm_graph()
+    gb = wm.comm_graph(capacity=32)
+    assert float(communication_cost(a, ga)) == pytest.approx(
+        float(communication_cost(b, gb))
+    )
+    assert float(load_std(a)) == pytest.approx(float(load_std(b)), rel=1e-5)

@@ -1,0 +1,162 @@
+"""Policy kernels vs the oracle: decision-for-decision parity on randomized
+states, including tie-break cases (SURVEY.md §7 'exact tie-break parity')."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+from kubernetes_rescheduling_tpu import oracle
+from kubernetes_rescheduling_tpu.policies import (
+    POLICY_IDS,
+    choose_node,
+    deployment_group,
+    detect_hazard,
+    lex_argmax,
+    pick_victim,
+)
+
+
+def random_state(seed, n_nodes=4, n_services=20, quantize=True):
+    """Random cluster with quantized pod CPU (forces frequent ties)."""
+    rng = np.random.default_rng(seed)
+    n_pods = n_services  # one replica per service, like workmodelC
+    pod_cpu = rng.integers(1, 8, size=n_pods) * 50.0 if quantize else rng.uniform(10, 400, n_pods)
+    # shuffled node names so lexicographic order != index order
+    names = [f"w{c}" for c in rng.permutation([chr(ord('a') + i) for i in range(n_nodes)])]
+    return ClusterState.build(
+        node_names=names,
+        node_cpu_cap=[2000.0] * n_nodes,
+        node_mem_cap=[1e9] * n_nodes,
+        pod_services=list(range(n_services)),
+        pod_nodes=rng.integers(0, n_nodes, size=n_pods).tolist(),
+        pod_cpu=pod_cpu.tolist(),
+        pod_mem=[0.0] * n_pods,
+        pod_names=[f"s{i}-0" for i in range(n_services)],
+    )
+
+
+@pytest.fixture(scope="module")
+def wm():
+    return mubench_workmodel_c()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_hazard_detection_parity(seed, wm):
+    state = random_state(seed)
+    graph = wm.comm_graph()
+    snap = oracle.to_snapshot(state, graph)
+    exp_most, exp_hazard = oracle.detection(snap, threshold=30.0)
+    most, mask = detect_hazard(state, threshold=30.0)
+    got_hazard = [state.node_names[i] for i in range(state.num_nodes) if bool(mask[i])]
+    assert got_hazard == exp_hazard
+    got_most = state.node_names[int(most)] if int(most) >= 0 else ""
+    assert got_most == exp_most
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_victim_parity(seed, wm):
+    state = random_state(seed)
+    graph = wm.comm_graph()
+    snap = oracle.to_snapshot(state, graph)
+    for node_idx, node_name in enumerate(state.node_names):
+        exp = oracle.pick_max_pod(snap, node_name)
+        got = int(pick_victim(state, jnp.asarray(node_idx)))
+        if exp is None:
+            assert got == -1
+        else:
+            assert got == exp.index
+
+
+def test_deployment_group_moves_all_replicas():
+    state = ClusterState.build(
+        node_names=["n0", "n1"],
+        node_cpu_cap=[1000, 1000],
+        node_mem_cap=[1e9, 1e9],
+        pod_services=[0, 0, 1],
+        pod_nodes=[0, 1, 0],
+        pod_cpu=[100, 100, 100],
+        pod_mem=[0, 0, 0],
+    )
+    group = deployment_group(state, jnp.asarray(0))
+    assert list(np.asarray(group)) == [True, True, False]
+    empty = deployment_group(state, jnp.asarray(-1))
+    assert not np.asarray(empty).any()
+
+
+def _oracle_choice(policy, snap, hazard, relation, service):
+    if policy == "spread":
+        return oracle.choose_spread(snap, hazard)
+    if policy == "binpack":
+        return oracle.choose_binpack(snap, hazard)
+    if policy == "kubescheduling":
+        return oracle.choose_kubescheduling(snap, hazard)
+    if policy == "communication":
+        return oracle.choose_communication(snap, relation, service, hazard)
+    raise ValueError(policy)
+
+
+@pytest.mark.parametrize("policy", ["spread", "binpack", "kubescheduling", "communication"])
+@pytest.mark.parametrize("seed", range(15))
+def test_deterministic_policy_parity(policy, seed, wm):
+    state = random_state(seed)
+    graph = wm.comm_graph()
+    snap = oracle.to_snapshot(state, graph)
+    _, mask = detect_hazard(state, threshold=30.0)
+    hazard = [state.node_names[i] for i in range(state.num_nodes) if bool(mask[i])]
+    if len(hazard) == state.num_nodes:
+        pytest.skip("all nodes hazardous")
+    svc_idx = seed % 20
+    exp = _oracle_choice(policy, snap, hazard, wm.relation(), f"s{svc_idx}")
+    got = choose_node(
+        jnp.asarray(POLICY_IDS[policy]),
+        state,
+        graph,
+        jnp.asarray(svc_idx),
+        mask,
+        jax.random.PRNGKey(0),
+    )
+    assert state.node_names[int(got)] == exp
+
+
+def test_random_policy_uniform_over_candidates(wm):
+    state = random_state(3)
+    graph = wm.comm_graph()
+    _, mask = detect_hazard(state, threshold=30.0)
+    cand = [i for i in range(state.num_nodes) if not bool(mask[i])]
+    if len(cand) < 2:
+        pytest.skip("not enough candidates")
+    keys = jax.random.split(jax.random.PRNGKey(0), 300)
+    picks = jax.vmap(
+        lambda k: choose_node(
+            jnp.asarray(POLICY_IDS["random"]), state, graph, jnp.asarray(0), mask, k
+        )
+    )(keys)
+    picks = np.asarray(picks)
+    counts = {i: int((picks == i).sum()) for i in set(picks.tolist())}
+    assert set(counts) == set(cand)  # only candidates, never hazard nodes
+    # roughly uniform: every candidate gets at least half its fair share
+    for c in cand:
+        assert counts[c] > 300 / len(cand) / 2
+
+
+def test_choose_node_all_hazard_returns_minus_one(wm):
+    state = random_state(0)
+    graph = wm.comm_graph()
+    all_hazard = jnp.ones((state.num_nodes,), bool)
+    got = choose_node(
+        jnp.asarray(POLICY_IDS["spread"]),
+        state, graph, jnp.asarray(0), all_hazard, jax.random.PRNGKey(0),
+    )
+    assert int(got) == -1
+
+
+def test_lex_argmax_tiebreaks():
+    mask = jnp.ones((4,), bool)
+    k1 = jnp.asarray([1.0, 2.0, 2.0, 0.0])
+    k2 = jnp.asarray([9.0, 1.0, 5.0, 9.0])
+    assert int(lex_argmax([k1, k2], mask)) == 2
+    assert int(lex_argmax([k1], mask)) == 1  # first max wins
+    assert int(lex_argmax([k1], jnp.zeros((4,), bool))) == -1

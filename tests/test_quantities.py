@@ -72,3 +72,18 @@ class TestFormat:
     def test_bytes_as_mi(self):
         assert format_bytes_as_mi(536 * 1024**2) == "536Mi"
         assert format_bytes_as_mi(1024**2 + 524288) == "2Mi"  # rounds
+
+
+class TestMetricsServerQuirks:
+    """metrics-server can emit sub-byte memory quantities (e.g. '3988799488m'
+    millibytes); these must parse instead of crashing the live adapter."""
+
+    def test_millibytes(self):
+        assert mem_to_bytes("3988799488m") == 3988799
+        assert mem_to_bytes("100m") == 0
+
+    def test_microbytes(self):
+        assert mem_to_bytes("5000000u") == 5
+
+    def test_nanobytes(self):
+        assert mem_to_bytes("2000000000n") == 2

@@ -1,0 +1,148 @@
+"""The scanned round loop vs an oracle-driven loop with identical (fixed)
+semantics, plus behavioral checks on the reference's own scenario."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_rescheduling_tpu.core.state import UNASSIGNED
+from kubernetes_rescheduling_tpu.core.topology import mubench_scenario, state_from_workmodel
+from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+from kubernetes_rescheduling_tpu import oracle
+from kubernetes_rescheduling_tpu.objectives import communication_cost
+from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.solver import run_rounds
+
+
+def oracle_loop(state, graph, relation, policy, rounds, threshold=30.0):
+    """Reference-semantics loop in numpy/dict world (same deliberate fixes
+    as solver.round_loop: real snapshot edit, skip instead of crash)."""
+    trace = []
+    for _ in range(rounds):
+        snap = oracle.to_snapshot(state, graph)
+        most, hazard = oracle.detection(snap, threshold)
+        if not most:
+            trace.append(None)
+            continue
+        victim = oracle.pick_max_pod(snap, most)
+        if victim is None:
+            trace.append(None)
+            continue
+        svc = victim.service
+        svc_idx = graph.names.index(svc)
+        group = np.asarray(state.pod_valid) & (
+            np.asarray(state.pod_service) == svc_idx
+        )
+        removed = state.replace(
+            pod_node=jnp.where(jnp.asarray(group), UNASSIGNED, state.pod_node)
+        )
+        snap2 = oracle.to_snapshot(removed, graph)
+        if len(hazard) == len(snap.nodes_name):
+            trace.append(None)
+            continue
+        if policy == "spread":
+            target = oracle.choose_spread(snap2, hazard)
+        elif policy == "binpack":
+            target = oracle.choose_binpack(snap2, hazard)
+        elif policy == "kubescheduling":
+            target = oracle.choose_kubescheduling(snap2, hazard)
+        elif policy == "communication":
+            target = oracle.choose_communication(snap2, relation, svc, hazard)
+        else:
+            raise ValueError(policy)
+        t_idx = state.node_names.index(target)
+        state = removed.replace(
+            pod_node=jnp.where(jnp.asarray(group), t_idx, removed.pod_node)
+        )
+        trace.append((most, victim.index, svc, target))
+    return state, trace
+
+
+@pytest.mark.parametrize("policy", ["spread", "binpack", "kubescheduling", "communication"])
+def test_round_loop_matches_oracle(policy):
+    wm = mubench_workmodel_c()
+    scn = mubench_scenario(imbalanced=True)
+    rounds = 6
+    final, tel = run_rounds(
+        scn.state,
+        scn.graph,
+        jnp.asarray(POLICY_IDS[policy]),
+        jax.random.PRNGKey(0),
+        rounds=rounds,
+    )
+    exp_final, exp_trace = oracle_loop(
+        scn.state, scn.graph, wm.relation(), policy, rounds
+    )
+    np.testing.assert_array_equal(
+        np.asarray(final.pod_node), np.asarray(exp_final.pod_node)
+    )
+    # telemetry matches the oracle trace step for step
+    for r, step in enumerate(exp_trace):
+        if step is None:
+            assert not bool(tel.moved[r])
+        else:
+            most, victim_idx, svc, target = step
+            assert bool(tel.moved[r])
+            assert scn.state.node_names[int(tel.most_hazard[r])] == most
+            assert int(tel.victim[r]) == victim_idx
+            assert scn.graph.names[int(tel.service[r])] == svc
+            assert scn.state.node_names[int(tel.target[r])] == target
+
+
+def test_car_reduces_comm_cost_from_random_start():
+    wm = mubench_workmodel_c()
+    state = state_from_workmodel(wm, seed=7, node_cpu_cap_m=2000.0)
+    graph = wm.comm_graph()
+    before = float(communication_cost(state, graph))
+    final, tel = run_rounds(
+        state, graph, jnp.asarray(POLICY_IDS["communication"]),
+        jax.random.PRNGKey(0), rounds=10,
+    )
+    after = float(communication_cost(final, graph))
+    assert bool(tel.moved.any())
+    assert after <= before
+
+
+def test_stable_cluster_is_noop():
+    # Big caps -> no node over 30% -> all rounds no-op (reference main.py:109-112)
+    wm = mubench_workmodel_c()
+    state = state_from_workmodel(wm, seed=1, node_cpu_cap_m=1e6)
+    graph = wm.comm_graph()
+    final, tel = run_rounds(
+        state, graph, jnp.asarray(POLICY_IDS["communication"]),
+        jax.random.PRNGKey(0), rounds=5,
+    )
+    assert not bool(tel.moved.any())
+    np.testing.assert_array_equal(
+        np.asarray(final.pod_node), np.asarray(state.pod_node)
+    )
+
+
+def test_all_hazard_skips_moves():
+    # tiny caps -> every node hazardous -> skip, deployments kept
+    wm = mubench_workmodel_c()
+    state = state_from_workmodel(wm, seed=1, node_cpu_cap_m=300.0)
+    graph = wm.comm_graph()
+    final, tel = run_rounds(
+        state, graph, jnp.asarray(POLICY_IDS["spread"]),
+        jax.random.PRNGKey(0), rounds=3,
+    )
+    assert not bool(tel.moved.any())
+    assert int(np.asarray(final.pod_valid).sum()) == int(
+        np.asarray(state.pod_valid).sum()
+    )
+
+
+def test_random_policy_runs_and_respects_hazard():
+    scn = mubench_scenario(imbalanced=True)
+    final, tel = run_rounds(
+        scn.state, scn.graph, jnp.asarray(POLICY_IDS["random"]),
+        jax.random.PRNGKey(42), rounds=10,
+    )
+    moved_rounds = np.asarray(tel.moved)
+    hazard_nodes = np.asarray(tel.most_hazard)
+    targets = np.asarray(tel.target)
+    for r in range(10):
+        if moved_rounds[r]:
+            assert targets[r] != hazard_nodes[r]

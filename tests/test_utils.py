@@ -1,0 +1,72 @@
+"""Logging, profiling, checkpoint/resume."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.core.topology import mubench_scenario
+from kubernetes_rescheduling_tpu.utils import (
+    CheckpointManager,
+    LatencyHistogram,
+    StructuredLogger,
+    Timer,
+    load_state,
+    save_state,
+    trace_to,
+)
+
+
+def test_structured_logger(tmp_path):
+    log = StructuredLogger(name="t", path=tmp_path / "log.jsonl", level="info")
+    log.debug("hidden")          # below level
+    log.info("round", n=1, cost=3.5)
+    log.error("boom", reason="x")
+    lines = [json.loads(l) for l in (tmp_path / "log.jsonl").read_text().splitlines()]
+    assert [l["event"] for l in lines] == ["round", "boom"]
+    assert lines[0]["cost"] == 3.5
+    assert len(log.records) == 2
+
+
+def test_timer_and_histogram():
+    with Timer() as t:
+        sum(range(1000))
+    assert t.elapsed_s > 0
+    h = LatencyHistogram()
+    assert h.summary() == {"count": 0}
+    for v in [0.01, 0.02, 0.03]:
+        h.add(v)
+    s = h.summary()
+    assert s["count"] == 3
+    assert s["mean_ms"] == pytest.approx(20.0)
+    assert s["decisions_per_sec"] == pytest.approx(50.0)
+
+
+def test_trace_to_noop():
+    with trace_to(None):
+        pass  # must not require jax.profiler
+
+
+def test_state_roundtrip(tmp_path):
+    scn = mubench_scenario()
+    save_state(scn.state, tmp_path / "ckpt", extra={"round": 3})
+    state, extra = load_state(tmp_path / "ckpt")
+    assert extra["round"] == 3
+    np.testing.assert_array_equal(
+        np.asarray(state.pod_node), np.asarray(scn.state.pod_node)
+    )
+    assert state.node_names == scn.state.node_names
+    # derived metrics still work on the restored state
+    assert float(state.node_cpu_pct().sum()) >= 0
+
+
+def test_checkpoint_manager_resume_and_gc(tmp_path):
+    scn = mubench_scenario()
+    mgr = CheckpointManager(tmp_path, keep=3)
+    assert mgr.latest() is None
+    for r in range(1, 8):
+        mgr.save(r, scn.state, extra={"cost": float(r)})
+    r, state, extra = mgr.latest()
+    assert r == 7
+    assert extra["cost"] == 7.0
+    assert len(list(tmp_path.glob("round_*.npz"))) == 3  # gc kept last 3

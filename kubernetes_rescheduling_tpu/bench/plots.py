@@ -1,0 +1,102 @@
+"""Result charts — the reference publishes three normalized bar charts
+(result/*.png: node CPU-std, communication cost, response time; SURVEY.md §6)
+but not the script that made them. This module regenerates all three from a
+harness ``summary.json``, with the same normalizations:
+
+- node CPU-std:        Before = 1.0   (reference result/Node standard.png)
+- communication cost:  spread = 1.0   (reference result/communication cost.png)
+- response time:       Before = 1.0   (reference result/responsetime.png)
+
+Design: one measure across algorithms → single-series bars, one neutral hue
+with the CAR/global bars accented, direct value labels, no legend (the title
+names the single series), light grid behind thin bars.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+_BAR = "#9aa5b1"      # neutral series hue
+_ACCENT = "#4269d0"   # the subject policies (communication/global)
+_INK = "#2b2f36"
+
+
+def _plot_bar(ax, labels, values, title, accent_on=("communication", "global")):
+    import matplotlib
+
+    xs = np.arange(len(labels))
+    colors = [_ACCENT if l in accent_on else _BAR for l in labels]
+    ax.bar(xs, values, width=0.62, color=colors, zorder=2)
+    for x, v in zip(xs, values):
+        ax.text(x, v, f"{v:.2f}", ha="center", va="bottom", fontsize=9, color=_INK)
+    ax.set_xticks(xs, labels, rotation=20, ha="right", fontsize=9)
+    ax.set_title(title, fontsize=11, color=_INK, loc="left")
+    ax.grid(axis="y", color="#e3e6ea", linewidth=0.8, zorder=0)
+    ax.spines[["top", "right"]].set_visible(False)
+    ax.tick_params(colors=_INK)
+    ax.margins(y=0.15)
+
+
+def plot_summary(summary: dict | str | Path, out_dir: str | Path) -> list[Path]:
+    """Write the three normalized charts from a harness summary.
+
+    Accepts the summary dict or a path to ``summary.json``. Returns the
+    written file paths.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if not isinstance(summary, dict):
+        summary = json.loads(Path(summary).read_text())
+
+    runs = summary["runs"]
+    algos = list(dict.fromkeys(r["algorithm"] for r in runs))
+
+    def mean(algo, phase, metric):
+        vals = [r[phase][metric] for r in runs if r["algorithm"] == algo]
+        return float(np.mean(vals)) if vals else float("nan")
+
+    before_std = float(np.mean([r["before"]["load_std"] for r in runs]))
+    before_rt = float(np.mean([r["before"]["response_time_ms"] for r in runs]))
+    spread_cost = mean("spread", "after", "communication_cost") if "spread" in algos else 1.0
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    written: list[Path] = []
+
+    charts = [
+        (
+            "node_standard.png",
+            "Node CPU-usage std-dev (Before = 1.0, lower is better)",
+            [mean(a, "after", "load_std") / before_std if before_std else 0 for a in algos],
+        ),
+        (
+            "communication_cost.png",
+            "Communication cost (spread = 1.0, lower is better)",
+            [
+                mean(a, "after", "communication_cost") / spread_cost
+                if spread_cost
+                else 0
+                for a in algos
+            ],
+        ),
+        (
+            "responsetime.png",
+            "Avg response time (Before = 1.0, lower is better)",
+            [mean(a, "after", "response_time_ms") / before_rt if before_rt else 0 for a in algos],
+        ),
+    ]
+    for fname, title, values in charts:
+        fig, ax = plt.subplots(figsize=(6.4, 3.6), dpi=120)
+        _plot_bar(ax, algos, values, title)
+        fig.tight_layout()
+        path = out / fname
+        fig.savefig(path)
+        plt.close(fig)
+        written.append(path)
+    return written

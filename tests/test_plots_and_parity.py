@@ -1,0 +1,54 @@
+"""Chart regeneration + qualitative parity with the reference's headline
+result: CAR (communication) achieves the lowest communication cost and
+response time across the policy matrix (SURVEY.md §6)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.bench.harness import ExperimentConfig, run_experiment
+from kubernetes_rescheduling_tpu.bench.plots import plot_summary
+
+
+@pytest.fixture(scope="module")
+def matrix_summary(tmp_path_factory):
+    out = tmp_path_factory.mktemp("matrix")
+    cfg = ExperimentConfig(
+        algorithms=("spread", "binpack", "random", "kubescheduling", "communication"),
+        repeats=3,
+        rounds=10,
+        scenario="mubench",
+        out_dir=str(out),
+        seed=11,
+    )
+    return run_experiment(cfg)
+
+
+def test_plot_summary_writes_three_charts(matrix_summary, tmp_path):
+    written = plot_summary(matrix_summary, tmp_path)
+    names = sorted(p.name for p in written)
+    assert names == [
+        "communication_cost.png",
+        "node_standard.png",
+        "responsetime.png",
+    ]
+    for p in written:
+        assert p.stat().st_size > 5_000  # a real rendered image
+
+
+def test_car_wins_comm_cost_and_response_time(matrix_summary):
+    agg = matrix_summary["aggregate"]
+    car_cost = agg["communication"]["communication_cost"]
+    car_rt = agg["communication"]["response_time_ms"]
+    for algo in ("spread", "binpack", "random", "kubescheduling"):
+        assert car_cost <= agg[algo]["communication_cost"] + 1e-6, (
+            f"CAR comm cost {car_cost} worse than {algo}: {agg[algo]}"
+        )
+    assert car_rt == min(a["response_time_ms"] for a in agg.values())
+
+
+def test_rescheduling_improves_over_before(matrix_summary):
+    # every policy should reduce response time vs the imbalanced Before state
+    runs = matrix_summary["runs"]
+    before_rt = np.mean([r["before"]["response_time_ms"] for r in runs])
+    car_rt = matrix_summary["aggregate"]["communication"]["response_time_ms"]
+    assert car_rt <= before_rt

@@ -27,7 +27,7 @@ import numpy as np
 
 from kubernetes_rescheduling_tpu.backends.base import MoveRequest
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph, UNASSIGNED
-from kubernetes_rescheduling_tpu.core.workmodel import Workmodel
+from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, kahn_traversal
 
 
 @dataclass
@@ -39,49 +39,33 @@ class LoadModel:
     cost_per_req_m: float = 2.0       # millicores per request/s (cpu_stress, workmodelC.json)
     idle_m: float = 20.0              # baseline per-pod usage
     noise_frac: float = 0.0           # gaussian noise on per-pod usage
+    # probability a request to a service calls each callee. µBench calls every
+    # callee every time (=1.0, workmodelC.json external_services); synthetic
+    # multi-parent meshes need <1 or path-count multiplication saturates
+    # every node (each of k parents forwards the full upstream rate)
+    fanout_frac: float = 1.0
 
     def service_rps(self, wm: Workmodel) -> dict[str, float]:
         """Propagate entry rps through the directed call graph: each request
         to a service triggers one request to each of its callees.
 
-        Processed in topological (Kahn) order so every upstream contribution
-        has accumulated before a service's outgoing edges fire — a BFS with
-        visit-once edges understates load on any multi-parent call graph.
-        Edges that close a cycle are dropped (visit-once on the *node* at
-        pop time), bounding flow in cyclic meshes.
+        Edges come from the shared cycle-broken traversal
+        (``core.workmodel.kahn_traversal`` — also used by the request-level
+        load generator, so CPU load and latency agree on which edges exist);
+        processing in its topological order means every upstream contribution
+        accumulates before a service's outgoing edges fire.
         """
         rps = {name: 0.0 for name in wm.names}
         if self.entry_service not in rps:
             return rps
         rps[self.entry_service] = self.entry_rps
-        callees = wm.directed_relation()
-        indeg = {name: 0 for name in wm.names}
-        for src, dsts in callees.items():
-            for d in dsts:
-                if d in indeg:
-                    indeg[d] += 1
-        ready = [n for n in wm.names if indeg[n] == 0]
-        done: set[str] = set()
-        while ready:
-            svc = ready.pop()
-            if svc in done:
-                continue
-            done.add(svc)
-            for callee in callees.get(svc, []):
-                if callee not in indeg or callee in done:
-                    continue  # cycle-closing edge: drop
-                rps[callee] += rps[svc]
-                indeg[callee] -= 1
-                if indeg[callee] == 0:
-                    ready.append(callee)
-        # cyclic remainder (indeg never hit 0): process in name order once
-        for svc in wm.names:
-            if svc in done:
-                continue
-            done.add(svc)
-            for callee in callees.get(svc, []):
-                if callee in indeg and callee not in done:
-                    rps[callee] += rps[svc]
+        order, edges = kahn_traversal(wm.directed_relation(), wm.names)
+        out_edges: dict[str, list[str]] = {}
+        for s, d in edges:
+            out_edges.setdefault(s, []).append(d)
+        for svc in order:
+            for callee in out_edges.get(svc, ()):
+                rps[callee] += rps[svc] * self.fanout_frac
         return rps
 
 

@@ -4,13 +4,21 @@
   ``solver.run_rounds``), with decision-latency measurement.
 - ``sinks`` — CSV metric files compatible with the reference's
   ``node_std.csv`` / ``communication_cost.csv`` plus structured JSONL.
+- ``loadgen`` — request-level load generation: the reference's curl fleet
+  (release1.sh/release2.sh) as a vectorized on-device simulation with
+  success/error counts and latency percentiles.
 - ``harness`` — the algorithm × repeat experiment matrix with per-session
   result directories (reference auto_full_pipeline_repeat.sh).
 """
 
 from kubernetes_rescheduling_tpu.bench.controller import ControllerResult, run_controller
-from kubernetes_rescheduling_tpu.bench.sinks import CsvSink, JsonlSink
 from kubernetes_rescheduling_tpu.bench.harness import ExperimentConfig, run_experiment
+from kubernetes_rescheduling_tpu.bench.loadgen import (
+    LoadGenConfig,
+    LoadGenerator,
+    RequestStats,
+)
+from kubernetes_rescheduling_tpu.bench.sinks import CsvSink, JsonlSink
 
 __all__ = [
     "ControllerResult",
@@ -19,4 +27,7 @@ __all__ = [
     "JsonlSink",
     "ExperimentConfig",
     "run_experiment",
+    "LoadGenConfig",
+    "LoadGenerator",
+    "RequestStats",
 ]

@@ -42,6 +42,7 @@ class RoundRecord:
     communication_cost: float
     load_std: float
     decision_latency_s: float  # device-side decision time (no cluster I/O)
+    services_moved: tuple[str, ...] = ()  # every Deployment recreated this round
 
 
 @dataclass
@@ -68,8 +69,15 @@ def run_controller(
     config: RescheduleConfig,
     *,
     key: jax.Array | None = None,
+    on_round=None,
 ) -> ControllerResult:
-    """Run ``config.max_rounds`` rounds against a backend."""
+    """Run ``config.max_rounds`` rounds against a backend.
+
+    ``on_round(record, state)`` — if given — is called after each round with
+    the completed record and the post-move snapshot; the harness uses it to
+    sustain simulated request load while the loop runs (reference
+    release2.sh:50-59) and for per-round checkpointing.
+    """
     config = config.validate()
     key = key if key is not None else jax.random.PRNGKey(config.seed)
     graph = backend.comm_graph()
@@ -91,6 +99,8 @@ def run_controller(
         record.communication_cost = float(communication_cost(state, graph))
         record.load_std = float(load_std(state))
         result.rounds.append(record)
+        if on_round is not None:
+            on_round(record, state)
     return result
 
 
@@ -129,6 +139,7 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         communication_cost=0.0,  # filled by run_controller from the post-move snapshot
         load_std=0.0,
         decision_latency_s=latency,
+        services_moved=(service_name,) if moved else (),
     )
 
 
@@ -147,6 +158,7 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
     valid = np.asarray(state.pod_valid)
     svc_arr = np.asarray(state.pod_service)
     moved_any = False
+    moved_names: list[str] = []
     seen: set[int] = set()
     for i in np.flatnonzero(valid & (old_nodes != new_nodes)):
         s = int(svc_arr[i])
@@ -161,6 +173,8 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
             )
         )
         moved_any = moved_any or ok
+        if ok:
+            moved_names.append(graph.names[s])
     return RoundRecord(
         round=rnd,
         moved=moved_any,
@@ -170,4 +184,5 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         communication_cost=0.0,  # filled by run_controller from the post-move snapshot
         load_std=0.0,
         decision_latency_s=latency,
+        services_moved=tuple(moved_names),
     )

@@ -10,14 +10,18 @@ in ``<out>/session_<ts>/<algo>/run_<n>/`` (reference
 auto_full_pipeline_repeat.sh:13-16, 32-45) with the reference's CSV schemas
 plus structured JSONL and a machine-readable ``summary.json``.
 
-Response time is modeled, not curl-measured: every cross-node call edge pays
-a network penalty and overloaded nodes pay a queueing penalty — the two
-effects the reference's experiments attribute response-time differences to
-(README.md:55-59).
+Response time is *measured from simulated requests*, not modeled with
+constants: a request-level load generator (``bench.loadgen``) replays the
+reference's curl fleet against each placement — phase r1 before rescheduling
+(release1.sh), phase r2 sustained while the control loop runs with teardown
+outages per move (release2.sh:50-59), phase r3 after — yielding
+success/error counts, min/avg/max latency, and a restart/disruption total,
+the same stat block the reference aggregates (release1.sh:74-117).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import time
 from dataclasses import dataclass, field
@@ -28,13 +32,18 @@ import numpy as np
 
 from kubernetes_rescheduling_tpu.backends.sim import LoadModel, SimBackend
 from kubernetes_rescheduling_tpu.bench.controller import run_controller
+from kubernetes_rescheduling_tpu.bench.loadgen import (
+    LoadGenConfig,
+    LoadGenerator,
+    RequestStats,
+    new_samples,
+)
 from kubernetes_rescheduling_tpu.bench.sinks import (
     JsonlSink,
     communication_cost_sink,
     node_std_sink,
 )
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
-from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 from kubernetes_rescheduling_tpu.core.topology import _random_workmodel
 from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, mubench_workmodel_c
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
@@ -57,35 +66,12 @@ class ExperimentConfig:
     seed: int = 0
     hazard_threshold_pct: float = 30.0
     inject_imbalance: bool = True      # the cordon trick
-
-
-# response-time model constants (documented, not measured)
-_RESP_BASE_MS = 20.0   # in-node call path
-_RESP_NET_MS = 25.0    # added per fully-remote call graph
-_RESP_QUEUE_MS = 30.0  # M/M/1 queueing coefficient
-_RHO_CAP = 0.95
-
-
-def modeled_response_time_ms(state: ClusterState, graph: CommGraph) -> float:
-    """base + net·(cross-node edge fraction) + queueing.
-
-    Queueing is M/M/1-shaped — ρ/(1−ρ) of each pod's node, pod-weighted — so
-    piling every pod on one node (the reference's cordon-induced 'Before'
-    state) is penalized well before 100% utilization, matching the
-    experiment's observed Before-is-worst response times (SURVEY.md §6).
-    """
-    adj = np.asarray(graph.adj)
-    valid = np.asarray(graph.service_valid)
-    total_edges = adj[valid][:, valid].sum() / 2
-    cost = float(communication_cost(state, graph))
-    cross_frac = cost / total_edges if total_edges else 0.0
-    rho = np.clip(np.asarray(state.node_cpu_pct()) / 100.0, 0.0, _RHO_CAP)
-    queue_by_node = rho / (1.0 - rho)
-    pod_valid = np.asarray(state.pod_valid)
-    pod_node = np.asarray(state.pod_node)
-    placed = pod_valid & (pod_node >= 0)
-    queue = float(queue_by_node[pod_node[placed]].mean()) if placed.any() else 0.0
-    return _RESP_BASE_MS + _RESP_NET_MS * cross_frac + _RESP_QUEUE_MS * queue
+    pacing_s: float = 15.0             # simulated seconds per round (main.py:27)
+    load: LoadGenConfig = field(default_factory=LoadGenConfig)
+    # λ for the global solver: comm-cost edges traded per load-std point.
+    # 0 would let the solver "win" by keeping the Before pile-up intact
+    # (comm cost 0, load std terrible) — never what an operator wants.
+    balance_weight: float = 0.5
 
 
 def make_backend(scenario: str, seed: int) -> SimBackend:
@@ -98,8 +84,15 @@ def make_backend(scenario: str, seed: int) -> SimBackend:
             node_names=["worker1", "worker2", "worker3"],
             node_cpu_cap_m=20_000.0,
             seed=seed,
-            load=LoadModel(entry_rps=100.0, cost_per_req_m=4.0, idle_m=50.0),
+            # sized so the cordon-induced "Before" pile-up drives worker1 to
+            # ~85% CPU — the saturation regime the reference's ~1000
+            # concurrent clients create (release1.sh:9), where queueing
+            # dominates response time until pods spread out
+            load=LoadModel(entry_rps=100.0, cost_per_req_m=8.0, idle_m=50.0),
         )
+    # synthetic meshes: fanout_frac ≈ 1/(mean forward out-degree) keeps the
+    # expected request branching factor at ~1, so the entry rate neither
+    # dies out nor multiplies combinatorially through multi-parent DAGs
     if scenario == "dense":
         wm = _random_workmodel(200, rng, powerlaw=False, mean_degree=8.0)
         return SimBackend(
@@ -107,6 +100,9 @@ def make_backend(scenario: str, seed: int) -> SimBackend:
             node_names=[f"worker{i:04d}" for i in range(20)],
             node_cpu_cap_m=20_000.0,
             seed=seed,
+            # idle sized so the injected pile-up (200 pods on one node)
+            # crosses the 30% hazard threshold and the loop has work to do
+            load=LoadModel(idle_m=40.0, cost_per_req_m=5.0, fanout_frac=0.25),
         )
     if scenario == "powerlaw":
         wm = _random_workmodel(2000, rng, powerlaw=True, mean_degree=4.0)
@@ -115,6 +111,7 @@ def make_backend(scenario: str, seed: int) -> SimBackend:
             node_names=[f"worker{i:04d}" for i in range(200)],
             node_cpu_cap_m=20_000.0,
             seed=seed,
+            load=LoadModel(fanout_frac=0.5),
         )
     if scenario == "large":
         wm = _random_workmodel(10_000, rng, powerlaw=True, mean_degree=4.0)
@@ -123,7 +120,9 @@ def make_backend(scenario: str, seed: int) -> SimBackend:
             node_names=[f"worker{i:04d}" for i in range(1000)],
             node_cpu_cap_m=2_000.0,
             seed=seed,
-            load=LoadModel(entry_rps=10.0, cost_per_req_m=0.1, idle_m=50.0),
+            load=LoadModel(
+                entry_rps=10.0, cost_per_req_m=0.1, idle_m=50.0, fanout_frac=0.5
+            ),
         )
     raise ValueError(f"unknown scenario {scenario!r}")
 
@@ -131,7 +130,7 @@ def make_backend(scenario: str, seed: int) -> SimBackend:
 def run_experiment(cfg: ExperimentConfig) -> dict:
     """Run the full matrix; returns (and writes) the summary."""
     session = Path(cfg.out_dir) / f"session_{time.strftime('%Y%m%d_%H%M%S')}"
-    summary: dict = {"config": cfg.__dict__ | {"algorithms": list(cfg.algorithms)}, "runs": []}
+    summary: dict = {"config": dataclasses.asdict(cfg), "runs": []}
 
     for algo in cfg.algorithms:
         for run_i in range(1, cfg.repeats + 1):
@@ -143,37 +142,89 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                 backend.inject_imbalance(backend.node_names[0])
 
             graph = backend.comm_graph()
+            # the request stream must sample the same call tree the CPU-load
+            # model propagates: copy the backend's per-edge call probability
+            lcfg = dataclasses.replace(cfg.load, fanout_frac=backend.load.fanout_frac)
+            loadgen = LoadGenerator(backend.workmodel, lcfg)
+            key = jax.random.PRNGKey(seed)
+            key, k_before, k_during, k_after = jax.random.split(key, 4)
             std_sink = node_std_sink(run_dir)
             cost_sink = communication_cost_sink(run_dir)
             rounds_sink = JsonlSink(run_dir / "rounds.jsonl")
 
+            # phase r1: load against the imbalanced "Before" placement
             before = backend.monitor()
+            load_before = loadgen.measure(before, k_before)
             before_metrics = {
                 "communication_cost": float(communication_cost(before, graph)),
                 "load_std": float(load_std(before)),
-                "response_time_ms": modeled_response_time_ms(before, graph),
+                "response_time_ms": load_before.latency_avg_ms,
             }
             std_sink.append(before_metrics["load_std"])
 
+            # phase r2: the control loop under sustained load — per round,
+            # simulate the segment's requests with teardown outages for every
+            # Deployment moved that round (reference release2.sh:50-59)
             rcfg = RescheduleConfig(
                 algorithm=algo,
                 max_rounds=cfg.rounds,
                 hazard_threshold_pct=cfg.hazard_threshold_pct,
-                sleep_after_action_s=0.0,  # simulated pacing only
+                sleep_after_action_s=cfg.pacing_s,  # simulated clock, not wall
+                balance_weight=cfg.balance_weight,
                 seed=seed,
             )
+            during = new_samples()
+            reconcile = getattr(backend, "reconcile_delay_s", 0.0)
+            seg_state = {"clock": backend.clock_s, "i": 0}
+
+            def on_round(rec, state, _ss=seg_state, _backend=backend, _during=during):
+                seg_dur = max(_backend.clock_s - _ss["clock"], 1e-9)
+                _ss["clock"] = _backend.clock_s
+                n_req = max(
+                    int(
+                        cfg.load.requests_per_phase
+                        * seg_dur
+                        / max(cfg.load.duration_s, 1e-9)
+                    ),
+                    64,
+                )
+                outages = [
+                    (svc, i * reconcile, (i + 1) * reconcile)
+                    for i, svc in enumerate(rec.services_moved)
+                ]
+                loadgen.run(
+                    state,
+                    jax.random.fold_in(k_during, _ss["i"]),
+                    duration_s=seg_dur,
+                    n_requests=n_req,
+                    outages=outages,
+                    samples=_during,
+                )
+                _ss["i"] += 1
+
+            events_mark = len(backend.events)
             t0 = time.perf_counter()
-            result = run_controller(backend, rcfg, key=jax.random.PRNGKey(seed))
+            result = run_controller(
+                backend, rcfg, key=jax.random.PRNGKey(seed), on_round=on_round
+            )
             wall_s = time.perf_counter() - t0
+            during.restarts = sum(
+                int(e.get("pods", 0))
+                for e in backend.events[events_mark:]
+                if e.get("event") == "move"
+            )
+            load_during = during.stats()
             for rec in result.rounds:
                 std_sink.append(rec.load_std)
                 rounds_sink.append(rec.__dict__)
 
+            # phase r3: load against the final placement
             after = backend.monitor()
+            load_after = loadgen.measure(after, k_after)
             after_metrics = {
                 "communication_cost": float(communication_cost(after, graph)),
                 "load_std": float(load_std(after)),
-                "response_time_ms": modeled_response_time_ms(after, graph),
+                "response_time_ms": load_after.latency_avg_ms,
             }
             cost_sink.append(after_metrics["communication_cost"])
 
@@ -184,6 +235,11 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                     "seed": seed,
                     "before": before_metrics,
                     "after": after_metrics,
+                    "load": {
+                        "before": load_before.as_dict(),
+                        "during": load_during.as_dict(),
+                        "after": load_after.as_dict(),
+                    },
                     "moves": result.moves,
                     "decisions_per_sec": result.decisions_per_sec,
                     "wall_s": wall_s,
@@ -202,6 +258,12 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
             "load_std": float(np.mean([r["after"]["load_std"] for r in runs])),
             "response_time_ms": float(
                 np.mean([r["after"]["response_time_ms"] for r in runs])
+            ),
+            "error_rate_during": float(
+                np.mean([r["load"]["during"]["error_rate"] for r in runs])
+            ),
+            "restarts": float(
+                np.mean([r["load"]["during"]["restarts"] for r in runs])
             ),
             "decisions_per_sec": float(
                 np.mean([r["decisions_per_sec"] for r in runs])

@@ -1,0 +1,464 @@
+"""Request-level load generation.
+
+The reference validates placements with a fleet of ~1000 concurrent curl
+clients hammering the µBench entry service for 180 s, reporting success and
+error counts plus min/avg/max latency (reference release1.sh:7-10, 29-42,
+74-117) and sustaining the same load while the rescheduling loop runs
+(reference release2.sh:50-59). Round 1 replaced all of that with a
+four-constant analytic formula; this module replaces the formula with an
+actual simulated request stream, so response-time results come from
+per-request dynamics, not curve-fitting.
+
+Model
+-----
+A request enters at the entry service (µBench ``s0`` behind the NodePort,
+reference release1.sh:7) and fans out along the *directed* call graph —
+each request to a service issues one sub-request to every callee
+(workmodelC.json ``external_services`` semantics). End-to-end latency is the
+recursive sum over the call DAG::
+
+    L(s) = proc(s) · q(node(s)) + Σ_{c ∈ callees(s)} [ hop(s, c) + L(c) ]
+
+- ``proc(s)``: base service time, inflated by an M/M/1-shaped queueing
+  factor ``q = 1/(1-ρ)`` of the replica's node — overloaded nodes answer
+  slowly (the "Before" state's signature, SURVEY.md §6).
+- ``hop(s, c)``: cheap if caller and callee replicas share a node, a
+  network round-trip over the CNI if not — the quantity CAR minimizes.
+- Each request picks one replica per service uniformly at random (k8s
+  Service load balancing, simplified to one draw per request rather than
+  per sub-request — connection reuse within a request); latency also
+  carries multiplicative lognormal jitter.
+
+Errors come from two sources, mirroring the reference's counters:
+
+- **outage**: a Deployment being torn down and re-created serves nothing
+  (the reference polls up to 180 s for the 404, delete_replaced_pod.py:8-22);
+  requests that traverse it during the window fail. This is the simulated
+  analogue of the reference's container-restart accounting
+  (release1.sh:101-102) — disruption now has a visible cost.
+- **overload**: a node driven past 100% CPU drops a utilization-dependent
+  fraction of the requests it serves.
+
+TPU-first shape
+---------------
+The hot path is one jitted kernel over a fixed-size request chunk: the call
+graph is an **edge list** (``src[E]``, ``dst[E]``), latency propagation is
+``depth`` rounds of gather + scatter-add (depth = longest path in the
+cycle-broken DAG, computed host-side), and everything is batched over the
+chunk — no Python per request, no retracing across segments (shapes are
+static). The same kernel serves 20-service µBench and 10k-service synthetic
+meshes; memory is O(chunk · E), never O(S²).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, kahn_traversal
+
+
+@dataclass(frozen=True)
+class LoadGenConfig:
+    """Knobs for the simulated client fleet (reference release1.sh:7-10).
+
+    The reference's ~1000 concurrent clients show up in two places here:
+    the *offered CPU load* is the sim backend's ``LoadModel.entry_rps``,
+    and the *measurement sample* is ``requests_per_phase`` requests drawn
+    uniformly over ``duration_s``.
+    """
+
+    duration_s: float = 180.0      # load duration (release1.sh:8)
+    requests_per_phase: int = 8192 # sampled requests per measurement phase
+    chunk: int = 1024              # requests per kernel invocation (static shape)
+    entry_service: str = "s0"      # NodePort target (release1.sh:7)
+    proc_ms: float = 1.5           # base per-service processing time
+    hop_local_ms: float = 0.2      # same-node call
+    hop_remote_ms: float = 3.0     # cross-node call over the CNI
+    queue_rho_cap: float = 0.95    # ρ clamp for the 1/(1-ρ) factor
+    jitter_sigma: float = 0.15     # lognormal latency jitter
+    drop_rho: float = 1.0          # nodes past this utilization drop requests
+    max_drop_p: float = 0.95       # per-service drop probability ceiling
+    # per-edge call probability, sampled per request — must match the CPU
+    # load model's fanout (backends.sim.LoadModel.fanout_frac); the harness
+    # copies it from the backend
+    fanout_frac: float = 1.0
+
+
+@dataclass(frozen=True)
+class RequestStats:
+    """The reference's client-side stat block (release1.sh:74-117)."""
+
+    sent: int
+    ok: int
+    err_outage: int
+    err_overload: int
+    duration_s: float
+    latency_min_ms: float
+    latency_avg_ms: float
+    latency_max_ms: float
+    latency_p50_ms: float
+    latency_p95_ms: float
+    latency_p99_ms: float
+    restarts: int = 0              # pods disrupted by moves (release1.sh:101-102)
+
+    @property
+    def errors(self) -> int:
+        return self.err_outage + self.err_overload
+
+    @property
+    def error_rate(self) -> float:
+        return self.errors / self.sent if self.sent else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "sent": self.sent,
+            "ok": self.ok,
+            "errors": self.errors,
+            "err_outage": self.err_outage,
+            "err_overload": self.err_overload,
+            "error_rate": self.error_rate,
+            "duration_s": self.duration_s,
+            "latency_min_ms": self.latency_min_ms,
+            "latency_avg_ms": self.latency_avg_ms,
+            "latency_max_ms": self.latency_max_ms,
+            "latency_p50_ms": self.latency_p50_ms,
+            "latency_p95_ms": self.latency_p95_ms,
+            "latency_p99_ms": self.latency_p99_ms,
+            "restarts": self.restarts,
+        }
+
+
+@dataclass(frozen=True)
+class CallPlan:
+    """Host-side precomputation of the call DAG (static across a phase)."""
+
+    names: tuple[str, ...]
+    entry: int
+    src: np.ndarray          # i32[E] caller service index per edge
+    dst: np.ndarray          # i32[E] callee service index per edge
+    reach: np.ndarray        # bool[S] reachable from entry (cycle-broken DAG)
+    depth: int               # longest entry-reachable path, in edges
+
+    @property
+    def num_services(self) -> int:
+        return len(self.reach)
+
+
+def build_call_plan(
+    relation: Mapping[str, Sequence[str]],
+    names: Sequence[str],
+    entry_service: str,
+) -> CallPlan:
+    """Extract the cycle-broken edge list + entry reachability/depth.
+
+    Uses the shared :func:`core.workmodel.kahn_traversal`, so latency and
+    CPU-load propagation agree on which edges exist in a cyclic mesh.
+    """
+    names = tuple(names)
+    index = {n: i for i, n in enumerate(names)}
+    S = len(names)
+
+    order, name_edges = kahn_traversal(relation, names)
+    edges = [(index[s], index[d]) for s, d in name_edges]
+    src = np.asarray([e[0] for e in edges], dtype=np.int32)
+    dst = np.asarray([e[1] for e in edges], dtype=np.int32)
+
+    reach = np.zeros(S, dtype=bool)
+    depth = 0
+    if entry_service in index:
+        reach[index[entry_service]] = True
+        out_edges: dict[int, list[int]] = {}
+        for s, d in edges:
+            out_edges.setdefault(s, []).append(d)
+        # propagate reachability + longest path in topological order
+        dist = np.full(S, -1, dtype=np.int64)
+        dist[index[entry_service]] = 0
+        for svc in order:
+            i = index[svc]
+            if dist[i] < 0:
+                continue
+            for d in out_edges.get(i, ()):
+                if dist[d] < dist[i] + 1:
+                    dist[d] = dist[i] + 1
+                    reach[d] = True
+        depth = int(dist.max()) if (dist >= 0).any() else 0
+    return CallPlan(
+        names=names,
+        entry=index.get(entry_service, -1),
+        src=src,
+        dst=dst,
+        reach=reach,
+        depth=max(depth, 1),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "chunk"))
+def _request_chunk(
+    key: jax.Array,
+    src: jax.Array,            # i32[E]
+    dst: jax.Array,            # i32[E]
+    entry: jax.Array,          # i32 scalar
+    proc_ms: jax.Array,        # f32[S]
+    replica_nodes: jax.Array,  # i32[S, Rmax] node of each replica (pad = 0)
+    replica_counts: jax.Array, # i32[S] placed replicas (0 = unavailable)
+    node_rho: jax.Array,       # f32[N] utilization fraction
+    outage_frac: jax.Array,    # f32[S, 2] outage window as fractions of phase
+    cfg_vec: jax.Array,        # f32[7] local, remote, rho_cap, jitter,
+                               #        drop_rho, max_drop_p, fanout
+    *,
+    depth: int,
+    chunk: int,
+):
+    """Simulate one fixed-size chunk of requests. Returns per-request
+    ``(latency_ms, ok, err_outage, err_overload)``."""
+    local_ms, remote_ms, rho_cap, jitter, drop_rho, max_drop_p, fanout = (
+        cfg_vec[0], cfg_vec[1], cfg_vec[2], cfg_vec[3],
+        cfg_vec[4], cfg_vec[5], cfg_vec[6],
+    )
+    S = proc_ms.shape[0]
+    k_rep, k_t, k_jit, k_drop, k_edge = jax.random.split(key, 5)
+
+    # each sub-request picks a replica uniformly (k8s Service balancing)
+    u = jax.random.uniform(k_rep, (chunk, S))
+    ridx = jnp.minimum(
+        (u * jnp.maximum(replica_counts, 1)).astype(jnp.int32),
+        jnp.maximum(replica_counts - 1, 0),
+    )
+    svc_node = replica_nodes[jnp.arange(S)[None, :], ridx]  # i32[chunk, S]
+
+    # sample this request's call tree: each kept edge fires with p = fanout
+    E = src.shape[0]
+    active = jax.random.uniform(k_edge, (chunk, E)) < fanout  # bool[chunk, E]
+
+    # queue-inflated processing time per (request, service)
+    rho = jnp.clip(node_rho, 0.0, rho_cap)
+    q = 1.0 / (1.0 - rho)                                # f32[N]
+    proc_q = proc_ms[None, :] * q[svc_node]              # f32[chunk, S]
+
+    # per-edge hop cost: local if caller/callee replicas share a node
+    n_src = svc_node[:, src]                             # [chunk, E]
+    n_dst = svc_node[:, dst]
+    hop = jnp.where(n_src == n_dst, local_ms, remote_ms)
+    af = active.astype(proc_q.dtype)
+
+    # latency: depth rounds of L = proc·q + scatter-add of active sub-calls
+    def lat_step(lat, _):
+        lat = proc_q.at[:, src].add(af * (hop + lat[:, dst]))
+        return lat, None
+
+    lat, _ = jax.lax.scan(lat_step, proc_q, None, length=depth)
+    latency = lat[:, entry]
+    latency = latency * jnp.exp(
+        jitter * jax.random.normal(k_jit, (chunk,))
+    )
+
+    # which services this request's sampled call tree actually visits
+    entry_visit = jnp.zeros((chunk, S), bool).at[:, entry].set(True)
+
+    def visit_step(v, _):
+        v = entry_visit.at[:, dst].max(active & v[:, src])
+        return v, None
+
+    visited, _ = jax.lax.scan(visit_step, entry_visit, None, length=depth)
+
+    # outage: arrival time falls inside a visited service's teardown window
+    t = jax.random.uniform(k_t, (chunk,))                # phase-fraction arrivals
+    down = (t[:, None] >= outage_frac[None, :, 0]) & (t[:, None] < outage_frac[None, :, 1])
+    unavailable = replica_counts[None, :] == 0
+    err_outage = jnp.any(visited & (down | unavailable), axis=1)
+
+    # overload: each visited service on a >drop_rho node drops requests
+    rho_at = node_rho[svc_node]                          # [chunk, S]
+    p_drop = jnp.clip(1.0 - drop_rho / jnp.maximum(rho_at, 1e-6), 0.0, max_drop_p)
+    p_drop = jnp.where(visited, p_drop, 0.0)
+    log_survive = jnp.sum(jnp.log1p(-p_drop), axis=1)
+    survive = jnp.exp(log_survive)
+    err_overload = (~err_outage) & (
+        jax.random.uniform(k_drop, (chunk,)) > survive
+    )
+
+    ok = ~(err_outage | err_overload)
+    return latency, ok, err_outage, err_overload
+
+
+@dataclass
+class _Samples:
+    """Accumulated per-request outcomes across chunks/segments."""
+
+    latencies: list[np.ndarray] = field(default_factory=list)
+    sent: int = 0
+    err_outage: int = 0
+    err_overload: int = 0
+    sim_s: float = 0.0
+    restarts: int = 0
+
+    def extend(self, latency, ok, e_out, e_over, n: int) -> None:
+        lat = np.asarray(latency[:n])
+        okm = np.asarray(ok[:n])
+        self.latencies.append(lat[okm])
+        self.sent += n
+        self.err_outage += int(np.asarray(e_out[:n]).sum())
+        self.err_overload += int(np.asarray(e_over[:n]).sum())
+
+    def stats(self) -> RequestStats:
+        lat = (
+            np.concatenate(self.latencies)
+            if self.latencies
+            else np.zeros(0, dtype=np.float32)
+        )
+        have = lat.size > 0
+        return RequestStats(
+            sent=self.sent,
+            ok=int(lat.size),
+            err_outage=self.err_outage,
+            err_overload=self.err_overload,
+            duration_s=self.sim_s,
+            latency_min_ms=float(lat.min()) if have else 0.0,
+            latency_avg_ms=float(lat.mean()) if have else 0.0,
+            latency_max_ms=float(lat.max()) if have else 0.0,
+            latency_p50_ms=float(np.percentile(lat, 50)) if have else 0.0,
+            latency_p95_ms=float(np.percentile(lat, 95)) if have else 0.0,
+            latency_p99_ms=float(np.percentile(lat, 99)) if have else 0.0,
+            restarts=self.restarts,
+        )
+
+
+class LoadGenerator:
+    """Simulated client fleet over a workmodel + placements.
+
+    Reusable across phases and segments: the call plan and kernel compile
+    once per (workmodel, chunk) pair; each :meth:`run` re-binds placement,
+    utilization, and outage windows (cheap device transfers).
+    """
+
+    def __init__(self, workmodel: Workmodel, cfg: LoadGenConfig | None = None):
+        self.cfg = cfg or LoadGenConfig()
+        self.workmodel = workmodel
+        names = workmodel.names
+        self.plan = build_call_plan(
+            workmodel.directed_relation(), names, self.cfg.entry_service
+        )
+        self._svc_index = {n: i for i, n in enumerate(names)}
+        c = self.cfg
+        self._cfg_vec = jnp.asarray(
+            [c.hop_local_ms, c.hop_remote_ms, c.queue_rho_cap,
+             c.jitter_sigma, c.drop_rho, c.max_drop_p, c.fanout_frac],
+            jnp.float32,
+        )
+        # static across phases/segments: ship to device once
+        self._src = jnp.asarray(self.plan.src)
+        self._dst = jnp.asarray(self.plan.dst)
+        self._entry = jnp.asarray(self.plan.entry, jnp.int32)
+        self._proc_ms = jnp.full((self.plan.num_services,), c.proc_ms, jnp.float32)
+
+    def _placement_arrays(self, state: ClusterState):
+        """Per-service replica→node tables from a cluster snapshot."""
+        S = self.plan.num_services
+        pod_svc = np.asarray(state.pod_service)
+        pod_node = np.asarray(state.pod_node)
+        valid = np.asarray(state.pod_valid) & (pod_node >= 0)
+        by_svc: list[list[int]] = [[] for _ in range(S)]
+        for i in np.flatnonzero(valid):
+            s = int(pod_svc[i])
+            if 0 <= s < S:
+                by_svc[s].append(int(pod_node[i]))
+        rmax = max(1, max((len(v) for v in by_svc), default=1))
+        nodes = np.zeros((S, rmax), dtype=np.int32)
+        counts = np.zeros(S, dtype=np.int32)
+        for s, v in enumerate(by_svc):
+            counts[s] = len(v)
+            for r, n in enumerate(v):
+                nodes[s, r] = n
+        return nodes, counts
+
+    def run(
+        self,
+        state: ClusterState,
+        key: jax.Array,
+        *,
+        duration_s: float | None = None,
+        n_requests: int | None = None,
+        outages: Sequence[tuple[str, float, float]] = (),
+        samples: _Samples | None = None,
+    ) -> _Samples:
+        """Simulate one phase/segment of load against a placement snapshot.
+
+        ``outages``: (service, start_s, end_s) windows within the phase
+        during which that service's Deployment serves nothing — at most one
+        window per service (duplicates raise rather than silently merging).
+        Pass ``samples`` to accumulate across segments (phase r2).
+        """
+        cfg = self.cfg
+        duration = cfg.duration_s if duration_s is None else duration_s
+        total = cfg.requests_per_phase if n_requests is None else n_requests
+        samples = samples if samples is not None else _Samples()
+        if total <= 0 or self.plan.entry < 0:
+            samples.sim_s += duration
+            return samples
+
+        nodes, counts = self._placement_arrays(state)
+        S = self.plan.num_services
+        outage = np.zeros((S, 2), dtype=np.float32)
+        seen_outage: set[int] = set()
+        for svc, start, end in outages:
+            i = self._svc_index.get(svc)
+            if i is None or duration <= 0:
+                continue
+            if i in seen_outage:
+                raise ValueError(
+                    f"multiple outage windows for {svc!r}; split the phase "
+                    "into segments instead (one window per service each)"
+                )
+            seen_outage.add(i)
+            outage[i] = (start / duration, end / duration)
+
+        rho = np.asarray(state.node_cpu_pct(), dtype=np.float32) / 100.0
+        args = (
+            self._src,
+            self._dst,
+            self._entry,
+            self._proc_ms,
+            jnp.asarray(nodes),
+            jnp.asarray(counts),
+            jnp.asarray(rho),
+            jnp.asarray(outage),
+            self._cfg_vec,
+        )
+        done = 0
+        chunk_i = 0
+        while done < total:
+            n = min(cfg.chunk, total - done)
+            sub = jax.random.fold_in(key, chunk_i)
+            latency, ok, e_out, e_over = _request_chunk(
+                sub, *args, depth=self.plan.depth, chunk=cfg.chunk
+            )
+            samples.extend(latency, ok, e_out, e_over, n)
+            done += n
+            chunk_i += 1
+        samples.sim_s += duration
+        return samples
+
+    def measure(
+        self,
+        state: ClusterState,
+        key: jax.Array,
+        *,
+        duration_s: float | None = None,
+        outages: Sequence[tuple[str, float, float]] = (),
+    ) -> RequestStats:
+        """One self-contained measurement phase (reference release1.sh)."""
+        return self.run(
+            state, key, duration_s=duration_s, outages=outages
+        ).stats()
+
+
+def new_samples() -> _Samples:
+    """Fresh accumulator for a multi-segment phase (reference release2.sh)."""
+    return _Samples()

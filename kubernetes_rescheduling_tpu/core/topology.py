@@ -117,6 +117,10 @@ def _random_workmodel(
 ) -> Workmodel:
     from kubernetes_rescheduling_tpu.core.workmodel import ServiceSpec
 
+    # Call direction is earlier→later service (each new service i is *called
+    # by* k existing services j < i), so s0 is the call-graph root — every
+    # service is reachable from the entry, like µBench's s0 fan-out. The
+    # undirected closure (what placement cost sees) is unaffected.
     if powerlaw:
         # Barabási–Albert-style preferential attachment → power-law degree DAG.
         # Sampling uniformly from the endpoint list is equivalent to
@@ -136,7 +140,7 @@ def _random_workmodel(
             while len(picks) < k:  # rare fallback: fill uniformly
                 picks.add(int(rng.integers(0, i)))
             for j in picks:
-                targets[i].append(f"s{j}")
+                targets[j].append(f"s{i}")
                 endpoints.append(j)
                 endpoints.append(i)
     else:
@@ -146,7 +150,7 @@ def _random_workmodel(
         for i in range(n_services):
             for j in range(i):
                 if rng.random() < p:
-                    targets[i].append(f"s{j}")
+                    targets[j].append(f"s{i}")
     services = tuple(
         ServiceSpec(
             name=f"s{i}",

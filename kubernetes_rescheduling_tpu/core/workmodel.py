@@ -106,6 +106,57 @@ class Workmodel:
         return cls.from_dict(json.loads(p.read_text()), source=str(p))
 
 
+def kahn_traversal(
+    relation: Mapping[str, Sequence[str]], names: Sequence[str]
+) -> tuple[list[str], list[tuple[str, str]]]:
+    """Cycle-broken topological traversal of a directed call graph.
+
+    Returns ``(order, edges)``: a processing order covering every service,
+    and the kept caller→callee edges. Edges that would close a cycle are
+    dropped (visit-once on the node at pop time); services left in a cyclic
+    remainder are appended in name order with the same edge-keeping rule.
+    ``order`` is a valid topological order of the kept edges.
+
+    Single source of truth for *which edges exist* in a cyclic mesh — CPU
+    load propagation (``backends.sim.LoadModel.service_rps``) and request
+    latency propagation (``bench.loadgen``) both build on it, so the two
+    models can never disagree.
+    """
+    names = list(names)
+    index = set(names)
+    indeg = {n: 0 for n in names}
+    for src, dsts in relation.items():
+        for d in dsts:
+            if d in indeg:
+                indeg[d] += 1
+    ready = [n for n in names if indeg[n] == 0]
+    order: list[str] = []
+    done: set[str] = set()
+    edges: list[tuple[str, str]] = []
+    while ready:
+        svc = ready.pop()
+        if svc in done:
+            continue
+        done.add(svc)
+        order.append(svc)
+        for callee in relation.get(svc, []):
+            if callee not in index or callee in done:
+                continue  # cycle-closing edge: drop
+            edges.append((svc, callee))
+            indeg[callee] -= 1
+            if indeg[callee] == 0:
+                ready.append(callee)
+    for svc in names:  # cyclic remainder (indeg never hit 0), name order
+        if svc in done:
+            continue
+        done.add(svc)
+        order.append(svc)
+        for callee in relation.get(svc, []):
+            if callee in index and callee not in done:
+                edges.append((svc, callee))
+    return order, edges
+
+
 def mubench_workmodel_c() -> Workmodel:
     """The reference's s0–s19 topology, reconstructed from its call graph.
 

@@ -1,0 +1,200 @@
+"""Request-level load generator: the properties the reference measures with
+its curl fleet (release1.sh:29-42, 74-117; release2.sh:50-59), each isolated.
+
+The constructed-placement tests hold everything else fixed and vary one
+term — cross-node edges, node utilization, outage windows — so they cannot
+be flipped by an unrelated term dominating (the round-1 failure mode)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends.sim import SimBackend
+from kubernetes_rescheduling_tpu.bench.loadgen import (
+    LoadGenConfig,
+    LoadGenerator,
+    build_call_plan,
+    new_samples,
+)
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+from kubernetes_rescheduling_tpu.core.workmodel import (
+    ServiceSpec,
+    Workmodel,
+    mubench_workmodel_c,
+)
+
+CFG = LoadGenConfig(requests_per_phase=1024, chunk=512, jitter_sigma=0.0)
+
+
+def chain_workmodel(n=4):
+    """s0 -> s1 -> ... -> s(n-1), one pod each."""
+    return Workmodel(
+        services=tuple(
+            ServiceSpec(name=f"s{i}", callees=(f"s{i+1}",) if i < n - 1 else ())
+            for i in range(n)
+        )
+    )
+
+
+def place(wm, pod_nodes, node_cpu=None, n_nodes=3, cap=10_000.0):
+    """ClusterState with explicit per-service placement and node usage."""
+    names = [f"n{i}" for i in range(n_nodes)]
+    return ClusterState.build(
+        node_names=names,
+        node_cpu_cap=[cap] * n_nodes,
+        node_mem_cap=[2**30] * n_nodes,
+        node_alive=[True] * n_nodes,
+        pod_services=list(range(len(wm.names))),
+        pod_nodes=list(pod_nodes),
+        pod_cpu=list(node_cpu) if node_cpu else [100.0] * len(wm.names),
+        pod_mem=[0.0] * len(wm.names),
+        pod_names=[f"{n}-0" for n in wm.names],
+    )
+
+
+def test_call_plan_mubench():
+    wm = mubench_workmodel_c()
+    plan = build_call_plan(wm.directed_relation(), wm.names, "s0")
+    assert len(plan.src) == 19          # tree: 20 services, 19 call edges
+    assert plan.depth == 3              # s0 -> s3 -> s9 -> s11
+    assert plan.reach.sum() == 20       # all services reachable from s0
+    assert plan.entry == 0
+
+
+def test_call_plan_breaks_cycles():
+    wm = Workmodel(
+        services=(
+            ServiceSpec(name="a", callees=("b",)),
+            ServiceSpec(name="b", callees=("c",)),
+            ServiceSpec(name="c", callees=("a",)),  # cycle back
+        )
+    )
+    plan = build_call_plan(wm.directed_relation(), wm.names, "a")
+    assert len(plan.src) == 2           # a->b, b->c kept; c->a dropped
+    assert plan.depth == 2
+
+
+def test_latency_increases_with_cross_node_edges():
+    """Equal load, equal node utilization — only the placement's cross-node
+    edge count differs. The network term must be visible on its own."""
+    wm = chain_workmodel(4)
+    gen = LoadGenerator(wm, CFG)
+    key = jax.random.PRNGKey(0)
+    # both placements use 2 pods per node on the same nodes -> same rho
+    colocated = place(wm, [0, 0, 1, 1])     # one cross edge (s1->s2)
+    alternating = place(wm, [0, 1, 0, 1])   # three cross edges
+    lat_co = gen.measure(colocated, key).latency_avg_ms
+    lat_alt = gen.measure(alternating, key).latency_avg_ms
+    expected_gap = 2 * (CFG.hop_remote_ms - CFG.hop_local_ms)
+    assert lat_alt > lat_co
+    assert lat_alt - lat_co == pytest.approx(expected_gap, rel=0.01)
+
+
+def test_latency_increases_with_utilization():
+    """Same placement, hotter node -> queueing inflates service time."""
+    wm = chain_workmodel(4)
+    gen = LoadGenerator(wm, CFG)
+    key = jax.random.PRNGKey(0)
+    cool = place(wm, [0, 0, 0, 0], node_cpu=[100.0] * 4)       # 4% rho
+    hot = place(wm, [0, 0, 0, 0], node_cpu=[2000.0] * 4)       # 80% rho
+    assert gen.measure(hot, key).latency_avg_ms > gen.measure(cool, key).latency_avg_ms
+
+
+def test_outage_window_fails_requests_proportionally():
+    wm = chain_workmodel(3)
+    gen = LoadGenerator(wm, CFG)
+    key = jax.random.PRNGKey(1)
+    st = place(wm, [0, 0, 0])
+    clean = gen.measure(st, key)
+    assert clean.errors == 0
+    # s1 down for 25% of the phase: every request traverses s1 -> ~25% fail
+    down = gen.measure(st, key, outages=[("s1", 0.0, 45.0)])
+    assert down.err_outage == pytest.approx(0.25 * down.sent, rel=0.15)
+    assert down.ok + down.errors == down.sent
+
+
+def test_unplaced_service_errors_all_requests():
+    wm = chain_workmodel(3)
+    gen = LoadGenerator(wm, CFG)
+    st = place(wm, [0, 0, -1])  # s2 has no running pod
+    stats = gen.measure(st, jax.random.PRNGKey(0))
+    assert stats.err_outage == stats.sent
+
+
+def test_overload_drops_requests():
+    wm = chain_workmodel(3)
+    gen = LoadGenerator(wm, CFG)
+    key = jax.random.PRNGKey(2)
+    ok_state = place(wm, [0, 0, 0], node_cpu=[1000.0] * 3)      # 30% rho
+    sat_state = place(wm, [0, 0, 0], node_cpu=[5000.0] * 3)     # 150% rho
+    assert gen.measure(ok_state, key).err_overload == 0
+    sat = gen.measure(sat_state, key)
+    assert sat.err_overload > 0.3 * sat.sent
+
+
+def test_deterministic_given_key():
+    wm = mubench_workmodel_c()
+    cfg = LoadGenConfig(requests_per_phase=512, chunk=256)  # jitter on
+    gen = LoadGenerator(wm, cfg)
+    backend = SimBackend(
+        workmodel=wm, node_names=["w1", "w2", "w3"], seed=3
+    )
+    st = backend.monitor()
+    a = gen.measure(st, jax.random.PRNGKey(42))
+    b = gen.measure(st, jax.random.PRNGKey(42))
+    assert a == b
+    c = gen.measure(st, jax.random.PRNGKey(43))
+    assert c.latency_avg_ms != a.latency_avg_ms
+
+
+def test_multi_segment_accumulation():
+    """Phase r2 semantics: segments with different placements accumulate
+    into one stat block (reference release2.sh sustains load across the
+    whole rescheduling run)."""
+    wm = chain_workmodel(4)
+    gen = LoadGenerator(wm, CFG)
+    key = jax.random.PRNGKey(0)
+    samples = new_samples()
+    gen.run(place(wm, [0, 0, 0, 0]), key, duration_s=18.0, n_requests=100,
+            samples=samples)
+    gen.run(place(wm, [0, 1, 0, 1]), jax.random.fold_in(key, 1),
+            duration_s=18.0, n_requests=100,
+            outages=[("s1", 0.0, 3.0)], samples=samples)
+    stats = samples.stats()
+    assert stats.sent == 200
+    assert stats.duration_s == pytest.approx(36.0)
+    assert stats.err_outage > 0                # outage segment contributed
+    assert stats.ok + stats.errors == stats.sent
+
+
+def test_replica_load_balancing_mixes_hops():
+    """A callee with replicas on two nodes: some requests hit the local
+    replica, some the remote one — avg sits strictly between."""
+    wm = Workmodel(
+        services=(
+            ServiceSpec(name="a", callees=("b",)),
+            ServiceSpec(name="b", replicas=2),
+        )
+    )
+    gen = LoadGenerator(wm, LoadGenConfig(
+        requests_per_phase=2048, chunk=512, jitter_sigma=0.0, entry_service="a",
+    ))
+    names = ["n0", "n1"]
+    st = ClusterState.build(
+        node_names=names,
+        node_cpu_cap=[10_000.0] * 2,
+        node_mem_cap=[2**30] * 2,
+        node_alive=[True] * 2,
+        pod_services=[0, 1, 1],
+        pod_nodes=[0, 0, 1],          # a on n0; b replicas on n0 and n1
+        pod_cpu=[100.0] * 3,
+        pod_mem=[0.0] * 3,
+        pod_names=["a-0", "b-0", "b-1"],
+    )
+    stats = gen.measure(st, jax.random.PRNGKey(0))
+    lo = stats.latency_min_ms
+    hi = stats.latency_max_ms
+    assert hi - lo == pytest.approx(
+        gen.cfg.hop_remote_ms - gen.cfg.hop_local_ms, rel=0.01
+    )
+    assert lo < stats.latency_avg_ms < hi

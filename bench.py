@@ -73,6 +73,35 @@ def main() -> int:
     float(last_inf["objective_after"])
     solve_ms = (time.perf_counter() - t0) / rounds * 1e3
 
+    # device-only per-round latency: K chained solves inside ONE jitted
+    # program (lax.scan with a true state dependency), fenced once. A single
+    # dispatch+fence costs the same regardless of K, so timing K1 and K2
+    # and taking the slope isolates pure device compute per round — no
+    # tunnel-RTT subtraction, no profiler attribution guesswork.
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("k",))
+    def chained(st0, g, key0, k):
+        # g must be an argument, not a closure: closed-over arrays become
+        # HLO constants, and a 10k x 10k adjacency embedded in the program
+        # overflows remote-compile request limits
+        def body(st_c, i):
+            st_n, inf_n = global_assign(st_c, g, jax.random.fold_in(key0, i), cfg)
+            return st_n, inf_n["objective_after"]
+        return jax.lax.scan(body, st0, jnp.arange(k))
+
+    def timed_chain(k):
+        _, objs = chained(state, graph, jax.random.PRNGKey(7), k)
+        float(objs[-1])  # warm-up/compile
+        t = time.perf_counter()
+        _, objs = chained(state, graph, jax.random.PRNGKey(8), k)
+        float(objs[-1])  # completion fence
+        return time.perf_counter() - t
+
+    k1, k2 = 2, 12
+    device_ms = (timed_chain(k2) - timed_chain(k1)) / (k2 - k1) * 1e3
+
     baseline_ms = 100.0  # BASELINE.md: <100 ms/round at 10k x 1k
     cost_before = float(communication_cost(state, graph))
     cost_after = float(communication_cost(new_state, graph))
@@ -88,6 +117,9 @@ def main() -> int:
                     "sweeps": sweeps,
                     "rounds_pipelined": rounds,
                     "single_round_fenced_ms": round(single_ms, 3),
+                    "device_ms_per_round": round(device_ms, 3),
+                    "vs_baseline_fenced": round(baseline_ms / single_ms, 3),
+                    "vs_baseline_device": round(baseline_ms / device_ms, 3),
                     "devices": [str(d) for d in jax.devices()],
                     "communication_cost_before": cost_before,
                     "communication_cost_after": cost_after,

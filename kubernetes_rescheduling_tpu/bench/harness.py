@@ -142,10 +142,9 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                 backend.inject_imbalance(backend.node_names[0])
 
             graph = backend.comm_graph()
-            # the request stream must sample the same call tree the CPU-load
-            # model propagates: copy the backend's per-edge call probability
-            lcfg = dataclasses.replace(cfg.load, fanout_frac=backend.load.fanout_frac)
-            loadgen = LoadGenerator(backend.workmodel, lcfg)
+            loadgen = LoadGenerator(
+                backend.workmodel, cfg.load, fanout_frac=backend.load.fanout_frac
+            )
             key = jax.random.PRNGKey(seed)
             key, k_before, k_during, k_after = jax.random.split(key, 4)
             std_sink = node_std_sink(run_dir)

@@ -85,10 +85,6 @@ class LoadGenConfig:
     jitter_sigma: float = 0.15     # lognormal latency jitter
     drop_rho: float = 1.0          # nodes past this utilization drop requests
     max_drop_p: float = 0.95       # per-service drop probability ceiling
-    # per-edge call probability, sampled per request — must match the CPU
-    # load model's fanout (backends.sim.LoadModel.fanout_frac); the harness
-    # copies it from the backend
-    fanout_frac: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -338,9 +334,21 @@ class LoadGenerator:
     utilization, and outage windows (cheap device transfers).
     """
 
-    def __init__(self, workmodel: Workmodel, cfg: LoadGenConfig | None = None):
+    def __init__(
+        self,
+        workmodel: Workmodel,
+        cfg: LoadGenConfig | None = None,
+        *,
+        fanout_frac: float = 1.0,
+    ):
+        """``fanout_frac`` is the per-edge call probability and MUST come
+        from the same place the CPU-load model reads it
+        (``backends.sim.LoadModel.fanout_frac``) — it is a constructor
+        argument rather than a config field precisely so callers pass the
+        backend's value instead of maintaining a second copy."""
         self.cfg = cfg or LoadGenConfig()
         self.workmodel = workmodel
+        self.fanout_frac = fanout_frac
         names = workmodel.names
         self.plan = build_call_plan(
             workmodel.directed_relation(), names, self.cfg.entry_service
@@ -349,7 +357,7 @@ class LoadGenerator:
         c = self.cfg
         self._cfg_vec = jnp.asarray(
             [c.hop_local_ms, c.hop_remote_ms, c.queue_rho_cap,
-             c.jitter_sigma, c.drop_rho, c.max_drop_p, c.fanout_frac],
+             c.jitter_sigma, c.drop_rho, c.max_drop_p, fanout_frac],
             jnp.float32,
         )
         # static across phases/segments: ship to device once

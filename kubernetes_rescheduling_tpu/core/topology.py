@@ -144,13 +144,19 @@ def _random_workmodel(
                 endpoints.append(j)
                 endpoints.append(i)
     else:
-        # Dense Erdős–Rényi mesh.
+        # Dense Erdős–Rényi mesh, plus one guaranteed caller per service so
+        # the whole mesh stays reachable from the s0 entry (ER alone leaves
+        # a service caller-less with probability (1-p)^i).
         p = min(1.0, mean_degree / max(1, n_services - 1))
         targets = [[] for _ in range(n_services)]
-        for i in range(n_services):
+        for i in range(1, n_services):
+            called = False
             for j in range(i):
                 if rng.random() < p:
                     targets[j].append(f"s{i}")
+                    called = True
+            if not called:
+                targets[int(rng.integers(0, i))].append(f"s{i}")
     services = tuple(
         ServiceSpec(
             name=f"s{i}",

@@ -25,10 +25,8 @@ from kubernetes_rescheduling_tpu.backends.k8s import PlacementMechanism
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS
-from kubernetes_rescheduling_tpu.solver.global_solver import (
-    GlobalSolverConfig,
-    global_assign,
-)
+from kubernetes_rescheduling_tpu.parallel.sharded import solve_with_restarts
+from kubernetes_rescheduling_tpu.solver.global_solver import GlobalSolverConfig
 from kubernetes_rescheduling_tpu.solver.round_loop import decide
 
 
@@ -150,7 +148,11 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         enforce_capacity=config.enforce_capacity,
     )
     t0 = time.perf_counter()
-    new_state, info = jax.block_until_ready(global_assign(state, graph, key, cfg))
+    new_state, info = jax.block_until_ready(
+        solve_with_restarts(
+            state, graph, key, n_restarts=config.solver_restarts, config=cfg
+        )
+    )
     latency = time.perf_counter() - t0
 
     old_nodes = np.asarray(state.pod_node)

@@ -72,6 +72,7 @@ class ExperimentConfig:
     # 0 would let the solver "win" by keeping the Before pile-up intact
     # (comm cost 0, load std terrible) — never what an operator wants.
     balance_weight: float = 0.5
+    solver_restarts: int = 1           # best-of-N global solves per round
 
 
 def make_backend(scenario: str, seed: int) -> SimBackend:
@@ -170,6 +171,7 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                 hazard_threshold_pct=cfg.hazard_threshold_pct,
                 sleep_after_action_s=cfg.pacing_s,  # simulated clock, not wall
                 balance_weight=cfg.balance_weight,
+                solver_restarts=cfg.solver_restarts,
                 seed=seed,
             )
             during = new_samples()

@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--sweeps", type=int, default=8)
     s.add_argument("--balance-weight", type=float, default=0.0)
     s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--restarts", type=int, default=1,
+                   help="best-of-N independent solves, sharded over the "
+                        "device mesh (1 = single solve)")
     return p
 
 
@@ -115,21 +118,33 @@ def cmd_solve(args) -> dict:
 
     from kubernetes_rescheduling_tpu.bench.harness import make_backend
     from kubernetes_rescheduling_tpu.objectives import communication_cost, load_std
-    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+    from kubernetes_rescheduling_tpu.parallel import solve_with_restarts
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
 
     backend = make_backend(args.scenario, args.seed)
     state = backend.monitor()
     graph = backend.comm_graph()
     cfg = GlobalSolverConfig(sweeps=args.sweeps, balance_weight=args.balance_weight)
-    new_state, info = global_assign(state, graph, jax.random.PRNGKey(args.seed), cfg)
-    return {
+    new_state, info = solve_with_restarts(
+        state,
+        graph,
+        jax.random.PRNGKey(args.seed),
+        n_restarts=args.restarts,
+        config=cfg,
+    )
+    out = {
         "scenario": args.scenario,
+        "restarts": int(info["restarts"]),
         "communication_cost_before": float(communication_cost(state, graph)),
         "communication_cost_after": float(communication_cost(new_state, graph)),
         "load_std_before": float(load_std(state)),
         "load_std_after": float(load_std(new_state)),
-        "moves_per_sweep": [int(m) for m in info["moves_per_sweep"]],
     }
+    if "moves_per_sweep" in info:
+        out["moves_per_sweep"] = [int(m) for m in info["moves_per_sweep"]]
+    if "restart_objectives" in info:
+        out["restart_objectives"] = [float(o) for o in info["restart_objectives"]]
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:

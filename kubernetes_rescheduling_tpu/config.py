@@ -44,6 +44,7 @@ class RescheduleConfig:
     enforce_capacity: bool = False         # reference never checks capacity
     global_solver_iters: int = 8           # best-response sweeps per solve
     balance_weight: float = 0.0            # λ for load-balance term in global solver
+    solver_restarts: int = 1               # best-of-N solves over the device mesh
     seed: int = 0
 
     # Scale (array capacities; 0 = size to the scenario)

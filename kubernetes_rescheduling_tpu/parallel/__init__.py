@@ -8,6 +8,8 @@ TPU-natively, the solver's collectives ride ICI via XLA:
   (dp = restarts/services, tp = nodes).
 - ``parallel_restarts`` — data-parallel multi-restart global solve: R
   restarts sharded over dp, best result selected on device.
+- ``solve_with_restarts`` — the production wrapper: best-of-N with an
+  auto-built mesh, degenerating to a batched single-device solve.
 - ``sharded_choose_node`` — the policy kernel with the node axis sharded
   over tp: per-shard lexicographic maxima combined with all-gather.
 """
@@ -16,6 +18,12 @@ from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh
 from kubernetes_rescheduling_tpu.parallel.sharded import (
     parallel_restarts,
     sharded_choose_node,
+    solve_with_restarts,
 )
 
-__all__ = ["make_mesh", "parallel_restarts", "sharded_choose_node"]
+__all__ = [
+    "make_mesh",
+    "parallel_restarts",
+    "sharded_choose_node",
+    "solve_with_restarts",
+]

@@ -141,6 +141,17 @@ def test_cli_solve(capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out)
     assert out["communication_cost_after"] <= out["communication_cost_before"]
+    assert out["restarts"] == 1
+
+
+def test_cli_solve_restarts(capsys):
+    rc = cli_main(["solve", "--scenario", "mubench", "--sweeps", "4",
+                   "--restarts", "4"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["restarts"] == 4
+    assert len(out["restart_objectives"]) == 4
+    assert out["communication_cost_after"] <= out["communication_cost_before"]
 
 
 def test_cli_bench(tmp_path, capsys):

@@ -11,9 +11,10 @@ from kubernetes_rescheduling_tpu.parallel import (
     make_mesh,
     parallel_restarts,
     sharded_choose_node,
+    solve_with_restarts,
 )
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS, choose_node, detect_hazard
-from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
+from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
 
 
 def test_make_mesh_shapes():
@@ -38,6 +39,75 @@ def test_parallel_restarts_beats_or_matches_single():
     assert float(info["objective_after"]) == pytest.approx(objs.min())
     # selected state really achieves the reported objective
     assert float(communication_cost(best_state, scn.graph)) <= objs.min() + 1e-3
+    before = float(communication_cost(scn.state, scn.graph))
+    assert float(info["objective_after"]) <= before
+
+
+def test_solve_with_restarts_single_matches_global_assign():
+    """n_restarts=1 degenerates to the plain solver (same keys, same result)."""
+    scn = synthetic_scenario(n_pods=64, n_nodes=8, seed=5, mean_degree=5.0)
+    cfg = GlobalSolverConfig(sweeps=4)
+    key = jax.random.PRNGKey(3)
+    st1, info1 = solve_with_restarts(scn.state, scn.graph, key, n_restarts=1, config=cfg)
+    st2, info2 = global_assign(scn.state, scn.graph, key, cfg)
+    assert int(info1["restarts"]) == 1
+    np.testing.assert_array_equal(np.asarray(st1.pod_node), np.asarray(st2.pod_node))
+
+
+def test_solve_with_restarts_multi_beats_or_matches_single_powerlaw():
+    """The VERDICT-r1 wiring requirement: best-of-N on the mesh is never
+    worse than a single solve on the power-law scenario."""
+    scn = synthetic_scenario(
+        n_pods=256, n_nodes=16, seed=6, powerlaw=True, mean_degree=4.0
+    )
+    cfg = GlobalSolverConfig(sweeps=4)
+    key = jax.random.PRNGKey(0)
+    _, single_info = solve_with_restarts(
+        scn.state, scn.graph, key, n_restarts=1, config=cfg
+    )
+    multi_state, multi_info = solve_with_restarts(
+        scn.state, scn.graph, key, n_restarts=8, config=cfg
+    )
+    assert int(multi_info["restarts"]) == 8
+    assert float(multi_info["objective_after"]) <= float(
+        single_info["objective_after"]
+    ) + 1e-3
+    # reported objective is achieved by the returned placement
+    assert float(communication_cost(multi_state, scn.graph)) == pytest.approx(
+        float(multi_info["objective_after"]), abs=1e-2
+    )
+
+
+def test_solve_with_restarts_auto_mesh_odd_count():
+    """Restart counts that don't divide the device count still run (largest
+    divisor <= devices). n_restarts=3 -> dp=3 mesh, one restart per shard."""
+    scn = synthetic_scenario(n_pods=32, n_nodes=8, seed=7, mean_degree=4.0)
+    _, info = solve_with_restarts(
+        scn.state,
+        scn.graph,
+        jax.random.PRNGKey(1),
+        n_restarts=3,
+        config=GlobalSolverConfig(sweeps=2),
+    )
+    assert int(info["restarts"]) == 3
+    assert info["restart_objectives"].shape == (3,)
+
+
+def test_solve_with_restarts_single_device_sequential():
+    """The dp=1 degradation path: several restarts scanned back to back on
+    one device (prime count > device count forces dp=1)."""
+    scn = synthetic_scenario(n_pods=32, n_nodes=8, seed=8, mean_degree=4.0)
+    mesh = make_mesh(1)
+    _, info = solve_with_restarts(
+        scn.state,
+        scn.graph,
+        jax.random.PRNGKey(2),
+        n_restarts=5,
+        config=GlobalSolverConfig(sweeps=2),
+        mesh=mesh,
+    )
+    assert int(info["restarts"]) == 5
+    assert info["restart_objectives"].shape == (5,)
     before = float(communication_cost(scn.state, scn.graph))
     assert float(info["objective_after"]) <= before
 

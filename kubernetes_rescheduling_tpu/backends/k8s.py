@@ -27,6 +27,7 @@ package (constructed lazily — the package is optional).
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -35,6 +36,8 @@ from kubernetes_rescheduling_tpu.backends.base import MoveRequest
 from kubernetes_rescheduling_tpu.core.quantities import cpu_to_millicores, mem_to_bytes
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph, UNASSIGNED
 from kubernetes_rescheduling_tpu.core.workmodel import Workmodel
+
+logger = logging.getLogger(__name__)
 
 # policy name -> how the reference pins the re-created Deployment
 PlacementMechanism: dict[str, str] = {
@@ -86,27 +89,27 @@ def exclude_hazard_affinity(hazard_nodes: list[str]) -> dict:
 
 
 def merge_affinity(orig: dict | None, patch: dict) -> dict:
-    """Deep-merge an affinity patch, extending lists at the leaf level
-    (semantics of reference rescheduling.py:21-40)."""
+    """Merge an affinity patch into an existing affinity dict.
+
+    One rule, applied recursively at every depth: two dicts merge key-wise,
+    two lists concatenate (extra ``nodeSelectorTerms``/``matchExpressions``
+    accumulate instead of clobbering what the Deployment already had), and
+    any other collision resolves to the patch value. Behavioral parity
+    target: reference rescheduling.py:21-40.
+    """
     import copy
 
-    out = copy.deepcopy(orig) if orig else {}
-    for k, v in patch.items():
-        if k not in out or not isinstance(out.get(k), dict) or not isinstance(v, dict):
-            out[k] = v
-            continue
-        for kk, vv in v.items():
-            if kk not in out[k]:
-                out[k][kk] = vv
-            elif isinstance(vv, dict) and isinstance(out[k][kk], dict):
-                for kkk, vvv in vv.items():
-                    if isinstance(vvv, list) and isinstance(out[k][kk].get(kkk), list):
-                        out[k][kk][kkk] = out[k][kk][kkk] + list(vvv)
-                    else:
-                        out[k][kk][kkk] = vvv
-            else:
-                out[k][kk] = vv
-    return out
+    def merge(a, b):
+        if isinstance(a, dict) and isinstance(b, dict):
+            out = dict(a)
+            for k, v in b.items():
+                out[k] = merge(a[k], v) if k in a else v
+            return out
+        if isinstance(a, list) and isinstance(b, list):
+            return [*a, *b]
+        return b
+
+    return merge(copy.deepcopy(orig) if orig else {}, copy.deepcopy(patch))
 
 
 _KEPT_CONTAINER_KEYS = (
@@ -322,13 +325,22 @@ class K8sBackend:
     def _wait_deleted(self, name: str) -> bool:
         """Poll for the 404 (reference delete_replaced_pod.py:8-22).
 
-        Transient non-404 errors are retried until the deadline instead of
-        raised: at this point the Deployment has already been foreground-
-        deleted, and crashing the controller here would lose the workload —
-        the exact reference flaw the round loop is built to avoid.
+        Transient non-404 errors are logged and retried until the poll
+        budget runs out instead of raised: at this point the Deployment has
+        already been foreground-deleted, and crashing the controller here
+        would lose the workload — the exact reference flaw the round loop
+        is built to avoid. The wait is bounded both ways: a poll budget
+        (timeout / interval) so an injected fast/no-op sleeper shortens the
+        wait instead of busy-spinning the API server for the full real-time
+        window, AND the wall-clock deadline so slow API calls can never
+        stretch the stall past ``delete_timeout_s``.
         """
+        interval = max(self.delete_poll_interval_s, 1e-9)
+        polls = max(1, int(round(self.delete_timeout_s / interval)))
         deadline = time.monotonic() + self.delete_timeout_s
-        while time.monotonic() < deadline:
+        for _ in range(polls):
+            if time.monotonic() > deadline:
+                return False
             try:
                 self.apps_api.read_namespaced_deployment(
                     name=name, namespace=self.namespace
@@ -336,8 +348,10 @@ class K8sBackend:
             except Exception as e:
                 if getattr(e, "status", None) == 404:
                     return True
-                # transient API failure: keep polling
-            self.sleeper(self.delete_poll_interval_s)
+                logger.warning(
+                    "wait_deleted(%s): non-404 error while polling: %s", name, e
+                )
+            self.sleeper(interval)
         return False
 
     def apply_move(self, move: MoveRequest) -> bool:

@@ -34,11 +34,15 @@ _ARRAY_FIELDS = (
 
 
 def save_state(state: ClusterState, path: str | Path, extra: dict | None = None) -> None:
-    """Write ``<path>.npz`` (arrays) + ``<path>.json`` (names, extra)."""
+    """Write ``<path>.npz`` (arrays) + ``<path>.json`` (names, extra).
+
+    Extensions are appended, not substituted: a checkpoint named
+    ``ckpt.v2`` writes ``ckpt.v2.npz``, never colliding with ``ckpt``.
+    """
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
     np.savez_compressed(
-        p.with_suffix(".npz"),
+        Path(f"{p}.npz"),
         **{f: np.asarray(getattr(state, f)) for f in _ARRAY_FIELDS},
     )
     meta = {
@@ -46,14 +50,14 @@ def save_state(state: ClusterState, path: str | Path, extra: dict | None = None)
         "pod_names": list(state.pod_names),
         "extra": extra or {},
     }
-    p.with_suffix(".json").write_text(json.dumps(meta, default=float))
+    Path(f"{p}.json").write_text(json.dumps(meta, default=float))
 
 
 def load_state(path: str | Path) -> tuple[ClusterState, dict]:
     """Inverse of :func:`save_state`; returns ``(state, extra)``."""
     p = Path(path)
-    arrays = np.load(p.with_suffix(".npz"))
-    meta = json.loads(p.with_suffix(".json").read_text())
+    arrays = np.load(f"{p}.npz")
+    meta = json.loads(Path(f"{p}.json").read_text())
     state = ClusterState(
         **{f: jnp.asarray(arrays[f]) for f in _ARRAY_FIELDS},
         node_names=tuple(meta["node_names"]),

@@ -41,6 +41,7 @@ class RoundRecord:
     load_std: float
     decision_latency_s: float  # device-side decision time (no cluster I/O)
     services_moved: tuple[str, ...] = ()  # every Deployment recreated this round
+    decisions: int = 1         # decide()/solve calls this round (normalizes latency)
 
 
 @dataclass
@@ -49,8 +50,9 @@ class ControllerResult:
 
     @property
     def decisions_per_sec(self) -> float:
-        lat = [r.decision_latency_s for r in self.rounds if r.decision_latency_s > 0]
-        return 1.0 / (sum(lat) / len(lat)) if lat else 0.0
+        lat = sum(r.decision_latency_s for r in self.rounds)
+        n = sum(r.decisions for r in self.rounds if r.decision_latency_s > 0)
+        return n / lat if lat > 0 else 0.0
 
     @property
     def moves(self) -> int:
@@ -88,7 +90,7 @@ def run_controller(
     for rnd in range(1, config.max_rounds + 1):
         key, sub = jax.random.split(key)
 
-        if config.algorithm == "global":
+        if config.algorithm == "global" or config.moves_per_round == "all":
             record = _global_round(backend, state, graph, config, sub, rnd)
         else:
             record = _greedy_round(backend, state, graph, config, sub, rnd)
@@ -103,24 +105,46 @@ def run_controller(
 
 
 def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
+    """Up to ``config.moves_per_round`` greedy moves: after each move the
+    working snapshot is edited in place (the moved service's pods re-homed —
+    reference main.py:73's ``edit_cluster`` intent, done correctly), so the
+    next decision sees the drained hazard node and stops early once nothing
+    is hazardous anymore."""
     pid = jnp.asarray(POLICY_IDS[config.algorithm])
-    t0 = time.perf_counter()
-    most, hazard_mask, victim, svc, target = jax.block_until_ready(
-        _decide(state, graph, pid, jnp.asarray(config.hazard_threshold_pct), key)
-    )
-    latency = time.perf_counter() - t0
+    k_moves = config.moves_per_round
+    first_hazard: str | None = None
+    moved_names: list[str] = []
+    first_target: str | None = None
+    latency = 0.0
+    n_decisions = 0
 
-    moved = False
-    most_i, victim_i, target_i = int(most), int(victim), int(target)
-    service_name = graph.names[int(svc)] if victim_i >= 0 else None
-    target_name = state.node_names[target_i] if target_i >= 0 else None
-    if most_i >= 0 and victim_i >= 0 and target_i >= 0:
-        hazard_names = tuple(
-            state.node_names[i]
-            for i in range(state.num_nodes)
-            if bool(hazard_mask[i])
+    for i in range(k_moves):
+        key, sub = jax.random.split(key)
+        n_decisions += 1
+        t0 = time.perf_counter()
+        most, hazard_mask, victim, svc, target = jax.block_until_ready(
+            _decide(state, graph, pid, jnp.asarray(config.hazard_threshold_pct), sub)
         )
-        moved = backend.apply_move(
+        latency += time.perf_counter() - t0
+
+        most_i, victim_i, target_i = int(most), int(victim), int(target)
+        if first_hazard is None and most_i >= 0:
+            first_hazard = state.node_names[most_i]
+        if most_i < 0 or victim_i < 0 or target_i < 0:
+            break  # no hazard left (or nowhere to go): the round is done
+        service_name = graph.names[int(svc)]
+        if service_name in moved_names:
+            # the drain has started ping-ponging (the move made the target
+            # the new hazard node and elected the same service back) —
+            # further moves this round are churn, not progress
+            break
+        target_name = state.node_names[target_i]
+        hazard_names = tuple(
+            state.node_names[j]
+            for j in range(state.num_nodes)
+            if bool(hazard_mask[j])
+        )
+        ok = backend.apply_move(
             MoveRequest(
                 service=service_name,
                 target_node=target_name,
@@ -128,16 +152,29 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
                 mechanism=PlacementMechanism[config.algorithm],
             )
         )
+        if not ok:
+            break
+        moved_names.append(service_name)
+        if first_target is None:
+            first_target = target_name
+        if i + 1 < k_moves:
+            # re-home the moved service in the working snapshot
+            svc_pods = (state.pod_service == int(svc)) & state.pod_valid
+            state = state.replace(
+                pod_node=jnp.where(svc_pods, target_i, state.pod_node)
+            )
+
     return RoundRecord(
         round=rnd,
-        moved=moved,
-        most_hazard=state.node_names[most_i] if most_i >= 0 else None,
-        service=service_name if moved else None,
-        target=target_name if moved else None,
+        moved=bool(moved_names),
+        most_hazard=first_hazard,
+        service=moved_names[0] if moved_names else None,
+        target=first_target,
         communication_cost=0.0,  # filled by run_controller from the post-move snapshot
         load_std=0.0,
         decision_latency_s=latency,
-        services_moved=(service_name,) if moved else (),
+        services_moved=tuple(moved_names),
+        decisions=n_decisions,
     )
 
 

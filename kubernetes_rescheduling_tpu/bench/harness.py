@@ -62,6 +62,7 @@ class ExperimentConfig:
     repeats: int = 5                   # reference auto_full_pipeline_repeat.sh:10
     rounds: int = 10                   # reference main.py:28
     scenario: str = "mubench"          # mubench | dense | powerlaw | large
+    workmodel: str | None = None       # external workmodel JSON (overrides scenario topology)
     out_dir: str = "result"
     seed: int = 0
     hazard_threshold_pct: float = 30.0
@@ -73,15 +74,27 @@ class ExperimentConfig:
     # (comm cost 0, load std terrible) — never what an operator wants.
     balance_weight: float = 0.5
     solver_restarts: int = 1           # best-of-N global solves per round
+    moves_per_round: int | str = 1     # k per greedy round, or "all"
 
 
-def make_backend(scenario: str, seed: int) -> SimBackend:
-    """Scenario factory covering the BASELINE.md benchmark configs."""
+def make_backend(
+    scenario: str, seed: int, workmodel_path: str | None = None
+) -> SimBackend:
+    """Scenario factory covering the BASELINE.md benchmark configs.
+
+    ``workmodel_path`` swaps the scenario's builtin *topology* for an
+    external µBench workmodel JSON (the reference's externalized workload,
+    workmodelC.json) while keeping that scenario's cluster shape and load
+    model.
+    """
     rng = np.random.default_rng(seed)
+    wm_override = (
+        Workmodel.from_file(workmodel_path) if workmodel_path is not None else None
+    )
     if scenario == "mubench":
         # reference cluster: 3 workers, i9-10900K = 20 threads (README.md:44-46)
         return SimBackend(
-            workmodel=mubench_workmodel_c(),
+            workmodel=wm_override or mubench_workmodel_c(),
             node_names=["worker1", "worker2", "worker3"],
             node_cpu_cap_m=20_000.0,
             seed=seed,
@@ -95,7 +108,7 @@ def make_backend(scenario: str, seed: int) -> SimBackend:
     # expected request branching factor at ~1, so the entry rate neither
     # dies out nor multiplies combinatorially through multi-parent DAGs
     if scenario == "dense":
-        wm = _random_workmodel(200, rng, powerlaw=False, mean_degree=8.0)
+        wm = wm_override or _random_workmodel(200, rng, powerlaw=False, mean_degree=8.0)
         return SimBackend(
             workmodel=wm,
             node_names=[f"worker{i:04d}" for i in range(20)],
@@ -106,7 +119,7 @@ def make_backend(scenario: str, seed: int) -> SimBackend:
             load=LoadModel(idle_m=40.0, cost_per_req_m=5.0, fanout_frac=0.25),
         )
     if scenario == "powerlaw":
-        wm = _random_workmodel(2000, rng, powerlaw=True, mean_degree=4.0)
+        wm = wm_override or _random_workmodel(2000, rng, powerlaw=True, mean_degree=4.0)
         return SimBackend(
             workmodel=wm,
             node_names=[f"worker{i:04d}" for i in range(200)],
@@ -115,7 +128,7 @@ def make_backend(scenario: str, seed: int) -> SimBackend:
             load=LoadModel(fanout_frac=0.5),
         )
     if scenario == "large":
-        wm = _random_workmodel(10_000, rng, powerlaw=True, mean_degree=4.0)
+        wm = wm_override or _random_workmodel(10_000, rng, powerlaw=True, mean_degree=4.0)
         return SimBackend(
             workmodel=wm,
             node_names=[f"worker{i:04d}" for i in range(1000)],
@@ -138,7 +151,7 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
             run_dir = session / algo / f"run_{run_i}"
             run_dir.mkdir(parents=True, exist_ok=True)
             seed = cfg.seed * 1000 + run_i
-            backend = make_backend(cfg.scenario, seed)
+            backend = make_backend(cfg.scenario, seed, workmodel_path=cfg.workmodel)
             if cfg.inject_imbalance:
                 backend.inject_imbalance(backend.node_names[0])
 
@@ -172,6 +185,7 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                 sleep_after_action_s=cfg.pacing_s,  # simulated clock, not wall
                 balance_weight=cfg.balance_weight,
                 solver_restarts=cfg.solver_restarts,
+                moves_per_round=cfg.moves_per_round,
                 seed=seed,
             )
             during = new_samples()

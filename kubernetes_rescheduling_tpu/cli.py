@@ -25,6 +25,20 @@ def _norm_algo(name: str) -> str:
     return ALGO_ALIASES.get(name, name)
 
 
+def _moves_per_round(value: str) -> int | str:
+    if value == "all":
+        return "all"
+    try:
+        n = int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected a positive int or 'all', got {value!r}"
+        )
+    if n < 1:
+        raise argparse.ArgumentTypeError("must be >= 1 (or 'all')")
+    return n
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="kubernetes_rescheduling_tpu",
@@ -32,22 +46,32 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = p.add_subparsers(dest="command", required=True)
 
+    workmodel_help = (
+        "path to a µBench workmodel JSON (e.g. workmodelC.json); "
+        "overrides the scenario's builtin topology"
+    )
+
     r = sub.add_parser("reschedule", help="run the rescheduling control loop")
     r.add_argument("--algorithm", default="communication",
                    help="spread|binpack|random|kubescheduling|communication|car|global")
     r.add_argument("--backend", default="sim", choices=["sim", "k8s"])
     r.add_argument("--scenario", default="mubench",
                    choices=["mubench", "dense", "powerlaw", "large"])
+    r.add_argument("--workmodel", default=None, help=workmodel_help)
     r.add_argument("--rounds", type=int, default=10)
     r.add_argument("--threshold", type=float, default=30.0)
     r.add_argument("--seed", type=int, default=0)
     r.add_argument("--imbalance", action="store_true",
                    help="inject the cordon-style imbalance before starting")
+    r.add_argument("--moves-per-round", type=_moves_per_round, default=1,
+                   help="deployments moved per round: a positive int "
+                        "(1 = reference-faithful) or 'all' (global solve)")
     r.add_argument("--namespace", default="default")
 
     b = sub.add_parser("bench", help="run the experiment matrix")
     b.add_argument("--scenario", default="mubench",
                    choices=["mubench", "dense", "powerlaw", "large"])
+    b.add_argument("--workmodel", default=None, help=workmodel_help)
     b.add_argument("--algorithms", default="spread,binpack,random,kubescheduling,communication,global")
     b.add_argument("--repeats", type=int, default=5)
     b.add_argument("--rounds", type=int, default=10)
@@ -57,6 +81,7 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("solve", help="one-shot global solve")
     s.add_argument("--scenario", default="mubench",
                    choices=["mubench", "dense", "powerlaw", "large"])
+    s.add_argument("--workmodel", default=None, help=workmodel_help)
     s.add_argument("--sweeps", type=int, default=8)
     s.add_argument("--balance-weight", type=float, default=0.0)
     s.add_argument("--seed", type=int, default=0)
@@ -76,11 +101,19 @@ def cmd_reschedule(args) -> dict:
     algo = _norm_algo(args.algorithm)
     if args.backend == "k8s":
         from kubernetes_rescheduling_tpu.backends.k8s import K8sBackend
-        from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+        from kubernetes_rescheduling_tpu.core.workmodel import (
+            Workmodel,
+            mubench_workmodel_c,
+        )
 
-        backend = K8sBackend(workmodel=mubench_workmodel_c(), namespace=args.namespace)
+        wm = (
+            Workmodel.from_file(args.workmodel)
+            if args.workmodel
+            else mubench_workmodel_c()
+        )
+        backend = K8sBackend(workmodel=wm, namespace=args.namespace)
     else:
-        backend = make_backend(args.scenario, args.seed)
+        backend = make_backend(args.scenario, args.seed, workmodel_path=args.workmodel)
         if args.imbalance:
             backend.inject_imbalance(backend.node_names[0])
     cfg = RescheduleConfig(
@@ -88,6 +121,7 @@ def cmd_reschedule(args) -> dict:
         max_rounds=args.rounds,
         hazard_threshold_pct=args.threshold,
         sleep_after_action_s=0.0 if args.backend == "sim" else 15.0,
+        moves_per_round=args.moves_per_round,
         seed=args.seed,
     )
     result = run_controller(backend, cfg, key=jax.random.PRNGKey(args.seed))
@@ -107,6 +141,7 @@ def cmd_bench(args) -> dict:
         repeats=args.repeats,
         rounds=args.rounds,
         scenario=args.scenario,
+        workmodel=args.workmodel,
         out_dir=args.out,
         seed=args.seed,
     )
@@ -121,7 +156,7 @@ def cmd_solve(args) -> dict:
     from kubernetes_rescheduling_tpu.parallel import solve_with_restarts
     from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
 
-    backend = make_backend(args.scenario, args.seed)
+    backend = make_backend(args.scenario, args.seed, workmodel_path=args.workmodel)
     state = backend.monitor()
     graph = backend.comm_graph()
     cfg = GlobalSolverConfig(sweeps=args.sweeps, balance_weight=args.balance_weight)

@@ -37,7 +37,12 @@ class RescheduleConfig:
     hazard_threshold_pct: float = 30.0     # reference harzard_detect.py:7
     max_rounds: int = 10                   # reference main.py:28
     sleep_after_action_s: float = 15.0     # reference main.py:27 (live backend only)
-    moves_per_round: int = 1               # 1 = reference-faithful (one deployment/round)
+    # Deployments moved per greedy round. 1 = reference-faithful (one
+    # victim, delete_replaced_pod.py:154); k = up to k victims drained from
+    # the hazard node (stopping early once no hazard remains); "all" = the
+    # SURVEY §7 greedy→global bridge, routing the round through the batched
+    # global solver regardless of algorithm.
+    moves_per_round: int | str = 1
 
     # New capabilities
     backend: str = "sim"                   # "sim" | "k8s"
@@ -62,8 +67,13 @@ class RescheduleConfig:
             raise ValueError(
                 f"unknown algorithm {self.algorithm!r}; expected one of {sorted(valid)}"
             )
-        if self.max_rounds < 0 or self.moves_per_round < 1:
-            raise ValueError("max_rounds must be >= 0 and moves_per_round >= 1")
+        if self.max_rounds < 0:
+            raise ValueError("max_rounds must be >= 0")
+        mpr = self.moves_per_round
+        if not (mpr == "all" or (isinstance(mpr, int) and mpr >= 1)):
+            raise ValueError(
+                f"moves_per_round must be a positive int or 'all', got {mpr!r}"
+            )
         return self
 
     @classmethod

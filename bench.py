@@ -16,6 +16,7 @@ Environment knobs:
   BENCH_SWEEPS    solver sweeps per round (default 8)
   BENCH_REPS      timed repetitions (default 5)
   BENCH_RESTARTS  best-of-N solves over the device mesh (default 1)
+  BENCH_TRACE_DIR write a jax.profiler trace of the timed loop here
 """
 
 from __future__ import annotations
@@ -52,13 +53,16 @@ def main() -> int:
 
     # single-round latency: fence every round (includes one full host<->device
     # round trip per solve — the tunnel RTT floor alone is ~65 ms here)
+    from kubernetes_rescheduling_tpu.utils.profiling import trace_to
+
     times = []
-    for i in range(reps):
-        k = jax.random.PRNGKey(i + 1)
-        t0 = time.perf_counter()
-        _, inf = global_assign(state, graph, k, cfg)
-        float(inf["objective_after"])  # host read = completion fence
-        times.append(time.perf_counter() - t0)
+    with trace_to(os.environ.get("BENCH_TRACE_DIR")):
+        for i in range(reps):
+            k = jax.random.PRNGKey(i + 1)
+            t0 = time.perf_counter()
+            _, inf = global_assign(state, graph, k, cfg)
+            float(inf["objective_after"])  # host read = completion fence
+            times.append(time.perf_counter() - t0)
     single_ms = sorted(times)[len(times) // 2] * 1e3  # median
 
     # steady-state per-round latency: the online control loop — each round's

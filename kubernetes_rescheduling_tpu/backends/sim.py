@@ -173,6 +173,23 @@ class SimBackend:
     def advance(self, seconds: float) -> None:
         self.clock_s += seconds
 
+    def restore_placement(self, state: ClusterState) -> int:
+        """Pin pods back to the placement recorded in a checkpoint snapshot
+        (crash-resume support; pods are matched by name)."""
+        node_of: dict[str, int] = {}
+        pod_node = np.asarray(state.pod_node)
+        valid = np.asarray(state.pod_valid)
+        for i, name in enumerate(state.pod_names):
+            if valid[i]:
+                node_of[name] = int(pod_node[i])
+        restored = 0
+        for pod in self._pods:
+            if pod[2] in node_of:
+                pod[1] = node_of[pod[2]]
+                restored += 1
+        self.events.append({"t": self.clock_s, "event": "restore", "pods": restored})
+        return restored
+
     # ---- fault injection (SURVEY.md §5.3) ----
 
     def inject_imbalance(self, node: str) -> None:

@@ -25,6 +25,9 @@ from kubernetes_rescheduling_tpu.backends.k8s import PlacementMechanism
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.utils.checkpoint import CheckpointManager
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+from kubernetes_rescheduling_tpu.utils.profiling import LatencyHistogram
 from kubernetes_rescheduling_tpu.parallel.sharded import solve_with_restarts
 from kubernetes_rescheduling_tpu.solver.global_solver import GlobalSolverConfig
 from kubernetes_rescheduling_tpu.solver.round_loop import decide
@@ -42,11 +45,13 @@ class RoundRecord:
     decision_latency_s: float  # device-side decision time (no cluster I/O)
     services_moved: tuple[str, ...] = ()  # every Deployment recreated this round
     decisions: int = 1         # decide()/solve calls this round (normalizes latency)
+    decision_latencies_s: tuple[float, ...] = ()  # per-decision samples
 
 
 @dataclass
 class ControllerResult:
     rounds: list[RoundRecord] = field(default_factory=list)
+    resumed_from_round: int = 0  # >0 when a checkpoint resume skipped rounds
 
     @property
     def decisions_per_sec(self) -> float:
@@ -57,6 +62,16 @@ class ControllerResult:
     @property
     def moves(self) -> int:
         return sum(1 for r in self.rounds if r.moved)
+
+    def latency_summary(self) -> dict[str, float]:
+        """Per-decision latency distribution (utils.profiling histogram),
+        built from the real per-decision samples — a round's compile-heavy
+        first decide shows up in max/p99 instead of being averaged away."""
+        hist = LatencyHistogram()
+        for r in self.rounds:
+            for s in r.decision_latencies_s:
+                hist.add(s)
+        return hist.summary()
 
 
 # the same decision kernel the scanned loop uses (solver.round_loop.decide),
@@ -70,25 +85,50 @@ def run_controller(
     *,
     key: jax.Array | None = None,
     on_round=None,
+    checkpoint_dir: str | None = None,
+    logger: StructuredLogger | None = None,
 ) -> ControllerResult:
     """Run ``config.max_rounds`` rounds against a backend.
 
     ``on_round(record, state)`` — if given — is called after each round with
     the completed record and the post-move snapshot; the harness uses it to
     sustain simulated request load while the loop runs (reference
-    release2.sh:50-59) and for per-round checkpointing.
+    release2.sh:50-59).
+
+    ``checkpoint_dir`` enables crash-resume: the post-move snapshot is saved
+    every round, and on start the latest checkpoint (if any) restores the
+    backend placement (``restore_placement``, sim only — a live cluster IS
+    its own state) and skips the already-completed rounds. Per-round keys
+    derive from ``fold_in(key, round)`` so a resumed run makes the same
+    decisions the uninterrupted run would have.
+
+    ``logger`` records one structured event per round (SURVEY §5.5 gap).
     """
     config = config.validate()
     key = key if key is not None else jax.random.PRNGKey(config.seed)
     graph = backend.comm_graph()
     result = ControllerResult()
 
+    mgr = CheckpointManager(checkpoint_dir) if checkpoint_dir else None
+    start_round = 1
+    if mgr is not None:
+        latest = mgr.latest()
+        if latest is not None:
+            done_round, saved_state, _extra = latest
+            restore = getattr(backend, "restore_placement", None)
+            if restore is not None:
+                restore(saved_state)
+            start_round = done_round + 1
+            result.resumed_from_round = start_round
+            if logger is not None:
+                logger.info("resume", round=start_round, checkpoint=done_round)
+
     # one snapshot per round: the post-move snapshot provides this round's
     # metrics AND the next round's state (a live monitor() is 4 cluster-wide
     # API calls — doubling it per round doubles API-server load)
     state = backend.monitor()
-    for rnd in range(1, config.max_rounds + 1):
-        key, sub = jax.random.split(key)
+    for rnd in range(start_round, config.max_rounds + 1):
+        sub = jax.random.fold_in(key, rnd)
 
         if config.algorithm == "global" or config.moves_per_round == "all":
             record = _global_round(backend, state, graph, config, sub, rnd)
@@ -99,6 +139,19 @@ def run_controller(
         record.communication_cost = float(communication_cost(state, graph))
         record.load_std = float(load_std(state))
         result.rounds.append(record)
+        if mgr is not None:
+            mgr.save(rnd, state, extra={"algorithm": config.algorithm})
+        if logger is not None:
+            logger.info(
+                "round",
+                round=rnd,
+                moved=record.moved,
+                services=list(record.services_moved),
+                most_hazard=record.most_hazard,
+                communication_cost=record.communication_cost,
+                load_std=record.load_std,
+                decision_latency_s=record.decision_latency_s,
+            )
         if on_round is not None:
             on_round(record, state)
     return result
@@ -115,17 +168,15 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
     first_hazard: str | None = None
     moved_names: list[str] = []
     first_target: str | None = None
-    latency = 0.0
-    n_decisions = 0
+    latencies: list[float] = []
 
     for i in range(k_moves):
         key, sub = jax.random.split(key)
-        n_decisions += 1
         t0 = time.perf_counter()
         most, hazard_mask, victim, svc, target = jax.block_until_ready(
             _decide(state, graph, pid, jnp.asarray(config.hazard_threshold_pct), sub)
         )
-        latency += time.perf_counter() - t0
+        latencies.append(time.perf_counter() - t0)
 
         most_i, victim_i, target_i = int(most), int(victim), int(target)
         if first_hazard is None and most_i >= 0:
@@ -172,9 +223,10 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         target=first_target,
         communication_cost=0.0,  # filled by run_controller from the post-move snapshot
         load_std=0.0,
-        decision_latency_s=latency,
+        decision_latency_s=sum(latencies),
         services_moved=tuple(moved_names),
-        decisions=n_decisions,
+        decisions=len(latencies),
+        decision_latencies_s=tuple(latencies),
     )
 
 
@@ -224,4 +276,5 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         load_std=0.0,
         decision_latency_s=latency,
         services_moved=tuple(moved_names),
+        decision_latencies_s=(latency,),
     )

@@ -47,6 +47,7 @@ from kubernetes_rescheduling_tpu.config import RescheduleConfig
 from kubernetes_rescheduling_tpu.core.topology import _random_workmodel
 from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, mubench_workmodel_c
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
 
 
 @dataclass(frozen=True)
@@ -64,6 +65,10 @@ class ExperimentConfig:
     scenario: str = "mubench"          # mubench | dense | powerlaw | large
     workmodel: str | None = None       # external workmodel JSON (overrides scenario topology)
     out_dir: str = "result"
+    # named sessions are resumable: completed (algorithm, run) cells are
+    # loaded from their run.json, a crashed cell resumes from its latest
+    # per-round checkpoint. None = fresh timestamped session every call.
+    session_name: str | None = None
     seed: int = 0
     hazard_threshold_pct: float = 30.0
     inject_imbalance: bool = True      # the cordon trick
@@ -142,14 +147,44 @@ def make_backend(
 
 
 def run_experiment(cfg: ExperimentConfig) -> dict:
-    """Run the full matrix; returns (and writes) the summary."""
-    session = Path(cfg.out_dir) / f"session_{time.strftime('%Y%m%d_%H%M%S')}"
-    summary: dict = {"config": dataclasses.asdict(cfg), "runs": []}
+    """Run the full matrix; returns (and writes) the summary.
+
+    With ``cfg.session_name`` set, the session is resumable after a crash:
+    finished (algorithm, run) cells reload from their ``run.json`` marker,
+    and a half-finished cell restores the simulator from its latest
+    per-round checkpoint and continues (SURVEY §5.4 — the reference restarts
+    from round 1, losing the experiment).
+    """
+    stamp = cfg.session_name or time.strftime("%Y%m%d_%H%M%S")
+    session = Path(cfg.out_dir) / f"session_{stamp}"
+    cfg_dict = dataclasses.asdict(cfg)
+    summary: dict = {"config": cfg_dict, "runs": []}
+
+    if cfg.session_name:
+        # a resumed session must be the SAME experiment: reloading another
+        # config's run.json would silently mix results
+        session.mkdir(parents=True, exist_ok=True)
+        fingerprint = {k: v for k, v in cfg_dict.items() if k != "out_dir"}
+        fp_file = session / "config.json"
+        if fp_file.is_file():
+            saved = json.loads(fp_file.read_text())
+            if saved != json.loads(json.dumps(fingerprint, default=float)):
+                raise ValueError(
+                    f"session {cfg.session_name!r} was created with a different "
+                    f"config; refusing to mix results (delete {session} or use "
+                    "a new session name)"
+                )
+        else:
+            fp_file.write_text(json.dumps(fingerprint, default=float))
 
     for algo in cfg.algorithms:
         for run_i in range(1, cfg.repeats + 1):
             run_dir = session / algo / f"run_{run_i}"
             run_dir.mkdir(parents=True, exist_ok=True)
+            run_marker = run_dir / "run.json"
+            if cfg.session_name and run_marker.is_file():
+                summary["runs"].append(json.loads(run_marker.read_text()))
+                continue
             seed = cfg.seed * 1000 + run_i
             backend = make_backend(cfg.scenario, seed, workmodel_path=cfg.workmodel)
             if cfg.inject_imbalance:
@@ -164,16 +199,32 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
             std_sink = node_std_sink(run_dir)
             cost_sink = communication_cost_sink(run_dir)
             rounds_sink = JsonlSink(run_dir / "rounds.jsonl")
+            logger = StructuredLogger(name=f"{algo}/run_{run_i}", path=run_dir / "log.jsonl")
 
-            # phase r1: load against the imbalanced "Before" placement
-            before = backend.monitor()
-            load_before = loadgen.measure(before, k_before)
-            before_metrics = {
-                "communication_cost": float(communication_cost(before, graph)),
-                "load_std": float(load_std(before)),
-                "response_time_ms": load_before.latency_avg_ms,
-            }
-            std_sink.append(before_metrics["load_std"])
+            # phase r1: load against the imbalanced "Before" placement.
+            # Persisted immediately so a crash-resume doesn't re-measure
+            # "before" against a mid-rescheduling cluster.
+            phase1 = run_dir / "phase1.json"
+            if cfg.session_name and phase1.is_file():
+                saved = json.loads(phase1.read_text())
+                before_metrics = saved["before"]
+                load_before_dict = saved["load_before"]
+            else:
+                before = backend.monitor()
+                load_before = loadgen.measure(before, k_before)
+                load_before_dict = load_before.as_dict()
+                before_metrics = {
+                    "communication_cost": float(communication_cost(before, graph)),
+                    "load_std": float(load_std(before)),
+                    "response_time_ms": load_before.latency_avg_ms,
+                }
+                std_sink.append(before_metrics["load_std"])
+                phase1.write_text(
+                    json.dumps(
+                        {"before": before_metrics, "load_before": load_before_dict},
+                        default=float,
+                    )
+                )
 
             # phase r2: the control loop under sustained load — per round,
             # simulate the segment's requests with teardown outages for every
@@ -193,6 +244,10 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
             seg_state = {"clock": backend.clock_s, "i": 0}
 
             def on_round(rec, state, _ss=seg_state, _backend=backend, _during=during):
+                # sinks written in-loop so a crash keeps completed rounds'
+                # rows (the reference CSV schemas) for the resumed session
+                std_sink.append(rec.load_std)
+                rounds_sink.append(rec.__dict__)
                 seg_dur = max(_backend.clock_s - _ss["clock"], 1e-9)
                 _ss["clock"] = _backend.clock_s
                 n_req = max(
@@ -220,7 +275,12 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
             events_mark = len(backend.events)
             t0 = time.perf_counter()
             result = run_controller(
-                backend, rcfg, key=jax.random.PRNGKey(seed), on_round=on_round
+                backend,
+                rcfg,
+                key=jax.random.PRNGKey(seed),
+                on_round=on_round,
+                checkpoint_dir=str(run_dir / "checkpoints") if cfg.session_name else None,
+                logger=logger,
             )
             wall_s = time.perf_counter() - t0
             during.restarts = sum(
@@ -229,9 +289,6 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                 if e.get("event") == "move"
             )
             load_during = during.stats()
-            for rec in result.rounds:
-                std_sink.append(rec.load_std)
-                rounds_sink.append(rec.__dict__)
 
             # phase r3: load against the final placement
             after = backend.monitor()
@@ -243,29 +300,40 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
             }
             cost_sink.append(after_metrics["communication_cost"])
 
-            summary["runs"].append(
-                {
-                    "algorithm": algo,
-                    "run": run_i,
-                    "seed": seed,
-                    "before": before_metrics,
-                    "after": after_metrics,
-                    "load": {
-                        "before": load_before.as_dict(),
-                        "during": load_during.as_dict(),
-                        "after": load_after.as_dict(),
-                    },
-                    "moves": result.moves,
-                    "decisions_per_sec": result.decisions_per_sec,
-                    "wall_s": wall_s,
-                    "sim_clock_s": backend.clock_s,
-                }
-            )
+            run_record = {
+                "algorithm": algo,
+                "run": run_i,
+                "seed": seed,
+                "before": before_metrics,
+                "after": after_metrics,
+                "load": {
+                    "before": load_before_dict,
+                    "during": load_during.as_dict(),
+                    "after": load_after.as_dict(),
+                },
+                "moves": result.moves,
+                "decisions_per_sec": result.decisions_per_sec,
+                "decision_latency": result.latency_summary(),
+                "resumed_from_round": result.resumed_from_round,
+                "wall_s": wall_s,
+                "sim_clock_s": backend.clock_s,
+            }
+            run_marker.write_text(json.dumps(run_record, default=float))
+            logger.info("run_complete", moves=result.moves)
+            summary["runs"].append(run_record)
 
-    # per-algorithm aggregates (mean over runs)
+    # per-algorithm aggregates (mean over runs). Final-placement metrics
+    # average over every run; loop-phase metrics (decision rate, disruption)
+    # only over runs that actually executed rounds — a crash-resumed cell
+    # whose loop had already finished contributes zeros that would skew them.
     agg: dict[str, dict] = {}
     for algo in cfg.algorithms:
         runs = [r for r in summary["runs"] if r["algorithm"] == algo]
+        looped = [r for r in runs if r["decision_latency"].get("count", 0) > 0]
+
+        def loop_mean(metric_fn):
+            return float(np.mean([metric_fn(r) for r in looped])) if looped else 0.0
+
         agg[algo] = {
             "communication_cost": float(
                 np.mean([r["after"]["communication_cost"] for r in runs])
@@ -274,15 +342,11 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
             "response_time_ms": float(
                 np.mean([r["after"]["response_time_ms"] for r in runs])
             ),
-            "error_rate_during": float(
-                np.mean([r["load"]["during"]["error_rate"] for r in runs])
+            "error_rate_during": loop_mean(
+                lambda r: r["load"]["during"]["error_rate"]
             ),
-            "restarts": float(
-                np.mean([r["load"]["during"]["restarts"] for r in runs])
-            ),
-            "decisions_per_sec": float(
-                np.mean([r["decisions_per_sec"] for r in runs])
-            ),
+            "restarts": loop_mean(lambda r: r["load"]["during"]["restarts"]),
+            "decisions_per_sec": loop_mean(lambda r: r["decisions_per_sec"]),
         }
     summary["aggregate"] = agg
 

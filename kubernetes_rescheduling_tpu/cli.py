@@ -76,6 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--repeats", type=int, default=5)
     b.add_argument("--rounds", type=int, default=10)
     b.add_argument("--out", default="result")
+    b.add_argument("--session", default=None,
+                   help="named session: re-running with the same name "
+                        "resumes a crashed matrix instead of restarting")
+    b.add_argument("--moves-per-round", type=_moves_per_round, default=1)
+    b.add_argument("--restarts", type=int, default=1,
+                   help="best-of-N global solves per round (global algorithm)")
     b.add_argument("--seed", type=int, default=0)
 
     s = sub.add_parser("solve", help="one-shot global solve")
@@ -143,6 +149,9 @@ def cmd_bench(args) -> dict:
         scenario=args.scenario,
         workmodel=args.workmodel,
         out_dir=args.out,
+        session_name=args.session,
+        moves_per_round=args.moves_per_round,
+        solver_restarts=args.restarts,
         seed=args.seed,
     )
     return run_experiment(cfg)

@@ -36,10 +36,13 @@ class Backend(Protocol):
         """The service communication graph."""
         ...
 
-    def apply_move(self, move: MoveRequest) -> bool:
+    def apply_move(self, move: MoveRequest) -> str | None:
         """Tear down the service's Deployment and re-create it pinned/steered
-        to the target node. Returns False if the move failed (the round is
-        then treated as a skip, reference main.py:103-107)."""
+        to the target node. Returns the node the Deployment actually landed
+        on — which may differ from ``move.target_node`` when the mechanism
+        leaves the choice to the scheduler (``affinityOnly``; a live cluster
+        can only report the advisory target there) — or None if the move
+        failed (the round is then a skip, reference main.py:103-107)."""
         ...
 
     def advance(self, seconds: float) -> None:

@@ -354,16 +354,18 @@ class K8sBackend:
             self.sleeper(interval)
         return False
 
-    def apply_move(self, move: MoveRequest) -> bool:
+    def apply_move(self, move: MoveRequest) -> str | None:
         """Foreground delete + pinned re-create (reference
-        delete_replaced_pod.py:144-185 + rescheduling.py:57-73)."""
+        delete_replaced_pod.py:144-185 + rescheduling.py:57-73). Returns the
+        landing node on success (the advisory target for ``affinityOnly`` —
+        the live scheduler's pick is only observable at the next monitor)."""
         name = move.service
         try:
             dep = self.apps_api.read_namespaced_deployment(
                 name=name, namespace=self.namespace
             )
         except Exception:
-            return False
+            return None
         if not isinstance(dep, dict):
             # real client model → plain dict
             from kubernetes.client import ApiClient  # type: ignore
@@ -391,16 +393,16 @@ class K8sBackend:
             )
         except Exception as e:
             if getattr(e, "status", None) != 404:  # already gone = fine
-                return False  # transient failure: skip the round, keep the loop alive
+                return None  # transient failure: skip the round, keep the loop alive
         if not self._wait_deleted(name):
-            return False  # timeout → skip round (reference delete_replaced_pod.py:178-180)
+            return None  # timeout → skip round (reference delete_replaced_pod.py:178-180)
         try:
             self.apps_api.create_namespaced_deployment(
                 namespace=self.namespace, body=body
             )
-            return True
+            return move.target_node
         except Exception:
-            return False
+            return None
 
     def advance(self, seconds: float) -> None:
         self.sleeper(seconds)

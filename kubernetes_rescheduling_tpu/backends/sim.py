@@ -89,6 +89,7 @@ class SimBackend:
         self._rng = np.random.default_rng(self.seed)
         self._graph = self.workmodel.comm_graph()
         self._svc_index = {n: i for i, n in enumerate(self.workmodel.names)}
+        self._rps_cache: dict[str, float] | None = None
         self.clock_s = 0.0
         self.events: list[dict] = []
         n = len(self.node_names)
@@ -142,15 +143,29 @@ class SimBackend:
         )
 
     def apply_move(self, move: MoveRequest) -> bool:
-        """Foreground delete + pinned re-create of one service's Deployment
-        (reference delete_replaced_pod.py:173-177 + rescheduling.py:57-73)."""
+        """Foreground delete + re-create of one service's Deployment
+        (reference delete_replaced_pod.py:173-177 + rescheduling.py:57-73).
+
+        ``mechanism`` is honored the way the cluster would: ``nodeName`` and
+        ``nodeSelector`` pin to the requested target, while ``affinityOnly``
+        (the kubescheduling policy, reference rescheduling.py:159-171) only
+        excludes the anti-affinity nodes and lets the *simulated scheduler*
+        choose — least-allocated CPU, tie → first node in order, the same
+        model the kubescheduling policy kernel implements. The requested
+        target is advisory for that mechanism, exactly as on a real cluster.
+        """
         if move.service not in self._svc_index:
-            return False
-        if move.target_node not in self.node_names:
-            return False
-        target = self.node_names.index(move.target_node)
+            return None
+        if move.mechanism == "affinityOnly":
+            target = self._scheduler_choice(exclude=move.hazard_nodes)
+            if target is None:
+                return None
+        else:
+            if move.target_node not in self.node_names:
+                return None
+            target = self.node_names.index(move.target_node)
         if not self._node_alive[target]:
-            return False
+            return None
         svc_idx = self._svc_index[move.service]
         moved = 0
         for pod in self._pods:
@@ -158,20 +173,51 @@ class SimBackend:
                 pod[1] = target
                 moved += 1
         self.clock_s += self.reconcile_delay_s
+        landed = self.node_names[target]
         self.events.append(
             {
                 "t": self.clock_s,
                 "event": "move",
                 "service": move.service,
-                "target": move.target_node,
+                "target": landed,  # where pods actually went
+                "requested": move.target_node,
                 "pods": moved,
                 "mechanism": move.mechanism,
             }
         )
-        return moved > 0
+        return landed if moved > 0 else None
 
     def advance(self, seconds: float) -> None:
         self.clock_s += seconds
+
+    def _scheduler_choice(self, exclude: tuple[str, ...] = ()) -> int | None:
+        """The sim's stand-in for the default kube-scheduler: least-allocated
+        CPU among alive, non-excluded nodes; tie → first in node order.
+
+        Computed host-side from the pod table (no full monitor() snapshot);
+        the rps propagation is cached since workmodel and load are fixed
+        per backend."""
+        if self._rps_cache is None:
+            self._rps_cache = self.load.service_rps(self.workmodel)
+        rps = self._rps_cache
+        replicas = {s.name: max(1, s.replicas) for s in self.workmodel.services}
+        used = np.zeros(len(self.node_names))
+        for svc_idx, node, _name in self._pods:
+            if node < 0:
+                continue
+            spec = self.workmodel.services[svc_idx]
+            per_pod = (
+                self.load.idle_m
+                + rps.get(spec.name, 0.0) / replicas[spec.name] * self.load.cost_per_req_m
+            )
+            used[node] += per_pod * self._cpu_spike.get(spec.name, 1.0)
+        best, best_used = None, np.inf
+        for i, name in enumerate(self.node_names):
+            if not self._node_alive[i] or name in exclude:
+                continue
+            if used[i] < best_used:
+                best, best_used = i, float(used[i])
+        return best
 
     def restore_placement(self, state: ClusterState) -> int:
         """Pin pods back to the placement recorded in a checkpoint snapshot

@@ -39,13 +39,27 @@ class RoundRecord:
     moved: bool
     most_hazard: str | None
     service: str | None
-    target: str | None
+    target: str | None  # node the first move actually landed on
     communication_cost: float
     load_std: float
-    decision_latency_s: float  # device-side decision time (no cluster I/O)
     services_moved: tuple[str, ...] = ()  # every Deployment recreated this round
-    decisions: int = 1         # decide()/solve calls this round (normalizes latency)
-    decision_latencies_s: tuple[float, ...] = ()  # per-decision samples
+    decision_latencies_s: tuple[float, ...] = ()  # one sample per decide/solve
+
+    @property
+    def decision_latency_s(self) -> float:
+        """Total device-side decision time this round (no cluster I/O)."""
+        return sum(self.decision_latencies_s)
+
+    @property
+    def decisions(self) -> int:
+        return len(self.decision_latencies_s)
+
+    def as_dict(self) -> dict:
+        return {
+            **self.__dict__,
+            "decision_latency_s": self.decision_latency_s,
+            "decisions": self.decisions,
+        }
 
 
 @dataclass
@@ -139,8 +153,6 @@ def run_controller(
         record.communication_cost = float(communication_cost(state, graph))
         record.load_std = float(load_std(state))
         result.rounds.append(record)
-        if mgr is not None:
-            mgr.save(rnd, state, extra={"algorithm": config.algorithm})
         if logger is not None:
             logger.info(
                 "round",
@@ -154,6 +166,11 @@ def run_controller(
             )
         if on_round is not None:
             on_round(record, state)
+        # checkpoint LAST: a crash inside on_round (sinks, load segment)
+        # replays this round on resume instead of leaving a hole in its
+        # outputs; replaying a move is idempotent (same pin, same target)
+        if mgr is not None:
+            mgr.save(rnd, state, extra={"algorithm": config.algorithm})
     return result
 
 
@@ -195,7 +212,7 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
             for j in range(state.num_nodes)
             if bool(hazard_mask[j])
         )
-        ok = backend.apply_move(
+        landed = backend.apply_move(
             MoveRequest(
                 service=service_name,
                 target_node=target_name,
@@ -203,16 +220,23 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
                 mechanism=PlacementMechanism[config.algorithm],
             )
         )
-        if not ok:
+        if landed is None:
             break
         moved_names.append(service_name)
         if first_target is None:
-            first_target = target_name
+            first_target = landed
         if i + 1 < k_moves:
-            # re-home the moved service in the working snapshot
+            # re-home the moved service in the working snapshot — to where
+            # it actually LANDED (the scheduler may have overridden the
+            # advisory target under the affinityOnly mechanism)
+            landed_i = (
+                state.node_names.index(landed)
+                if landed in state.node_names
+                else target_i
+            )
             svc_pods = (state.pod_service == int(svc)) & state.pod_valid
             state = state.replace(
-                pod_node=jnp.where(svc_pods, target_i, state.pod_node)
+                pod_node=jnp.where(svc_pods, landed_i, state.pod_node)
             )
 
     return RoundRecord(
@@ -223,9 +247,7 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         target=first_target,
         communication_cost=0.0,  # filled by run_controller from the post-move snapshot
         load_std=0.0,
-        decision_latency_s=sum(latencies),
         services_moved=tuple(moved_names),
-        decisions=len(latencies),
         decision_latencies_s=tuple(latencies),
     )
 
@@ -256,15 +278,15 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         if s in seen:
             continue
         seen.add(s)
-        ok = backend.apply_move(
+        landed = backend.apply_move(
             MoveRequest(
                 service=graph.names[s],
                 target_node=new_state.node_names[int(new_nodes[i])],
                 mechanism=PlacementMechanism["global"],
             )
         )
-        moved_any = moved_any or ok
-        if ok:
+        moved_any = moved_any or landed is not None
+        if landed is not None:
             moved_names.append(graph.names[s])
     return RoundRecord(
         round=rnd,
@@ -274,7 +296,6 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         target=None,
         communication_cost=0.0,  # filled by run_controller from the post-move snapshot
         load_std=0.0,
-        decision_latency_s=latency,
         services_moved=tuple(moved_names),
         decision_latencies_s=(latency,),
     )

@@ -63,6 +63,9 @@ class ExperimentConfig:
     repeats: int = 5                   # reference auto_full_pipeline_repeat.sh:10
     rounds: int = 10                   # reference main.py:28
     scenario: str = "mubench"          # mubench | dense | powerlaw | large
+    backend: str = "sim"               # sim | k8s (live cluster, like the
+                                       # reference's auto_full_pipeline_repeat.sh)
+    namespace: str = "default"         # k8s backend only (reference main.py:68)
     workmodel: str | None = None       # external workmodel JSON (overrides scenario topology)
     out_dir: str = "result"
     # named sessions are resumable: completed (algorithm, run) cells are
@@ -146,7 +149,24 @@ def make_backend(
     raise ValueError(f"unknown scenario {scenario!r}")
 
 
-def run_experiment(cfg: ExperimentConfig) -> dict:
+def make_experiment_backend(cfg: ExperimentConfig, seed: int, **k8s_apis):
+    """Backend for one matrix cell: the hermetic simulator, or the live
+    cluster adapter when ``cfg.backend == "k8s"`` (the reference's pipeline
+    always runs live, auto_full_pipeline_repeat.sh:25-187). ``k8s_apis``
+    passes through client objects (tests inject fakes)."""
+    if cfg.backend == "k8s":
+        from kubernetes_rescheduling_tpu.backends.k8s import K8sBackend
+
+        wm = (
+            Workmodel.from_file(cfg.workmodel)
+            if cfg.workmodel
+            else mubench_workmodel_c()
+        )
+        return K8sBackend(workmodel=wm, namespace=cfg.namespace, **k8s_apis)
+    return make_backend(cfg.scenario, seed, workmodel_path=cfg.workmodel)
+
+
+def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
     """Run the full matrix; returns (and writes) the summary.
 
     With ``cfg.session_name`` set, the session is resumable after a crash:
@@ -186,13 +206,16 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                 summary["runs"].append(json.loads(run_marker.read_text()))
                 continue
             seed = cfg.seed * 1000 + run_i
-            backend = make_backend(cfg.scenario, seed, workmodel_path=cfg.workmodel)
-            if cfg.inject_imbalance:
+            backend = make_experiment_backend(cfg, seed, **backend_kwargs)
+            if cfg.inject_imbalance and hasattr(backend, "inject_imbalance"):
                 backend.inject_imbalance(backend.node_names[0])
 
             graph = backend.comm_graph()
+            load_model = getattr(backend, "load", None)
             loadgen = LoadGenerator(
-                backend.workmodel, cfg.load, fanout_frac=backend.load.fanout_frac
+                backend.workmodel,
+                cfg.load,
+                fanout_frac=load_model.fanout_frac if load_model else 1.0,
             )
             key = jax.random.PRNGKey(seed)
             key, k_before, k_during, k_after = jax.random.split(key, 4)
@@ -241,15 +264,22 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
             )
             during = new_samples()
             reconcile = getattr(backend, "reconcile_delay_s", 0.0)
-            seg_state = {"clock": backend.clock_s, "i": 0}
 
-            def on_round(rec, state, _ss=seg_state, _backend=backend, _during=during):
+            def clock(_backend=backend):
+                # sim: the simulated clock; live cluster: wall time
+                c = getattr(_backend, "clock_s", None)
+                return time.monotonic() if c is None else c
+
+            seg_state = {"clock": clock(), "i": 0}
+
+            def on_round(rec, state, _ss=seg_state, _during=during):
                 # sinks written in-loop so a crash keeps completed rounds'
                 # rows (the reference CSV schemas) for the resumed session
                 std_sink.append(rec.load_std)
-                rounds_sink.append(rec.__dict__)
-                seg_dur = max(_backend.clock_s - _ss["clock"], 1e-9)
-                _ss["clock"] = _backend.clock_s
+                rounds_sink.append(rec.as_dict())
+                now = clock()
+                seg_dur = max(now - _ss["clock"], 1e-9)
+                _ss["clock"] = now
                 n_req = max(
                     int(
                         cfg.load.requests_per_phase
@@ -272,7 +302,8 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                 )
                 _ss["i"] += 1
 
-            events_mark = len(backend.events)
+            events = getattr(backend, "events", None)
+            events_mark = len(events) if events is not None else 0
             t0 = time.perf_counter()
             result = run_controller(
                 backend,
@@ -283,11 +314,21 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                 logger=logger,
             )
             wall_s = time.perf_counter() - t0
-            during.restarts = sum(
-                int(e.get("pods", 0))
-                for e in backend.events[events_mark:]
-                if e.get("event") == "move"
-            )
+            if events is not None:
+                during.restarts = sum(
+                    int(e.get("pods", 0))
+                    for e in events[events_mark:]
+                    if e.get("event") == "move"
+                )
+            else:
+                # live backend keeps no event log: moves × replicas is the
+                # same disruption count (a Deployment's replicas all restart)
+                replicas = {s.name: max(1, s.replicas) for s in backend.workmodel.services}
+                during.restarts = sum(
+                    replicas.get(svc, 1)
+                    for rec in result.rounds
+                    for svc in rec.services_moved
+                )
             load_during = during.stats()
 
             # phase r3: load against the final placement
@@ -316,7 +357,7 @@ def run_experiment(cfg: ExperimentConfig) -> dict:
                 "decision_latency": result.latency_summary(),
                 "resumed_from_round": result.resumed_from_round,
                 "wall_s": wall_s,
-                "sim_clock_s": backend.clock_s,
+                "sim_clock_s": getattr(backend, "clock_s", None),
             }
             run_marker.write_text(json.dumps(run_record, default=float))
             logger.info("run_complete", moves=result.moves)

@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--namespace", default="default")
 
     b = sub.add_parser("bench", help="run the experiment matrix")
+    b.add_argument("--backend", default="sim", choices=["sim", "k8s"],
+                   help="k8s runs the matrix against the live cluster, like "
+                        "the reference's auto_full_pipeline_repeat.sh")
+    b.add_argument("--namespace", default="default")
     b.add_argument("--scenario", default="mubench",
                    choices=["mubench", "dense", "powerlaw", "large"])
     b.add_argument("--workmodel", default=None, help=workmodel_help)
@@ -133,7 +137,7 @@ def cmd_reschedule(args) -> dict:
     result = run_controller(backend, cfg, key=jax.random.PRNGKey(args.seed))
     return {
         "algorithm": algo,
-        "rounds": [rec.__dict__ for rec in result.rounds],
+        "rounds": [rec.as_dict() for rec in result.rounds],
         "moves": result.moves,
         "decisions_per_sec": result.decisions_per_sec,
     }
@@ -147,6 +151,8 @@ def cmd_bench(args) -> dict:
         repeats=args.repeats,
         rounds=args.rounds,
         scenario=args.scenario,
+        backend=args.backend,
+        namespace=args.namespace,
         workmodel=args.workmodel,
         out_dir=args.out,
         session_name=args.session,

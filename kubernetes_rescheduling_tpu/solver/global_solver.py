@@ -201,6 +201,11 @@ def global_assign(
             land_cpu = jnp.take_along_axis(prefix_cpu, o_prop[:, None], axis=1)[:, 0]
             land_mem = jnp.take_along_axis(prefix_mem, o_prop[:, None], axis=1)[:, 0]
             if config.enforce_capacity:
+                # Deliberately conservative: landing capacity is checked
+                # against pre-chunk loads plus same-target arrivals, ignoring
+                # room freed by same-chunk departures. A feasible move can be
+                # deferred to a later sweep (slower convergence under tight
+                # capacity), but an infeasible one can never be admitted.
                 ok = (cpu_load[o_prop] + land_cpu + o_cpu <= cap[o_prop]) & (
                     mem_load[o_prop] + land_mem + o_mem <= mem_cap[o_prop]
                 )

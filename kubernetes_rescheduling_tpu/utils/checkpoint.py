@@ -41,8 +41,12 @@ def save_state(state: ClusterState, path: str | Path, extra: dict | None = None)
     """
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
+    # write-then-rename, .json before .npz: latest() discovers checkpoints
+    # by .npz, so a kill at any point leaves either no round_k entry or a
+    # complete one — never a truncated file that poisons every later resume
+    tmp_npz = Path(f"{p}.tmp.npz")  # numpy insists on the .npz extension
     np.savez_compressed(
-        Path(f"{p}.npz"),
+        tmp_npz,
         **{f: np.asarray(getattr(state, f)) for f in _ARRAY_FIELDS},
     )
     meta = {
@@ -50,7 +54,10 @@ def save_state(state: ClusterState, path: str | Path, extra: dict | None = None)
         "pod_names": list(state.pod_names),
         "extra": extra or {},
     }
-    Path(f"{p}.json").write_text(json.dumps(meta, default=float))
+    tmp_json = Path(f"{p}.json.tmp")
+    tmp_json.write_text(json.dumps(meta, default=float))
+    tmp_json.rename(f"{p}.json")
+    tmp_npz.rename(f"{p}.npz")
 
 
 def load_state(path: str | Path) -> tuple[ClusterState, dict]:
@@ -82,20 +89,26 @@ class CheckpointManager:
         return path
 
     def latest(self) -> tuple[int, ClusterState, dict] | None:
-        """Most recent checkpoint, or None (start from round 1)."""
-        rounds = self._rounds()
-        if not rounds:
-            return None
-        r = rounds[-1]
-        state, extra = load_state(Path(self.directory) / f"round_{r:06d}")
-        return r, state, extra
+        """Most recent *loadable* checkpoint, or None (start from round 1).
+
+        A checkpoint a previous crash left unreadable is skipped (falling
+        back to the one before it) rather than poisoning every resume."""
+        for r in reversed(self._rounds()):
+            try:
+                state, extra = load_state(Path(self.directory) / f"round_{r:06d}")
+                return r, state, extra
+            except Exception:
+                continue
+        return None
 
     def _rounds(self) -> list[int]:
         d = Path(self.directory)
         if not d.is_dir():
             return []
         return sorted(
-            int(f.stem.split("_")[1]) for f in d.glob("round_*.npz")
+            int(f.stem.split("_")[1])
+            for f in d.glob("round_*.npz")
+            if not f.stem.endswith(".tmp")  # half-written leftovers
         )
 
     def _gc(self) -> None:

@@ -1,0 +1,15 @@
+"""Pallas TPU kernels for the solver's hot ops.
+
+The global solver's chunk step is launch-bound: after the neighbor-mass
+matmul, XLA runs a dependent chain of ~15 small ops (score, feasibility,
+argmax, pairwise admission) whose per-kernel overhead dominates at
+C = 1024, N = 1024. These kernels fuse that epilogue into two Pallas
+calls so each chunk step is matmul + 2 kernels + a couple of scatters.
+"""
+
+from kubernetes_rescheduling_tpu.ops.fused_admission import (
+    fused_score_admission,
+    reference_score_admission,
+)
+
+__all__ = ["fused_score_admission", "reference_score_admission"]

@@ -1,0 +1,329 @@
+"""Fused score → argmax → capacity-admission for one solver chunk step.
+
+Semantics (identical to the XLA path in ``solver.global_solver.chunk_step``,
+which remains the reference implementation and the fallback):
+
+1. ``score[c, n] = M[c, n] − λ · proj_cpu[c, n] / cap[n] · 100 (+ gumbel)``
+   where ``proj_cpu`` is the node's CPU load if service c landed on n.
+2. Feasibility: fits capacity (or is the current node), node valid.
+3. ``prop[c]`` = first-max feasible node; ``gain`` vs the current node.
+4. Admission: a proposal lands only if the target's free capacity covers
+   every strictly-higher-priority same-target arrival plus itself
+   (priority = greater gain, ties → lower chunk index — the stable-sort
+   order of the reference path).
+
+Two kernels:
+
+- ``_score_kernel`` — grid over C tiles; per tile the [BC, N] score block
+  lives only in VMEM (never HBM), reduced on the fly to per-service
+  ``prop / gain / wants / slack`` vectors.
+- ``_admission_kernel`` — one program; the pairwise priority race as a
+  [C, C] MXU matmul against the per-service move masses.
+
+Gumbel noise uses the TPU core PRNG (`pltpu.prng_seed` / ``prng_random_bits``)
+seeded per (chunk, tile), so the fused path is deterministic for a fixed
+seed but samples a different stream than ``jax.random.gumbel`` — annealing
+noise has no parity requirement (the XLA reference path is compared against
+this path with ``temp = 0``).
+
+On non-TPU backends the kernels run only under ``interpret=True`` (tests);
+production CPU solves use the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+
+def _score_kernel(
+    lam_ref,        # SMEM (1, 1) f32
+    temp_ref,       # SMEM (1, 1) f32
+    seed_ref,       # SMEM (1, 1) i32
+    m_ref,          # VMEM (BC, N) f32 — neighbor mass for this C tile
+    cur_ref,        # VMEM (BC, 1) i32
+    c_cpu_ref,      # VMEM (BC, 1) f32
+    c_mem_ref,      # VMEM (BC, 1) f32
+    valid_ref,      # VMEM (BC, 1) i32
+    cpu_load_ref,   # VMEM (1, N) f32
+    mem_load_ref,   # VMEM (1, N) f32
+    cap_ref,        # VMEM (1, N) f32
+    mem_cap_ref,    # VMEM (1, N) f32
+    node_valid_ref, # VMEM (1, N) i32
+    prop_ref,       # out VMEM (BC, 1) i32
+    gain_ref,       # out VMEM (BC, 1) f32
+    wants_ref,      # out VMEM (BC, 1) i32
+    slack_cpu_ref,  # out VMEM (BC, 1) f32
+    slack_mem_ref,  # out VMEM (BC, 1) f32
+    *,
+    enforce_capacity: bool,
+    use_noise: bool,
+):
+    bc, n = m_ref.shape
+    lam = lam_ref[0, 0]
+    cur = cur_ref[:]                                      # (BC, 1)
+    c_cpu = c_cpu_ref[:]
+    c_mem = c_mem_ref[:]
+    col = jax.lax.broadcasted_iota(jnp.int32, (bc, n), 1)
+    is_cur = col == cur                                   # (BC, N)
+
+    proj_cpu = cpu_load_ref[:] + jnp.where(is_cur, 0.0, c_cpu)
+    score = m_ref[:] - lam * (proj_cpu / cap_ref[:]) * 100.0
+    if use_noise:
+        pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+        bits = pltpu.prng_random_bits((bc, n))
+        # uniform in (0, 1): keep 23 low bits — sign-safe whatever the
+        # carrier dtype (a plain uint32→f32 convert can go through a signed
+        # path and yield negatives, turning the log-log below into NaNs)
+        mant = (bits & 0x7FFFFF).astype(jnp.float32)
+        u = (mant + 0.5) * (1.0 / 8388608.0)
+        score = score + temp_ref[0, 0] * (-jnp.log(-jnp.log(u)))
+
+    if enforce_capacity:
+        proj_mem = mem_load_ref[:] + jnp.where(is_cur, 0.0, c_mem)
+        fits = (proj_cpu <= cap_ref[:]) & (proj_mem <= mem_cap_ref[:])
+        feasible = (fits | is_cur) & (node_valid_ref[:] != 0)
+    else:
+        feasible = jnp.broadcast_to(node_valid_ref[:] != 0, (bc, n))
+
+    masked = jnp.where(feasible, score, _NEG_INF)
+    prop_score = jnp.max(masked, axis=1, keepdims=True)   # (BC, 1)
+    # first-max parity with jnp.argmax: lowest column index among maxima
+    at_max = masked == prop_score
+    big = jnp.int32(n)
+    prop = jnp.min(jnp.where(at_max, col, big), axis=1, keepdims=True)
+    prop = jnp.minimum(prop, big - 1)
+    cur_score = jnp.sum(jnp.where(is_cur, score, 0.0), axis=1, keepdims=True)
+    gain = prop_score - cur_score
+    wants = (valid_ref[:] != 0) & (gain > 0) & (prop != cur)
+
+    is_prop = col == prop
+    load_p = jnp.sum(jnp.where(is_prop, cpu_load_ref[:], 0.0), axis=1, keepdims=True)
+    cap_p = jnp.sum(jnp.where(is_prop, cap_ref[:], 0.0), axis=1, keepdims=True)
+    mload_p = jnp.sum(jnp.where(is_prop, mem_load_ref[:], 0.0), axis=1, keepdims=True)
+    mcap_p = jnp.sum(jnp.where(is_prop, mem_cap_ref[:], 0.0), axis=1, keepdims=True)
+
+    prop_ref[:] = prop
+    gain_ref[:] = gain
+    wants_ref[:] = wants.astype(jnp.int32)
+    slack_cpu_ref[:] = cap_p - load_p - c_cpu
+    slack_mem_ref[:] = mcap_p - mload_p - c_mem
+
+
+def _admission_kernel(
+    prop_ref,       # VMEM (BC, 1) i32 — this row tile
+    gain_ref,       # VMEM (BC, 1) f32
+    wants_ref,      # VMEM (BC, 1) i32
+    cur_ref,        # VMEM (BC, 1) i32
+    slack_cpu_ref,  # VMEM (BC, 1) f32
+    slack_mem_ref,  # VMEM (BC, 1) f32
+    prop_row_ref,   # VMEM (1, C) i32 — full vectors, every tile
+    gain_row_ref,   # VMEM (1, C) f32
+    wants_row_ref,  # VMEM (1, C) i32
+    moving_cpu_ref, # VMEM (C, 1) f32: c_cpu where wants else 0
+    moving_mem_ref, # VMEM (C, 1) f32
+    new_node_ref,   # out VMEM (BC, 1) i32
+    admitted_ref,   # out VMEM (BC, 1) i32
+    *,
+    enforce_capacity: bool,
+):
+    bc = prop_ref.shape[0]
+    c = prop_row_ref.shape[1]
+    wants = wants_ref[:] != 0
+    if enforce_capacity:
+        gw = jnp.where(wants, gain_ref[:], _NEG_INF)          # (BC, 1)
+        gw_row = jnp.where(wants_row_ref[:] != 0, gain_row_ref[:], _NEG_INF)
+        ridx = pl.program_id(0) * bc + jax.lax.broadcasted_iota(
+            jnp.int32, (bc, c), 0
+        )
+        cidx = jax.lax.broadcasted_iota(jnp.int32, (bc, c), 1)
+        before = (gw_row > gw) | ((gw_row == gw) & (cidx < ridx))
+        pri = (
+            before
+            & (wants_row_ref[:] != 0)
+            & (prop_row_ref[:] == prop_ref[:])
+        ).astype(jnp.float32)                                 # (BC, C)
+        # HIGHEST precision: a default bf16-demoted matmul could round a
+        # landing mass down and admit a move the exact check would reject
+        land_cpu = jnp.dot(
+            pri, moving_cpu_ref[:],
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        land_mem = jnp.dot(
+            pri, moving_mem_ref[:],
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        ok = (land_cpu <= slack_cpu_ref[:]) & (land_mem <= slack_mem_ref[:])
+        admitted = wants & ok
+    else:
+        admitted = wants
+    new_node_ref[:] = jnp.where(admitted, prop_ref[:], cur_ref[:])
+    admitted_ref[:] = admitted.astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("enforce_capacity", "use_noise", "interpret", "block_c"),
+)
+def fused_score_admission(
+    M,            # f32[C, N] neighbor mass (kept-local comm weight per node)
+    cur,          # i32[C] current node per service
+    c_cpu,        # f32[C]
+    c_mem,        # f32[C]
+    valid_c,      # bool[C]
+    cpu_load,     # f32[N]
+    mem_load,     # f32[N]
+    cap,          # f32[N]
+    mem_cap,      # f32[N]
+    node_valid,   # bool[N]
+    lam,          # f32 scalar: balance weight
+    temp,         # f32 scalar: gumbel temperature
+    seed,         # i32 scalar: PRNG seed for this chunk
+    *,
+    enforce_capacity: bool,
+    use_noise: bool,
+    interpret: bool = False,
+    block_c: int = 256,
+):
+    """Returns ``(new_node i32[C], admitted bool[C])`` — the chunk step's
+    decision, fused into two Pallas calls."""
+    C, N = M.shape
+    bc = min(block_c, C)
+    grid = (pl.cdiv(C, bc),)
+
+    col_i32 = lambda x: x.reshape(C, 1).astype(jnp.int32)
+    col_f32 = lambda x: x.reshape(C, 1).astype(jnp.float32)
+    row_f32 = lambda x: x.reshape(1, N).astype(jnp.float32)
+    row_i32 = lambda x: x.reshape(1, N).astype(jnp.int32)
+
+    smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    cvec = pl.BlockSpec((bc, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    nvec = pl.BlockSpec((1, N), lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+    out_c = jax.ShapeDtypeStruct((C, 1), jnp.float32)
+    out_ci = jax.ShapeDtypeStruct((C, 1), jnp.int32)
+
+    prop, gain, wants, slack_cpu, slack_mem = pl.pallas_call(
+        functools.partial(
+            _score_kernel, enforce_capacity=enforce_capacity, use_noise=use_noise
+        ),
+        grid=grid,
+        in_specs=[
+            smem, smem, smem,
+            pl.BlockSpec((bc, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            cvec, cvec, cvec, cvec,
+            nvec, nvec, nvec, nvec, nvec,
+        ],
+        out_specs=[cvec, cvec, cvec, cvec, cvec],
+        out_shape=[out_ci, out_c, out_ci, out_c, out_c],
+        interpret=interpret,
+    )(
+        jnp.asarray(lam, jnp.float32).reshape(1, 1),
+        jnp.asarray(temp, jnp.float32).reshape(1, 1),
+        jnp.asarray(seed, jnp.int32).reshape(1, 1),
+        M.astype(jnp.float32),
+        col_i32(cur),
+        col_f32(c_cpu),
+        col_f32(c_mem),
+        col_i32(valid_c),
+        row_f32(cpu_load),
+        row_f32(mem_load),
+        row_f32(cap),
+        row_f32(mem_cap),
+        row_i32(node_valid),
+    )
+
+    # admission tiled over C rows: the (BC, C) priority block stays small
+    # while the full priority matrix would not fit VMEM at C ≥ ~1000
+    crow = pl.BlockSpec((1, C), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    cfull = pl.BlockSpec((C, 1), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    wants_b = wants != 0
+    new_node, admitted = pl.pallas_call(
+        functools.partial(_admission_kernel, enforce_capacity=enforce_capacity),
+        grid=grid,
+        in_specs=[cvec, cvec, cvec, cvec, cvec, cvec, crow, crow, crow,
+                  cfull, cfull],
+        out_specs=[cvec, cvec],
+        out_shape=[out_ci, out_ci],
+        interpret=interpret,
+    )(
+        prop,
+        gain,
+        wants,
+        col_i32(cur),
+        slack_cpu,
+        slack_mem,
+        prop.reshape(1, C),
+        gain.reshape(1, C),
+        wants.reshape(1, C),
+        jnp.where(wants_b, col_f32(c_cpu), 0.0),
+        jnp.where(wants_b, col_f32(c_mem), 0.0),
+    )
+    return new_node[:, 0], admitted[:, 0] != 0
+
+
+def reference_score_admission(
+    M, cur, c_cpu, c_mem, valid_c, cpu_load, mem_load, cap, mem_cap,
+    node_valid, lam, noise=None, *, enforce_capacity: bool,
+):
+    """Plain-XLA twin of :func:`fused_score_admission` — and the solver's
+    production XLA epilogue (one implementation, two lowerings).
+
+    Expressions mirror the kernel term for term (same f32 operation order),
+    so exact-equality parity between the two paths is structural, not a
+    rounding coincidence. ``noise`` is a caller-supplied [C, N] additive
+    score perturbation (the fused path samples the TPU core PRNG instead —
+    annealing noise carries no parity requirement).
+    """
+    C, N = M.shape
+    is_cur = jnp.arange(N)[None, :] == cur[:, None]
+    proj_cpu = cpu_load[None, :] + jnp.where(is_cur, 0.0, c_cpu[:, None])
+    score = M - lam * (proj_cpu / cap[None, :]) * 100.0
+    if noise is not None:
+        score = score + noise
+    if enforce_capacity:
+        proj_mem = mem_load[None, :] + jnp.where(is_cur, 0.0, c_mem[:, None])
+        fits = (proj_cpu <= cap[None, :]) & (proj_mem <= mem_cap[None, :])
+        feasible = (fits | is_cur) & node_valid[None, :]
+    else:
+        feasible = jnp.broadcast_to(node_valid[None, :], score.shape)
+    masked = jnp.where(feasible, score, -jnp.inf)
+    prop = jnp.argmax(masked, axis=1).astype(jnp.int32)
+    prop_score = jnp.take_along_axis(masked, prop[:, None], axis=1)[:, 0]
+    cur_score = jnp.take_along_axis(score, cur[:, None], axis=1)[:, 0]
+    gain = prop_score - cur_score
+    wants = valid_c & (gain > 0) & (prop != cur)
+    if enforce_capacity:
+        cidx = jnp.arange(C)
+        gain_w = jnp.where(wants, gain, -jnp.inf)
+        before = (gain_w[None, :] > gain_w[:, None]) | (
+            (gain_w[None, :] == gain_w[:, None]) & (cidx[None, :] < cidx[:, None])
+        )
+        pri = (before & wants[None, :] & (prop[None, :] == prop[:, None])).astype(
+            jnp.float32
+        )
+        land_cpu = jnp.dot(
+            pri, jnp.where(wants, c_cpu, 0.0),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        land_mem = jnp.dot(
+            pri, jnp.where(wants, c_mem, 0.0),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        slack_cpu = cap[prop] - cpu_load[prop] - c_cpu
+        slack_mem = mem_cap[prop] - mem_load[prop] - c_mem
+        ok = (land_cpu <= slack_cpu) & (land_mem <= slack_mem)
+        admitted = wants & ok
+    else:
+        admitted = wants
+    return jnp.where(admitted, prop, cur), admitted

@@ -40,6 +40,10 @@ from jax import lax
 
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+from kubernetes_rescheduling_tpu.ops.fused_admission import (
+    fused_score_admission,
+    reference_score_admission,
+)
 
 
 @struct.dataclass
@@ -69,6 +73,12 @@ class GlobalSolverConfig:
     # means the result can never get worse than the input. Set "float32"
     # for bit-identical scoring.
     matmul_dtype: str = struct.field(pytree_node=False, default="bfloat16")
+    # Fused Pallas epilogue (ops.fused_admission): score → argmax →
+    # pairwise admission in two kernels instead of XLA's ~15-op chain.
+    # "auto" = on for TPU backends at kernel-worthy sizes (C, N ≥ 128),
+    # off elsewhere (parity-tested in interpret mode; annealing noise uses
+    # the TPU core PRNG, a different stream than jax.random).
+    fused_epilogue: str = struct.field(pytree_node=False, default="auto")
 
 
 def _service_aggregates(state: ClusterState, num_services: int):
@@ -167,6 +177,34 @@ def global_assign(
         var = jnp.sum(jnp.where(state.node_valid, (pct - mean) ** 2, 0.0)) / nvalid
         return comm + config.balance_weight * jnp.sqrt(var)
 
+    # fused Pallas epilogue: on for real TPU at kernel-worthy sizes;
+    # "interpret" runs the same kernels through the interpreter (tests)
+    fused_interpret = config.fused_epilogue == "interpret"
+    use_fused = (
+        config.fused_epilogue in ("on", "interpret")
+        or (
+            config.fused_epilogue == "auto"
+            and jax.default_backend() == "tpu"
+            and C >= 128
+            and N >= 128
+        )
+    )
+
+    def _commit(inner, ids, valid_c, c_cpu, c_mem, cur, new_node, admitted):
+        """Apply a chunk's admitted moves to the sweep state (shared by the
+        fused and XLA epilogues)."""
+        assign, X, cpu_load, mem_load = inner
+        new_assign = assign.at[ids].set(new_node)
+        # incremental occupancy update: only the chunk's rows change
+        X = X.at[ids].set(
+            jax.nn.one_hot(new_node, N, dtype=mm_dtype) * valid_c[:, None]
+        )
+        d_cpu = jnp.where(admitted, c_cpu, 0.0)
+        d_mem = jnp.where(admitted, c_mem, 0.0)
+        cpu_load = cpu_load.at[new_node].add(d_cpu).at[cur].add(-d_cpu)
+        mem_load = mem_load.at[new_node].add(d_mem).at[cur].add(-d_mem)
+        return (new_assign, X, cpu_load, mem_load), jnp.sum(admitted)
+
     def sweep(carry, xs):
         sweep_key, temp = xs
         assign, best_assign, best_obj = carry
@@ -189,62 +227,43 @@ def global_assign(
             c_cpu = svc_cpu[ids]
             c_mem = svc_mem[ids]
             cur = assign[ids]
-            cur_oh = jax.nn.one_hot(cur, N, dtype=jnp.float32)
-            # projected CPU load% if the service lands on each node
-            proj_cpu = cpu_load[None, :] - cur_oh * c_cpu[:, None] + c_cpu[:, None]
-            proj_mem = mem_load[None, :] - cur_oh * c_mem[:, None] + c_mem[:, None]
-            score = M - config.balance_weight * (proj_cpu / cap[None, :]) * 100.0
-            if config.noise_temp > 0:
-                score = score + temp * jax.random.gumbel(chunk_key, score.shape)
 
-            if config.enforce_capacity:
-                fits = (proj_cpu <= cap[None, :]) & (proj_mem <= mem_cap[None, :])
-                feasible = (fits | cur_oh.astype(bool)) & state.node_valid[None, :]
-            else:
-                feasible = jnp.broadcast_to(state.node_valid[None, :], score.shape)
-
-            masked = jnp.where(feasible, score, -jnp.inf)
-            prop = jnp.argmax(masked, axis=1).astype(jnp.int32)
-            prop_score = jnp.take_along_axis(masked, prop[:, None], axis=1)[:, 0]
-            cur_score = jnp.take_along_axis(score, cur[:, None], axis=1)[:, 0]
-            gain = prop_score - cur_score
-            wants = valid_c & (gain > 0) & (prop != cur)
-
-            # within-chunk capacity race: admit by gain order via prefix sums
-            order = jnp.argsort(-jnp.where(wants, gain, -jnp.inf))
-            o_prop = prop[order]
-            o_cpu = jnp.where(wants[order], c_cpu[order], 0.0)
-            o_mem = jnp.where(wants[order], c_mem[order], 0.0)
-            oh_prop = jax.nn.one_hot(o_prop, N, dtype=jnp.float32)
-            prefix_cpu = jnp.cumsum(oh_prop * o_cpu[:, None], axis=0) - oh_prop * o_cpu[:, None]
-            prefix_mem = jnp.cumsum(oh_prop * o_mem[:, None], axis=0) - oh_prop * o_mem[:, None]
-            land_cpu = jnp.take_along_axis(prefix_cpu, o_prop[:, None], axis=1)[:, 0]
-            land_mem = jnp.take_along_axis(prefix_mem, o_prop[:, None], axis=1)[:, 0]
-            if config.enforce_capacity:
-                # Deliberately conservative: landing capacity is checked
-                # against pre-chunk loads plus same-target arrivals, ignoring
-                # room freed by same-chunk departures. A feasible move can be
-                # deferred to a later sweep (slower convergence under tight
-                # capacity), but an infeasible one can never be admitted.
-                ok = (cpu_load[o_prop] + land_cpu + o_cpu <= cap[o_prop]) & (
-                    mem_load[o_prop] + land_mem + o_mem <= mem_cap[o_prop]
+            # Score → argmax → sort-free pairwise admission. One shared
+            # implementation, two lowerings: the fused Pallas epilogue
+            # (ops.fused_admission, two kernels — the [C, N] score block
+            # never leaves VMEM) on TPU, and its plain-XLA twin
+            # reference_score_admission elsewhere. Admission semantics in
+            # both: a proposal lands only if the target's free capacity
+            # covers every higher-priority (greater gain, ties → lower
+            # index) same-target arrival plus itself — deliberately
+            # conservative: room freed by same-chunk departures is ignored,
+            # so a feasible move may be deferred to a later sweep but an
+            # infeasible one can never be admitted.
+            if use_fused:
+                seed = jax.random.randint(chunk_key, (), 0, 2**31 - 1)
+                new_node, admitted = fused_score_admission(
+                    M, cur, c_cpu, c_mem, valid_c,
+                    cpu_load, mem_load, cap, mem_cap, state.node_valid,
+                    config.balance_weight, temp, seed,
+                    enforce_capacity=config.enforce_capacity,
+                    # the TPU core PRNG has no interpret-mode lowering
+                    use_noise=config.noise_temp > 0 and not fused_interpret,
+                    interpret=fused_interpret,
                 )
             else:
-                ok = jnp.ones_like(land_cpu, bool)
-            admitted_sorted = wants[order] & ok
-            admitted = jnp.zeros_like(wants).at[order].set(admitted_sorted)
-
-            new_node = jnp.where(admitted, prop, cur)
-            new_assign = assign.at[ids].set(new_node)
-            # incremental occupancy update: only the chunk's rows change
-            X = X.at[ids].set(
-                jax.nn.one_hot(new_node, N, dtype=mm_dtype) * valid_c[:, None]
-            )
-            d_cpu = jnp.where(admitted, c_cpu, 0.0)
-            d_mem = jnp.where(admitted, c_mem, 0.0)
-            cpu_load = cpu_load.at[prop].add(d_cpu).at[cur].add(-d_cpu)
-            mem_load = mem_load.at[prop].add(d_mem).at[cur].add(-d_mem)
-            return (new_assign, X, cpu_load, mem_load), jnp.sum(admitted)
+                noise = (
+                    temp * jax.random.gumbel(chunk_key, M.shape)
+                    if config.noise_temp > 0
+                    else None
+                )
+                new_node, admitted = reference_score_admission(
+                    M, cur, c_cpu, c_mem, valid_c,
+                    cpu_load, mem_load, cap, mem_cap, state.node_valid,
+                    config.balance_weight, noise,
+                    enforce_capacity=config.enforce_capacity,
+                )
+            return _commit(inner, ids, valid_c, c_cpu, c_mem, cur,
+                           new_node, admitted)
 
         X0 = jax.nn.one_hot(assign, N, dtype=mm_dtype) * svc_valid[:, None]
         cpu_load, mem_load = loads(assign)

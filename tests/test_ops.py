@@ -1,0 +1,127 @@
+"""Fused Pallas chunk-step kernels vs the plain-XLA reference twin.
+
+Runs in Pallas interpret mode on the CPU mesh; the real-TPU path is
+exercised by bench.py. Noise is off for parity (the fused path samples the
+TPU core PRNG, a different stream by construction)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.ops import (
+    fused_score_admission,
+    reference_score_admission,
+)
+
+
+def random_instance(seed, C=64, N=128, tight=False):
+    rng = np.random.default_rng(seed)
+    M = jnp.asarray(
+        rng.integers(0, 6, size=(C, N)).astype(np.float32)
+    )  # small-int masses -> frequent exact ties
+    cur = jnp.asarray(rng.integers(0, N, size=C), jnp.int32)
+    c_cpu = jnp.asarray(rng.integers(1, 5, size=C) * 100.0, jnp.float32)
+    c_mem = jnp.asarray(rng.integers(0, 3, size=C) * 1e6, jnp.float32)
+    valid_c = jnp.asarray(rng.random(C) < 0.9)
+    cap_val = 2_000.0 if tight else 50_000.0
+    cap = jnp.full((N,), cap_val, jnp.float32)
+    cpu_load = jnp.asarray(rng.uniform(0, cap_val * 0.8, N), jnp.float32)
+    mem_cap = jnp.full((N,), 1e9, jnp.float32)
+    mem_load = jnp.asarray(rng.uniform(0, 1e8, N), jnp.float32)
+    node_valid = jnp.asarray(rng.random(N) < 0.95)
+    return (M, cur, c_cpu, c_mem, valid_c, cpu_load, mem_load, cap, mem_cap,
+            node_valid)
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("tight", [False, True])
+def test_fused_matches_reference(seed, tight):
+    args = random_instance(seed, tight=tight)
+    got_node, got_adm = fused_score_admission(
+        *args, 0.5, 0.0, seed, interpret=True, block_c=32,
+        enforce_capacity=True, use_noise=False,
+    )
+    exp_node, exp_adm = reference_score_admission(
+        *args, 0.5, None, enforce_capacity=True
+    )
+    np.testing.assert_array_equal(np.asarray(got_adm), np.asarray(exp_adm))
+    np.testing.assert_array_equal(np.asarray(got_node), np.asarray(exp_node))
+
+
+def test_fused_no_capacity_mode():
+    args = random_instance(3)
+    got_node, got_adm = fused_score_admission(
+        *args, 0.0, 0.0, 3, enforce_capacity=False, use_noise=False,
+        interpret=True, block_c=32,
+    )
+    exp_node, exp_adm = reference_score_admission(
+        *args, 0.0, None, enforce_capacity=False
+    )
+    np.testing.assert_array_equal(np.asarray(got_node), np.asarray(exp_node))
+    np.testing.assert_array_equal(np.asarray(got_adm), np.asarray(exp_adm))
+
+
+def test_admission_respects_capacity_race():
+    """Two proposals race for one nearly-full node: only the higher-gain
+    one lands (the other is deferred)."""
+    C, N = 8, 128
+    M = jnp.zeros((C, N), jnp.float32)
+    # services 0 and 1 both strongly prefer node 5
+    M = M.at[0, 5].set(10.0).at[1, 5].set(20.0)
+    cur = jnp.asarray([1, 2] + [0] * (C - 2), jnp.int32)
+    c_cpu = jnp.full((C,), 300.0)
+    c_mem = jnp.zeros((C,))
+    valid_c = jnp.asarray([True, True] + [False] * (C - 2))
+    cpu_load = jnp.zeros((N,)).at[5].set(500.0)
+    cap = jnp.full((N,), 1000.0)  # node 5 fits ONE 300m service, not two
+    mem_load = jnp.zeros((N,))
+    mem_cap = jnp.full((N,), 1e9)
+    node_valid = jnp.ones((N,), bool)
+    new_node, admitted = fused_score_admission(
+        M, cur, c_cpu, c_mem, valid_c, cpu_load, mem_load, cap, mem_cap,
+        node_valid, 0.0, 0.0, 0,
+        enforce_capacity=True, use_noise=False, interpret=True, block_c=8,
+    )
+    assert bool(admitted[1]) and int(new_node[1]) == 5   # higher gain wins
+    assert not bool(admitted[0])                         # loser deferred
+    assert int(new_node[0]) == 1                         # stays put
+
+
+def test_solver_fused_epilogue_matches_xla_path():
+    """The whole global solver, fused epilogue (interpret) vs XLA path:
+    identical assignments when annealing noise is off."""
+    from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+
+    scn = synthetic_scenario(n_pods=256, n_nodes=128, seed=9, mean_degree=4.0)
+    key = jax.random.PRNGKey(4)
+    base = dict(sweeps=3, noise_temp=0.0, balance_weight=0.5)
+    st_fused, info_fused = global_assign(
+        scn.state, scn.graph, key,
+        GlobalSolverConfig(**base, fused_epilogue="interpret"),
+    )
+    st_xla, info_xla = global_assign(
+        scn.state, scn.graph, key,
+        GlobalSolverConfig(**base, fused_epilogue="off"),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_fused.pod_node), np.asarray(st_xla.pod_node)
+    )
+    assert float(info_fused["objective_after"]) == pytest.approx(
+        float(info_xla["objective_after"])
+    )
+
+
+def test_fused_noise_is_deterministic_per_seed():
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("TPU core PRNG has no CPU interpret rule")
+    args = random_instance(5)
+    kw = dict(enforce_capacity=True, use_noise=True, interpret=True, block_c=32)
+    a1 = fused_score_admission(*args, 0.5, 1.0, 42, **kw)
+    a2 = fused_score_admission(*args, 0.5, 1.0, 42, **kw)
+    b = fused_score_admission(*args, 0.5, 1.0, 43, **kw)
+    np.testing.assert_array_equal(np.asarray(a1[0]), np.asarray(a2[0]))
+    assert not np.array_equal(np.asarray(a1[0]), np.asarray(b[0])) or not (
+        np.array_equal(np.asarray(a1[1]), np.asarray(b[1]))
+    )

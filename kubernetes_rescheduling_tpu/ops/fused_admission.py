@@ -121,6 +121,9 @@ def _admission_kernel(
     gain_ref,       # VMEM (BC, 1) f32
     wants_ref,      # VMEM (BC, 1) i32
     cur_ref,        # VMEM (BC, 1) i32
+    valid_ref,      # VMEM (BC, 1) i32
+    c_cpu_ref,      # VMEM (BC, 1) f32
+    c_mem_ref,      # VMEM (BC, 1) f32
     slack_cpu_ref,  # VMEM (BC, 1) f32
     slack_mem_ref,  # VMEM (BC, 1) f32
     prop_row_ref,   # VMEM (1, C) i32 — full vectors, every tile
@@ -130,11 +133,15 @@ def _admission_kernel(
     moving_mem_ref, # VMEM (C, 1) f32
     new_node_ref,   # out VMEM (BC, 1) i32
     admitted_ref,   # out VMEM (BC, 1) i32
+    x_rows_ref,     # out VMEM (BC, N) x_dtype: one-hot(new_node)·valid
+    d_cpu_ref,      # out VMEM (1, N) f32: net load delta, grid-accumulated
+    d_mem_ref,      # out VMEM (1, N) f32
     *,
     enforce_capacity: bool,
 ):
     bc = prop_ref.shape[0]
     c = prop_row_ref.shape[1]
+    n = x_rows_ref.shape[1]
     wants = wants_ref[:] != 0
     if enforce_capacity:
         gw = jnp.where(wants, gain_ref[:], _NEG_INF)          # (BC, 1)
@@ -165,13 +172,50 @@ def _admission_kernel(
         admitted = wants & ok
     else:
         admitted = wants
-    new_node_ref[:] = jnp.where(admitted, prop_ref[:], cur_ref[:])
+    new_node = jnp.where(admitted, prop_ref[:], cur_ref[:])
+    new_node_ref[:] = new_node
     admitted_ref[:] = admitted.astype(jnp.int32)
+
+    # the commit arithmetic, fused: the service's new occupancy row and the
+    # tile's net per-node load delta (moves in minus moves out)
+    ncol = jax.lax.broadcasted_iota(jnp.int32, (bc, n), 1)
+    is_new = ncol == new_node
+    x_rows_ref[:] = jnp.where(
+        is_new & (valid_ref[:] != 0), 1.0, 0.0
+    ).astype(x_rows_ref.dtype)
+    # mask the last tile's padding rows: per-row outputs beyond C are
+    # discarded by Pallas, but these (1, N) reductions would fold the
+    # padding rows' unspecified inputs into the accumulated deltas
+    in_range = (
+        pl.program_id(0) * bc
+        + jax.lax.broadcasted_iota(jnp.int32, (bc, 1), 0)
+    ) < c
+    a_cpu = jnp.where(admitted & in_range, c_cpu_ref[:], 0.0)
+    a_mem = jnp.where(admitted & in_range, c_mem_ref[:], 0.0)
+    is_old = ncol == cur_ref[:]
+    tile_d_cpu = jnp.sum(
+        jnp.where(is_new, a_cpu, 0.0) - jnp.where(is_old, a_cpu, 0.0),
+        axis=0, keepdims=True,
+    )
+    tile_d_mem = jnp.sum(
+        jnp.where(is_new, a_mem, 0.0) - jnp.where(is_old, a_mem, 0.0),
+        axis=0, keepdims=True,
+    )
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        d_cpu_ref[:] = jnp.zeros_like(d_cpu_ref)
+        d_mem_ref[:] = jnp.zeros_like(d_mem_ref)
+
+    d_cpu_ref[:] += tile_d_cpu
+    d_mem_ref[:] += tile_d_mem
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("enforce_capacity", "use_noise", "interpret", "block_c"),
+    static_argnames=(
+        "enforce_capacity", "use_noise", "interpret", "block_c", "x_dtype"
+    ),
 )
 def fused_score_admission(
     M,            # f32[C, N] neighbor mass (kept-local comm weight per node)
@@ -192,9 +236,12 @@ def fused_score_admission(
     use_noise: bool,
     interpret: bool = False,
     block_c: int = 256,
+    x_dtype=jnp.bfloat16,
 ):
-    """Returns ``(new_node i32[C], admitted bool[C])`` — the chunk step's
-    decision, fused into two Pallas calls."""
+    """Returns ``(new_node i32[C], admitted bool[C], x_rows x_dtype[C, N],
+    d_cpu f32[N], d_mem f32[N])`` — the chunk step's decision plus its
+    commit arithmetic (new occupancy rows and net per-node load deltas),
+    fused into two Pallas calls."""
     C, N = M.shape
     bc = min(block_c, C)
     grid = (pl.cdiv(C, bc),)
@@ -242,23 +289,38 @@ def fused_score_admission(
     )
 
     # admission tiled over C rows: the (BC, C) priority block stays small
-    # while the full priority matrix would not fit VMEM at C ≥ ~1000
+    # while the full priority matrix would not fit VMEM at C ≥ ~1000.
+    # The (1, N) load-delta outputs map every tile to the same block and
+    # accumulate across the sequential grid.
     crow = pl.BlockSpec((1, C), lambda i: (0, 0), memory_space=pltpu.VMEM)
     cfull = pl.BlockSpec((C, 1), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    nacc = pl.BlockSpec((1, N), lambda i: (0, 0), memory_space=pltpu.VMEM)
     wants_b = wants != 0
-    new_node, admitted = pl.pallas_call(
+    new_node, admitted, x_rows, d_cpu, d_mem = pl.pallas_call(
         functools.partial(_admission_kernel, enforce_capacity=enforce_capacity),
         grid=grid,
-        in_specs=[cvec, cvec, cvec, cvec, cvec, cvec, crow, crow, crow,
-                  cfull, cfull],
-        out_specs=[cvec, cvec],
-        out_shape=[out_ci, out_ci],
+        in_specs=[cvec, cvec, cvec, cvec, cvec, cvec, cvec, cvec, cvec,
+                  crow, crow, crow, cfull, cfull],
+        out_specs=[
+            cvec, cvec,
+            pl.BlockSpec((bc, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            nacc, nacc,
+        ],
+        out_shape=[
+            out_ci, out_ci,
+            jax.ShapeDtypeStruct((C, N), x_dtype),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+        ],
         interpret=interpret,
     )(
         prop,
         gain,
         wants,
         col_i32(cur),
+        col_i32(valid_c),
+        col_f32(c_cpu),
+        col_f32(c_mem),
         slack_cpu,
         slack_mem,
         prop.reshape(1, C),
@@ -267,7 +329,13 @@ def fused_score_admission(
         jnp.where(wants_b, col_f32(c_cpu), 0.0),
         jnp.where(wants_b, col_f32(c_mem), 0.0),
     )
-    return new_node[:, 0], admitted[:, 0] != 0
+    return (
+        new_node[:, 0],
+        admitted[:, 0] != 0,
+        x_rows,
+        d_cpu[0],
+        d_mem[0],
+    )
 
 
 def reference_score_admission(

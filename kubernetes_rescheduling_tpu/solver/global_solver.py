@@ -191,8 +191,10 @@ def global_assign(
     )
 
     def _commit(inner, ids, valid_c, c_cpu, c_mem, cur, new_node, admitted):
-        """Apply a chunk's admitted moves to the sweep state (shared by the
-        fused and XLA epilogues)."""
+        """Apply a chunk's admitted moves to the sweep state (XLA path only;
+        the fused epilogue computes the equivalent occupancy rows and load
+        deltas inside its admission kernel and commits inline — keep the
+        two in lockstep when changing either)."""
         assign, X, cpu_load, mem_load = inner
         new_assign = assign.at[ids].set(new_node)
         # incremental occupancy update: only the chunk's rows change
@@ -241,7 +243,7 @@ def global_assign(
             # infeasible one can never be admitted.
             if use_fused:
                 seed = jax.random.randint(chunk_key, (), 0, 2**31 - 1)
-                new_node, admitted = fused_score_admission(
+                new_node, admitted, x_rows, d_cpu, d_mem = fused_score_admission(
                     M, cur, c_cpu, c_mem, valid_c,
                     cpu_load, mem_load, cap, mem_cap, state.node_valid,
                     config.balance_weight, temp, seed,
@@ -249,6 +251,16 @@ def global_assign(
                     # the TPU core PRNG has no interpret-mode lowering
                     use_noise=config.noise_temp > 0 and not fused_interpret,
                     interpret=fused_interpret,
+                    x_dtype=mm_dtype,
+                )
+                return (
+                    (
+                        assign.at[ids].set(new_node),
+                        X.at[ids].set(x_rows),
+                        cpu_load + d_cpu,
+                        mem_load + d_mem,
+                    ),
+                    jnp.sum(admitted),
                 )
             else:
                 noise = (

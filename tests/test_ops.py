@@ -36,10 +36,13 @@ def random_instance(seed, C=64, N=128, tight=False):
 
 @pytest.mark.parametrize("seed", range(8))
 @pytest.mark.parametrize("tight", [False, True])
-def test_fused_matches_reference(seed, tight):
+# block_c=48 does not divide C=64: exercises the last partial tile, whose
+# padding rows must not leak into the accumulated load deltas
+@pytest.mark.parametrize("block_c", [32, 48])
+def test_fused_matches_reference(seed, tight, block_c):
     args = random_instance(seed, tight=tight)
-    got_node, got_adm = fused_score_admission(
-        *args, 0.5, 0.0, seed, interpret=True, block_c=32,
+    got_node, got_adm, x_rows, d_cpu, d_mem = fused_score_admission(
+        *args, 0.5, 0.0, seed, interpret=True, block_c=block_c,
         enforce_capacity=True, use_noise=False,
     )
     exp_node, exp_adm = reference_score_admission(
@@ -47,11 +50,24 @@ def test_fused_matches_reference(seed, tight):
     )
     np.testing.assert_array_equal(np.asarray(got_adm), np.asarray(exp_adm))
     np.testing.assert_array_equal(np.asarray(got_node), np.asarray(exp_node))
+    # fused commit outputs: occupancy rows and net per-node load deltas
+    (M, cur, c_cpu, c_mem, valid_c, cpu_load, *_rest) = args
+    N = M.shape[1]
+    exp_rows = jax.nn.one_hot(exp_node, N) * np.asarray(valid_c)[:, None]
+    np.testing.assert_array_equal(
+        np.asarray(x_rows, dtype=np.float32), np.asarray(exp_rows)
+    )
+    for got_delta, per_svc in ((d_cpu, c_cpu), (d_mem, c_mem)):
+        moved = np.where(np.asarray(exp_adm), np.asarray(per_svc), 0.0)
+        exp_d = np.zeros(N)
+        np.add.at(exp_d, np.asarray(exp_node), moved)
+        np.add.at(exp_d, np.asarray(cur), -moved)
+        np.testing.assert_allclose(np.asarray(got_delta), exp_d, atol=1e-4)
 
 
 def test_fused_no_capacity_mode():
     args = random_instance(3)
-    got_node, got_adm = fused_score_admission(
+    got_node, got_adm, *_ = fused_score_admission(
         *args, 0.0, 0.0, 3, enforce_capacity=False, use_noise=False,
         interpret=True, block_c=32,
     )
@@ -78,7 +94,7 @@ def test_admission_respects_capacity_race():
     mem_load = jnp.zeros((N,))
     mem_cap = jnp.full((N,), 1e9)
     node_valid = jnp.ones((N,), bool)
-    new_node, admitted = fused_score_admission(
+    new_node, admitted, *_ = fused_score_admission(
         M, cur, c_cpu, c_mem, valid_c, cpu_load, mem_load, cap, mem_cap,
         node_valid, 0.0, 0.0, 0,
         enforce_capacity=True, use_noise=False, interpret=True, block_c=8,
@@ -89,8 +105,13 @@ def test_admission_respects_capacity_race():
 
 
 def test_solver_fused_epilogue_matches_xla_path():
-    """The whole global solver, fused epilogue (interpret) vs XLA path:
-    identical assignments when annealing noise is off."""
+    """The whole global solver, fused epilogue (interpret) vs XLA path.
+
+    Per-chunk decisions are exactly equal for equal inputs (the kernel test
+    above), but the two paths accumulate load commits in different f32
+    association (scatter-add vs tile-reduced deltas), so after the first
+    commit an exact ulp-tie could in principle diverge — objectives must
+    agree tightly, placements near-identically."""
     from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
     from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
 
@@ -105,11 +126,10 @@ def test_solver_fused_epilogue_matches_xla_path():
         scn.state, scn.graph, key,
         GlobalSolverConfig(**base, fused_epilogue="off"),
     )
-    np.testing.assert_array_equal(
-        np.asarray(st_fused.pod_node), np.asarray(st_xla.pod_node)
-    )
+    same = np.asarray(st_fused.pod_node) == np.asarray(st_xla.pod_node)
+    assert same.mean() > 0.99
     assert float(info_fused["objective_after"]) == pytest.approx(
-        float(info_xla["objective_after"])
+        float(info_xla["objective_after"]), rel=1e-3
     )
 
 

@@ -1,0 +1,187 @@
+"""Property-based tests (hypothesis) — the invariants SURVEY §4 commits to:
+capacity constraints never violated, policy choices never land on hazard
+nodes, quantity parsing is total and monotone, admission is safe for any
+input. Randomized far wider than the seeded fixtures elsewhere."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from kubernetes_rescheduling_tpu.core.quantities import (
+    cpu_to_millicores,
+    format_millicores,
+    mem_to_bytes,
+)
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+from kubernetes_rescheduling_tpu.objectives import communication_cost
+from kubernetes_rescheduling_tpu.ops import (
+    fused_score_admission,
+    reference_score_admission,
+)
+from kubernetes_rescheduling_tpu.policies import POLICY_IDS, choose_node, detect_hazard
+from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+
+SETTINGS = settings(max_examples=25, deadline=None)
+
+
+# ---- quantities -----------------------------------------------------------
+
+_CPU_SUFFIX = st.sampled_from(["", "m", "n", "u"])
+
+
+@SETTINGS
+@given(st.integers(min_value=0, max_value=10**9), _CPU_SUFFIX)
+def test_cpu_parse_total_and_nonnegative(value, suffix):
+    out = cpu_to_millicores(f"{value}{suffix}")
+    assert isinstance(out, int) and out >= 0
+
+
+@SETTINGS
+@given(st.integers(min_value=0, max_value=10**6))
+def test_cpu_parse_monotone_in_value(value):
+    # more cores can never parse to fewer millicores
+    assert cpu_to_millicores(str(value + 1)) >= cpu_to_millicores(str(value))
+    assert cpu_to_millicores(f"{value + 1}m") >= cpu_to_millicores(f"{value}m")
+
+
+@SETTINGS
+@given(st.integers(min_value=0, max_value=10**7))
+def test_millicores_format_parse_roundtrip(m):
+    assert cpu_to_millicores(format_millicores(m)) == m
+
+
+_MEM_MULT = {"": 1, "Ki": 2**10, "Mi": 2**20, "Gi": 2**30,
+             "k": 10**3, "M": 10**6, "G": 10**9}
+
+
+@SETTINGS
+@given(
+    st.integers(min_value=0, max_value=10**6),
+    st.sampled_from(sorted(_MEM_MULT)),
+)
+def test_mem_parse_scales_exactly(value, suffix):
+    # the k8s quantity grammar: binary Ki/Mi/Gi, decimal lowercase-k/M/G
+    out = mem_to_bytes(f"{value}{suffix}")
+    assert out == value * _MEM_MULT[suffix]
+
+
+# ---- policies -------------------------------------------------------------
+
+def _state_from(pod_nodes, pod_cpu, n_nodes, cap):
+    n_pods = len(pod_nodes)
+    return ClusterState.build(
+        node_names=[f"w{i:02d}" for i in range(n_nodes)],
+        node_cpu_cap=[cap] * n_nodes,
+        node_mem_cap=[1e9] * n_nodes,
+        pod_services=list(range(n_pods)),
+        pod_nodes=pod_nodes,
+        pod_cpu=pod_cpu,
+        pod_mem=[0.0] * n_pods,
+        pod_names=[f"s{i}-0" for i in range(n_pods)],
+    )
+
+
+@SETTINGS
+@given(
+    st.integers(min_value=0, max_value=2**31 - 1),
+    st.sampled_from(list(POLICY_IDS)),
+)
+def test_choice_never_lands_on_hazard_node(seed, policy):
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(2, 8))
+    n_pods = int(rng.integers(1, 20))
+    state = _state_from(
+        rng.integers(0, n_nodes, n_pods).tolist(),
+        (rng.integers(1, 10, n_pods) * 100.0).tolist(),
+        n_nodes,
+        cap=4000.0,
+    )
+    graph = mubench_workmodel_c().comm_graph()
+    _, mask = detect_hazard(state, threshold=30.0)
+    got = int(
+        choose_node(
+            jnp.asarray(POLICY_IDS[policy]),
+            state,
+            graph,
+            jnp.asarray(int(rng.integers(0, min(n_pods, 20)))),
+            mask,
+            jax.random.PRNGKey(seed % 1000),
+        )
+    )
+    mask = np.asarray(mask)
+    if mask.all():
+        assert got == -1          # nowhere to go -> explicit no-choice
+    else:
+        assert got >= 0
+        assert not mask[got]      # anti-affinity always respected
+
+
+# ---- admission safety -----------------------------------------------------
+
+@SETTINGS
+@given(st.integers(min_value=0, max_value=2**31 - 1))
+def test_admission_never_overcommits(seed):
+    """For ANY instance: per target node, pre-chunk load plus all admitted
+    arrivals stays within capacity (departures deliberately not credited)."""
+    rng = np.random.default_rng(seed)
+    C = int(rng.integers(2, 48))
+    N = int(rng.integers(2, 32))
+    M = jnp.asarray(rng.integers(0, 5, (C, N)).astype(np.float32))
+    cur = jnp.asarray(rng.integers(0, N, C), jnp.int32)
+    c_cpu = jnp.asarray(rng.integers(1, 6, C) * 100.0, jnp.float32)
+    c_mem = jnp.zeros((C,), jnp.float32)
+    valid = jnp.asarray(rng.random(C) < 0.95)
+    cap_val = float(rng.integers(5, 20) * 100)
+    cap = jnp.full((N,), cap_val, jnp.float32)
+    load = jnp.asarray(rng.uniform(0, cap_val, N), jnp.float32)
+    common = (M, cur, c_cpu, c_mem, valid, load, jnp.zeros((N,)), cap,
+              jnp.full((N,), jnp.inf), jnp.ones((N,), bool))
+    ref = reference_score_admission(*common, 0.3, None, enforce_capacity=True)
+    fused = fused_score_admission(
+        *common, 0.3, 0.0, seed,
+        enforce_capacity=True, use_noise=False, interpret=True, block_c=16,
+    )
+    for new_node, admitted in (ref, fused[:2]):
+        new_node, admitted = np.asarray(new_node), np.asarray(admitted)
+        arrivals = np.zeros(N)
+        moved = np.where(admitted, np.asarray(c_cpu), 0.0)
+        mask = admitted & (new_node != np.asarray(cur))
+        np.add.at(arrivals, new_node[mask], moved[mask])
+        assert (np.asarray(load) + arrivals <= np.asarray(cap) + 1e-3).all()
+
+
+# ---- solver ---------------------------------------------------------------
+
+@SETTINGS
+@given(st.integers(min_value=0, max_value=2**31 - 1))
+def test_solver_never_worse_and_capacity_safe(seed):
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(2, 6))
+    n_pods = 20
+    cap = 4000.0
+    state = _state_from(
+        rng.integers(0, n_nodes, n_pods).tolist(),
+        (rng.integers(1, 8, n_pods) * 100.0).tolist(),
+        n_nodes,
+        cap=cap,
+    )
+    graph = mubench_workmodel_c().comm_graph()
+    cost0 = float(communication_cost(state, graph))
+    std0 = float(jnp.std(state.node_cpu_pct()[: n_nodes]))
+    lam = 0.5
+    new_state, info = global_assign(
+        state, graph, jax.random.PRNGKey(seed % 997),
+        GlobalSolverConfig(sweeps=3, balance_weight=lam, enforce_capacity=True),
+    )
+    cost1 = float(communication_cost(new_state, graph))
+    # never worse on the combined objective (the solver's guarantee)
+    assert cost1 + lam * float(
+        jnp.std(new_state.node_cpu_pct()[: n_nodes])
+    ) <= cost0 + lam * std0 + 1e-3
+    # capacity respected wherever the input respected it
+    used0 = np.asarray(state.node_cpu_used())[:n_nodes]
+    used1 = np.asarray(new_state.node_cpu_used())[:n_nodes]
+    ok0 = used0 <= cap
+    assert (used1[ok0] <= cap + 1e-3).all()

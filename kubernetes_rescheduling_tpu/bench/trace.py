@@ -21,7 +21,7 @@ import numpy as np
 
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 from kubernetes_rescheduling_tpu.core.workmodel import ServiceSpec, Workmodel
-from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost
+from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
 from kubernetes_rescheduling_tpu.solver.global_solver import (
     GlobalSolverConfig,
     global_assign,
@@ -101,6 +101,8 @@ class ReplayRecord:
     t: float
     cost_before_solve: float  # under the NEW weights, old placement
     cost_after_solve: float
+    load_std_before: float
+    load_std_after: float
     moves: int
 
 
@@ -135,6 +137,8 @@ def replay(
                 t=step.t,
                 cost_before_solve=before,
                 cost_after_solve=after,
+                load_std_before=float(load_std(state)),
+                load_std_after=float(load_std(new_state)),
                 moves=moves,
             )
         )

@@ -9,6 +9,7 @@ Subcommands:
   reschedule  run the control loop once (reference ``python3 main.py <algo>``)
   bench       run the experiment matrix (reference auto_full_pipeline_repeat.sh)
   solve       one-shot global solve on a scenario, printing objectives
+  trace       streaming trace replay (Bookinfo canary; BASELINE config 5)
 """
 
 from __future__ import annotations
@@ -88,6 +89,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="best-of-N global solves per round (global algorithm)")
     b.add_argument("--seed", type=int, default=0)
 
+    t = sub.add_parser(
+        "trace",
+        help="streaming trace replay: online rescheduling as edge weights "
+             "shift (Bookinfo canary rollout demo)",
+    )
+    t.add_argument("--steps", type=int, default=12)
+    t.add_argument("--replicas", type=int, default=1)
+    t.add_argument("--nodes", type=int, default=3)
+    t.add_argument("--sweeps", type=int, default=4)
+    t.add_argument("--balance-weight", type=float, default=0.5)
+    t.add_argument("--seed", type=int, default=0)
+
     s = sub.add_parser("solve", help="one-shot global solve")
     s.add_argument("--scenario", default="mubench",
                    choices=["mubench", "dense", "powerlaw", "large"])
@@ -163,6 +176,42 @@ def cmd_bench(args) -> dict:
     return run_experiment(cfg)
 
 
+def cmd_trace(args) -> dict:
+    import jax
+
+    from kubernetes_rescheduling_tpu.bench.trace import (
+        bookinfo_workmodel,
+        canary_trace,
+        replay,
+    )
+    from kubernetes_rescheduling_tpu.core.topology import state_from_workmodel
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
+
+    wm = bookinfo_workmodel(replicas=args.replicas)
+    state = state_from_workmodel(
+        wm,
+        node_names=[f"worker{i}" for i in range(args.nodes)],
+        node_cpu_cap_m=20_000.0,
+        seed=args.seed,
+    )
+    _, records = replay(
+        state,
+        wm.comm_graph(),
+        canary_trace(steps=args.steps),
+        key=jax.random.PRNGKey(args.seed),
+        config=GlobalSolverConfig(
+            sweeps=args.sweeps, balance_weight=args.balance_weight
+        ),
+    )
+    return {
+        "workmodel": wm.source,
+        "balance_weight": args.balance_weight,
+        "steps": [r.__dict__ for r in records],
+        "total_moves": sum(r.moves for r in records),
+        "final_cost": records[-1].cost_after_solve if records else None,
+    }
+
+
 def cmd_solve(args) -> dict:
     import jax
 
@@ -203,6 +252,7 @@ def main(argv: list[str] | None = None) -> int:
         "reschedule": cmd_reschedule,
         "bench": cmd_bench,
         "solve": cmd_solve,
+        "trace": cmd_trace,
     }[args.command]
     out = handler(args)
     json.dump(out, sys.stdout, indent=2, default=float)

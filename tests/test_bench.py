@@ -305,6 +305,20 @@ def test_cli_workmodel_file_reproduces_builtin(tmp_path, capsys):
     assert external["moves"] == builtin["moves"]
 
 
+def test_cli_trace(capsys):
+    rc = cli_main(["trace", "--steps", "5", "--sweeps", "2"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert len(out["steps"]) == 5
+    lam = out["balance_weight"]
+    # the solver's guarantee is on the COMBINED objective under the new
+    # weights (comm + lambda*std); comm alone may trade against balance
+    for s in out["steps"]:
+        before = s["cost_before_solve"] + lam * s["load_std_before"]
+        after = s["cost_after_solve"] + lam * s["load_std_after"]
+        assert after <= before + 1e-4
+
+
 def test_cli_bench(tmp_path, capsys):
     rc = cli_main(
         [

@@ -20,9 +20,13 @@ Each sweep:
   1. neighbor-mass matmul ``M = W[chunk] @ X`` (C×S · S×N — MXU work),
   2. score each (service, node): kept-local comm weight − λ·projected load%,
   3. every service in the chunk proposes its argmax feasible node,
-  4. within-chunk capacity races resolve by gain order (sorted prefix-sum
-     admission), improving moves commit, loads update incrementally,
-then scan to the next chunk. The best state seen across all sweeps (by true
+  4. within-chunk capacity races resolve by gain order (sort-free
+     pairwise-priority admission — a [C, C] MXU matmul against the
+     per-service move masses), improving moves commit, loads update
+     incrementally,
+then scan to the next chunk. On TPU, steps 2–4 plus the commit arithmetic
+run as two fused Pallas kernels (``ops.fused_admission``); elsewhere the
+term-for-term XLA twin runs. The best state seen across all sweeps (by true
 objective) is returned, so oscillation can never make the answer worse than
 the initial placement. Everything is static-shaped — service arrays are
 padded to a chunk multiple, so one compilation serves every round at a given

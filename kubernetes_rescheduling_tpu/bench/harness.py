@@ -83,6 +83,13 @@ class ExperimentConfig:
     balance_weight: float = 0.5
     solver_restarts: int = 1           # best-of-N global solves per round
     moves_per_round: int | str = 1     # k per greedy round, or "all"
+    # Packing budget for the global solver's feasibility (fraction of node
+    # capacity, with enforcement). On dense meshes the comm objective
+    # genuinely prefers total colocation at any moderate λ; the budget is
+    # what forces the pile apart — and since queueing delay is convex in
+    # utilization, it is also the response-time lever.
+    enforce_capacity: bool = False
+    capacity_frac: float = 1.0
 
 
 def make_backend(
@@ -260,6 +267,8 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 balance_weight=cfg.balance_weight,
                 solver_restarts=cfg.solver_restarts,
                 moves_per_round=cfg.moves_per_round,
+                enforce_capacity=cfg.enforce_capacity,
+                capacity_frac=cfg.capacity_frac,
                 seed=seed,
             )
             during = new_samples()

@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--moves-per-round", type=_moves_per_round, default=1)
     b.add_argument("--restarts", type=int, default=1,
                    help="best-of-N global solves per round (global algorithm)")
+    b.add_argument("--capacity-frac", type=float, default=None,
+                   help="enable capacity enforcement with this packing "
+                        "budget (fraction of node capacity; global "
+                        "algorithm only)")
     b.add_argument("--seed", type=int, default=0)
 
     t = sub.add_parser(
@@ -107,6 +111,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--workmodel", default=None, help=workmodel_help)
     s.add_argument("--sweeps", type=int, default=8)
     s.add_argument("--balance-weight", type=float, default=0.0)
+    s.add_argument("--capacity-frac", type=float, default=1.0,
+                   help="packing budget as a fraction of node capacity "
+                        "(solver feasibility + over-budget repulsion)")
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--restarts", type=int, default=1,
                    help="best-of-N independent solves, sharded over the "
@@ -171,6 +178,8 @@ def cmd_bench(args) -> dict:
         session_name=args.session,
         moves_per_round=args.moves_per_round,
         solver_restarts=args.restarts,
+        enforce_capacity=args.capacity_frac is not None,
+        capacity_frac=args.capacity_frac if args.capacity_frac is not None else 1.0,
         seed=args.seed,
     )
     return run_experiment(cfg)
@@ -223,7 +232,11 @@ def cmd_solve(args) -> dict:
     backend = make_backend(args.scenario, args.seed, workmodel_path=args.workmodel)
     state = backend.monitor()
     graph = backend.comm_graph()
-    cfg = GlobalSolverConfig(sweeps=args.sweeps, balance_weight=args.balance_weight)
+    cfg = GlobalSolverConfig(
+        sweeps=args.sweeps,
+        balance_weight=args.balance_weight,
+        capacity_frac=args.capacity_frac,
+    )
     new_state, info = solve_with_restarts(
         state,
         graph,

@@ -3,8 +3,9 @@
 Semantics (identical to the XLA path in ``solver.global_solver.chunk_step``,
 which remains the reference implementation and the fallback):
 
-1. ``score[c, n] = M[c, n] − λ · proj_cpu[c, n] / cap[n] · 100 (+ gumbel)``
-   where ``proj_cpu`` is the node's CPU load if service c landed on n.
+1. ``score[c, n] = M[c, n] − λ·proj_pct − ow·relu(proj_pct − 100) (+ gumbel)``
+   where ``proj_pct`` is the node's CPU load in % of the packing budget if
+   service c landed on n, and ``ow`` repels over-budget residency.
 2. Feasibility: fits capacity (or is the current node), node valid.
 3. ``prop[c]`` = first-max feasible node; ``gain`` vs the current node.
 4. Admission: a proposal lands only if the target's free capacity covers
@@ -44,6 +45,7 @@ _NEG_INF = float("-inf")
 
 def _score_kernel(
     lam_ref,        # SMEM (1, 1) f32
+    ow_ref,         # SMEM (1, 1) f32 — over-budget repulsion weight
     temp_ref,       # SMEM (1, 1) f32
     seed_ref,       # SMEM (1, 1) i32
     m_ref,          # VMEM (BC, N) f32 — neighbor mass for this C tile
@@ -74,7 +76,12 @@ def _score_kernel(
     is_cur = col == cur                                   # (BC, N)
 
     proj_cpu = cpu_load_ref[:] + jnp.where(is_cur, 0.0, c_cpu)
-    score = m_ref[:] - lam * (proj_cpu / cap_ref[:]) * 100.0
+    proj_pct = proj_cpu / cap_ref[:] * 100.0
+    score = (
+        m_ref[:]
+        - lam * proj_pct
+        - ow_ref[0, 0] * jnp.maximum(proj_pct - 100.0, 0.0)
+    )
     if use_noise:
         pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
         bits = pltpu.prng_random_bits((bc, n))
@@ -231,6 +238,7 @@ def fused_score_admission(
     lam,          # f32 scalar: balance weight
     temp,         # f32 scalar: gumbel temperature
     seed,         # i32 scalar: PRNG seed for this chunk
+    overload_weight=0.0,  # f32 scalar: repulsion per % beyond the budget
     *,
     enforce_capacity: bool,
     use_noise: bool,
@@ -264,7 +272,7 @@ def fused_score_admission(
         ),
         grid=grid,
         in_specs=[
-            smem, smem, smem,
+            smem, smem, smem, smem,
             pl.BlockSpec((bc, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
             cvec, cvec, cvec, cvec,
             nvec, nvec, nvec, nvec, nvec,
@@ -274,6 +282,7 @@ def fused_score_admission(
         interpret=interpret,
     )(
         jnp.asarray(lam, jnp.float32).reshape(1, 1),
+        jnp.asarray(overload_weight, jnp.float32).reshape(1, 1),
         jnp.asarray(temp, jnp.float32).reshape(1, 1),
         jnp.asarray(seed, jnp.int32).reshape(1, 1),
         M.astype(jnp.float32),
@@ -340,7 +349,7 @@ def fused_score_admission(
 
 def reference_score_admission(
     M, cur, c_cpu, c_mem, valid_c, cpu_load, mem_load, cap, mem_cap,
-    node_valid, lam, noise=None, *, enforce_capacity: bool,
+    node_valid, lam, noise=None, overload_weight=0.0, *, enforce_capacity: bool,
 ):
     """Plain-XLA twin of :func:`fused_score_admission` — and the solver's
     production XLA epilogue (one implementation, two lowerings).
@@ -354,7 +363,11 @@ def reference_score_admission(
     C, N = M.shape
     is_cur = jnp.arange(N)[None, :] == cur[:, None]
     proj_cpu = cpu_load[None, :] + jnp.where(is_cur, 0.0, c_cpu[:, None])
-    score = M - lam * (proj_cpu / cap[None, :]) * 100.0
+    proj_pct = proj_cpu / cap[None, :] * 100.0
+    score = (
+        M - lam * proj_pct
+        - overload_weight * jnp.maximum(proj_pct - 100.0, 0.0)
+    )
     if noise is not None:
         score = score + noise
     if enforce_capacity:

@@ -64,6 +64,22 @@ class GlobalSolverConfig:
     chunk_size: int = struct.field(pytree_node=False, default=0)
     balance_weight: float = struct.field(pytree_node=False, default=0.0)
     enforce_capacity: bool = struct.field(pytree_node=False, default=True)
+    # Utilization headroom: feasibility uses capacity_frac·capacity, the
+    # operator's packing budget (k8s clusters are not packed to 100%). On
+    # dense meshes the comm objective genuinely prefers total colocation —
+    # a finite budget is what forces the pile-up apart while comm cost is
+    # minimized within it; queueing (response time) is convex in
+    # utilization, so the budget is also the response-time lever.
+    capacity_frac: float = struct.field(pytree_node=False, default=1.0)
+    # Repulsion from over-budget nodes (active only with enforce_capacity —
+    # the no-budget mode keeps the reference's capacity-blind semantics):
+    # feasibility alone only vetoes moves that would newly exceed the
+    # budget — a node already past it (e.g. the cordon pile-up) is every
+    # resident's "current node" and so always feasible to stay on. This
+    # term charges comm-weight units per % of load beyond the budget,
+    # making over-budget residency score (and count in the objective)
+    # worse than relocating, so saturated nodes drain.
+    overload_weight: float = struct.field(pytree_node=False, default=10.0)
     # Annealing: Gumbel noise added to move scores, linearly decayed to zero
     # over the sweeps. Lets the search climb out of local optima of the
     # partition objective; the best-seen tracking below means noise can only
@@ -130,6 +146,12 @@ def global_assign(
     improve the true objective are ever adopted — the result is never worse
     than the input.
     """
+    if not config.capacity_frac > 0:
+        raise ValueError(
+            f"capacity_frac must be > 0, got {config.capacity_frac}"
+        )
+    # over-budget repulsion only exists alongside budget enforcement
+    ow = config.overload_weight if config.enforce_capacity else 0.0
     S = graph.num_services
     N = state.num_nodes
     C = config.chunk_size or max(1, min(1024, S // 10))
@@ -160,8 +182,11 @@ def global_assign(
 
     cpu_cap = jnp.where(state.node_valid, state.node_cpu_cap, 0.0)
     mem_cap_raw = jnp.where(state.node_valid, state.node_mem_cap, 0.0)
-    mem_cap = jnp.where(mem_cap_raw > 0, mem_cap_raw, jnp.inf)
-    cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0)
+    # capacity_frac shrinks the budget everywhere — feasibility checks and
+    # the load-% denominators alike (inf·frac stays inf), so "load %" means
+    # percent of the operator's packing budget throughout
+    mem_cap = jnp.where(mem_cap_raw > 0, mem_cap_raw, jnp.inf) * config.capacity_frac
+    cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0) * config.capacity_frac
     base_cpu = state.node_base_cpu
     base_mem = state.node_base_mem
 
@@ -179,7 +204,8 @@ def global_assign(
         nvalid = jnp.maximum(jnp.sum(state.node_valid), 1)
         mean = jnp.sum(pct) / nvalid
         var = jnp.sum(jnp.where(state.node_valid, (pct - mean) ** 2, 0.0)) / nvalid
-        return comm + config.balance_weight * jnp.sqrt(var)
+        overload = jnp.sum(jnp.maximum(pct - 100.0, 0.0))
+        return comm + config.balance_weight * jnp.sqrt(var) + ow * overload
 
     # fused Pallas epilogue: on for real TPU at kernel-worthy sizes;
     # "interpret" runs the same kernels through the interpreter (tests)
@@ -251,6 +277,7 @@ def global_assign(
                     M, cur, c_cpu, c_mem, valid_c,
                     cpu_load, mem_load, cap, mem_cap, state.node_valid,
                     config.balance_weight, temp, seed,
+                    overload_weight=ow,
                     enforce_capacity=config.enforce_capacity,
                     # the TPU core PRNG has no interpret-mode lowering
                     use_noise=config.noise_temp > 0 and not fused_interpret,
@@ -276,6 +303,7 @@ def global_assign(
                     M, cur, c_cpu, c_mem, valid_c,
                     cpu_load, mem_load, cap, mem_cap, state.node_valid,
                     config.balance_weight, noise,
+                    overload_weight=ow,
                     enforce_capacity=config.enforce_capacity,
                 )
             return _commit(inner, ids, valid_c, c_cpu, c_mem, cur,
@@ -297,8 +325,15 @@ def global_assign(
     # assignment). The solver's result only replaces the input when it beats
     # this, so "never worse than the input" holds even though assign0
     # (first-pod's-node collapse) may itself be worse than the input.
-    obj_true0 = communication_cost(state, graph) + config.balance_weight * load_std(
-        state
+    # load_std measures % of raw capacity; the solver's objective measures
+    # % of the packing budget — same units once divided by capacity_frac
+    pct_true0 = jnp.where(
+        state.node_valid, state.node_cpu_used() / cap * 100.0, 0.0
+    )
+    obj_true0 = (
+        communication_cost(state, graph)
+        + config.balance_weight * (load_std(state) / config.capacity_frac)
+        + ow * jnp.sum(jnp.maximum(pct_true0 - 100.0, 0.0))
     )
     obj0 = objective(assign0)
     keys = jax.random.split(key, config.sweeps)

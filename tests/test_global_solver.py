@@ -76,6 +76,46 @@ def test_beats_greedy_car():
     assert global_cost <= greedy_cost
 
 
+def test_capacity_frac_breaks_up_dense_pile():
+    """On a dense mesh the comm objective prefers total colocation at any
+    moderate lambda, leaving a piled-up node saturated; a packing budget
+    (capacity_frac) is what forces it apart — comm cost minimized within
+    the budget instead of globally."""
+    import jax.numpy as jnp
+
+    from kubernetes_rescheduling_tpu.bench.harness import make_backend
+
+    backend = make_backend("dense", seed=3)
+    backend.inject_imbalance(backend.node_names[0])
+    state = backend.monitor()
+    graph = backend.comm_graph()
+
+    free = global_assign(
+        state, graph, jax.random.PRNGKey(0),
+        GlobalSolverConfig(sweeps=4, balance_weight=0.5),
+    )[0]
+    # without a budget the pile survives (colocation is comm-optimal)
+    assert float(jnp.max(free.node_cpu_pct())) > 40.0
+
+    budget = 0.20
+    capped = global_assign(
+        state, graph, jax.random.PRNGKey(0),
+        GlobalSolverConfig(
+            sweeps=4, balance_weight=0.5,
+            enforce_capacity=True, capacity_frac=budget,
+        ),
+    )[0]
+    pct = jnp.asarray(capped.node_cpu_pct())[: capped.num_nodes]
+    # every node that started within budget stays within it
+    start_pct = jnp.asarray(state.node_cpu_pct())[: state.num_nodes]
+    ok0 = start_pct <= budget * 100.0
+    import numpy as np
+
+    assert (np.asarray(pct)[np.asarray(ok0)] <= budget * 100.0 + 1e-3).all()
+    # the pile node itself must have been drained below the raw saturation
+    assert float(pct[0]) < float(start_pct[0])
+
+
 def test_balance_weight_tradeoff():
     wm = mubench_workmodel_c()
     state = state_from_workmodel(wm, seed=3, node_cpu_cap_m=4000.0)

@@ -65,6 +65,23 @@ def test_fused_matches_reference(seed, tight, block_c):
         np.testing.assert_allclose(np.asarray(got_delta), exp_d, atol=1e-4)
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_fused_overload_term_parity(seed):
+    """Over-budget repulsion: loads scaled past capacity so the relu term
+    is live, fused vs reference exactly equal."""
+    args = list(random_instance(seed, tight=True))
+    args[5] = args[5] * 1.6  # cpu_load: push part of the mesh over budget
+    got_node, got_adm, *_ = fused_score_admission(
+        *args, 0.5, 0.0, seed, overload_weight=10.0,
+        interpret=True, block_c=32, enforce_capacity=True, use_noise=False,
+    )
+    exp_node, exp_adm = reference_score_admission(
+        *args, 0.5, None, overload_weight=10.0, enforce_capacity=True
+    )
+    np.testing.assert_array_equal(np.asarray(got_node), np.asarray(exp_node))
+    np.testing.assert_array_equal(np.asarray(got_adm), np.asarray(exp_adm))
+
+
 def test_fused_no_capacity_mode():
     args = random_instance(3)
     got_node, got_adm, *_ = fused_score_admission(

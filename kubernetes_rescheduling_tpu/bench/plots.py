@@ -91,6 +91,30 @@ def plot_summary(summary: dict | str | Path, out_dir: str | Path) -> list[Path]:
             [mean(a, "after", "response_time_ms") / before_rt if before_rt else 0 for a in algos],
         ),
     ]
+
+    # request-level stats (the reference's release1.sh:74-117 block): tail
+    # latency after rescheduling and the disruption paid during it
+    def load_mean(algo, phase, metric):
+        vals = [
+            r["load"][phase][metric]
+            for r in runs
+            if r["algorithm"] == algo and "load" in r
+        ]
+        return float(np.mean(vals)) if vals else float("nan")
+
+    if any("load" in r for r in runs):
+        charts += [
+            (
+                "tail_latency.png",
+                "p95 response time after rescheduling (ms)",
+                [load_mean(a, "after", "latency_p95_ms") for a in algos],
+            ),
+            (
+                "disruption.png",
+                "Requests failed while rescheduling (% of phase r2)",
+                [100.0 * load_mean(a, "during", "error_rate") for a in algos],
+            ),
+        ]
     for fname, title, values in charts:
         fig, ax = plt.subplots(figsize=(6.4, 3.6), dpi=120)
         _plot_bar(ax, algos, values, title)

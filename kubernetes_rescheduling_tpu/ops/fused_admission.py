@@ -347,6 +347,36 @@ def fused_score_admission(
     )
 
 
+def pairwise_admission(gain, prop, wants, c_cpu, c_mem, slack_cpu, slack_mem):
+    """The sort-free within-chunk capacity race on replicated vectors —
+    shared by the XLA reference twin and the node-sharded solver (the
+    Pallas kernel carries the same math; keep all in lockstep).
+
+    A proposal is admitted iff the target's slack covers every
+    higher-priority (greater gain, ties → lower index) same-target
+    arrival plus itself."""
+    C = gain.shape[0]
+    cidx = jnp.arange(C)
+    gain_w = jnp.where(wants, gain, -jnp.inf)
+    before = (gain_w[None, :] > gain_w[:, None]) | (
+        (gain_w[None, :] == gain_w[:, None]) & (cidx[None, :] < cidx[:, None])
+    )
+    pri = (before & wants[None, :] & (prop[None, :] == prop[:, None])).astype(
+        jnp.float32
+    )
+    land_cpu = jnp.dot(
+        pri, jnp.where(wants, c_cpu, 0.0),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    land_mem = jnp.dot(
+        pri, jnp.where(wants, c_mem, 0.0),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return wants & (land_cpu <= slack_cpu) & (land_mem <= slack_mem)
+
+
 def reference_score_admission(
     M, cur, c_cpu, c_mem, valid_c, cpu_load, mem_load, cap, mem_cap,
     node_valid, lam, noise=None, overload_weight=0.0, *, enforce_capacity: bool,
@@ -383,28 +413,11 @@ def reference_score_admission(
     gain = prop_score - cur_score
     wants = valid_c & (gain > 0) & (prop != cur)
     if enforce_capacity:
-        cidx = jnp.arange(C)
-        gain_w = jnp.where(wants, gain, -jnp.inf)
-        before = (gain_w[None, :] > gain_w[:, None]) | (
-            (gain_w[None, :] == gain_w[:, None]) & (cidx[None, :] < cidx[:, None])
+        admitted = pairwise_admission(
+            gain, prop, wants, c_cpu, c_mem,
+            cap[prop] - cpu_load[prop] - c_cpu,
+            mem_cap[prop] - mem_load[prop] - c_mem,
         )
-        pri = (before & wants[None, :] & (prop[None, :] == prop[:, None])).astype(
-            jnp.float32
-        )
-        land_cpu = jnp.dot(
-            pri, jnp.where(wants, c_cpu, 0.0),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        land_mem = jnp.dot(
-            pri, jnp.where(wants, c_mem, 0.0),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        slack_cpu = cap[prop] - cpu_load[prop] - c_cpu
-        slack_mem = mem_cap[prop] - mem_load[prop] - c_mem
-        ok = (land_cpu <= slack_cpu) & (land_mem <= slack_mem)
-        admitted = wants & ok
     else:
         admitted = wants
     return jnp.where(admitted, prop, cur), admitted

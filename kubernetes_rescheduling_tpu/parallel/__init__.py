@@ -12,6 +12,9 @@ TPU-natively, the solver's collectives ride ICI via XLA:
   auto-built mesh, degenerating to a batched single-device solve.
 - ``sharded_choose_node`` — the policy kernel with the node axis sharded
   over tp: per-shard lexicographic maxima combined with all-gather.
+- ``sharded_global_assign`` — the flagship solver with the NODE axis
+  sharded over tp: per-shard scoring, all_gather'd argmax, psum'd
+  current-score/slack contributions — O(C) scalars over ICI per step.
 """
 
 from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh
@@ -20,10 +23,12 @@ from kubernetes_rescheduling_tpu.parallel.sharded import (
     sharded_choose_node,
     solve_with_restarts,
 )
+from kubernetes_rescheduling_tpu.parallel.sharded_solver import sharded_global_assign
 
 __all__ = [
     "make_mesh",
     "parallel_restarts",
     "sharded_choose_node",
+    "sharded_global_assign",
     "solve_with_restarts",
 ]

@@ -1,0 +1,316 @@
+"""Node-axis-sharded global solver: the flagship solve as an SPMD program.
+
+``global_assign`` holds the whole problem on one chip; fine to ~10k×1k
+(X is 20 MB, W 400 MB). Beyond that — or to put a whole pod slice on one
+solve — the node axis shards over the mesh's ``tp`` dimension:
+
+- sharded: the occupancy matrix ``X [SP, N/tp]``, per-node loads and
+  capacities. Each shard scores its own node columns.
+- replicated: ``W`` (service×service weights), service vectors, and the
+  assignment (global node ids) — every shard agrees on every decision.
+- collectives per chunk step, all O(C) scalars over ICI:
+  ``all_gather`` of each shard's local top-1 (score, global index) and
+  ``psum`` of the current-node score / landing-slack contributions (only
+  the owning shard's term is nonzero). The pairwise admission race then
+  runs replicated on the gathered vectors — bit-identical on all shards.
+
+Decision math mirrors ``global_assign``'s XLA path term for term, so with
+annealing noise off the sharded solve makes the same moves (objective
+sums associate differently across shards, so best-seen selection can in
+principle differ on exact ulp ties).
+
+This is deliberately plain shard_map + XLA (no Pallas): the single-chip
+fused path optimizes launch count, while here the structure exists to
+scale memory and FLOPs across chips — profile before fusing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+from kubernetes_rescheduling_tpu.ops.fused_admission import pairwise_admission
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    _pad_to,
+    _service_aggregates,
+)
+
+_NEG_INF = float("-inf")
+
+
+def _dims(config: GlobalSolverConfig, S: int, N: int, tp: int):
+    C = config.chunk_size or max(1, min(1024, S // 10))
+    C = min(C, S)
+    n_chunks = -(-S // C)
+    return C, n_chunks, n_chunks * C, N // tp
+
+
+# compiled SPMD solvers keyed by (mesh, config, S, N): repeated calls —
+# e.g. one solve per control-loop round — hit the jit cache instead of
+# retracing a fresh shard_map closure every time (same pattern as
+# parallel.sharded._RUN_SHARD_CACHE)
+_SOLVE_CACHE: dict = {}
+
+
+def _build_solve(mesh: Mesh, config: GlobalSolverConfig, S: int, N: int):
+    cache_key = (mesh, config, S, N)
+    fn = _SOLVE_CACHE.get(cache_key)
+    if fn is not None:
+        return fn
+    tp = mesh.shape["tp"]
+    C, n_chunks, SP, Nl = _dims(config, S, N, tp)
+    ow = config.overload_weight if config.enforce_capacity else 0.0
+    temps = config.noise_temp * (
+        1.0 - jnp.arange(config.sweeps, dtype=jnp.float32) / max(config.sweeps - 1, 1)
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        # W/W_mm and service vectors are replicated ARGUMENTS, not closures:
+        # a closed-over array becomes an HLO constant, and a 10k×10k weight
+        # matrix baked into the program overflows compile-request limits
+        in_specs=(
+            P(), P(), P(), P(), P(), P(),
+            P("tp"), P("tp"), P("tp"), P("tp"), P("tp"), P(),
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def solve(
+        assign_init, W, W_mm, svc_valid, svc_cpu, svc_mem,
+        cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r,
+    ):
+        shard = lax.axis_index("tp")
+        gcol = shard * Nl + lax.broadcasted_iota(jnp.int32, (1, Nl), 1)  # (1, Nl)
+        nvalid = jnp.maximum(lax.psum(jnp.sum(valid_l), "tp"), 1)
+
+        def local_loads(assign):
+            owned = (assign[:, None] == gcol) & svc_valid[:, None]  # (SP, Nl)
+            of = owned.astype(jnp.float32)
+            return (
+                base_cpu_l + svc_cpu @ of,
+                base_mem_l + svc_mem @ of,
+            )
+
+        def objective(assign, cpu_l):
+            same = assign[:, None] == assign[None, :]
+            comm = 0.5 * jnp.sum(W * (1.0 - same.astype(jnp.float32)))
+            pct = jnp.where(valid_l, cpu_l / cap_l * 100.0, 0.0)
+            s1 = lax.psum(jnp.sum(pct), "tp")
+            s2 = lax.psum(jnp.sum(pct * pct), "tp")
+            mean = s1 / nvalid
+            var = jnp.maximum(s2 / nvalid - mean * mean, 0.0)
+            over = lax.psum(jnp.sum(jnp.maximum(pct - 100.0, 0.0)), "tp")
+            return comm + config.balance_weight * jnp.sqrt(var) + ow * over
+
+        def chunk_step(inner, xs_c):
+            ids, chunk_key, temp = xs_c
+            assign, X_l, cpu_l, mem_l = inner
+            valid_c = svc_valid[ids]
+            c_cpu = svc_cpu[ids]
+            c_mem = svc_mem[ids]
+            cur = assign[ids]
+
+            M = jnp.matmul(W_mm[ids], X_l, preferred_element_type=jnp.float32)
+            is_cur = gcol == cur[:, None]                     # (C, Nl)
+            proj_cpu = cpu_l[None, :] + jnp.where(is_cur, 0.0, c_cpu[:, None])
+            proj_pct = proj_cpu / cap_l[None, :] * 100.0
+            score = (
+                M
+                - config.balance_weight * proj_pct
+                - ow * jnp.maximum(proj_pct - 100.0, 0.0)
+            )
+            if config.noise_temp > 0:
+                # keys are replicated; fold in the shard so each node column
+                # block draws its own stream (matches nothing — annealing
+                # noise carries no parity requirement)
+                noise_key = jax.random.fold_in(chunk_key, shard)
+                score = score + temp * jax.random.gumbel(noise_key, score.shape)
+
+            if config.enforce_capacity:
+                proj_mem = mem_l[None, :] + jnp.where(is_cur, 0.0, c_mem[:, None])
+                fits = (proj_cpu <= cap_l[None, :]) & (proj_mem <= mem_cap_l[None, :])
+                feasible = (fits | is_cur) & valid_l[None, :]
+            else:
+                feasible = jnp.broadcast_to(valid_l[None, :], score.shape)
+
+            masked = jnp.where(feasible, score, _NEG_INF)
+            loc_val = jnp.max(masked, axis=1)                 # (C,)
+            at_max = masked == loc_val[:, None]
+            loc_idx = jnp.min(jnp.where(at_max, gcol, N), axis=1)
+            cur_score = lax.psum(
+                jnp.sum(jnp.where(is_cur, score, 0.0), axis=1), "tp"
+            )
+
+            # global first-max: gather each shard's top-1, then among the
+            # shards achieving the max score take the lowest global index
+            all_val = lax.all_gather(loc_val, "tp")           # (tp, C)
+            all_idx = lax.all_gather(loc_idx, "tp")           # (tp, C)
+            best_val = jnp.max(all_val, axis=0)
+            prop = jnp.min(
+                jnp.where(all_val == best_val[None, :], all_idx, N), axis=0
+            ).astype(jnp.int32)
+            prop = jnp.minimum(prop, N - 1)
+            gain = best_val - cur_score
+            wants = valid_c & (gain > 0) & (prop != cur)
+
+            # landing slack lives on the owning shard; psum the masked term
+            is_prop = gcol == prop[:, None]                   # (C, Nl)
+            slack_cpu = lax.psum(
+                jnp.sum(jnp.where(is_prop, cap_l[None, :] - cpu_l[None, :], 0.0), axis=1),
+                "tp",
+            ) - c_cpu
+            slack_mem = lax.psum(
+                jnp.sum(
+                    jnp.where(
+                        is_prop,
+                        jnp.where(
+                            jnp.isinf(mem_cap_l), 3.4e38, mem_cap_l
+                        )[None, :]
+                        - mem_l[None, :],
+                        0.0,
+                    ),
+                    axis=1,
+                ),
+                "tp",
+            ) - c_mem
+
+            if config.enforce_capacity:
+                # replicated vectors -> the shared race, bit-identical to
+                # the single-device reference path
+                admitted = pairwise_admission(
+                    gain, prop, wants, c_cpu, c_mem, slack_cpu, slack_mem
+                )
+            else:
+                admitted = wants
+
+            new_node = jnp.where(admitted, prop, cur)
+            new_assign = assign.at[ids].set(new_node)
+            is_new = gcol == new_node[:, None]
+            X_l = X_l.at[ids].set(
+                (is_new & valid_c[:, None]).astype(X_l.dtype)
+            )
+            a_cpu = jnp.where(admitted, c_cpu, 0.0)
+            a_mem = jnp.where(admitted, c_mem, 0.0)
+            d_cpu = jnp.sum(
+                jnp.where(is_new, a_cpu[:, None], 0.0)
+                - jnp.where(is_cur, a_cpu[:, None], 0.0),
+                axis=0,
+            )
+            d_mem = jnp.sum(
+                jnp.where(is_new, a_mem[:, None], 0.0)
+                - jnp.where(is_cur, a_mem[:, None], 0.0),
+                axis=0,
+            )
+            return (new_assign, X_l, cpu_l + d_cpu, mem_l + d_mem), jnp.sum(admitted)
+
+        def sweep(carry, xs):
+            sweep_key, temp = xs
+            assign, best_assign, best_obj = carry
+            perm_key, noise_key = jax.random.split(sweep_key)
+            chunk_ids = jax.random.permutation(perm_key, SP).reshape(n_chunks, C)
+            chunk_keys = jax.random.split(noise_key, n_chunks)
+            chunk_temps = jnp.full((n_chunks,), temp)
+            X0 = (
+                (assign[:, None] == gcol) & svc_valid[:, None]
+            ).astype(jnp.dtype(config.matmul_dtype))
+            cpu_l, mem_l = local_loads(assign)
+            (assign, _, cpu_l, _), moves = lax.scan(
+                chunk_step,
+                (assign, X0, cpu_l, mem_l),
+                (chunk_ids, chunk_keys, chunk_temps),
+            )
+            obj = objective(assign, cpu_l)
+            better = obj < best_obj
+            best_assign = jnp.where(better, assign, best_assign)
+            best_obj = jnp.where(better, obj, best_obj)
+            return (assign, best_assign, best_obj), jnp.sum(moves)
+
+        cpu0, _ = local_loads(assign_init)
+        obj0 = objective(assign_init, cpu0)
+        (_, best_assign, best_obj), _ = lax.scan(
+            sweep, (assign_init, assign_init, obj0), (keys_r, temps)
+        )
+        return best_assign, best_obj
+
+    fn = jax.jit(solve)
+    _SOLVE_CACHE[cache_key] = fn
+    return fn
+
+
+def sharded_global_assign(
+    state: ClusterState,
+    graph: CommGraph,
+    key: jax.Array,
+    mesh: Mesh,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """``global_assign`` with the node axis sharded over ``mesh``'s ``tp``.
+
+    Requires ``num_nodes % tp == 0``. Never worse than the input placement
+    (same best-seen gating as the single-chip solver).
+    """
+    if not config.capacity_frac > 0:
+        raise ValueError(f"capacity_frac must be > 0, got {config.capacity_frac}")
+    tp = mesh.shape["tp"]
+    S = graph.num_services
+    N = state.num_nodes
+    if N % tp:
+        raise ValueError(f"num_nodes {N} must be a multiple of tp={tp}")
+    C, n_chunks, SP, Nl = _dims(config, S, N, tp)
+    ow = config.overload_weight if config.enforce_capacity else 0.0
+
+    replicas, svc_cpu, svc_mem, cur_node, has_pods = _service_aggregates(state, S)
+    svc_valid = _pad_to(graph.service_valid & has_pods, SP, False)
+    svc_cpu = _pad_to(svc_cpu, SP)
+    svc_mem = _pad_to(svc_mem, SP)
+    replicas = _pad_to(replicas, SP)
+    cur_node = _pad_to(cur_node, SP, -1)
+
+    W = graph.adj * replicas[:S, None] * replicas[None, :S]
+    W = jnp.pad(W, ((0, SP - S), (0, SP - S)))
+    W = W * svc_valid[:, None] * svc_valid[None, :]
+    W_mm = W.astype(jnp.dtype(config.matmul_dtype))
+
+    cpu_cap = jnp.where(state.node_valid, state.node_cpu_cap, 0.0)
+    mem_cap_raw = jnp.where(state.node_valid, state.node_mem_cap, 0.0)
+    mem_cap = jnp.where(mem_cap_raw > 0, mem_cap_raw, jnp.inf) * config.capacity_frac
+    cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0) * config.capacity_frac
+    base_cpu = state.node_base_cpu
+    base_mem = state.node_base_mem
+    node_valid = state.node_valid
+
+    assign0 = jnp.where(svc_valid, jnp.clip(cur_node, 0, N - 1), 0)
+    keys = jax.random.split(key, config.sweeps)
+
+    best_assign, best_obj = _build_solve(mesh, config, S, N)(
+        assign0, W, W_mm, svc_valid, svc_cpu, svc_mem,
+        cap, mem_cap, base_cpu, base_mem, node_valid, keys,
+    )
+
+    pct0 = jnp.where(node_valid, state.node_cpu_used() / cap * 100.0, 0.0)
+    obj_true0 = (
+        communication_cost(state, graph)
+        + config.balance_weight * (load_std(state) / config.capacity_frac)
+        + ow * jnp.sum(jnp.maximum(pct0 - 100.0, 0.0))
+    )
+    improved = best_obj < obj_true0
+    new_pod_node = jnp.where(
+        improved & state.pod_valid,
+        best_assign[jnp.clip(state.pod_service, 0, SP - 1)],
+        state.pod_node,
+    )
+    info = {
+        "objective_before": obj_true0,
+        "objective_after": jnp.minimum(best_obj, obj_true0),
+        "tp": jnp.asarray(tp),
+    }
+    return state.replace(pod_node=new_pod_node), info

@@ -112,6 +112,56 @@ def test_solve_with_restarts_single_device_sequential():
     assert float(info["objective_after"]) <= before
 
 
+def test_sharded_global_assign_matches_single_device():
+    """The node-sharded SPMD solver (tp=4) makes the same decisions as the
+    single-device solver with annealing off — the collectives (all_gather
+    argmax, psum'd score/slack contributions) are exact reformulations."""
+    from kubernetes_rescheduling_tpu.parallel import sharded_global_assign
+
+    scn = synthetic_scenario(n_pods=200, n_nodes=16, seed=11, mean_degree=5.0)
+    mesh = make_mesh(8, shape=(2, 4))
+    cfg = GlobalSolverConfig(sweeps=3, noise_temp=0.0, balance_weight=0.5)
+    key = jax.random.PRNGKey(5)
+    st_sh, info_sh = sharded_global_assign(scn.state, scn.graph, key, mesh, cfg)
+    st_1, info_1 = global_assign(scn.state, scn.graph, key, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(st_sh.pod_node), np.asarray(st_1.pod_node)
+    )
+    assert float(info_sh["objective_after"]) == pytest.approx(
+        float(info_1["objective_after"])
+    )
+    before = float(communication_cost(scn.state, scn.graph))
+    assert float(communication_cost(st_sh, scn.graph)) <= before
+
+
+def test_sharded_global_assign_with_capacity_and_noise():
+    """Budget + repulsion + annealing all run under the sharded solver;
+    never-worse holds on its own objective."""
+    from kubernetes_rescheduling_tpu.parallel import sharded_global_assign
+
+    scn = synthetic_scenario(n_pods=128, n_nodes=8, seed=12, mean_degree=4.0)
+    mesh = make_mesh(8, shape=(1, 8))
+    cfg = GlobalSolverConfig(
+        sweeps=3, balance_weight=0.5, enforce_capacity=True, capacity_frac=0.5
+    )
+    st, info = sharded_global_assign(
+        scn.state, scn.graph, jax.random.PRNGKey(0), mesh, cfg
+    )
+    assert float(info["objective_after"]) <= float(info["objective_before"]) + 1e-3
+    assert int(info["tp"]) == 8
+
+
+def test_sharded_global_assign_rejects_indivisible_nodes():
+    from kubernetes_rescheduling_tpu.parallel import sharded_global_assign
+
+    scn = synthetic_scenario(n_pods=32, n_nodes=6, seed=1, mean_degree=4.0)
+    mesh = make_mesh(8, shape=(2, 4))  # 6 % 4 != 0
+    with pytest.raises(ValueError, match="must be a multiple"):
+        sharded_global_assign(
+            scn.state, scn.graph, jax.random.PRNGKey(0), mesh, GlobalSolverConfig()
+        )
+
+
 @pytest.mark.parametrize("policy", ["spread", "binpack", "kubescheduling", "communication"])
 def test_sharded_choose_node_matches_unsharded(policy):
     scn = synthetic_scenario(n_pods=64, n_nodes=8, seed=2, mean_degree=5.0)

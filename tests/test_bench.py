@@ -122,6 +122,22 @@ def test_moves_per_round_all_routes_to_global_solver():
     assert len(result.rounds[0].services_moved) > 1
 
 
+def test_config_from_toml(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        'algorithm = "communication"\nmax_rounds = 5\n'
+        'moves_per_round = "all"\ncapacity_frac = 0.5\n'
+    )
+    cfg = RescheduleConfig.from_toml(p)
+    assert cfg.max_rounds == 5
+    assert cfg.moves_per_round == "all"
+    assert cfg.capacity_frac == 0.5
+    bad = tmp_path / "bad.toml"
+    bad.write_text("nope = 1\n")
+    with pytest.raises(ValueError, match="unknown config keys"):
+        RescheduleConfig.from_toml(bad)
+
+
 def test_moves_per_round_validation():
     with pytest.raises(ValueError):
         RescheduleConfig(moves_per_round=0).validate()

@@ -94,8 +94,11 @@ def merge_affinity(orig: dict | None, patch: dict) -> dict:
     One rule, applied recursively at every depth: two dicts merge key-wise,
     two lists concatenate (extra ``nodeSelectorTerms``/``matchExpressions``
     accumulate instead of clobbering what the Deployment already had), and
-    any other collision resolves to the patch value. Behavioral parity
-    target: reference rescheduling.py:21-40.
+    any other collision resolves to the patch value. This is deliberately
+    MORE general than reference rescheduling.py:21-40 (a hand-rolled merge
+    fixed at the hazard patch's exact 3-level nesting); for that patch shape
+    the two agree, but at other depths this rule keeps merging/concatenating
+    where the reference would clobber with the patch value.
     """
     import copy
 
@@ -185,6 +188,12 @@ class K8sBackend:
     delete_poll_interval_s: float = 1.5
     node_capacity: int | None = None
     pod_capacity: int | None = None
+    # teardown outage estimate for disruption accounting (the window in
+    # which a moved Deployment serves nothing). Starts as a conservative
+    # default and is replaced by the MEASURED delete→404→recreate wall time
+    # after each successful move, so the harness's release2-style outage
+    # windows track what the cluster actually does rather than zero.
+    reconcile_delay_s: float = 10.0
     sleeper: Callable[[float], None] = field(default=time.sleep)
 
     def __post_init__(self) -> None:
@@ -354,6 +363,37 @@ class K8sBackend:
             self.sleeper(interval)
         return False
 
+    def _wait_ready(self, name: str) -> bool:
+        """Poll until the re-created Deployment reports every replica ready —
+        the true end of the serving outage. ``create_namespaced_deployment``
+        returning only means the API accepted the object; scheduling, image
+        pull, and readiness gates dominate the real restoration time, so
+        stamping the teardown measurement at create-acceptance would
+        systematically understate disruption. Bounded exactly like
+        :meth:`_wait_deleted` (poll budget + wall-clock deadline)."""
+        interval = max(self.delete_poll_interval_s, 1e-9)
+        polls = max(1, int(round(self.delete_timeout_s / interval)))
+        deadline = time.monotonic() + self.delete_timeout_s
+        for _ in range(polls):
+            if time.monotonic() > deadline:
+                return False
+            try:
+                dep = self.apps_api.read_namespaced_deployment(
+                    name=name, namespace=self.namespace
+                )
+                want = _get(dep, "spec", "replicas", default=1) or 1
+                ready = (
+                    _get(dep, "status", "ready_replicas")
+                    or _get(dep, "status", "readyReplicas")
+                    or 0
+                )
+                if int(ready) >= int(want):
+                    return True
+            except Exception as e:
+                logger.warning("wait_ready(%s): error while polling: %s", name, e)
+            self.sleeper(interval)
+        return False
+
     def apply_move(self, move: MoveRequest) -> str | None:
         """Foreground delete + pinned re-create (reference
         delete_replaced_pod.py:144-185 + rescheduling.py:57-73). Returns the
@@ -385,6 +425,7 @@ class K8sBackend:
         elif move.mechanism != "affinityOnly":
             raise ValueError(f"unknown mechanism {move.mechanism!r}")
 
+        t0 = time.monotonic()
         try:
             self.apps_api.delete_namespaced_deployment(
                 name=name,
@@ -400,9 +441,14 @@ class K8sBackend:
             self.apps_api.create_namespaced_deployment(
                 namespace=self.namespace, body=body
             )
-            return move.target_node
         except Exception:
             return None
+        # outage window = delete → 404 → re-create → pods READY (a ready
+        # timeout still stamps the elapsed budget — conservative, not zero);
+        # the floor keeps a fake-client test run from zeroing the accounting
+        self._wait_ready(name)
+        self.reconcile_delay_s = max(time.monotonic() - t0, 1e-3)
+        return move.target_node
 
     def advance(self, seconds: float) -> None:
         self.sleeper(seconds)

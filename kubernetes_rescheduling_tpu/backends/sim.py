@@ -142,7 +142,7 @@ class SimBackend:
             pod_capacity=self.pod_capacity,
         )
 
-    def apply_move(self, move: MoveRequest) -> bool:
+    def apply_move(self, move: MoveRequest) -> str | None:
         """Foreground delete + re-create of one service's Deployment
         (reference delete_replaced_pod.py:173-177 + rescheduling.py:57-73).
 

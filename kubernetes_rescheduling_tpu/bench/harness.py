@@ -272,7 +272,6 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 seed=seed,
             )
             during = new_samples()
-            reconcile = getattr(backend, "reconcile_delay_s", 0.0)
 
             def clock(_backend=backend):
                 # sim: the simulated clock; live cluster: wall time
@@ -297,6 +296,10 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                     ),
                     64,
                 )
+                # read per round, not once: K8sBackend replaces its initial
+                # estimate with the measured delete→recreate wall time after
+                # each move (sim exposes its simulated teardown latency)
+                reconcile = getattr(backend, "reconcile_delay_s", 10.0)
                 outages = [
                     (svc, i * reconcile, (i + 1) * reconcile)
                     for i, svc in enumerate(rec.services_moved)

@@ -21,7 +21,10 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
-from kubernetes_rescheduling_tpu.policies.scoring import node_features
+from kubernetes_rescheduling_tpu.policies.scoring import (
+    node_features,
+    policy_key_table,
+)
 from kubernetes_rescheduling_tpu.solver.global_solver import (
     GlobalSolverConfig,
     global_assign,
@@ -202,15 +205,9 @@ def sharded_choose_node(
 
 
 def _policy_keys(policy_id, f, state, key):
-    """The lexicographic key list for each policy (same table as
-    policies.scoring.choose_node), selected by traced policy id."""
-    g = jax.random.gumbel(key, (state.num_nodes,))
-    zero = jnp.zeros_like(g)
-    k1 = jnp.stack(
-        [-f["pod_count"], f["cpu_pct_rounded"], g, f["free_frac"], f["affinity"]]
-    )
-    k2 = jnp.stack(
-        [-f["lex_rank"], f["lex_rank"], zero, zero, f["cpu_free"]]
-    )
-    pid = jnp.clip(policy_id, 0, 4)
+    """Traced-policy key selection from the ONE table
+    (``policies.scoring.policy_key_table``) the single-device path also
+    uses — a policy edit there propagates here by construction."""
+    k1, k2 = policy_key_table(f, state, key)
+    pid = jnp.clip(policy_id, 0, k1.shape[0] - 1)
     return [k1[pid], k2[pid]]

@@ -223,12 +223,17 @@ def _build_solve(mesh: Mesh, config: GlobalSolverConfig, S: int, N: int):
                 (assign[:, None] == gcol) & svc_valid[:, None]
             ).astype(jnp.dtype(config.matmul_dtype))
             cpu_l, mem_l = local_loads(assign)
-            (assign, _, cpu_l, _), moves = lax.scan(
+            (assign, _, _, _), moves = lax.scan(
                 chunk_step,
                 (assign, X0, cpu_l, mem_l),
                 (chunk_ids, chunk_keys, chunk_temps),
             )
-            obj = objective(assign, cpu_l)
+            # best-seen selection uses loads recomputed from the assignment,
+            # not the incrementally-carried cpu_l: accumulated f32 drift in
+            # the carry could flip near-tie selections away from the
+            # single-chip solver, whose objective() also rebuilds loads
+            cpu_fresh, _ = local_loads(assign)
+            obj = objective(assign, cpu_fresh)
             better = obj < best_obj
             best_assign = jnp.where(better, assign, best_assign)
             best_obj = jnp.where(better, obj, best_obj)

@@ -25,7 +25,6 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 from kubernetes_rescheduling_tpu.objectives.metrics import node_cpu_pct_rounded
@@ -81,6 +80,28 @@ def node_features(
     }
 
 
+def policy_key_table(
+    f: dict[str, jax.Array], state: ClusterState, key: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """The per-policy lexicographic key rows — SINGLE source of truth.
+
+    Returns ``(k1, k2)``, each ``f32[len(POLICY_NAMES), N]``: policy ``p``
+    picks the masked lexicographic argmax of ``(k1[p], k2[p])`` (see the
+    module docstring's table; policies with one key get a constant-zero
+    second key, which never changes the winner). Both the single-device
+    :func:`choose_node` and the node-sharded
+    ``parallel.sharded.sharded_choose_node`` consume this table, so a policy
+    edit can never de-synchronize the two paths.
+    """
+    g = jax.random.gumbel(key, (state.num_nodes,))
+    zero = jnp.zeros_like(g)
+    k1 = jnp.stack(
+        [-f["pod_count"], f["cpu_pct_rounded"], g, f["free_frac"], f["affinity"]]
+    )
+    k2 = jnp.stack([-f["lex_rank"], f["lex_rank"], zero, zero, f["cpu_free"]])
+    return k1, k2
+
+
 def choose_node(
     policy_id: jax.Array,
     state: ClusterState,
@@ -91,32 +112,13 @@ def choose_node(
 ) -> jax.Array:
     """i32 scalar — the chosen target node for ``service_idx``'s Deployment.
 
-    ``policy_id`` may be traced (``lax.switch``), so a whole batch of
-    policies can be evaluated under one compilation. Returns -1 when every
-    valid node is hazardous (the reference raises RuntimeError there,
+    ``policy_id`` may be traced (it indexes the key table), so a whole batch
+    of policies can be evaluated under one compilation. Returns -1 when
+    every valid node is hazardous (the reference raises RuntimeError there,
     rescheduling.py:98-99; the caller decides whether to skip or fail).
     """
     f = node_features(state, graph, service_idx)
     cand = state.node_valid & ~hazard_mask
-
-    def spread(_):
-        return lex_argmax([-f["pod_count"], -f["lex_rank"]], cand)
-
-    def binpack(_):
-        return lex_argmax([f["cpu_pct_rounded"], f["lex_rank"]], cand)
-
-    def random(_):
-        g = jax.random.gumbel(key, (state.num_nodes,))
-        return lex_argmax([g], cand)
-
-    def kubescheduling(_):
-        return lex_argmax([f["free_frac"]], cand)
-
-    def communication(_):
-        return lex_argmax([f["affinity"], f["cpu_free"]], cand)
-
-    return lax.switch(
-        jnp.clip(policy_id, 0, len(POLICY_NAMES) - 1),
-        [spread, binpack, random, kubescheduling, communication],
-        None,
-    )
+    k1, k2 = policy_key_table(f, state, key)
+    pid = jnp.clip(policy_id, 0, len(POLICY_NAMES) - 1)
+    return lex_argmax([k1[pid], k2[pid]], cand)

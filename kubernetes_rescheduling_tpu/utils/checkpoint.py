@@ -112,7 +112,17 @@ class CheckpointManager:
         )
 
     def _gc(self) -> None:
+        d = Path(self.directory)
         rounds = self._rounds()
         for r in rounds[: -self.keep] if self.keep > 0 else []:
             for suffix in (".npz", ".json"):
-                (Path(self.directory) / f"round_{r:06d}{suffix}").unlink(missing_ok=True)
+                (d / f"round_{r:06d}{suffix}").unlink(missing_ok=True)
+        # a crash between savez and the renames leaves *.tmp.npz /
+        # *.json.tmp (and possibly a .json with no matching .npz) that
+        # _rounds() skips but would otherwise accumulate forever
+        for tmp in (*d.glob("round_*.tmp.npz"), *d.glob("round_*.json.tmp")):
+            tmp.unlink(missing_ok=True)
+        live = {f"round_{r:06d}" for r in rounds}
+        for meta in d.glob("round_*.json"):
+            if meta.stem not in live:
+                meta.unlink(missing_ok=True)

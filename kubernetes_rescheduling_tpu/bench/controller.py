@@ -262,7 +262,12 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
     t0 = time.perf_counter()
     new_state, info = jax.block_until_ready(
         solve_with_restarts(
-            state, graph, key, n_restarts=config.solver_restarts, config=cfg
+            state,
+            graph,
+            key,
+            n_restarts=config.solver_restarts,
+            config=cfg,
+            tp=config.solver_tp,
         )
     )
     latency = time.perf_counter() - t0

@@ -82,6 +82,7 @@ class ExperimentConfig:
     # (comm cost 0, load std terrible) — never what an operator wants.
     balance_weight: float = 0.5
     solver_restarts: int = 1           # best-of-N global solves per round
+    solver_tp: int = 1                 # node-axis devices per solve (SPMD solver)
     moves_per_round: int | str = 1     # k per greedy round, or "all"
     # Packing budget for the global solver's feasibility (fraction of node
     # capacity, with enforcement). On dense meshes the comm objective
@@ -266,6 +267,7 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 sleep_after_action_s=cfg.pacing_s,  # simulated clock, not wall
                 balance_weight=cfg.balance_weight,
                 solver_restarts=cfg.solver_restarts,
+                solver_tp=cfg.solver_tp,
                 moves_per_round=cfg.moves_per_round,
                 enforce_capacity=cfg.enforce_capacity,
                 capacity_frac=cfg.capacity_frac,

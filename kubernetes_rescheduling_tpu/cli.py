@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 ALGO_ALIASES = {"car": "communication"}
@@ -87,6 +88,10 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--moves-per-round", type=_moves_per_round, default=1)
     b.add_argument("--restarts", type=int, default=1,
                    help="best-of-N global solves per round (global algorithm)")
+    b.add_argument("--tp", type=int, default=1,
+                   help="node-axis devices per solve: each global solve runs "
+                        "as the SPMD node-sharded solver over tp devices "
+                        "(composes with --restarts as a dp×tp mesh)")
     b.add_argument("--capacity-frac", type=float, default=None,
                    help="enable capacity enforcement with this packing "
                         "budget (fraction of node capacity; global "
@@ -118,6 +123,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--restarts", type=int, default=1,
                    help="best-of-N independent solves, sharded over the "
                         "device mesh (1 = single solve)")
+    s.add_argument("--tp", type=int, default=1,
+                   help="node-axis devices per solve (SPMD node-sharded "
+                        "solver; composes with --restarts as a dp×tp mesh)")
     return p
 
 
@@ -178,6 +186,7 @@ def cmd_bench(args) -> dict:
         session_name=args.session,
         moves_per_round=args.moves_per_round,
         solver_restarts=args.restarts,
+        solver_tp=args.tp,
         enforce_capacity=args.capacity_frac is not None,
         capacity_frac=args.capacity_frac if args.capacity_frac is not None else 1.0,
         seed=args.seed,
@@ -243,10 +252,12 @@ def cmd_solve(args) -> dict:
         jax.random.PRNGKey(args.seed),
         n_restarts=args.restarts,
         config=cfg,
+        tp=args.tp,
     )
     out = {
         "scenario": args.scenario,
         "restarts": int(info["restarts"]),
+        "tp": int(info["tp"]) if "tp" in info else 1,
         "communication_cost_before": float(communication_cost(state, graph)),
         "communication_cost_after": float(communication_cost(new_state, graph)),
         "load_std_before": float(load_std(state)),
@@ -260,6 +271,25 @@ def cmd_solve(args) -> dict:
 
 
 def main(argv: list[str] | None = None) -> int:
+    # Honor JAX_PLATFORMS even when a site hook pre-imported jax and pinned
+    # an accelerator plugin (the env var only applies before first backend
+    # init; the config update applies after). Lets operators run the CLI on
+    # a forced-CPU mesh: XLA_FLAGS=--xla_force_host_platform_device_count=8
+    # JAX_PLATFORMS=cpu python -m kubernetes_rescheduling_tpu solve --tp 2
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception as e:
+            # the run continues on whatever platform is pinned — say so
+            # instead of silently ignoring the operator's explicit choice
+            print(
+                f"warning: could not apply JAX_PLATFORMS={plat!r} ({e}); "
+                f"running on {jax.default_backend()}",
+                file=sys.stderr,
+            )
     args = build_parser().parse_args(argv)
     handler = {
         "reschedule": cmd_reschedule,

@@ -51,6 +51,7 @@ class RescheduleConfig:
     global_solver_iters: int = 8           # best-response sweeps per solve
     balance_weight: float = 0.0            # λ for load-balance term in global solver
     solver_restarts: int = 1               # best-of-N solves over the device mesh
+    solver_tp: int = 1                     # node-axis sharding of each solve (devices per solve)
     seed: int = 0
 
     # Scale (array capacities; 0 = size to the scenario)

@@ -15,6 +15,8 @@ TPU-natively, the solver's collectives ride ICI via XLA:
 - ``sharded_global_assign`` — the flagship solver with the NODE axis
   sharded over tp: per-shard scoring, all_gather'd argmax, psum'd
   current-score/slack contributions — O(C) scalars over ICI per step.
+- ``sharded_solve_with_restarts`` — dp restarts *of* tp-sharded solves:
+  the two axes composed on one mesh, best-of-N selected on device.
 """
 
 from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh
@@ -23,12 +25,16 @@ from kubernetes_rescheduling_tpu.parallel.sharded import (
     sharded_choose_node,
     solve_with_restarts,
 )
-from kubernetes_rescheduling_tpu.parallel.sharded_solver import sharded_global_assign
+from kubernetes_rescheduling_tpu.parallel.sharded_solver import (
+    sharded_global_assign,
+    sharded_solve_with_restarts,
+)
 
 __all__ = [
     "make_mesh",
     "parallel_restarts",
     "sharded_choose_node",
     "sharded_global_assign",
+    "sharded_solve_with_restarts",
     "solve_with_restarts",
 ]

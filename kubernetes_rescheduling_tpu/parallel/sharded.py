@@ -101,6 +101,12 @@ def _run_shard(mesh: Mesh, config: GlobalSolverConfig):
     return fn
 
 
+def _largest_divisor(r: int, cap: int) -> int:
+    """Largest divisor of ``r`` that is <= ``cap`` — the dp extent used by
+    mesh auto-shaping (one heuristic, shared by the tp and non-tp paths)."""
+    return max(d for d in range(1, min(cap, r) + 1) if r % d == 0)
+
+
 def solve_with_restarts(
     state: ClusterState,
     graph: CommGraph,
@@ -109,18 +115,58 @@ def solve_with_restarts(
     n_restarts: int = 1,
     config: GlobalSolverConfig = GlobalSolverConfig(),
     mesh: Mesh | None = None,
+    tp: int = 1,
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """Production best-of-N global solve — the mesh-parallel path with
     graceful degradation.
 
-    ``n_restarts <= 1`` is a plain single solve. Otherwise restarts
-    parallelize over the mesh's ``dp`` axis and run *sequentially* (scan)
-    within each shard; with no mesh given, one is built over the largest
-    divisor of ``n_restarts`` that fits the available devices — on a single
-    chip that is a 1×1 mesh running all N solves back to back (N× wall
-    clock, flat memory), so the same call works from laptop CPU to a pod
-    slice. ``info["restarts"]`` records N for benchmark provenance.
+    ``tp > 1`` shards the NODE axis of every solve over the mesh's ``tp``
+    dimension (``sharded_solver``): with ``n_restarts <= 1`` that is one
+    node-sharded solve; otherwise dp restarts compose *of* tp-sharded
+    solves on a (dp, tp) mesh. With ``tp == 1``: ``n_restarts <= 1`` is a
+    plain single-device solve, and otherwise restarts parallelize over the
+    mesh's ``dp`` axis and run *sequentially* (scan) within each shard.
+
+    With no mesh given one is auto-shaped: ``tp`` devices per solve, and
+    the dp extent the largest divisor of ``n_restarts`` that fits the
+    remaining devices — on a single chip that degenerates to a 1×1 mesh
+    running all N solves back to back (N× wall clock, flat memory), so the
+    same call works from laptop CPU to a pod slice. ``info["restarts"]``
+    records N for benchmark provenance; ``info["tp"]`` is present when the
+    node axis was sharded.
     """
+    if mesh is not None:
+        mesh_tp = mesh.shape.get("tp", 1)
+        if tp != 1 and mesh_tp != tp:
+            raise ValueError(
+                f"tp={tp} conflicts with the explicit mesh's tp={mesh_tp}; "
+                "pass one or the other"
+            )
+        tp = mesh_tp
+    if tp > 1:
+        from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh
+        from kubernetes_rescheduling_tpu.parallel.sharded_solver import (
+            sharded_global_assign,
+            sharded_solve_with_restarts,
+        )
+
+        if mesh is None:
+            n_dev = len(jax.devices())
+            if n_dev % tp:
+                raise ValueError(
+                    f"tp={tp} does not divide the {n_dev} available devices"
+                )
+            dp = _largest_divisor(max(n_restarts, 1), max(n_dev // tp, 1))
+            mesh = make_mesh(dp * tp, shape=(dp, tp))
+        if n_restarts <= 1:
+            new_state, info = sharded_global_assign(state, graph, key, mesh, config)
+        else:
+            new_state, info = sharded_solve_with_restarts(
+                state, graph, key, mesh, n_restarts=n_restarts, config=config
+            )
+        info = dict(info)
+        info["restarts"] = jnp.asarray(max(n_restarts, 1))
+        return new_state, info
     if n_restarts <= 1:
         new_state, info = global_assign(state, graph, key, config)
         info = dict(info)
@@ -129,8 +175,7 @@ def solve_with_restarts(
     if mesh is None:
         from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh
 
-        n_dev = len(jax.devices())
-        dp = max(d for d in range(1, min(n_dev, n_restarts) + 1) if n_restarts % d == 0)
+        dp = _largest_divisor(n_restarts, len(jax.devices()))
         mesh = make_mesh(dp, shape=(dp, 1))
     best_state, info = parallel_restarts(
         state, graph, key, mesh, n_restarts=n_restarts, config=config

@@ -52,39 +52,40 @@ def _dims(config: GlobalSolverConfig, S: int, N: int, tp: int):
     return C, n_chunks, n_chunks * C, N // tp
 
 
-# compiled SPMD solvers keyed by (mesh, config, S, N): repeated calls —
-# e.g. one solve per control-loop round — hit the jit cache instead of
-# retracing a fresh shard_map closure every time (same pattern as
+# compiled SPMD solvers keyed by (mesh, config, S, N[, r_local]): repeated
+# calls — e.g. one solve per control-loop round — hit the jit cache instead
+# of retracing a fresh shard_map closure every time (same pattern as
 # parallel.sharded._RUN_SHARD_CACHE)
 _SOLVE_CACHE: dict = {}
 
+# shard_map argument layout shared by the single-restart and dp×tp wrappers:
+# replicated problem data, node-axis-sharded per-node vectors, then keys.
+# W/W_mm and service vectors are replicated ARGUMENTS, not closures: a
+# closed-over array becomes an HLO constant, and a 10k×10k weight matrix
+# baked into the program overflows compile-request limits.
+_IN_SPECS_COMMON = (
+    P(), P(), P(), P(), P(), P(),
+    P("tp"), P("tp"), P("tp"), P("tp"), P("tp"),
+)
 
-def _build_solve(mesh: Mesh, config: GlobalSolverConfig, S: int, N: int):
-    cache_key = (mesh, config, S, N)
-    fn = _SOLVE_CACHE.get(cache_key)
-    if fn is not None:
-        return fn
-    tp = mesh.shape["tp"]
+
+def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
+    """The shard-local solve body (collectives over the mesh's ``tp`` axis).
+
+    Returns ``solve_one(assign_init, W, W_mm, svc_valid, svc_cpu, svc_mem,
+    cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r) ->
+    (best_assign, best_obj)``; must run under ``shard_map`` on a mesh with a
+    ``tp`` axis. Both the single-restart and the dp-restarts-of-tp-solves
+    wrappers are thin shard_map shells around this one body, so the decision
+    math cannot fork between the two production paths.
+    """
     C, n_chunks, SP, Nl = _dims(config, S, N, tp)
     ow = config.overload_weight if config.enforce_capacity else 0.0
     temps = config.noise_temp * (
         1.0 - jnp.arange(config.sweeps, dtype=jnp.float32) / max(config.sweeps - 1, 1)
     )
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        # W/W_mm and service vectors are replicated ARGUMENTS, not closures:
-        # a closed-over array becomes an HLO constant, and a 10k×10k weight
-        # matrix baked into the program overflows compile-request limits
-        in_specs=(
-            P(), P(), P(), P(), P(), P(),
-            P("tp"), P("tp"), P("tp"), P("tp"), P("tp"), P(),
-        ),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    def solve(
+    def solve_one(
         assign_init, W, W_mm, svc_valid, svc_cpu, svc_mem,
         cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r,
     ):
@@ -246,23 +247,81 @@ def _build_solve(mesh: Mesh, config: GlobalSolverConfig, S: int, N: int):
         )
         return best_assign, best_obj
 
-    fn = jax.jit(solve)
+    return solve_one
+
+
+def _build_solve(mesh: Mesh, config: GlobalSolverConfig, S: int, N: int):
+    """Single tp-sharded solve (one restart; keys replicated)."""
+    cache_key = (mesh, config, S, N)
+    fn = _SOLVE_CACHE.get(cache_key)
+    if fn is not None:
+        return fn
+    solve_one = _solve_factory(config, S, N, mesh.shape["tp"])
+    fn = jax.jit(
+        partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(*_IN_SPECS_COMMON, P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )(solve_one)
+    )
     _SOLVE_CACHE[cache_key] = fn
     return fn
 
 
-def sharded_global_assign(
-    state: ClusterState,
-    graph: CommGraph,
-    key: jax.Array,
-    mesh: Mesh,
-    config: GlobalSolverConfig = GlobalSolverConfig(),
-) -> tuple[ClusterState, dict[str, jax.Array]]:
-    """``global_assign`` with the node axis sharded over ``mesh``'s ``tp``.
+def _build_solve_restarts(
+    mesh: Mesh, config: GlobalSolverConfig, S: int, N: int, r_local: int
+):
+    """dp restarts of tp-sharded solves, best-of-N selected on device.
 
-    Requires ``num_nodes % tp == 0``. Never worse than the input placement
-    (same best-seen gating as the single-chip solver).
+    Each dp slice runs ``r_local`` restarts *sequentially* (lax.scan — the
+    same reasoning as ``parallel_restarts``: vmapping the solver multiplies
+    its working set and produces variadic-scatter HLO the TPU backend
+    rejects), with the node axis of every solve sharded over ``tp``. The
+    final all_gather over dp moves one ``[r_local, SP]`` assignment block
+    and ``r_local`` objectives per slice — O(R·S) ints over ICI, once.
     """
+    cache_key = (mesh, config, S, N, r_local)
+    fn = _SOLVE_CACHE.get(cache_key)
+    if fn is not None:
+        return fn
+    solve_one = _solve_factory(config, S, N, mesh.shape["tp"])
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(*_IN_SPECS_COMMON, P("dp")),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    def solve_r(
+        assign_init, W, W_mm, svc_valid, svc_cpu, svc_mem,
+        cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_block,
+    ):
+        def body(carry, keys_r):
+            ba, bo = solve_one(
+                assign_init, W, W_mm, svc_valid, svc_cpu, svc_mem,
+                cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r,
+            )
+            return carry, (ba, bo)
+
+        _, (assigns, objs) = lax.scan(body, 0, keys_block)
+        # global restart order = dp-shard-major (shard d owns restarts
+        # [d·r_local, (d+1)·r_local)), matching how the caller split the
+        # keys — so argmin tie-breaking (first minimum) agrees with the
+        # dp-only parallel_restarts path
+        all_objs = lax.all_gather(objs, "dp", tiled=True)         # [R]
+        all_assigns = lax.all_gather(assigns, "dp", tiled=True)   # [R, SP]
+        best = jnp.argmin(all_objs)
+        return all_assigns[best], all_objs[best], all_objs
+
+    fn = jax.jit(solve_r)
+    _SOLVE_CACHE[cache_key] = fn
+    return fn
+
+
+def _check_and_dims(state, graph, config, mesh):
     if not config.capacity_frac > 0:
         raise ValueError(f"capacity_frac must be > 0, got {config.capacity_frac}")
     tp = mesh.shape["tp"]
@@ -270,9 +329,12 @@ def sharded_global_assign(
     N = state.num_nodes
     if N % tp:
         raise ValueError(f"num_nodes {N} must be a multiple of tp={tp}")
-    C, n_chunks, SP, Nl = _dims(config, S, N, tp)
-    ow = config.overload_weight if config.enforce_capacity else 0.0
+    _, _, SP, _ = _dims(config, S, N, tp)
+    return tp, S, N, SP
 
+
+def _prep(state, graph, config, S, N, SP):
+    """Problem arrays in the shard_map argument order (minus keys)."""
     replicas, svc_cpu, svc_mem, cur_node, has_pods = _service_aggregates(state, S)
     svc_valid = _pad_to(graph.service_valid & has_pods, SP, False)
     svc_cpu = _pad_to(svc_cpu, SP)
@@ -289,19 +351,19 @@ def sharded_global_assign(
     mem_cap_raw = jnp.where(state.node_valid, state.node_mem_cap, 0.0)
     mem_cap = jnp.where(mem_cap_raw > 0, mem_cap_raw, jnp.inf) * config.capacity_frac
     cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0) * config.capacity_frac
-    base_cpu = state.node_base_cpu
-    base_mem = state.node_base_mem
-    node_valid = state.node_valid
 
     assign0 = jnp.where(svc_valid, jnp.clip(cur_node, 0, N - 1), 0)
-    keys = jax.random.split(key, config.sweeps)
-
-    best_assign, best_obj = _build_solve(mesh, config, S, N)(
+    return (
         assign0, W, W_mm, svc_valid, svc_cpu, svc_mem,
-        cap, mem_cap, base_cpu, base_mem, node_valid, keys,
+        cap, mem_cap, state.node_base_cpu, state.node_base_mem, state.node_valid,
     )
 
-    pct0 = jnp.where(node_valid, state.node_cpu_used() / cap * 100.0, 0.0)
+
+def _finalize(state, graph, config, best_assign, best_obj, SP, cap):
+    """Best-seen gating against the TRUE input objective + pod scatter —
+    identical to the single-chip solver's epilogue (global_solver.py)."""
+    ow = config.overload_weight if config.enforce_capacity else 0.0
+    pct0 = jnp.where(state.node_valid, state.node_cpu_used() / cap * 100.0, 0.0)
     obj_true0 = (
         communication_cost(state, graph)
         + config.balance_weight * (load_std(state) / config.capacity_frac)
@@ -316,6 +378,68 @@ def sharded_global_assign(
     info = {
         "objective_before": obj_true0,
         "objective_after": jnp.minimum(best_obj, obj_true0),
-        "tp": jnp.asarray(tp),
     }
     return state.replace(pod_node=new_pod_node), info
+
+
+def sharded_global_assign(
+    state: ClusterState,
+    graph: CommGraph,
+    key: jax.Array,
+    mesh: Mesh,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """``global_assign`` with the node axis sharded over ``mesh``'s ``tp``.
+
+    Requires ``num_nodes % tp == 0``. Never worse than the input placement
+    (same best-seen gating as the single-chip solver).
+    """
+    tp, S, N, SP = _check_and_dims(state, graph, config, mesh)
+    args = _prep(state, graph, config, S, N, SP)
+    keys = jax.random.split(key, config.sweeps)
+    best_assign, best_obj = _build_solve(mesh, config, S, N)(*args, keys)
+    new_state, info = _finalize(state, graph, config, best_assign, best_obj, SP, args[6])
+    info["tp"] = jnp.asarray(tp)
+    return new_state, info
+
+
+def sharded_solve_with_restarts(
+    state: ClusterState,
+    graph: CommGraph,
+    key: jax.Array,
+    mesh: Mesh,
+    *,
+    n_restarts: int = 1,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """dp restarts *of* tp-sharded solves — the full-mesh production solve.
+
+    ``n_restarts`` must be a multiple of the mesh's ``dp``; each dp slice
+    scans its share of restarts sequentially while every solve shards the
+    node axis over ``tp``. Per-restart keys match ``parallel_restarts``
+    (``split(key, n_restarts)``, each split into per-sweep keys the way
+    ``global_assign`` does), so with annealing noise off the composed path
+    makes the same per-restart decisions as the single-device solver and
+    the same best-of-N selection (first minimum in global restart order) as
+    the dp-only path.
+    """
+    tp, S, N, SP = _check_and_dims(state, graph, config, mesh)
+    dp = mesh.shape.get("dp", 1)
+    if n_restarts % dp:
+        raise ValueError(f"n_restarts {n_restarts} must be a multiple of dp={dp}")
+    r_local = n_restarts // dp
+    args = _prep(state, graph, config, S, N, SP)
+    keys_all = jax.random.split(key, n_restarts)                    # [R, 2]
+    keys_block = jax.vmap(
+        lambda k: jax.random.split(k, config.sweeps)
+    )(keys_all)                                                     # [R, sweeps, 2]
+    best_assign, best_obj, all_objs = _build_solve_restarts(
+        mesh, config, S, N, r_local
+    )(*args, keys_block)
+    new_state, info = _finalize(state, graph, config, best_assign, best_obj, SP, args[6])
+    info.update(
+        restart_objectives=all_objs,
+        best_restart=jnp.argmin(all_objs),
+        tp=jnp.asarray(tp),
+    )
+    return new_state, info

@@ -31,7 +31,7 @@ import jax
 
 def main() -> int:
     scenario = os.environ.get("BENCH_SCENARIO", "large")
-    sweeps = int(os.environ.get("BENCH_SWEEPS", "8"))
+    sweeps = int(os.environ.get("BENCH_SWEEPS", "9"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
     restarts = int(os.environ.get("BENCH_RESTARTS", "1"))
 

@@ -114,7 +114,7 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--scenario", default="mubench",
                    choices=["mubench", "dense", "powerlaw", "large"])
     s.add_argument("--workmodel", default=None, help=workmodel_help)
-    s.add_argument("--sweeps", type=int, default=8)
+    s.add_argument("--sweeps", type=int, default=9)
     s.add_argument("--balance-weight", type=float, default=0.0)
     s.add_argument("--capacity-frac", type=float, default=1.0,
                    help="packing budget as a fraction of node capacity "

@@ -48,7 +48,7 @@ class RescheduleConfig:
     backend: str = "sim"                   # "sim" | "k8s"
     enforce_capacity: bool = False         # reference never checks capacity
     capacity_frac: float = 1.0             # packing budget as a fraction of capacity
-    global_solver_iters: int = 8           # best-response sweeps per solve
+    global_solver_iters: int = 9           # best-response sweeps per solve
     balance_weight: float = 0.0            # λ for load-balance term in global solver
     solver_restarts: int = 1               # best-of-N solves over the device mesh
     solver_tp: int = 1                     # node-axis sharding of each solve (devices per solve)

@@ -140,15 +140,18 @@ def _admission_kernel(
     moving_mem_ref, # VMEM (C, 1) f32
     new_node_ref,   # out VMEM (BC, 1) i32
     admitted_ref,   # out VMEM (BC, 1) i32
-    x_rows_ref,     # out VMEM (BC, N) x_dtype: one-hot(new_node)·valid
     d_cpu_ref,      # out VMEM (1, N) f32: net load delta, grid-accumulated
     d_mem_ref,      # out VMEM (1, N) f32
+    x_rows_ref=None,  # out VMEM (BC, N) x_dtype: one-hot(new_node)·valid —
+                      # only when the caller maintains an occupancy matrix
+                      # (the inline-mass solver path regenerates occupancy
+                      # from `assign` on the fly and skips this write)
     *,
     enforce_capacity: bool,
 ):
     bc = prop_ref.shape[0]
     c = prop_row_ref.shape[1]
-    n = x_rows_ref.shape[1]
+    n = d_cpu_ref.shape[1]
     wants = wants_ref[:] != 0
     if enforce_capacity:
         gw = jnp.where(wants, gain_ref[:], _NEG_INF)          # (BC, 1)
@@ -187,9 +190,10 @@ def _admission_kernel(
     # tile's net per-node load delta (moves in minus moves out)
     ncol = jax.lax.broadcasted_iota(jnp.int32, (bc, n), 1)
     is_new = ncol == new_node
-    x_rows_ref[:] = jnp.where(
-        is_new & (valid_ref[:] != 0), 1.0, 0.0
-    ).astype(x_rows_ref.dtype)
+    if x_rows_ref is not None:
+        x_rows_ref[:] = jnp.where(
+            is_new & (valid_ref[:] != 0), 1.0, 0.0
+        ).astype(x_rows_ref.dtype)
     # mask the last tile's padding rows: per-row outputs beyond C are
     # discarded by Pallas, but these (1, N) reductions would fold the
     # padding rows' unspecified inputs into the accumulated deltas
@@ -221,7 +225,8 @@ def _admission_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "enforce_capacity", "use_noise", "interpret", "block_c", "x_dtype"
+        "enforce_capacity", "use_noise", "interpret", "block_c", "x_dtype",
+        "emit_x_rows",
     ),
 )
 def fused_score_admission(
@@ -245,11 +250,15 @@ def fused_score_admission(
     interpret: bool = False,
     block_c: int = 256,
     x_dtype=jnp.bfloat16,
+    emit_x_rows: bool = True,
 ):
     """Returns ``(new_node i32[C], admitted bool[C], x_rows x_dtype[C, N],
     d_cpu f32[N], d_mem f32[N])`` — the chunk step's decision plus its
     commit arithmetic (new occupancy rows and net per-node load deltas),
-    fused into two Pallas calls."""
+    fused into two Pallas calls. With ``emit_x_rows=False`` the occupancy
+    rows are neither computed nor written (the inline-mass solver path
+    regenerates occupancy from ``assign`` on the fly) and the return is
+    ``(new_node, admitted, d_cpu, d_mem)``."""
     C, N = M.shape
     bc = min(block_c, C)
     grid = (pl.cdiv(C, bc),)
@@ -305,22 +314,24 @@ def fused_score_admission(
     cfull = pl.BlockSpec((C, 1), lambda i: (0, 0), memory_space=pltpu.VMEM)
     nacc = pl.BlockSpec((1, N), lambda i: (0, 0), memory_space=pltpu.VMEM)
     wants_b = wants != 0
-    new_node, admitted, x_rows, d_cpu, d_mem = pl.pallas_call(
+    out_specs = [cvec, cvec, nacc, nacc]
+    out_shape = [
+        out_ci, out_ci,
+        jax.ShapeDtypeStruct((1, N), jnp.float32),
+        jax.ShapeDtypeStruct((1, N), jnp.float32),
+    ]
+    if emit_x_rows:
+        out_specs.append(
+            pl.BlockSpec((bc, N), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        )
+        out_shape.append(jax.ShapeDtypeStruct((C, N), x_dtype))
+    outs = pl.pallas_call(
         functools.partial(_admission_kernel, enforce_capacity=enforce_capacity),
         grid=grid,
         in_specs=[cvec, cvec, cvec, cvec, cvec, cvec, cvec, cvec, cvec,
                   crow, crow, crow, cfull, cfull],
-        out_specs=[
-            cvec, cvec,
-            pl.BlockSpec((bc, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            nacc, nacc,
-        ],
-        out_shape=[
-            out_ci, out_ci,
-            jax.ShapeDtypeStruct((C, N), x_dtype),
-            jax.ShapeDtypeStruct((1, N), jnp.float32),
-            jax.ShapeDtypeStruct((1, N), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(
         prop,
@@ -338,12 +349,100 @@ def fused_score_admission(
         jnp.where(wants_b, col_f32(c_cpu), 0.0),
         jnp.where(wants_b, col_f32(c_mem), 0.0),
     )
-    return (
-        new_node[:, 0],
-        admitted[:, 0] != 0,
-        x_rows,
-        d_cpu[0],
-        d_mem[0],
+    if emit_x_rows:
+        new_node, admitted, d_cpu, d_mem, x_rows = outs
+        return (
+            new_node[:, 0], admitted[:, 0] != 0, x_rows, d_cpu[0], d_mem[0]
+        )
+    new_node, admitted, d_cpu, d_mem = outs
+    return new_node[:, 0], admitted[:, 0] != 0, d_cpu[0], d_mem[0]
+
+
+def _mass_kernel(
+    blocks_ref,  # scalar-prefetch i32[KB]: W row-block id per chunk block
+    w_ref,       # VMEM (B, BJ) W row-block tile (gathered by the index_map)
+    assign_ref,  # VMEM (1, BJ) i32 current node per service (canonical order)
+    valid_ref,   # VMEM (1, BJ) i32 service validity
+    m_ref,       # out VMEM (B, N) f32, accumulated over the j grid axis
+):
+    del blocks_ref  # consumed by the index_map, not the body
+    n = m_ref.shape[1]
+    bj = w_ref.shape[1]
+    a = assign_ref[:].reshape(bj, 1)
+    v = valid_ref[:].reshape(bj, 1) != 0
+    col = jax.lax.broadcasted_iota(jnp.int32, (bj, n), 1)
+    # the occupancy tile, regenerated in VMEM: X[j, n] = [assign_j == n]·valid
+    oh = ((a == col) & v).astype(w_ref.dtype)
+    acc = jnp.dot(w_ref[:], oh, preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        m_ref[:] = acc
+
+    @pl.when(pl.program_id(1) > 0)
+    def _():
+        m_ref[:] += acc
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_nodes", "block_b", "block_j", "interpret"),
+)
+def fused_neighbor_mass(
+    W,          # [SP, SP] weight matrix, CANONICAL order (never permuted)
+    assign,     # i32[SP] current node per service, canonical order
+    svc_valid,  # bool[SP]
+    block_ids,  # i32[KB]: which B-row blocks of W form this chunk, in order
+    *,
+    num_nodes: int,
+    block_b: int = 256,
+    block_j: int = 1024,
+    interpret: bool = False,
+):
+    """``M = W[chunk rows] @ (one_hot(assign)·valid)`` where the chunk's rows
+    are the ``block_ids`` B-row blocks of the CANONICAL W — gathered by the
+    Pallas index_map (scalar prefetch), so no per-sweep W permute/copy ever
+    touches HBM — and the occupancy matrix is generated ON THE FLY in VMEM —
+    X never exists in HBM, the chunk step carries no occupancy state and
+    commits no [C, N] scatter; ``assign`` (a few KB) is the only coupling
+    between chunks. Returns ``f32[KB·block_b, N]``.
+    """
+    SP = W.shape[0]
+    N = int(num_nodes)
+    KB = block_ids.shape[0]
+    if SP % block_j or SP % block_b:
+        # flooring the grid would silently DROP the trailing service
+        # columns/rows from the contraction — wrong M, no shape error
+        raise ValueError(
+            f"SP={SP} must be divisible by block_j={block_j} and "
+            f"block_b={block_b}"
+        )
+    nj = SP // block_j
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(KB, nj),
+        in_specs=[
+            pl.BlockSpec(
+                (block_b, block_j),
+                lambda i, j, blocks_ref: (blocks_ref[i], j),
+            ),
+            pl.BlockSpec((1, block_j), lambda i, j, blocks_ref: (0, j)),
+            pl.BlockSpec((1, block_j), lambda i, j, blocks_ref: (0, j)),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_b, N), lambda i, j, blocks_ref: (i, 0)
+        ),
+    )
+    return pl.pallas_call(
+        _mass_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((KB * block_b, N), jnp.float32),
+        interpret=interpret,
+    )(
+        block_ids.astype(jnp.int32),
+        W,
+        assign.reshape(1, SP).astype(jnp.int32),
+        svc_valid.reshape(1, SP).astype(jnp.int32),
     )
 
 

@@ -40,14 +40,15 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     GlobalSolverConfig,
     _pad_to,
     _service_aggregates,
+    auto_chunk,
+    sweep_composition,
 )
 
 _NEG_INF = float("-inf")
 
 
 def _dims(config: GlobalSolverConfig, S: int, N: int, tp: int):
-    C = config.chunk_size or max(1, min(1024, S // 10))
-    C = min(C, S)
+    C = min(auto_chunk(S, config.chunk_size), S)
     n_chunks = -(-S // C)
     return C, n_chunks, n_chunks * C, N // tp
 
@@ -101,16 +102,33 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
                 base_mem_l + svc_mem @ of,
             )
 
-        def objective(assign, cpu_l):
-            same = assign[:, None] == assign[None, :]
-            comm = 0.5 * jnp.sum(W * (1.0 - same.astype(jnp.float32)))
+        def _balance_terms(cpu_l):
             pct = jnp.where(valid_l, cpu_l / cap_l * 100.0, 0.0)
             s1 = lax.psum(jnp.sum(pct), "tp")
             s2 = lax.psum(jnp.sum(pct * pct), "tp")
             mean = s1 / nvalid
             var = jnp.maximum(s2 / nvalid - mean * mean, 0.0)
             over = lax.psum(jnp.sum(jnp.maximum(pct - 100.0, 0.0)), "tp")
-            return comm + config.balance_weight * jnp.sqrt(var) + ow * over
+            return config.balance_weight * jnp.sqrt(var) + ow * over
+
+        def objective(assign, cpu_l):
+            """EXACT (f32 comm) — the final adopted/reported value."""
+            same = assign[:, None] == assign[None, :]
+            comm = 0.5 * jnp.sum(W * (1.0 - same.astype(jnp.float32)))
+            return comm + _balance_terms(cpu_l)
+
+        # per-sweep selection on the bf16 kept-mass form — same trade and
+        # same expression as global_solver.objective_fast (exact for
+        # integer weights; exact f32 re-evaluation after the scan)
+        w_total = jnp.sum(W)
+
+        def objective_fast(assign, cpu_l):
+            same = assign[:, None] == assign[None, :]
+            kept = jnp.einsum(
+                "ij,ij->", W_mm, same.astype(W_mm.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return 0.5 * (w_total - kept) + _balance_terms(cpu_l)
 
         def chunk_step(inner, xs_c):
             ids, chunk_key, temp = xs_c
@@ -217,7 +235,7 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
             sweep_key, temp = xs
             assign, best_assign, best_obj = carry
             perm_key, noise_key = jax.random.split(sweep_key)
-            chunk_ids = jax.random.permutation(perm_key, SP).reshape(n_chunks, C)
+            chunk_ids, _ = sweep_composition(perm_key, SP, C, n_chunks)
             chunk_keys = jax.random.split(noise_key, n_chunks)
             chunk_temps = jnp.full((n_chunks,), temp)
             X0 = (
@@ -234,18 +252,21 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
             # the carry could flip near-tie selections away from the
             # single-chip solver, whose objective() also rebuilds loads
             cpu_fresh, _ = local_loads(assign)
-            obj = objective(assign, cpu_fresh)
+            obj = objective_fast(assign, cpu_fresh)
             better = obj < best_obj
             best_assign = jnp.where(better, assign, best_assign)
             best_obj = jnp.where(better, obj, best_obj)
             return (assign, best_assign, best_obj), jnp.sum(moves)
 
         cpu0, _ = local_loads(assign_init)
-        obj0 = objective(assign_init, cpu0)
-        (_, best_assign, best_obj), _ = lax.scan(
+        obj0 = objective_fast(assign_init, cpu0)
+        (_, best_assign, _), _ = lax.scan(
             sweep, (assign_init, assign_init, obj0), (keys_r, temps)
         )
-        return best_assign, best_obj
+        # exact f32 re-evaluation of the adopted placement (same reason as
+        # global_solver: the fast objective only ranks sweeps)
+        cpu_best, _ = local_loads(best_assign)
+        return best_assign, objective(best_assign, cpu_best)
 
     return solve_one
 

@@ -24,13 +24,21 @@ Each sweep:
      pairwise-priority admission — a [C, C] MXU matmul against the
      per-service move masses), improving moves commit, loads update
      incrementally,
-then scan to the next chunk. On TPU, steps 2–4 plus the commit arithmetic
-run as two fused Pallas kernels (``ops.fused_admission``); elsewhere the
-term-for-term XLA twin runs. The best state seen across all sweeps (by true
-objective) is returned, so oscillation can never make the answer worse than
-the initial placement. Everything is static-shaped — service arrays are
-padded to a chunk multiple, so one compilation serves every round at a given
-(S, N) capacity.
+then scan to the next chunk. On TPU the whole step runs as three Pallas
+kernels (``ops.fused_admission``): the neighbor-mass matmul gathers W
+row-blocks by id and regenerates one-hot occupancy tiles in VMEM (the
+occupancy matrix never exists in HBM — ``assign`` is the only state between
+chunks), then score→argmax and sort-free admission; elsewhere the
+term-for-term XLA twin runs against a materialized occupancy matrix. The
+best state seen across all sweeps is returned (ranked by a bf16 kept-mass
+objective, re-evaluated exactly in f32 before adoption), so oscillation can
+never make the answer worse than the initial placement. Everything is
+static-shaped — service arrays are padded to a chunk multiple, so one
+compilation serves every round at a given (S, N) capacity.
+
+Round-3 measurement (10k services × 1k nodes, v5e-1, 9 sweeps): 28.9 ms
+device-side per round at comm cost 12115 — vs round 2's 41.5 ms @ 12180
+(8 sweeps, materialized X, f32 objective): both faster and better.
 """
 
 from __future__ import annotations
@@ -45,6 +53,7 @@ from jax import lax
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
 from kubernetes_rescheduling_tpu.ops.fused_admission import (
+    fused_neighbor_mass,
     fused_score_admission,
     reference_score_admission,
 )
@@ -52,15 +61,21 @@ from kubernetes_rescheduling_tpu.ops.fused_admission import (
 
 @struct.dataclass
 class GlobalSolverConfig:
-    sweeps: int = struct.field(pytree_node=False, default=8)
-    # 0 = auto: ~S/10, clamped to [1, 1024]. Small chunks make the sweep more
+    # 9 sweeps: with the round-3 inline-mass path a sweep costs ~2.9 ms at
+    # 10k×1k (v5e-1), so one more sweep than the historical 8 both lands
+    # under the <100 ms target with margin (28.9 ms) AND beats the round-2
+    # objective (12115 vs 12180 comm cost) — quality per millisecond went
+    # up, so spend one extra sweep of it.
+    sweeps: int = struct.field(pytree_node=False, default=9)
+    # 0 = auto: ~S/10, clamped to [1, 1024], rounded up to a multiple of 256
+    # past that size (see auto_chunk — the rounding is what lets the
+    # inline-mass Pallas path tile). Small chunks make the sweep more
     # Gauss-Seidel (each chunk sees the previous chunks' moves), which local
-    # search needs to converge; large chunks amortize kernel launches and
-    # feed the MXU. ~10% of the services per chunk balances both. The round
-    # is launch-bound, not FLOP-bound (many small ops per chunk step), so the
-    # cap sets latency almost directly: measured at 10k×1k on v5e-1,
-    # cap 512 → 66 ms/round @ cost 12145, cap 1024 → 53 ms @ 12196 —
-    # 20% faster for 0.4% objective, hence the 1024 default.
+    # search needs to converge; large chunks amortize per-step work and feed
+    # the MXU. Measured at 10k×1k on v5e-1 (round 3): C=1024 → 28.9 ms
+    # @ cost 12115 (9 sweeps); C=2048 → 43 ms @ 12300 (the [C, C] admission
+    # race grows quadratically and gets more conservative) — ~1k is the
+    # sweet spot.
     chunk_size: int = struct.field(pytree_node=False, default=0)
     balance_weight: float = struct.field(pytree_node=False, default=0.0)
     enforce_capacity: bool = struct.field(pytree_node=False, default=True)
@@ -133,6 +148,52 @@ def _pad_to(x: jax.Array, size: int, fill=0):
     return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1), constant_values=fill)
 
 
+COMPOSITION_BLOCK = 256
+
+
+def sweep_composition(perm_key: jax.Array, SP: int, C: int, n_chunks: int):
+    """Random per-sweep chunk composition: which services move together.
+
+    Returns ``(chunk_ids [n_chunks, C], block_rows [n_chunks, C // B])``
+    where B is the composition granularity: 256 when the padded sizes tile
+    (every auto-chunked large instance), else 1. At B=256 a chunk is a
+    random set of 256-service blocks — the TPU mass kernel gathers W
+    row-blocks directly by id (scalar prefetch), so randomizing composition
+    costs no W permute/copy at all. At B=1 this is exactly the historical
+    full permutation (`jax.random.permutation(key, SP)` — same key stream).
+    Shared by the single-chip and node-sharded solvers so their chunk
+    composition (and hence decisions) stay equal.
+    """
+    B = (
+        COMPOSITION_BLOCK
+        if C % COMPOSITION_BLOCK == 0 and SP % COMPOSITION_BLOCK == 0
+        else 1
+    )
+    NB = SP // B
+    bp = jax.random.permutation(perm_key, NB)
+    if B == 1:
+        return bp.reshape(n_chunks, C), bp.reshape(n_chunks, C)
+    ids = bp[:, None] * B + jnp.arange(B, dtype=jnp.int32)[None, :]
+    return ids.reshape(n_chunks, C), bp.reshape(n_chunks, C // B)
+
+
+def auto_chunk(S: int, chunk_size: int = 0) -> int:
+    """Resolve the chunk size: explicit, or ~S/10 in [1, 1024] (see
+    GlobalSolverConfig.chunk_size). Auto sizes >= 256 round UP to a
+    multiple of 256 so the padded service count tiles cleanly for the
+    Pallas kernels (256 | C and 512 | SP) — e.g. 10k services: S/10 =
+    1000 -> 1024, without which the inline-mass path would fall back to
+    the materialized-X scheme. Shared by the single-chip and node-sharded
+    solvers so their chunk composition (and hence decisions) stay equal.
+    """
+    if chunk_size:
+        return chunk_size
+    C = max(1, min(1024, S // 10))
+    if C >= 256:
+        C = min(1024, -(-C // 256) * 256)
+    return C
+
+
 @partial(jax.jit, static_argnames=("config",))
 def global_assign(
     state: ClusterState,
@@ -154,8 +215,7 @@ def global_assign(
     ow = config.overload_weight if config.enforce_capacity else 0.0
     S = graph.num_services
     N = state.num_nodes
-    C = config.chunk_size or max(1, min(1024, S // 10))
-    C = min(C, S)
+    C = min(auto_chunk(S, config.chunk_size), S)
     n_chunks = -(-S // C)
     SP = n_chunks * C  # padded service count
 
@@ -196,16 +256,37 @@ def global_assign(
         oh = jax.nn.one_hot(assign, N, dtype=jnp.float32) * svc_valid[:, None]
         return base_cpu + svc_cpu @ oh, base_mem + svc_mem @ oh
 
-    def objective(assign):
-        same = assign[:, None] == assign[None, :]
-        comm = 0.5 * jnp.sum(W * (1.0 - same.astype(jnp.float32)))
-        cpu_load, _ = loads(assign)
+    def _balance_terms(cpu_load):
         pct = jnp.where(state.node_valid, cpu_load / cap * 100.0, 0.0)
         nvalid = jnp.maximum(jnp.sum(state.node_valid), 1)
         mean = jnp.sum(pct) / nvalid
         var = jnp.sum(jnp.where(state.node_valid, (pct - mean) ** 2, 0.0)) / nvalid
         overload = jnp.sum(jnp.maximum(pct - 100.0, 0.0))
-        return comm + config.balance_weight * jnp.sqrt(var) + ow * overload
+        return config.balance_weight * jnp.sqrt(var) + ow * overload
+
+    def objective(assign):
+        """EXACT objective (f32 comm, fresh loads) — the adopt gate and
+        reported values."""
+        same = assign[:, None] == assign[None, :]
+        comm = 0.5 * jnp.sum(W * (1.0 - same.astype(jnp.float32)))
+        cpu_load, _ = loads(assign)
+        return comm + _balance_terms(cpu_load)
+
+    # per-sweep best-seen selection uses the kept-mass form on the bf16 W
+    # copy: comm = (ΣW − Σ W·[same])/2 reads 200 MB instead of 400+ and is
+    # EXACT for integer pair weights (every scenario graph; only fractional
+    # trace weights round). The returned objective is re-evaluated with the
+    # exact f32 form after the scan, so the never-worse gate cannot drift.
+    w_total = jnp.sum(W)
+
+    def objective_fast(assign, cpu_load):
+        same = assign[:, None] == assign[None, :]
+        kept = jnp.einsum(
+            "ij,ij->", W_mm, same.astype(mm_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        comm = 0.5 * (w_total - kept)
+        return comm + _balance_terms(cpu_load)
 
     # fused Pallas epilogue: on for real TPU at kernel-worthy sizes;
     # "interpret" runs the same kernels through the interpreter (tests)
@@ -218,6 +299,21 @@ def global_assign(
             and C >= 128
             and N >= 128
         )
+    )
+    # inline-mass variant of the fused path: the chunk matmul gathers W
+    # row-blocks by id (scalar prefetch over the canonical W — no per-sweep
+    # permute) and regenerates one-hot occupancy tiles from `assign` in VMEM
+    # (ops.fused_neighbor_mass) — the [SP, N] occupancy matrix is never
+    # built, carried, or scattered, and the chunk step's only state coupling
+    # is the assign vector. Engages when the composition is block-granular
+    # (256 | C and 256 | SP — every auto-chunked large instance); otherwise
+    # the fused path keeps the materialized-X scheme below.
+    mass_bj = next((b for b in (1024, 512, 256) if SP % b == 0), None)
+    inline_mass = (
+        use_fused
+        and C % COMPOSITION_BLOCK == 0
+        and SP % COMPOSITION_BLOCK == 0
+        and mass_bj is not None
     )
 
     def _commit(inner, ids, valid_c, c_cpu, c_mem, cur, new_node, admitted):
@@ -244,7 +340,7 @@ def global_assign(
         # together varies, so repeated sweeps (and parallel restarts with
         # different keys) explore different neighborhoods of the search space.
         perm_key, noise_key = jax.random.split(sweep_key)
-        chunk_ids = jax.random.permutation(perm_key, SP).reshape(n_chunks, C)
+        chunk_ids, _ = sweep_composition(perm_key, SP, C, n_chunks)
         chunk_keys = jax.random.split(noise_key, n_chunks)
 
         def chunk_step(inner, xs_c):
@@ -312,13 +408,72 @@ def global_assign(
         X0 = jax.nn.one_hot(assign, N, dtype=mm_dtype) * svc_valid[:, None]
         cpu_load, mem_load = loads(assign)
         (assign, _, _, _), moves = lax.scan(
-            chunk_step, (assign, X0, cpu_load, mem_load), (chunk_ids, chunk_keys)
+            chunk_step, (assign, X0, cpu_load, mem_load),
+            (chunk_ids, chunk_keys),
         )
-        obj = objective(assign)
+        obj = objective_fast(assign, loads(assign)[0])
         better = obj < best_obj
         best_assign = jnp.where(better, assign, best_assign)
         best_obj = jnp.where(better, obj, best_obj)
         return (assign, best_assign, best_obj), jnp.sum(moves)
+
+    def sweep_inline(carry, xs):
+        """The TPU inline-mass sweep: same decisions as `sweep` (same chunk
+        composition / chunk keys / kernel math; M values are exact for
+        integer weights), but the occupancy matrix never exists — the mass
+        kernel gathers the chunk's W row-blocks by id (scalar prefetch,
+        canonical W, no per-sweep permute) and regenerates occupancy tiles
+        from `assign` in VMEM; per-node loads are carried through the chunk
+        scan and refreshed from the assignment at each sweep boundary."""
+        sweep_key, temp = xs
+        assign, cpu_load, mem_load, best_assign, best_obj = carry
+        perm_key, noise_key = jax.random.split(sweep_key)
+        chunk_ids, block_rows = sweep_composition(perm_key, SP, C, n_chunks)
+        chunk_keys = jax.random.split(noise_key, n_chunks)
+
+        def chunk_step(inner, xs_c):
+            ids, blocks, chunk_key = xs_c
+            assign, cpu_load, mem_load = inner
+            valid_c = svc_valid[ids]
+            c_cpu = svc_cpu[ids]
+            c_mem = svc_mem[ids]
+            cur = assign[ids]
+            M = fused_neighbor_mass(
+                W_mm, assign, svc_valid, blocks,
+                num_nodes=N, block_b=COMPOSITION_BLOCK, block_j=mass_bj,
+                interpret=fused_interpret,
+            )
+            seed = jax.random.randint(chunk_key, (), 0, 2**31 - 1)
+            new_node, admitted, d_cpu, d_mem = fused_score_admission(
+                M, cur, c_cpu, c_mem, valid_c,
+                cpu_load, mem_load, cap, mem_cap, state.node_valid,
+                config.balance_weight, temp, seed,
+                overload_weight=ow,
+                enforce_capacity=config.enforce_capacity,
+                use_noise=config.noise_temp > 0 and not fused_interpret,
+                interpret=fused_interpret,
+                emit_x_rows=False,
+            )
+            return (
+                (assign.at[ids].set(new_node), cpu_load + d_cpu, mem_load + d_mem),
+                jnp.sum(admitted),
+            )
+
+        (assign, _, _), moves = lax.scan(
+            chunk_step, (assign, cpu_load, mem_load),
+            (chunk_ids, block_rows, chunk_keys),
+        )
+        # refresh the carried loads from the assignment each sweep (the
+        # objective needs fresh loads anyway): incremental-delta f32 drift
+        # is bounded to one sweep, matching the materialized-X and sharded
+        # sweeps — carried drift could otherwise flip a feasibility check
+        # on a node sitting exactly at its budget
+        cpu_fresh, mem_fresh = loads(assign)
+        obj = objective_fast(assign, cpu_fresh)
+        better = obj < best_obj
+        best_assign = jnp.where(better, assign, best_assign)
+        best_obj = jnp.where(better, obj, best_obj)
+        return (assign, cpu_fresh, mem_fresh, best_assign, best_obj), jnp.sum(moves)
 
     # True objective of the INPUT placement (which may have a service's
     # replicas split across nodes — not representable as a service-level
@@ -335,15 +490,25 @@ def global_assign(
         + config.balance_weight * (load_std(state) / config.capacity_frac)
         + ow * jnp.sum(jnp.maximum(pct_true0 - 100.0, 0.0))
     )
-    obj0 = objective(assign0)
+    cpu0, mem0 = loads(assign0)
+    obj0 = objective_fast(assign0, cpu0)
     keys = jax.random.split(key, config.sweeps)
     # linear decay to zero: the last sweeps polish greedily
     temps = config.noise_temp * (
         1.0 - jnp.arange(config.sweeps, dtype=jnp.float32) / max(config.sweeps - 1, 1)
     )
-    (_, best_assign, best_obj), moves_per_sweep = lax.scan(
-        sweep, (assign0, assign0, obj0), (keys, temps)
-    )
+    if inline_mass:
+        (_, _, _, best_assign, _), moves_per_sweep = lax.scan(
+            sweep_inline, (assign0, cpu0, mem0, assign0, obj0), (keys, temps)
+        )
+    else:
+        (_, best_assign, _), moves_per_sweep = lax.scan(
+            sweep, (assign0, assign0, obj0), (keys, temps)
+        )
+    # best-seen selection above ranks sweeps with the fast objective; the
+    # adopted value is re-evaluated EXACTLY so the never-worse gate and the
+    # reported objective carry no bf16 rounding
+    best_obj = objective(best_assign)
 
     # scatter service assignment back to pods — but only when the solve
     # strictly beats the true input placement; otherwise keep the input
@@ -362,5 +527,8 @@ def global_assign(
         "moves_per_sweep": moves_per_sweep,
         "communication_cost": communication_cost(new_state, graph),
         "load_std": load_std(new_state),
+        # which epilogue lowering ran (static): tests assert the inline
+        # path actually engaged rather than silently falling back
+        "inline_mass": jnp.asarray(inline_mass),
     }
     return new_state, info

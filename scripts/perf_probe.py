@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Perf probe: device-side per-round latency (slope method) for solver
+variants, to attribute time between the chunk loop, the per-sweep
+objective, and the epilogue kernels. Not part of the public API.
+
+Usage: python scripts/perf_probe.py [chunk_size ...]
+Env:   PROBE_SWEEPS (default 8), PROBE_SCENARIO (default large)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> int:
+    chunks = [int(a) for a in sys.argv[1:]] or [1024, 1020]
+    sweeps = int(os.environ.get("PROBE_SWEEPS", "8"))
+    scenario = os.environ.get("PROBE_SCENARIO", "large")
+
+    from kubernetes_rescheduling_tpu.bench.harness import make_backend
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+
+    backend = make_backend(scenario, seed=0)
+    state = backend.monitor()
+    graph = backend.comm_graph()
+
+    @partial(jax.jit, static_argnames=("k", "cfg"))
+    def chained(st0, g, key0, k, cfg):
+        def body(st_c, i):
+            st_n, inf_n = global_assign(st_c, g, jax.random.fold_in(key0, i), cfg)
+            return st_n, inf_n["objective_after"]
+
+        return jax.lax.scan(body, st0, jnp.arange(k))
+
+    def slope_ms(cfg):
+        def timed(k):
+            _, objs = chained(state, graph, jax.random.PRNGKey(7), k, cfg)
+            float(objs[-1])  # warm
+            t = time.perf_counter()
+            _, objs = chained(state, graph, jax.random.PRNGKey(8), k, cfg)
+            float(objs[-1])
+            return time.perf_counter() - t
+
+        k1, k2 = 2, 12
+        return (timed(k2) - timed(k1)) / (k2 - k1) * 1e3
+
+    for c in chunks:
+        cfg = GlobalSolverConfig(sweeps=sweeps, chunk_size=c)
+        ms = slope_ms(cfg)
+        _, inf = global_assign(state, graph, jax.random.PRNGKey(0), cfg)
+        print(
+            f"chunk={c:5d} sweeps={sweeps} device_ms={ms:8.2f} "
+            f"obj_after={float(inf['objective_after']):10.1f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

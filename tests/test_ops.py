@@ -150,6 +150,65 @@ def test_solver_fused_epilogue_matches_xla_path():
     )
 
 
+def test_fused_neighbor_mass_matches_matmul():
+    """The inline-mass kernel (W row-blocks gathered by id, occupancy
+    regenerated in VMEM) equals the materialized-X matmul for arbitrary
+    block compositions."""
+    from kubernetes_rescheduling_tpu.ops.fused_admission import fused_neighbor_mass
+
+    rng = np.random.default_rng(0)
+    SP, N, B = 128, 64, 16
+    W = jnp.asarray(
+        rng.integers(0, 5, size=(SP, SP)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    assign = jnp.asarray(rng.integers(0, N, size=SP), jnp.int32)
+    valid = jnp.asarray(rng.random(SP) < 0.9)
+    X = jax.nn.one_hot(assign, N, dtype=jnp.bfloat16) * valid[:, None]
+    for blocks in ([0, 1], [7, 2], [3, 0, 5, 6]):
+        ids = (np.asarray(blocks)[:, None] * B + np.arange(B)[None, :]).reshape(-1)
+        got = fused_neighbor_mass(
+            W, assign, valid, jnp.asarray(blocks, jnp.int32),
+            num_nodes=N, block_b=B, block_j=32, interpret=True,
+        )
+        want = jnp.matmul(W[ids], X, preferred_element_type=jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_solver_inline_mass_matches_xla_path():
+    """The no-occupancy-matrix fused path (inline mass kernel + x_rows-free
+    admission + loads carried across sweeps) vs the XLA path: same perm and
+    chunk keys, M exact for integer weights — placements must agree
+    near-identically, objectives tightly."""
+    from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+
+    scn = synthetic_scenario(n_pods=256, n_nodes=128, seed=9, mean_degree=4.0)
+    key = jax.random.PRNGKey(4)
+    # chunk_size=256 makes C and SP multiples of the 256 composition block,
+    # so the interpret run takes the inline-mass sweep (asserted via
+    # objective agreement with the XLA path, which is
+    # chunk-composition-identical)
+    base = dict(sweeps=3, noise_temp=0.0, balance_weight=0.5, chunk_size=256)
+    st_fused, info_fused = global_assign(
+        scn.state, scn.graph, key,
+        GlobalSolverConfig(**base, fused_epilogue="interpret"),
+    )
+    # guard against silent fallback: if a gate change stops the inline path
+    # from engaging here, this test would quietly re-test the materialized
+    # path and the production inline sweep would ship uncovered
+    assert bool(info_fused["inline_mass"])
+    st_xla, info_xla = global_assign(
+        scn.state, scn.graph, key,
+        GlobalSolverConfig(**base, fused_epilogue="off"),
+    )
+    assert not bool(info_xla["inline_mass"])
+    same = np.asarray(st_fused.pod_node) == np.asarray(st_xla.pod_node)
+    assert same.mean() > 0.99
+    assert float(info_fused["objective_after"]) == pytest.approx(
+        float(info_xla["objective_after"]), rel=1e-3
+    )
+
+
 def test_fused_noise_is_deterministic_per_seed():
     """TPU-only: the annealing-noise branch (what production 'auto' mode
     runs). The TPU core PRNG has no interpret lowering on ANY platform, so

@@ -116,7 +116,10 @@ class SimBackend:
             spec = self.workmodel.services[svc_idx]
             per_pod = (
                 self.load.idle_m
-                + rps.get(spec.name, 0.0) / replicas[spec.name] * self.load.cost_per_req_m
+                + rps.get(spec.name, 0.0)
+                / replicas[spec.name]
+                * self.load.cost_per_req_m
+                * spec.proc_cost  # per-service cpu_stress weight (workmodelC)
             )
             per_pod *= self._cpu_spike.get(spec.name, 1.0)
             if self.load.noise_frac > 0:
@@ -208,7 +211,10 @@ class SimBackend:
             spec = self.workmodel.services[svc_idx]
             per_pod = (
                 self.load.idle_m
-                + rps.get(spec.name, 0.0) / replicas[spec.name] * self.load.cost_per_req_m
+                + rps.get(spec.name, 0.0)
+                / replicas[spec.name]
+                * self.load.cost_per_req_m
+                * spec.proc_cost
             )
             used[node] += per_pod * self._cpu_spike.get(spec.name, 1.0)
         best, best_used = None, np.inf

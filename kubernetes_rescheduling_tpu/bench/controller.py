@@ -101,8 +101,14 @@ def run_controller(
     on_round=None,
     checkpoint_dir: str | None = None,
     logger: StructuredLogger | None = None,
+    graph=None,
 ) -> ControllerResult:
     """Run ``config.max_rounds`` rounds against a backend.
+
+    ``graph`` overrides the backend's declared comm graph for the DECISION
+    kernels — the harness passes traffic-estimated weights here
+    (``LoadGenerator.observed_graph``) so the solver optimizes what the
+    request stream actually does, not what the workmodel claims.
 
     ``on_round(record, state)`` — if given — is called after each round with
     the completed record and the post-move snapshot; the harness uses it to
@@ -120,7 +126,11 @@ def run_controller(
     """
     config = config.validate()
     key = key if key is not None else jax.random.PRNGKey(config.seed)
-    graph = backend.comm_graph()
+    # decisions may run on an estimated graph; TELEMETRY always reports on
+    # the backend's declared graph so round costs stay comparable across
+    # configurations (and with the harness's before/after metrics)
+    metric_graph = backend.comm_graph()
+    graph = graph if graph is not None else metric_graph
     result = ControllerResult()
 
     mgr = CheckpointManager(checkpoint_dir) if checkpoint_dir else None
@@ -150,7 +160,7 @@ def run_controller(
             record = _greedy_round(backend, state, graph, config, sub, rnd)
         backend.advance(config.sleep_after_action_s)
         state = backend.monitor()
-        record.communication_cost = float(communication_cost(state, graph))
+        record.communication_cost = float(communication_cost(state, metric_graph))
         record.load_std = float(load_std(state))
         result.rounds.append(record)
         if logger is not None:

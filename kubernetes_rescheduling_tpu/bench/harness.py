@@ -91,6 +91,12 @@ class ExperimentConfig:
     # utilization, it is also the response-time lever.
     enforce_capacity: bool = False
     capacity_frac: float = 1.0
+    # Ground the solver in OBSERVED traffic: estimate edge weights from the
+    # phase-r1 request stream's traversal counts (LoadGenerator.
+    # observed_graph) and hand the controller that graph instead of the
+    # declared workmodel topology (reference README.md:47 — the objective
+    # is defined on actual deployed traffic).
+    observe_weights: bool = False
 
 
 def make_backend(
@@ -240,10 +246,19 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 saved = json.loads(phase1.read_text())
                 before_metrics = saved["before"]
                 load_before_dict = saved["load_before"]
+                edge_counts = (
+                    np.asarray(saved["edge_counts"], dtype=np.int64)
+                    if saved.get("edge_counts") is not None
+                    else None
+                )
+                obs_sent = int(saved.get("obs_sent", 0))
             else:
                 before = backend.monitor()
-                load_before = loadgen.measure(before, k_before)
+                samples_before = loadgen.run(before, k_before)
+                load_before = samples_before.stats()
                 load_before_dict = load_before.as_dict()
+                edge_counts = samples_before.edge_counts
+                obs_sent = samples_before.sent
                 before_metrics = {
                     "communication_cost": float(communication_cost(before, graph)),
                     "load_std": float(load_std(before)),
@@ -252,10 +267,30 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 std_sink.append(before_metrics["load_std"])
                 phase1.write_text(
                     json.dumps(
-                        {"before": before_metrics, "load_before": load_before_dict},
+                        {
+                            "before": before_metrics,
+                            "load_before": load_before_dict,
+                            # persisted so a crash-resume can still estimate
+                            "edge_counts": (
+                                edge_counts.tolist()
+                                if edge_counts is not None
+                                else None
+                            ),
+                            "obs_sent": obs_sent,
+                        },
                         default=float,
                     )
                 )
+
+            # traffic-estimated weights for the DECISION graph: the solver
+            # optimizes what the phase-r1 request stream actually traversed;
+            # reported communication_cost metrics stay on the declared graph
+            # for comparability across configurations
+            solve_graph = (
+                loadgen.observed_graph(edge_counts, obs_sent, graph)
+                if cfg.observe_weights
+                else graph
+            )
 
             # phase r2: the control loop under sustained load — per round,
             # simulate the segment's requests with teardown outages for every
@@ -326,6 +361,7 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 on_round=on_round,
                 checkpoint_dir=str(run_dir / "checkpoints") if cfg.session_name else None,
                 logger=logger,
+                graph=solve_graph if cfg.observe_weights else None,
             )
             wall_s = time.perf_counter() - t0
             if events is not None:

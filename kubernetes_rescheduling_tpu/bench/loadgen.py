@@ -206,17 +206,21 @@ def _request_chunk(
     replica_counts: jax.Array, # i32[S] placed replicas (0 = unavailable)
     node_rho: jax.Array,       # f32[N] utilization fraction
     outage_frac: jax.Array,    # f32[S, 2] outage window as fractions of phase
-    cfg_vec: jax.Array,        # f32[7] local, remote, rho_cap, jitter,
-                               #        drop_rho, max_drop_p, fanout
+    edge_p: jax.Array,         # f32[E] per-edge call probability
+    n_valid: jax.Array,        # i32 scalar: real requests in this chunk
+    cfg_vec: jax.Array,        # f32[6] local, remote, rho_cap, jitter,
+                               #        drop_rho, max_drop_p
     *,
     depth: int,
     chunk: int,
 ):
     """Simulate one fixed-size chunk of requests. Returns per-request
-    ``(latency_ms, ok, err_outage, err_overload)``."""
-    local_ms, remote_ms, rho_cap, jitter, drop_rho, max_drop_p, fanout = (
+    ``(latency_ms, ok, err_outage, err_overload)`` plus the per-edge
+    traversal count over the chunk's first ``n_valid`` requests — the
+    observed-traffic signal the weight estimator aggregates."""
+    local_ms, remote_ms, rho_cap, jitter, drop_rho, max_drop_p = (
         cfg_vec[0], cfg_vec[1], cfg_vec[2], cfg_vec[3],
-        cfg_vec[4], cfg_vec[5], cfg_vec[6],
+        cfg_vec[4], cfg_vec[5],
     )
     S = proc_ms.shape[0]
     k_rep, k_t, k_jit, k_drop, k_edge = jax.random.split(key, 5)
@@ -229,9 +233,12 @@ def _request_chunk(
     )
     svc_node = replica_nodes[jnp.arange(S)[None, :], ridx]  # i32[chunk, S]
 
-    # sample this request's call tree: each kept edge fires with p = fanout
+    # sample this request's call tree: each kept edge fires with its own
+    # probability (uniform fanout_frac unless the caller supplied per-edge
+    # probabilities — actual deployed traffic need not match the declared
+    # call graph)
     E = src.shape[0]
-    active = jax.random.uniform(k_edge, (chunk, E)) < fanout  # bool[chunk, E]
+    active = jax.random.uniform(k_edge, (chunk, E)) < edge_p[None, :]
 
     # queue-inflated processing time per (request, service)
     rho = jnp.clip(node_rho, 0.0, rho_cap)
@@ -281,7 +288,14 @@ def _request_chunk(
     )
 
     ok = ~(err_outage | err_overload)
-    return latency, ok, err_outage, err_overload
+
+    # observed traffic: an edge is traversed when its caller is visited and
+    # the edge fired; only the chunk's real (non-padding) rows count
+    rowmask = jnp.arange(chunk) < n_valid
+    edge_count = jnp.sum(
+        active & visited[:, src] & rowmask[:, None], axis=0
+    ).astype(jnp.int32)
+    return latency, ok, err_outage, err_overload, edge_count
 
 
 @dataclass
@@ -294,14 +308,23 @@ class _Samples:
     err_overload: int = 0
     sim_s: float = 0.0
     restarts: int = 0
+    # per-edge traversal totals (aligned with the generator's CallPlan edge
+    # list) — the observed-traffic signal for weight estimation
+    edge_counts: np.ndarray | None = None
 
-    def extend(self, latency, ok, e_out, e_over, n: int) -> None:
+    def extend(self, latency, ok, e_out, e_over, n: int, edge_count=None) -> None:
         lat = np.asarray(latency[:n])
         okm = np.asarray(ok[:n])
         self.latencies.append(lat[okm])
         self.sent += n
         self.err_outage += int(np.asarray(e_out[:n]).sum())
         self.err_overload += int(np.asarray(e_over[:n]).sum())
+        if edge_count is not None:
+            ec = np.asarray(edge_count, dtype=np.int64)
+            if self.edge_counts is None:
+                self.edge_counts = ec.copy()
+            else:
+                self.edge_counts += ec
 
     def stats(self) -> RequestStats:
         lat = (
@@ -340,12 +363,19 @@ class LoadGenerator:
         cfg: LoadGenConfig | None = None,
         *,
         fanout_frac: float = 1.0,
+        edge_probs: Mapping[tuple[str, str], float] | None = None,
     ):
         """``fanout_frac`` is the per-edge call probability and MUST come
         from the same place the CPU-load model reads it
         (``backends.sim.LoadModel.fanout_frac``) — it is a constructor
         argument rather than a config field precisely so callers pass the
-        backend's value instead of maintaining a second copy."""
+        backend's value instead of maintaining a second copy.
+
+        ``edge_probs`` overrides the probability of individual directed
+        edges ``(caller, callee)`` — how ACTUAL traffic diverges from the
+        declared call graph (a canary taking most of the traffic, a
+        feature-flagged path going cold). The weight estimator recovers
+        these from observed traversal counts."""
         self.cfg = cfg or LoadGenConfig()
         self.workmodel = workmodel
         self.fanout_frac = fanout_frac
@@ -357,14 +387,28 @@ class LoadGenerator:
         c = self.cfg
         self._cfg_vec = jnp.asarray(
             [c.hop_local_ms, c.hop_remote_ms, c.queue_rho_cap,
-             c.jitter_sigma, c.drop_rho, c.max_drop_p, fanout_frac],
+             c.jitter_sigma, c.drop_rho, c.max_drop_p],
             jnp.float32,
         )
+        edge_p = np.full(len(self.plan.src), fanout_frac, dtype=np.float32)
+        for (a, b), p in (edge_probs or {}).items():
+            ia, ib = self._svc_index.get(a), self._svc_index.get(b)
+            if ia is None or ib is None:
+                continue
+            hit = (self.plan.src == ia) & (self.plan.dst == ib)
+            edge_p[hit] = p
+        self._edge_p = jnp.asarray(edge_p)
         # static across phases/segments: ship to device once
         self._src = jnp.asarray(self.plan.src)
         self._dst = jnp.asarray(self.plan.dst)
         self._entry = jnp.asarray(self.plan.entry, jnp.int32)
-        self._proc_ms = jnp.full((self.plan.num_services,), c.proc_ms, jnp.float32)
+        # per-service base service time: cfg.proc_ms scaled by the
+        # workmodel's cpu_stress-derived relative cost (workmodelC.json
+        # gives every service its OWN stress parameters — a heavy s3 on a
+        # hot node must dominate latency, not average away)
+        self._proc_ms = jnp.asarray(
+            [c.proc_ms * s.proc_cost for s in workmodel.services], jnp.float32
+        )
 
     def _placement_arrays(self, state: ClusterState):
         """Per-service replica→node tables from a cluster snapshot."""
@@ -428,7 +472,7 @@ class LoadGenerator:
             outage[i] = (start / duration, end / duration)
 
         rho = np.asarray(state.node_cpu_pct(), dtype=np.float32) / 100.0
-        args = (
+        head = (
             self._src,
             self._dst,
             self._entry,
@@ -437,17 +481,18 @@ class LoadGenerator:
             jnp.asarray(counts),
             jnp.asarray(rho),
             jnp.asarray(outage),
-            self._cfg_vec,
+            self._edge_p,
         )
         done = 0
         chunk_i = 0
         while done < total:
             n = min(cfg.chunk, total - done)
             sub = jax.random.fold_in(key, chunk_i)
-            latency, ok, e_out, e_over = _request_chunk(
-                sub, *args, depth=self.plan.depth, chunk=cfg.chunk
+            latency, ok, e_out, e_over, edge_count = _request_chunk(
+                sub, *head, jnp.asarray(n, jnp.int32), self._cfg_vec,
+                depth=self.plan.depth, chunk=cfg.chunk,
             )
-            samples.extend(latency, ok, e_out, e_over, n)
+            samples.extend(latency, ok, e_out, e_over, n, edge_count)
             done += n
             chunk_i += 1
         samples.sim_s += duration
@@ -465,6 +510,51 @@ class LoadGenerator:
         return self.run(
             state, key, duration_s=duration_s, outages=outages
         ).stats()
+
+    def observed_weights(
+        self, edge_counts: np.ndarray, sent: int
+    ) -> dict[tuple[str, str], float]:
+        """Symmetric pair weights from OBSERVED traversal counts: expected
+        traversals per request per service pair.
+
+        The reference's objective is defined on actual deployed traffic
+        (reference README.md:47, communicationcost.py:40-45) — a declared
+        workmodel whose call graph has drifted from reality silently
+        misdirects the solver; these weights ground it in what the request
+        stream really did.
+        """
+        out: dict[tuple[str, str], float] = {}
+        if sent <= 0:
+            return out
+        names = self.plan.names
+        for e in range(len(self.plan.src)):
+            a = names[int(self.plan.src[e])]
+            b = names[int(self.plan.dst[e])]
+            pair = (a, b) if a <= b else (b, a)
+            out[pair] = out.get(pair, 0.0) + float(edge_counts[e]) / sent
+        return out
+
+    def observed_graph(
+        self, edge_counts: np.ndarray | None, sent: int, base
+    ):
+        """``base`` CommGraph with its edge weights replaced by observed
+        traffic rates (untraversed declared edges drop toward 0 — stale
+        topology stops steering the solver). Declared pairs the request
+        model can never traverse (cycle-broken back-edges dropped by
+        ``kahn_traversal``) are zeroed too, so an unobservable edge cannot
+        keep its full declared weight and dominate the rescaled graph.
+        Returns ``base`` unchanged when there is nothing observed yet."""
+        from kubernetes_rescheduling_tpu.bench.trace import with_weights
+
+        if edge_counts is None or sent <= 0:
+            return base
+        updates = self.observed_weights(edge_counts, sent)
+        adj = np.asarray(base.adj)
+        names = list(base.names)
+        for i, j in np.argwhere(np.triu(adj, k=1) > 0):
+            pair = tuple(sorted((names[int(i)], names[int(j)])))
+            updates.setdefault(pair, 0.0)
+        return with_weights(base, updates)
 
 
 def new_samples() -> _Samples:

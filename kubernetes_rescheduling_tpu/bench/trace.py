@@ -96,6 +96,18 @@ def canary_trace(steps: int = 12) -> list[TraceStep]:
     return out
 
 
+def observed_step(t: float, loadgen, samples) -> TraceStep:
+    """A :class:`TraceStep` whose weights are the load generator's OBSERVED
+    per-pair traffic (``LoadGenerator.observed_weights``) — streaming
+    measured traffic into :func:`replay` instead of hand-written weight
+    schedules closes the loop between what the request stream does and
+    what the solver optimizes (reference README.md:47)."""
+    return TraceStep(
+        t=t,
+        weights=loadgen.observed_weights(samples.edge_counts, samples.sent),
+    )
+
+
 @dataclass
 class ReplayRecord:
     t: float

@@ -96,6 +96,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable capacity enforcement with this packing "
                         "budget (fraction of node capacity; global "
                         "algorithm only)")
+    b.add_argument("--observe-weights", action="store_true",
+                   help="estimate edge weights from the phase-r1 request "
+                        "stream's traversal counts and solve on those "
+                        "instead of the declared workmodel topology")
     b.add_argument("--seed", type=int, default=0)
 
     t = sub.add_parser(
@@ -187,6 +191,7 @@ def cmd_bench(args) -> dict:
         moves_per_round=args.moves_per_round,
         solver_restarts=args.restarts,
         solver_tp=args.tp,
+        observe_weights=args.observe_weights,
         enforce_capacity=args.capacity_frac is not None,
         capacity_frac=args.capacity_frac if args.capacity_frac is not None else 1.0,
         seed=args.seed,

@@ -29,6 +29,15 @@ class ServiceSpec:
     cpu_request_millicores: int = 100
     mem_request_bytes: int = 0
     replicas: int = 1
+    # Relative per-request processing cost, derived from the µBench
+    # cpu_stress parameters (reference workmodelC.json:16-24: each request
+    # runs `trials` loops at `range_complexity` over `thread_pool_size`
+    # threads). 1.0 = the builtin workmodelC loader (complexity 100 ×
+    # 10 trials, 1 thread); a service with heavier stress parameters costs
+    # proportionally more CPU per request AND takes proportionally longer
+    # to answer — consumed by both the simulator's CPU-load model and the
+    # request-level load generator, so the two stay consistent.
+    proc_cost: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -76,7 +85,11 @@ class Workmodel:
         Grammar (observed in reference workmodelC.json): top level maps
         service name → stanza; ``external_services`` is a list of groups,
         each with a ``services`` list of callee names; ``cpu-requests`` /
-        ``memory-requests`` are Kubernetes quantities; ``replicas`` optional.
+        ``memory-requests`` are Kubernetes quantities; ``replicas``
+        optional; ``internal_service.loader.cpu_stress`` gives the
+        per-request processing parameters (range_complexity, trials,
+        thread_pool_size — reference workmodelC.json:16-24), parsed into
+        the relative ``proc_cost``.
         """
         services = []
         for name, stanza in data.items():
@@ -96,6 +109,7 @@ class Workmodel:
                     cpu_request_millicores=cpu_to_millicores(cpu),
                     mem_request_bytes=mem_to_bytes(mem),
                     replicas=int(stanza.get("replicas", 1)),
+                    proc_cost=_parse_proc_cost(stanza),
                 )
             )
         return cls(services=tuple(services), source=source)
@@ -104,6 +118,43 @@ class Workmodel:
     def from_file(cls, path: str | Path) -> "Workmodel":
         p = Path(path)
         return cls.from_dict(json.loads(p.read_text()), source=str(p))
+
+
+# the builtin workmodelC loader: 100 complexity × 10 trials / 1 thread —
+# proc_cost is normalized so that stanza scores 1.0
+_BASELINE_STRESS = 100.0 * 10.0
+
+
+def _parse_proc_cost(stanza: Mapping[str, Any]) -> float:
+    """Relative per-request CPU cost from a µBench stanza's cpu_stress.
+
+    ``mean(range_complexity) · trials / thread_pool_size``, normalized to
+    the builtin workmodelC loader (= 1.0). A stanza without the loader
+    keeps the default 1.0; one whose cpu_stress is disabled (``run:
+    false``) gets a small floor (pass-through services still parse and
+    serialize requests, they are not free).
+    """
+    stress = _get_path(stanza, "internal_service", "loader", "cpu_stress")
+    if not isinstance(stress, Mapping):
+        return 1.0
+    if not stress.get("run", True):
+        return 0.05
+    rc = stress.get("range_complexity", [100, 100]) or [100, 100]
+    try:
+        complexity = (float(rc[0]) + float(rc[-1])) / 2.0
+    except (TypeError, ValueError, IndexError):
+        complexity = 100.0
+    trials = float(stress.get("trials", 10) or 10)
+    threads = max(float(stress.get("thread_pool_size", 1) or 1), 1.0)
+    return max(complexity * trials / threads / _BASELINE_STRESS, 0.05)
+
+
+def _get_path(obj: Any, *names: str):
+    for name in names:
+        if not isinstance(obj, Mapping):
+            return None
+        obj = obj.get(name)
+    return obj
 
 
 def kahn_traversal(

@@ -348,3 +348,29 @@ def test_cli_bench(tmp_path, capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out)
     assert "aggregate" in out
+
+
+def test_harness_observe_weights(tmp_path):
+    """--observe-weights: phase-r1 traversal counts are persisted and the
+    controller receives the traffic-estimated graph."""
+    cfg = ExperimentConfig(
+        algorithms=("global",),
+        repeats=1,
+        rounds=2,
+        scenario="mubench",
+        out_dir=str(tmp_path),
+        session_name="obs",
+        observe_weights=True,
+        seed=4,
+    )
+    summary = run_experiment(cfg)
+    assert len(summary["runs"]) == 1
+    phase1 = json.loads(
+        (tmp_path / "session_obs" / "global" / "run_1" / "phase1.json").read_text()
+    )
+    assert phase1["obs_sent"] > 0
+    assert phase1["edge_counts"] is not None
+    assert sum(phase1["edge_counts"]) > 0
+    # resumable: re-running the session reloads the counts without error
+    summary2 = run_experiment(cfg)
+    assert len(summary2["runs"]) == 1

@@ -198,3 +198,124 @@ def test_replica_load_balancing_mixes_hops():
         gen.cfg.hop_remote_ms - gen.cfg.hop_local_ms, rel=0.01
     )
     assert lo < stats.latency_avg_ms < hi
+
+
+def test_per_service_proc_cost_dominates_latency():
+    """V4 (workmodelC.json:16-24): a service with 10x cpu_stress dominates
+    end-to-end latency relative to a uniform-cost mesh."""
+    def chain(costly):
+        return Workmodel(
+            services=(
+                ServiceSpec(name="a", callees=("b",)),
+                ServiceSpec(name="b", proc_cost=10.0 if costly else 1.0),
+            )
+        )
+
+    st = ClusterState.build(
+        node_names=["n0"],
+        node_cpu_cap=[10_000.0],
+        node_mem_cap=[2**30],
+        node_alive=[True],
+        pod_services=[0, 1],
+        pod_nodes=[0, 0],
+        pod_cpu=[100.0, 100.0],
+        pod_mem=[0.0, 0.0],
+        pod_names=["a-0", "b-0"],
+    )
+    cfg = LoadGenConfig(
+        requests_per_phase=512, chunk=512, jitter_sigma=0.0, entry_service="a"
+    )
+    uniform = LoadGenerator(chain(False), cfg).measure(st, jax.random.PRNGKey(0))
+    heavy = LoadGenerator(chain(True), cfg).measure(st, jax.random.PRNGKey(0))
+    # b's base time goes 1.5 -> 15 ms: the extra 13.5 ms shows up 1:1,
+    # inflated by the node's M/M/1 factor (rho = 200m/10000m -> 1/0.98)
+    assert heavy.latency_avg_ms - uniform.latency_avg_ms == pytest.approx(
+        9.0 * cfg.proc_ms / (1.0 - 0.02), rel=0.001
+    )
+
+
+def test_edge_probs_and_observed_weights_recover_actual_traffic():
+    """V3: per-edge call probabilities diverge from the declared graph; the
+    traversal counts recover the actual rates."""
+    wm = Workmodel(
+        services=(
+            ServiceSpec(name="s0", callees=("s1", "s2")),
+            ServiceSpec(name="s1"),
+            ServiceSpec(name="s2"),
+        )
+    )
+    st = ClusterState.build(
+        node_names=["n0"],
+        node_cpu_cap=[10_000.0],
+        node_mem_cap=[2**30],
+        node_alive=[True],
+        pod_services=[0, 1, 2],
+        pod_nodes=[0, 0, 0],
+        pod_cpu=[100.0] * 3,
+        pod_mem=[0.0] * 3,
+        pod_names=["s0-0", "s1-0", "s2-0"],
+    )
+    gen = LoadGenerator(
+        wm,
+        LoadGenConfig(requests_per_phase=4096, chunk=1024, entry_service="s0"),
+        edge_probs={("s0", "s1"): 0.05, ("s0", "s2"): 1.0},
+    )
+    samples = gen.run(st, jax.random.PRNGKey(1))
+    w = gen.observed_weights(samples.edge_counts, samples.sent)
+    assert w[("s0", "s2")] == pytest.approx(1.0, abs=0.01)
+    assert w[("s0", "s1")] == pytest.approx(0.05, abs=0.02)
+    # graph built from observation replaces the declared 1.0 weights
+    est = gen.observed_graph(samples.edge_counts, samples.sent, wm.comm_graph())
+    import jax.numpy as jnp
+    i = {n: k for k, n in enumerate(est.names)}
+    assert float(est.adj[i["s0"], i["s2"]]) == pytest.approx(1.0, abs=0.01)
+    assert float(est.adj[i["s0"], i["s1"]]) < 0.1
+
+
+def test_estimated_weights_beat_declared_on_measured_latency():
+    """V3 headline (reference README.md:47): when declared topology and
+    actual traffic disagree, the solve on traffic-estimated weights yields
+    a measurably faster placement than the solve on declared weights."""
+    from kubernetes_rescheduling_tpu.bench.trace import with_weights
+    from kubernetes_rescheduling_tpu.core.topology import state_from_workmodel
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+
+    wm = Workmodel(
+        services=(
+            ServiceSpec(name="s0", callees=("s1", "s2")),
+            ServiceSpec(name="s1"),
+            ServiceSpec(name="s2"),
+        )
+    )
+    # DECLARED: s0-s1 is claimed hot (weight 3). ACTUAL: s0->s1 is nearly
+    # dead (p=.05), s0->s2 carries everything.
+    declared = with_weights(wm.comm_graph(), {("s0", "s1"): 3.0})
+    gen = LoadGenerator(
+        wm,
+        LoadGenConfig(requests_per_phase=4096, chunk=1024,
+                      jitter_sigma=0.0, entry_service="s0"),
+        edge_probs={("s0", "s1"): 0.05, ("s0", "s2"): 1.0},
+    )
+    state = state_from_workmodel(
+        wm, node_names=["n0", "n1"], node_cpu_cap_m=20_000.0, seed=3
+    )
+    # budget: 220m per node -> at most two 100m services colocate
+    cfg = GlobalSolverConfig(
+        sweeps=4, noise_temp=0.0, enforce_capacity=True, capacity_frac=0.011
+    )
+    key = jax.random.PRNGKey(0)
+    st_declared, _ = global_assign(state, declared, key, cfg)
+    samples = gen.run(state, jax.random.PRNGKey(1))
+    estimated = gen.observed_graph(samples.edge_counts, samples.sent, declared)
+    st_estimated, _ = global_assign(state, estimated, key, cfg)
+
+    def node_of(st, svc):
+        ps = np.asarray(st.pod_service); pn = np.asarray(st.pod_node)
+        return int(pn[np.flatnonzero(ps == svc)[0]])
+
+    # declared colocates the claimed-hot pair; estimation fixes it
+    assert node_of(st_declared, 0) == node_of(st_declared, 1)
+    assert node_of(st_estimated, 0) == node_of(st_estimated, 2)
+    lat_declared = gen.measure(st_declared, jax.random.PRNGKey(2)).latency_avg_ms
+    lat_estimated = gen.measure(st_estimated, jax.random.PRNGKey(2)).latency_avg_ms
+    assert lat_estimated < lat_declared

@@ -63,3 +63,29 @@ def test_replay_tracks_moving_objective():
         assert r.cost_after_solve <= r.cost_before_solve + 1e-5
     # at least one step adapts the placement as traffic shifts
     assert any(r.moves > 0 for r in records)
+
+
+def test_observed_step_streams_measured_traffic():
+    """Trace replay on OBSERVED weights: the canary's real traffic split
+    becomes a TraceStep without any hand-written weight schedule."""
+    import jax
+    from kubernetes_rescheduling_tpu.bench.loadgen import LoadGenConfig, LoadGenerator
+    from kubernetes_rescheduling_tpu.bench.trace import bookinfo_workmodel, observed_step
+    from kubernetes_rescheduling_tpu.core.topology import state_from_workmodel
+
+    wm = bookinfo_workmodel()
+    state = state_from_workmodel(wm, node_names=["n0", "n1"], seed=0)
+    gen = LoadGenerator(
+        wm,
+        LoadGenConfig(requests_per_phase=2048, chunk=512, entry_service="productpage"),
+        edge_probs={
+            ("productpage", "reviews-v1"): 0.1,
+            ("productpage", "reviews-v2"): 0.9,
+        },
+    )
+    samples = gen.run(state, jax.random.PRNGKey(0))
+    step = observed_step(1.0, gen, samples)
+    w = step.weights
+    key_v1 = tuple(sorted(("productpage", "reviews-v1")))
+    key_v2 = tuple(sorted(("productpage", "reviews-v2")))
+    assert w[key_v2] > 5 * w[key_v1]  # the canary shift is visible

@@ -113,3 +113,61 @@ class TestScenarios:
         sc = synthetic_scenario(n_pods=500, n_nodes=20, powerlaw=True, seed=3)
         deg = np.asarray(sc.graph.adj).sum(axis=0)
         assert deg.max() >= 4 * np.median(deg[deg > 0])
+
+
+class TestProcCost:
+    def test_parse_cpu_stress(self, tmp_path):
+        import json
+
+        data = {
+            "s0": {
+                "external_services": [{"services": ["s1"]}],
+                "internal_service": {"loader": {"cpu_stress": {
+                    "run": True, "range_complexity": [100, 100],
+                    "thread_pool_size": 1, "trials": 10,
+                }}},
+                "cpu-requests": "100m",
+            },
+            "s1": {
+                "internal_service": {"loader": {"cpu_stress": {
+                    "run": True, "range_complexity": [200, 400],
+                    "thread_pool_size": 2, "trials": 20,
+                }}},
+                "cpu-requests": "100m",
+            },
+            "s2": {
+                "internal_service": {"loader": {"cpu_stress": {"run": False}}},
+            },
+            "s3": {},  # no loader stanza at all
+        }
+        p = tmp_path / "wm.json"
+        p.write_text(json.dumps(data))
+        wm = Workmodel.from_file(p)
+        by = {s.name: s for s in wm.services}
+        assert by["s0"].proc_cost == 1.0          # the baseline loader
+        # mean(200,400)=300 x 20 trials / 2 threads = 3000 -> 3x baseline
+        assert by["s1"].proc_cost == 3.0
+        assert by["s2"].proc_cost == 0.05         # stress disabled: floor
+        assert by["s3"].proc_cost == 1.0          # absent: default
+
+    def test_builtin_is_uniform_baseline(self):
+        wm = mubench_workmodel_c()
+        assert all(s.proc_cost == 1.0 for s in wm.services)
+
+    def test_reference_workmodel_file_uniform(self, tmp_path):
+        """The reference's own workmodelC stanzas (100x10/1 everywhere)
+        must all normalize to 1.0 — file and builtin stay equivalent."""
+        import json
+
+        stanza = {
+            "external_services": [{"services": ["s1"]}],
+            "internal_service": {"loader": {"cpu_stress": {
+                "run": True, "range_complexity": [100, 100],
+                "thread_pool_size": 1, "trials": 10,
+            }}},
+            "cpu-requests": "100m",
+        }
+        p = tmp_path / "wm.json"
+        p.write_text(json.dumps({"s0": stanza, "s1": dict(stanza, external_services=[])}))
+        wm = Workmodel.from_file(p)
+        assert [s.proc_cost for s in wm.services] == [1.0, 1.0]

@@ -262,6 +262,72 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
     )
 
 
+def _top_gain_moves(
+    changed: list[tuple[int, int]], state, graph, solver_cfg, k: int
+) -> list[tuple[int, int]]:
+    """The ≤``k`` strictly-improving moves with the largest single-move
+    objective gain, using the SOLVER's own accounting (``solver_cfg`` is
+    the round's GlobalSolverConfig): comm + λ·std of CPU-% **of the
+    packing budget** (``capacity_frac``-scaled, exactly as the solver's
+    objective measures load) + the over-budget repulsion term when
+    capacity is enforced.
+
+    Comm gain of relocating service ``s`` to ``t`` with every other
+    service fixed: ``Σ_j W[s,j]·([node_j ≠ cur_s] − [node_j ≠ t])`` on the
+    replica-weighted pair matrix (row-wise host-side — only the changed
+    services' adjacency rows are touched). Moves whose individual gain is
+    ≤ 0 are dropped — they only pay off in combination, and applying them
+    alone is churn (the convergence criterion: a capped loop stops when
+    no single move helps)."""
+    S = graph.num_services
+    svc_arr = np.asarray(state.pod_service)
+    valid = np.asarray(state.pod_valid)
+    old_nodes = np.asarray(state.pod_node)
+    pod_cpu = np.asarray(state.pod_cpu)
+    svc_node = np.full(S, -1, dtype=np.int64)
+    svc_cpu = np.zeros(S)
+    for i in np.flatnonzero(valid):
+        s = int(svc_arr[i])
+        if 0 <= s < S:
+            if svc_node[s] < 0:
+                svc_node[s] = old_nodes[i]
+            svc_cpu[s] += float(pod_cpu[i])
+    replicas = np.bincount(svc_arr[valid & (svc_arr >= 0) & (svc_arr < S)], minlength=S)
+    adj = np.asarray(graph.adj)
+    placed = svc_node >= 0
+
+    node_valid = np.asarray(state.node_valid)
+    ow = solver_cfg.overload_weight if solver_cfg.enforce_capacity else 0.0
+    cap = np.where(
+        np.asarray(state.node_cpu_cap) > 0, np.asarray(state.node_cpu_cap), 1.0
+    ) * solver_cfg.capacity_frac
+    used = np.asarray(state.node_cpu_used())
+
+    def balance_terms(loads):
+        pct = np.where(node_valid, loads / cap * 100.0, 0.0)
+        n = max(int(node_valid.sum()), 1)
+        mean = pct.sum() / n
+        std = float(np.sqrt(np.sum(np.where(node_valid, (pct - mean) ** 2, 0.0)) / n))
+        over = float(np.sum(np.maximum(pct - 100.0, 0.0)))
+        return solver_cfg.balance_weight * std + ow * over
+
+    bal0 = balance_terms(used)
+    gains = []
+    for s, t in changed:
+        w = adj[s] * replicas[s] * replicas
+        cut_before = float(np.sum(w[placed & (svc_node != svc_node[s])]))
+        cut_after = float(np.sum(w[placed & (svc_node != t)]))
+        loads = used.copy()
+        if 0 <= svc_node[s] < len(loads):
+            loads[svc_node[s]] -= svc_cpu[s]
+        loads[t] += svc_cpu[s]
+        gains.append(cut_before - cut_after + bal0 - balance_terms(loads))
+    gains = np.asarray(gains)
+    # ties -> lower service index (stable sort on negated gains)
+    order = [i for i in np.argsort(-gains, kind="stable")[:k] if gains[i] > 1e-9]
+    return [changed[i] for i in sorted(order)]
+
+
 def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
     cfg = GlobalSolverConfig(
         sweeps=config.global_solver_iters,
@@ -286,18 +352,33 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
     new_nodes = np.asarray(new_state.pod_node)
     valid = np.asarray(state.pod_valid)
     svc_arr = np.asarray(state.pod_service)
-    moved_any = False
-    moved_names: list[str] = []
+    changed: list[tuple[int, int]] = []  # (service, target node)
     seen: set[int] = set()
     for i in np.flatnonzero(valid & (old_nodes != new_nodes)):
         s = int(svc_arr[i])
         if s in seen:
             continue
         seen.add(s)
+        changed.append((s, int(new_nodes[i])))
+
+    cap = config.global_moves_cap
+    if isinstance(cap, int):
+        # wave cap: apply only the k moves whose INDIVIDUAL relocation
+        # (others held at their old nodes) most improves the solver's
+        # objective (comm + balance), and only strictly-improving ones —
+        # the rest of the solve is re-derived next round, so the optimum
+        # is still pursued k Deployments at a time, and once no single
+        # move helps on its own the loop is converged instead of churning
+        # (the full solution may keep shifting under annealing noise)
+        changed = _top_gain_moves(changed, state, graph, cfg, cap)
+
+    moved_any = False
+    moved_names: list[str] = []
+    for s, target in changed:
         landed = backend.apply_move(
             MoveRequest(
                 service=graph.names[s],
-                target_node=new_state.node_names[int(new_nodes[i])],
+                target_node=new_state.node_names[target],
                 mechanism=PlacementMechanism["global"],
             )
         )

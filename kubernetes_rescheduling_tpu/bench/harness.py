@@ -84,6 +84,7 @@ class ExperimentConfig:
     solver_restarts: int = 1           # best-of-N global solves per round
     solver_tp: int = 1                 # node-axis devices per solve (SPMD solver)
     moves_per_round: int | str = 1     # k per greedy round, or "all"
+    global_moves_cap: int | str = "all"  # wave cap for global rounds
     # Packing budget for the global solver's feasibility (fraction of node
     # capacity, with enforcement). On dense meshes the comm objective
     # genuinely prefers total colocation at any moderate λ; the budget is
@@ -304,6 +305,7 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 solver_restarts=cfg.solver_restarts,
                 solver_tp=cfg.solver_tp,
                 moves_per_round=cfg.moves_per_round,
+                global_moves_cap=cfg.global_moves_cap,
                 enforce_capacity=cfg.enforce_capacity,
                 capacity_frac=cfg.capacity_frac,
                 seed=seed,

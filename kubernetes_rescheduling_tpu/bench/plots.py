@@ -28,7 +28,10 @@ def _plot_bar(ax, labels, values, title, accent_on=("communication", "global")):
     import matplotlib
 
     xs = np.arange(len(labels))
-    colors = [_ACCENT if l in accent_on else _BAR for l in labels]
+    colors = [
+        _ACCENT if any(l == a or l.startswith(f"{a} ") for a in accent_on) else _BAR
+        for l in labels
+    ]
     ax.bar(xs, values, width=0.62, color=colors, zorder=2)
     for x, v in zip(xs, values):
         ax.text(x, v, f"{v:.2f}", ha="center", va="bottom", fontsize=9, color=_INK)
@@ -38,6 +41,22 @@ def _plot_bar(ax, labels, values, title, accent_on=("communication", "global")):
     ax.spines[["top", "right"]].set_visible(False)
     ax.tick_params(colors=_INK)
     ax.margins(y=0.15)
+
+
+def merge_summaries(base: dict, extras: list[tuple[str, dict]]) -> dict:
+    """One summary whose runs include labeled configuration variants.
+
+    ``extras`` entries are ``(label, summary)``; their runs appear as
+    ``"<algorithm> <label>"`` bars — how the wave-capped global
+    configuration (``global_moves_cap=k``) shows up next to the uncapped
+    one in the disruption chart. ``base``'s per-run-derived ``aggregate``
+    is dropped rather than copied stale — the merged dict describes its
+    runs, nothing else."""
+    runs = list(base["runs"])
+    for label, s in extras:
+        for r in s["runs"]:
+            runs.append({**r, "algorithm": f"{r['algorithm']} {label}"})
+    return {k: v for k, v in base.items() if k != "aggregate"} | {"runs": runs}
 
 
 def plot_summary(summary: dict | str | Path, out_dir: str | Path) -> list[Path]:

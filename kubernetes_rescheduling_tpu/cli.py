@@ -86,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="named session: re-running with the same name "
                         "resumes a crashed matrix instead of restarting")
     b.add_argument("--moves-per-round", type=_moves_per_round, default=1)
+    b.add_argument("--global-moves-cap", type=_moves_per_round, default="all",
+                   help="wave cap for global rounds: apply only the k "
+                        "highest-gain moves per round ('all' = uncapped); "
+                        "spreads disruption across rounds at most of the "
+                        "comm-cost win")
     b.add_argument("--restarts", type=int, default=1,
                    help="best-of-N global solves per round (global algorithm)")
     b.add_argument("--tp", type=int, default=1,
@@ -189,6 +194,7 @@ def cmd_bench(args) -> dict:
         out_dir=args.out,
         session_name=args.session,
         moves_per_round=args.moves_per_round,
+        global_moves_cap=args.global_moves_cap,
         solver_restarts=args.restarts,
         solver_tp=args.tp,
         observe_weights=args.observe_weights,

@@ -43,6 +43,14 @@ class RescheduleConfig:
     # SURVEY §7 greedy→global bridge, routing the round through the batched
     # global solver regardless of algorithm.
     moves_per_round: int | str = 1
+    # Wave cap for GLOBAL rounds: the solver re-places every service, but
+    # only the k highest-comm-gain moves are applied per round ("all" =
+    # unlimited, the historical behavior). Each Deployment move restarts
+    # all its replicas (reference release1.sh:101-102 counts exactly this
+    # disruption), so an uncapped global round can fail a third of
+    # in-flight requests; capping spreads the wave across rounds while the
+    # per-round re-solve keeps pursuing the full optimum.
+    global_moves_cap: int | str = "all"
 
     # New capabilities
     backend: str = "sim"                   # "sim" | "k8s"
@@ -75,6 +83,11 @@ class RescheduleConfig:
         if not (mpr == "all" or (isinstance(mpr, int) and mpr >= 1)):
             raise ValueError(
                 f"moves_per_round must be a positive int or 'all', got {mpr!r}"
+            )
+        gmc = self.global_moves_cap
+        if not (gmc == "all" or (isinstance(gmc, int) and gmc >= 1)):
+            raise ValueError(
+                f"global_moves_cap must be a positive int or 'all', got {gmc!r}"
             )
         return self
 

@@ -374,3 +374,70 @@ def test_harness_observe_weights(tmp_path):
     # resumable: re-running the session reloads the counts without error
     summary2 = run_experiment(cfg)
     assert len(summary2["runs"]) == 1
+
+
+def test_global_moves_cap_limits_wave_and_converges():
+    """V5: global with a wave cap never recreates more than k Deployments
+    per round, and the per-round re-solve still drives comm cost toward
+    the uncapped solution."""
+    def run(cap):
+        backend = make_backend("mubench", seed=2)
+        backend.inject_imbalance("worker1")
+        cfg = RescheduleConfig(
+            algorithm="global",
+            max_rounds=6,
+            sleep_after_action_s=0.0,
+            balance_weight=0.5,
+            global_moves_cap=cap,
+            seed=2,
+        )
+        return run_controller(backend, cfg)
+
+    capped = run(2)
+    uncapped = run("all")
+    assert all(len(r.services_moved) <= 2 for r in capped.rounds)
+    assert any(len(r.services_moved) > 2 for r in uncapped.rounds)
+    # the capped run converges to (near) the uncapped final comm cost
+    assert capped.rounds[-1].communication_cost <= (
+        uncapped.rounds[-1].communication_cost + 2.0
+    )
+
+
+def test_top_gain_moves_ranks_by_comm_gain():
+    """The wave cap picks the moves that individually cut the most
+    replica-weighted communication cost."""
+    from kubernetes_rescheduling_tpu.bench.controller import _top_gain_moves
+    from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+
+    # a-b heavy edge split across nodes; c-d light edge split; moving a to
+    # b's node gains 5, moving c to d's node gains 1
+    graph = CommGraph.from_relation(
+        {"a": ["b"], "b": ["a"], "c": ["d"], "d": ["c"]},
+        names=["a", "b", "c", "d"],
+    )
+    import jax.numpy as jnp
+
+    graph = graph.replace(adj=graph.adj * jnp.asarray([
+        [0.0, 5.0, 0, 0], [5.0, 0, 0, 0], [0, 0, 0, 1.0], [0, 0, 1.0, 0],
+    ]))
+    state = ClusterState.build(
+        node_names=["n0", "n1"],
+        node_cpu_cap=[1000.0] * 2,
+        node_mem_cap=[2**30] * 2,
+        pod_services=[0, 1, 2, 3],
+        pod_nodes=[0, 1, 0, 1],
+        pod_cpu=[10.0] * 4,
+        pod_mem=[0.0] * 4,
+        pod_names=["a-0", "b-0", "c-0", "d-0"],
+    )
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
+
+    cfg = GlobalSolverConfig(balance_weight=0.0, enforce_capacity=False)
+    changed = [(0, 1), (2, 1)]  # move a -> n1 (gain 5), c -> n1 (gain 1)
+    top1 = _top_gain_moves(changed, state, graph, cfg, 1)
+    assert top1 == [(0, 1)]
+    # non-improving moves are dropped even under the cap: moving b ONTO
+    # a's node after a left would cut nothing extra (gain 0 from n1 -> n1
+    # is excluded by construction; use a genuinely zero-gain move)
+    zero = [(2, 0)]  # c joins a's old node: d stays remote, gain <= 0
+    assert _top_gain_moves(zero, state, graph, cfg, 5) == []

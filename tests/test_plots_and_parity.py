@@ -54,3 +54,23 @@ def test_rescheduling_improves_over_before(matrix_summary):
     before_rt = np.mean([r["before"]["response_time_ms"] for r in runs])
     car_rt = matrix_summary["aggregate"]["communication"]["response_time_ms"]
     assert car_rt <= before_rt
+
+
+def test_merge_summaries_labels_config_variants(matrix_summary, tmp_path):
+    """The wave-capped configuration appears as its own labeled bars in
+    every chart (V5: disruption chart must include the capped config)."""
+    from kubernetes_rescheduling_tpu.bench.plots import merge_summaries
+
+    capped = {
+        "runs": [
+            {**r, "seed": r["seed"] + 1000}
+            for r in matrix_summary["runs"]
+            if r["algorithm"] == "communication"
+        ]
+    }
+    merged = merge_summaries(matrix_summary, [("k=2", capped)])
+    labels = {r["algorithm"] for r in merged["runs"]}
+    assert "communication k=2" in labels
+    written = plot_summary(merged, tmp_path / "merged")
+    assert any(p.name == "disruption.png" for p in written)
+    assert all(p.is_file() for p in written)

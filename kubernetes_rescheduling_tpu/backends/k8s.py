@@ -329,6 +329,46 @@ class K8sBackend:
             pod_capacity=self.pod_capacity,
         )
 
+    def pod_restart_counts(self) -> dict[str, int] | None:
+        """Per-pod container ``restartCount`` sums over the namespace —
+        the raw data of the reference's experiment-health metric
+        (release1.sh:101-102: kubectl jsonpath over
+        ``status.containerStatuses[*].restartCount``). Per-pod, not a
+        cluster total, so the harness can compute a crash delta that
+        survives delete+recreate (a moved Deployment's fresh pods start at
+        0; a single cluster-wide total would go NEGATIVE and mask real
+        crashes). ``None`` when the listing fails."""
+        try:
+            lister = getattr(self.core_api, "list_namespaced_pod", None)
+            if lister is not None:
+                pods = lister(self.namespace, watch=False)
+                items = _get(pods, "items", default=[]) or []
+            else:
+                pods = self.core_api.list_pod_for_all_namespaces(watch=False)
+                items = [
+                    p
+                    for p in (_get(pods, "items", default=[]) or [])
+                    if _get(p, "metadata", "namespace") == self.namespace
+                ]
+        except Exception:
+            return None
+        out: dict[str, int] = {}
+        for p in items:
+            name = _get(p, "metadata", "name")
+            statuses = (
+                _get(p, "status", "container_statuses")
+                or _get(p, "status", "containerStatuses", default=[])
+                or []
+            )
+            total = 0
+            for cs in statuses:
+                count = _get(cs, "restart_count")
+                if count is None:
+                    count = _get(cs, "restartCount", default=0)
+                total += int(count or 0)
+            out[str(name)] = total
+        return out
+
     # ---- reconcile ----
 
     def _wait_deleted(self, name: str) -> bool:

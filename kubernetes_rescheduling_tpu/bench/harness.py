@@ -355,6 +355,11 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
 
             events = getattr(backend, "events", None)
             events_mark = len(events) if events is not None else 0
+            # live cluster: snapshot per-pod restartCount so the loop's
+            # container crashes can be MEASURED as a delta that survives
+            # delete+recreate (fresh pods start at 0)
+            crash_probe = getattr(backend, "pod_restart_counts", None)
+            crashes_at_start = crash_probe() if crash_probe else None
             t0 = time.perf_counter()
             result = run_controller(
                 backend,
@@ -366,20 +371,39 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 graph=solve_graph if cfg.observe_weights else None,
             )
             wall_s = time.perf_counter() - t0
+            # `restarts` = pods recreated by Deployment moves (the
+            # disruption the RESCHEDULER causes) — identical semantics on
+            # both backends: sim reads its event log, live derives from
+            # moved services' replica counts (each moved Deployment's
+            # replicas are all recreated, so this is exact, not estimated)
             if events is not None:
                 during.restarts = sum(
                     int(e.get("pods", 0))
                     for e in events[events_mark:]
                     if e.get("event") == "move"
                 )
+                restart_source = "event_log"
             else:
-                # live backend keeps no event log: moves × replicas is the
-                # same disruption count (a Deployment's replicas all restart)
-                replicas = {s.name: max(1, s.replicas) for s in backend.workmodel.services}
+                replicas = {
+                    s.name: max(1, s.replicas) for s in backend.workmodel.services
+                }
                 during.restarts = sum(
                     replicas.get(svc, 1)
                     for rec in result.rounds
                     for svc in rec.services_moved
+                )
+                restart_source = "derived_from_moves"
+            # `container_crashes` = the reference's restartCount metric
+            # (release1.sh:101-102) as a measured per-pod delta: pods in
+            # both snapshots contribute max(end-start, 0); pods created
+            # during the loop contribute their full count. (Crashes a pod
+            # accrued AFTER the start snapshot but before its own
+            # teardown are unobservable — restartCount dies with the pod.)
+            crashes_at_end = crash_probe() if crash_probe else None
+            if crashes_at_start is not None and crashes_at_end is not None:
+                during.container_crashes = sum(
+                    max(c - crashes_at_start.get(pod, 0), 0)
+                    for pod, c in crashes_at_end.items()
                 )
             load_during = during.stats()
 
@@ -405,6 +429,7 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                     "after": load_after.as_dict(),
                 },
                 "moves": result.moves,
+                "restart_source": restart_source,
                 "decisions_per_sec": result.decisions_per_sec,
                 "decision_latency": result.latency_summary(),
                 "resumed_from_round": result.resumed_from_round,

@@ -102,7 +102,14 @@ class RequestStats:
     latency_p50_ms: float
     latency_p95_ms: float
     latency_p99_ms: float
-    restarts: int = 0              # pods disrupted by moves (release1.sh:101-102)
+    # pods recreated by Deployment moves (every replica of a moved service
+    # restarts) — the disruption the RESCHEDULER causes. Same semantics on
+    # sim (event log) and live (replicas of moved services).
+    restarts: int = 0
+    # measured container-crash delta over the window (the reference's
+    # restartCount metric, release1.sh:101-102 — delete+recreate does NOT
+    # count here; crashes do). None = backend could not measure it.
+    container_crashes: int | None = None
 
     @property
     def errors(self) -> int:
@@ -128,6 +135,7 @@ class RequestStats:
             "latency_p95_ms": self.latency_p95_ms,
             "latency_p99_ms": self.latency_p99_ms,
             "restarts": self.restarts,
+            "container_crashes": self.container_crashes,
         }
 
 
@@ -308,6 +316,7 @@ class _Samples:
     err_overload: int = 0
     sim_s: float = 0.0
     restarts: int = 0
+    container_crashes: int | None = None
     # per-edge traversal totals (aligned with the generator's CallPlan edge
     # list) — the observed-traffic signal for weight estimation
     edge_counts: np.ndarray | None = None
@@ -346,6 +355,7 @@ class _Samples:
             latency_p95_ms=float(np.percentile(lat, 95)) if have else 0.0,
             latency_p99_ms=float(np.percentile(lat, 99)) if have else 0.0,
             restarts=self.restarts,
+            container_crashes=self.container_crashes,
         )
 
 

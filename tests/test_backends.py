@@ -151,6 +151,14 @@ class FakeCluster:
         }
 
     # CoreV1-ish
+    def list_namespaced_pod(self, namespace, watch=False):
+        items = [
+            p
+            for p in self.list_pod_for_all_namespaces()["items"]
+            if p["metadata"]["namespace"] == namespace
+        ]
+        return {"items": items}
+
     def list_node(self, watch=False):
         return {
             "items": [
@@ -174,6 +182,11 @@ class FakeCluster:
                         ],
                     },
                     "spec": {"nodeName": info["node"]},
+                    "status": {
+                        "containerStatuses": [
+                            {"restartCount": info.get("restarts", 0)}
+                        ]
+                    },
                 }
                 for pname, info in self.pods.items()
             ]
@@ -322,7 +335,12 @@ def test_harness_k8s_mode_runs_matrix(tmp_path):
     )
     run = summary["runs"][0]
     assert run["moves"] >= 1           # moves actually hit the (fake) cluster
+    # `restarts` = pods recreated by moves (same semantics as sim);
+    # `container_crashes` = the reference's restartCount metric, measured
+    # as a per-pod delta — 0 here because nothing actually crashed
+    assert run["restart_source"] == "derived_from_moves"
     assert run["load"]["during"]["restarts"] >= run["moves"]
+    assert run["load"]["during"]["container_crashes"] == 0
     assert run["load"]["after"]["sent"] > 0
     assert run["sim_clock_s"] is None  # live backend has no simulated clock
 
@@ -480,3 +498,79 @@ class TestRegressionFixes:
         assert int(most) == -1  # 30 < 30.9 — must not truncate to 30
         most2, _ = detect_hazard(state, threshold=30.0)
         assert int(most2) == 0
+
+
+def test_pod_restart_counts(fake_backend):
+    """V6 (reference release1.sh:101-102): per-pod restartCount sums, the
+    raw data of the crash-delta metric."""
+    backend, fc = fake_backend
+    counts = backend.pod_restart_counts()
+    assert counts is not None and all(v == 0 for v in counts.values())
+    pods = list(fc.pods)
+    fc.pods[pods[0]]["restarts"] = 2
+    fc.pods[pods[1]]["restarts"] = 3
+    counts = backend.pod_restart_counts()
+    assert counts[pods[0]] == 2 and counts[pods[1]] == 3
+    assert sum(counts.values()) == 5
+
+    class Failing:
+        def list_pod_for_all_namespaces(self, watch=False):
+            raise RuntimeError("api down")
+
+    backend.core_api = Failing()
+    assert backend.pod_restart_counts() is None  # harness skips the metric
+
+
+def test_harness_k8s_measures_crash_restart_delta(tmp_path):
+    """Container crashes during the loop show up in the measured per-pod
+    delta — the thing a moves-derived count could never see — and surviving
+    a concurrent delete+recreate (fresh pods at 0 must not cancel them)."""
+    from kubernetes_rescheduling_tpu.bench.harness import (
+        ExperimentConfig,
+        run_experiment,
+    )
+    from kubernetes_rescheduling_tpu.bench.loadgen import LoadGenConfig
+
+    wm = mubench_workmodel_c()
+
+    class CrashyFake(FakeCluster):
+        # worker1 hot so the loop moves things; every deployment delete
+        # coincides with one container crash on an unrelated pod
+        def __init__(self, wm):
+            super().__init__(wm)
+            self.pods["crashy-pod"] = {
+                "deployment": "untracked", "node": "worker2", "restarts": 0
+            }
+
+        def list_cluster_custom_object(self, group, version, plural):
+            usage = {"master": "1000m", "worker1": "4000m", "worker2": "1000m"}
+            return {
+                "items": [
+                    {"metadata": {"name": n}, "usage": {"cpu": usage[n], "memory": "4Gi"}}
+                    for n in self.nodes
+                ]
+            }
+
+        def delete_namespaced_deployment(self, name, namespace, body=None):
+            super().delete_namespaced_deployment(name, namespace, body=body)
+            self.pods["crashy-pod"]["restarts"] += 1
+
+    fc = CrashyFake(wm)
+    cfg = ExperimentConfig(
+        algorithms=("communication",),
+        repeats=1,
+        rounds=2,
+        backend="k8s",
+        inject_imbalance=False,
+        out_dir=str(tmp_path),
+        load=LoadGenConfig(requests_per_phase=256, chunk=256),
+        seed=2,
+    )
+    summary = run_experiment(
+        cfg, core_api=fc, apps_api=fc, custom_api=fc, sleeper=lambda s: None
+    )
+    run = summary["runs"][0]
+    assert run["moves"] >= 1
+    # exactly one injected crash per delete, and deletes == services moved
+    assert run["load"]["during"]["container_crashes"] == fc.deleted_gen
+    assert run["load"]["during"]["restarts"] >= run["moves"]

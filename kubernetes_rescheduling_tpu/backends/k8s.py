@@ -285,13 +285,10 @@ class K8sBackend:
         except Exception:
             pass
 
-        pods = self.core_api.list_pod_for_all_namespaces(watch=False)
         services, pod_nodes, pod_cpu, pod_mem, pod_names = [], [], [], [], []
         tracked_cpu = {n: 0.0 for n in node_names}
         tracked_mem = {n: 0.0 for n in node_names}
-        for p in _get(pods, "items", default=[]):
-            if _get(p, "metadata", "namespace") != self.namespace:
-                continue
+        for p in self._list_namespace_pods():
             dep = self._deployment_for_pod(p)
             if dep is None or dep not in self._svc_index:
                 continue
@@ -329,6 +326,22 @@ class K8sBackend:
             pod_capacity=self.pod_capacity,
         )
 
+    def _list_namespace_pods(self) -> list:
+        """This namespace's pods: server-side filtering when the client
+        offers ``list_namespaced_pod``, else the all-namespaces listing
+        filtered here — ONE shared convention for every pod-listing
+        caller (snapshot and restart probe alike)."""
+        lister = getattr(self.core_api, "list_namespaced_pod", None)
+        if lister is not None:
+            pods = lister(self.namespace, watch=False)
+            return _get(pods, "items", default=[]) or []
+        pods = self.core_api.list_pod_for_all_namespaces(watch=False)
+        return [
+            p
+            for p in (_get(pods, "items", default=[]) or [])
+            if _get(p, "metadata", "namespace") == self.namespace
+        ]
+
     def pod_restart_counts(self) -> dict[str, int] | None:
         """Per-pod container ``restartCount`` sums over the namespace —
         the raw data of the reference's experiment-health metric
@@ -339,17 +352,7 @@ class K8sBackend:
         0; a single cluster-wide total would go NEGATIVE and mask real
         crashes). ``None`` when the listing fails."""
         try:
-            lister = getattr(self.core_api, "list_namespaced_pod", None)
-            if lister is not None:
-                pods = lister(self.namespace, watch=False)
-                items = _get(pods, "items", default=[]) or []
-            else:
-                pods = self.core_api.list_pod_for_all_namespaces(watch=False)
-                items = [
-                    p
-                    for p in (_get(pods, "items", default=[]) or [])
-                    if _get(p, "metadata", "namespace") == self.namespace
-                ]
+            items = self._list_namespace_pods()
         except Exception:
             return None
         out: dict[str, int] = {}

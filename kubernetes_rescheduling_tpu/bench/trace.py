@@ -14,7 +14,9 @@ v1 → v2 → v3, the classic Istio demo traffic pattern.
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
+from pathlib import Path
 
 import jax
 import numpy as np
@@ -22,10 +24,7 @@ import numpy as np
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 from kubernetes_rescheduling_tpu.core.workmodel import ServiceSpec, Workmodel
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
-from kubernetes_rescheduling_tpu.solver.global_solver import (
-    GlobalSolverConfig,
-    global_assign,
-)
+from kubernetes_rescheduling_tpu.solver.global_solver import GlobalSolverConfig
 
 
 @dataclass(frozen=True)
@@ -96,6 +95,34 @@ def canary_trace(steps: int = 12) -> list[TraceStep]:
     return out
 
 
+def load_trace(path: str | Path) -> list[TraceStep]:
+    """Parse an EXTERNAL trace stream: JSONL, one step per line::
+
+        {"t": 1.0, "weights": [["productpage", "reviews-v2", 0.9], ...]}
+
+    ``weights`` entries are ``[service_a, service_b, weight]`` (symmetric
+    pairs — JSON objects cannot key on tuples). Missing ``t`` defaults to
+    the line index. This is how measured traffic from an external system
+    (a mesh telemetry export, a replayed incident) drives the online
+    resolver — BASELINE config 5 as a usable input, not a builtin demo."""
+    steps: list[TraceStep] = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        d = json.loads(line)
+        steps.append(
+            TraceStep(
+                t=float(d.get("t", len(steps))),
+                weights={
+                    (str(a), str(b)): float(w)
+                    for a, b, w in d.get("weights", [])
+                },
+            )
+        )
+    return steps
+
+
 def observed_step(t: float, loadgen, samples) -> TraceStep:
     """A :class:`TraceStep` whose weights are the load generator's OBSERVED
     per-pair traffic (``LoadGenerator.observed_weights``) — streaming
@@ -125,18 +152,43 @@ def replay(
     *,
     key: jax.Array,
     config: GlobalSolverConfig = GlobalSolverConfig(sweeps=4),
+    restarts: int = 1,
 ) -> tuple[ClusterState, list[ReplayRecord]]:
     """Online rescheduling over a streaming trace.
 
     Every step reuses the same compiled solver (weights are data, shapes are
     static), so per-step latency is one device round, not a recompile.
+    ``restarts > 1`` runs each step as a best-of-N solve over the device
+    mesh (``parallel.solve_with_restarts``).
     """
+    from kubernetes_rescheduling_tpu.parallel.sharded import solve_with_restarts
+
+    # a typo'd service name would otherwise replay as a silent no-op
+    # (with_weights skips unknown pairs): say so once, up front
+    known = set(graph.names)
+    unknown = sorted(
+        {n for step in trace for pair in step.weights for n in pair} - known
+    )
+    if unknown:
+        import warnings
+
+        warnings.warn(
+            f"trace weights reference services not in the workmodel "
+            f"(ignored): {unknown[:10]}{'…' if len(unknown) > 10 else ''}",
+            stacklevel=2,
+        )
+
     records: list[ReplayRecord] = []
     for step in trace:
         graph = with_weights(graph, step.weights)
         before = float(communication_cost(state, graph))
         key, sub = jax.random.split(key)
-        new_state, _ = global_assign(state, graph, sub, config)
+        # solve_with_restarts degrades to the plain single solve at
+        # n_restarts<=1 — one dispatch path, same key derivation as the
+        # controller's global rounds
+        new_state, _ = solve_with_restarts(
+            state, graph, sub, n_restarts=restarts, config=config
+        )
         after = float(communication_cost(new_state, graph))
         moves = int(
             np.sum(

@@ -69,6 +69,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deployments moved per round: a positive int "
                         "(1 = reference-faithful) or 'all' (global solve)")
     r.add_argument("--namespace", default="default")
+    r.add_argument("--balance-weight", type=float, default=0.0,
+                   help="λ: comm-cost edges traded per load-std point "
+                        "(global algorithm)")
+    r.add_argument("--capacity-frac", type=float, default=None,
+                   help="enable capacity enforcement with this packing "
+                        "budget (fraction of node capacity)")
+    r.add_argument("--restarts", type=int, default=1,
+                   help="best-of-N global solves per round over the mesh")
+    r.add_argument("--tp", type=int, default=1,
+                   help="node-axis devices per solve (SPMD sharded solver)")
+    r.add_argument("--global-moves-cap", type=_moves_per_round, default="all",
+                   help="apply only the k highest-gain improving moves per "
+                        "global round ('all' = uncapped)")
 
     b = sub.add_parser("bench", help="run the experiment matrix")
     b.add_argument("--backend", default="sim", choices=["sim", "k8s"],
@@ -110,13 +123,28 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser(
         "trace",
         help="streaming trace replay: online rescheduling as edge weights "
-             "shift (Bookinfo canary rollout demo)",
+             "shift (external workmodel + trace stream, or the builtin "
+             "Bookinfo canary rollout demo)",
     )
-    t.add_argument("--steps", type=int, default=12)
-    t.add_argument("--replicas", type=int, default=1)
+    t.add_argument("--workmodel", default=None,
+                   help="external µBench workmodel JSON to replay over "
+                        "(default: builtin Bookinfo)")
+    t.add_argument("--trace", default=None,
+                   help="external trace stream (JSONL, one step per line: "
+                        '{"t": 1.0, "weights": [["a", "b", 0.9], ...]}); '
+                        "default: the builtin canary schedule")
+    t.add_argument("--steps", type=int, default=12,
+                   help="builtin canary steps (ignored with --trace)")
+    t.add_argument("--replicas", type=int, default=1,
+                   help="replicas per service (builtin workmodel only)")
     t.add_argument("--nodes", type=int, default=3)
     t.add_argument("--sweeps", type=int, default=4)
     t.add_argument("--balance-weight", type=float, default=0.5)
+    t.add_argument("--capacity-frac", type=float, default=None,
+                   help="enable capacity enforcement with this packing "
+                        "budget (fraction of node capacity)")
+    t.add_argument("--restarts", type=int, default=1,
+                   help="best-of-N solves per trace step over the mesh")
     t.add_argument("--seed", type=int, default=0)
 
     s = sub.add_parser("solve", help="one-shot global solve")
@@ -169,6 +197,12 @@ def cmd_reschedule(args) -> dict:
         hazard_threshold_pct=args.threshold,
         sleep_after_action_s=0.0 if args.backend == "sim" else 15.0,
         moves_per_round=args.moves_per_round,
+        global_moves_cap=args.global_moves_cap,
+        balance_weight=args.balance_weight,
+        enforce_capacity=args.capacity_frac is not None,
+        capacity_frac=args.capacity_frac if args.capacity_frac is not None else 1.0,
+        solver_restarts=args.restarts,
+        solver_tp=args.tp,
         seed=args.seed,
     )
     result = run_controller(backend, cfg, key=jax.random.PRNGKey(args.seed))
@@ -211,12 +245,21 @@ def cmd_trace(args) -> dict:
     from kubernetes_rescheduling_tpu.bench.trace import (
         bookinfo_workmodel,
         canary_trace,
+        load_trace,
         replay,
     )
     from kubernetes_rescheduling_tpu.core.topology import state_from_workmodel
+    from kubernetes_rescheduling_tpu.core.workmodel import Workmodel
     from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
 
-    wm = bookinfo_workmodel(replicas=args.replicas)
+    wm = (
+        Workmodel.from_file(args.workmodel)
+        if args.workmodel
+        else bookinfo_workmodel(replicas=args.replicas)
+    )
+    steps = (
+        load_trace(args.trace) if args.trace else canary_trace(steps=args.steps)
+    )
     state = state_from_workmodel(
         wm,
         node_names=[f"worker{i}" for i in range(args.nodes)],
@@ -226,15 +269,23 @@ def cmd_trace(args) -> dict:
     _, records = replay(
         state,
         wm.comm_graph(),
-        canary_trace(steps=args.steps),
+        steps,
         key=jax.random.PRNGKey(args.seed),
         config=GlobalSolverConfig(
-            sweeps=args.sweeps, balance_weight=args.balance_weight
+            sweeps=args.sweeps,
+            balance_weight=args.balance_weight,
+            enforce_capacity=args.capacity_frac is not None,
+            capacity_frac=(
+                args.capacity_frac if args.capacity_frac is not None else 1.0
+            ),
         ),
+        restarts=args.restarts,
     )
     return {
         "workmodel": wm.source,
+        "trace": args.trace or f"builtin:canary[{args.steps}]",
         "balance_weight": args.balance_weight,
+        "restarts": args.restarts,
         "steps": [r.__dict__ for r in records],
         "total_moves": sum(r.moves for r in records),
         "final_cost": records[-1].cost_after_solve if records else None,

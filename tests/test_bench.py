@@ -441,3 +441,66 @@ def test_top_gain_moves_ranks_by_comm_gain():
     # is excluded by construction; use a genuinely zero-gain move)
     zero = [(2, 0)]  # c joins a's old node: d stays remote, gain <= 0
     assert _top_gain_moves(zero, state, graph, cfg, 5) == []
+
+
+def test_cli_reschedule_budgeted_global(capsys):
+    """V7: the live control-loop entry point can use the capacity budget,
+    best-of-N restarts, and the wave cap — no longer bench/solve-only."""
+    rc = cli_main(
+        [
+            "reschedule",
+            "--algorithm", "global",
+            "--backend", "sim",
+            "--rounds", "2",
+            "--imbalance",
+            "--balance-weight", "0.5",
+            "--capacity-frac", "0.5",
+            "--restarts", "2",
+            "--global-moves-cap", "3",
+            "--seed", "1",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert len(out["rounds"]) == 2
+    # the wave cap is honored by every round
+    assert all(len(r["services_moved"]) <= 3 for r in out["rounds"])
+
+
+def test_cli_trace_external_workmodel_and_trace(tmp_path, capsys):
+    """V7: replaying an EXTERNAL trace over an EXTERNAL workmodel from the
+    CLI (BASELINE config 5 as a usable input, not a closed demo)."""
+    wm = {
+        "a": {"external_services": [{"services": ["b", "c"]}],
+              "cpu-requests": "100m"},
+        "b": {"cpu-requests": "100m"},
+        "c": {"cpu-requests": "100m"},
+    }
+    (tmp_path / "wm.json").write_text(json.dumps(wm))
+    trace_lines = [
+        {"t": 0.0, "weights": [["a", "b", 1.0], ["a", "c", 0.0]]},
+        {"t": 1.0, "weights": [["a", "b", 0.0], ["a", "c", 1.0]]},
+    ]
+    (tmp_path / "trace.jsonl").write_text(
+        "\n".join(json.dumps(s) for s in trace_lines)
+    )
+    rc = cli_main(
+        [
+            "trace",
+            "--workmodel", str(tmp_path / "wm.json"),
+            "--trace", str(tmp_path / "trace.jsonl"),
+            "--nodes", "2",
+            "--sweeps", "3",
+            "--restarts", "2",
+            "--seed", "0",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["workmodel"].endswith("wm.json")
+    assert out["trace"].endswith("trace.jsonl")
+    assert len(out["steps"]) == 2
+    # the online solver tracks the moving objective: after each step the
+    # solved cost is <= the cost the new weights found it at
+    for s in out["steps"]:
+        assert s["cost_after_solve"] <= s["cost_before_solve"] + 1e-6

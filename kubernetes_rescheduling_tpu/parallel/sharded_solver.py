@@ -41,6 +41,7 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     _pad_to,
     _service_aggregates,
     auto_chunk,
+    check_weight_budget,
     sweep_composition,
 )
 
@@ -351,6 +352,7 @@ def _check_and_dims(state, graph, config, mesh):
     if N % tp:
         raise ValueError(f"num_nodes {N} must be a multiple of tp={tp}")
     _, _, SP, _ = _dims(config, S, N, tp)
+    check_weight_budget(SP, config)  # W is REPLICATED under tp
     return tp, S, N, SP
 
 

@@ -114,6 +114,16 @@ class GlobalSolverConfig:
     # off elsewhere (parity-tested in interpret mode; annealing noise uses
     # the TPU core PRNG, a different stream than jax.random).
     fused_epilogue: str = struct.field(pytree_node=False, default="auto")
+    # The dense S×S pair-weight matrix is this solver's scale wall: W (f32)
+    # plus its matmul copy (matmul_dtype) live per device and are
+    # REPLICATED even under tp node-sharding (tp shards nodes, not
+    # services). 12 GiB ≈ the comfortable budget on a 16 GB v5e chip:
+    # 10k services ≈ 0.59 GiB, 20k ≈ 2.3 GiB, ~46k hits the budget. Past
+    # it the solver raises a clear sizing error instead of OOM-crashing
+    # mid-compile; raise the budget on larger-HBM parts.
+    max_weight_bytes: int = struct.field(
+        pytree_node=False, default=12 * 1024**3
+    )
 
 
 def _service_aggregates(state: ClusterState, num_services: int):
@@ -177,6 +187,24 @@ def sweep_composition(perm_key: jax.Array, SP: int, C: int, n_chunks: int):
     return ids.reshape(n_chunks, C), bp.reshape(n_chunks, C // B)
 
 
+def check_weight_budget(SP: int, config: "GlobalSolverConfig") -> None:
+    """Fail with a SIZING error — not a mid-compile OOM — when the dense
+    pair-weight matrix exceeds ``config.max_weight_bytes``. Shared by the
+    single-chip and node-sharded solvers (W is replicated under tp)."""
+    mm_bytes = jnp.dtype(config.matmul_dtype).itemsize
+    need = SP * SP * (4 + mm_bytes)
+    if need > config.max_weight_bytes:
+        raise ValueError(
+            f"dense pair-weight matrix needs {need / 2**30:.2f} GiB "
+            f"({SP} padded services, f32 + {config.matmul_dtype}) — over "
+            f"max_weight_bytes={config.max_weight_bytes / 2**30:.2f} GiB. "
+            "The dense W formulation is the documented scale wall (README "
+            "scaling notes); tp node-sharding does NOT shard W. Raise "
+            "max_weight_bytes on larger-HBM devices or reduce the service "
+            "count."
+        )
+
+
 def auto_chunk(S: int, chunk_size: int = 0) -> int:
     """Resolve the chunk size: explicit, or ~S/10 in [1, 1024] (see
     GlobalSolverConfig.chunk_size). Auto sizes >= 256 round UP to a
@@ -218,6 +246,7 @@ def global_assign(
     C = min(auto_chunk(S, config.chunk_size), S)
     n_chunks = -(-S // C)
     SP = n_chunks * C  # padded service count
+    check_weight_budget(SP, config)
 
     replicas, svc_cpu, svc_mem, cur_node, has_pods = _service_aggregates(state, S)
     svc_valid = graph.service_valid & has_pods

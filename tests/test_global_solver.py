@@ -160,3 +160,32 @@ def test_no_improvement_keeps_split_replicas_untouched():
         np.testing.assert_array_equal(
             np.asarray(new_state.pod_node), np.asarray(scn.state.pod_node)
         )
+
+
+def test_weight_budget_raises_clear_sizing_error():
+    """V9: past the dense-W budget the solver raises a sizing error naming
+    the knob — never a mid-compile OOM."""
+    import jax
+    import pytest
+
+    from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+    from kubernetes_rescheduling_tpu.parallel import make_mesh, sharded_global_assign
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+
+    scn = synthetic_scenario(n_pods=64, n_nodes=8, seed=0, mean_degree=4.0)
+    tiny = GlobalSolverConfig(max_weight_bytes=1024)  # ~anything trips it
+    with pytest.raises(ValueError, match="max_weight_bytes"):
+        global_assign(scn.state, scn.graph, jax.random.PRNGKey(0), tiny)
+    # W is replicated under tp — the sharded solver must refuse identically
+    with pytest.raises(ValueError, match="max_weight_bytes"):
+        sharded_global_assign(
+            scn.state, scn.graph, jax.random.PRNGKey(0),
+            make_mesh(8, shape=(2, 4)), tiny,
+        )
+    # the default budget admits the north-star scale (10240 padded: 0.59 GiB)
+    from kubernetes_rescheduling_tpu.solver.global_solver import check_weight_budget
+
+    check_weight_budget(10240, GlobalSolverConfig())
+    check_weight_budget(20480, GlobalSolverConfig())
+    with pytest.raises(ValueError):
+        check_weight_budget(50_000, GlobalSolverConfig())

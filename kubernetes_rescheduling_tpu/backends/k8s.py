@@ -115,6 +115,35 @@ def merge_affinity(orig: dict | None, patch: dict) -> dict:
     return merge(copy.deepcopy(orig) if orig else {}, copy.deepcopy(patch))
 
 
+def _strip_placement(tmpl_spec: dict) -> None:
+    """Remove placement state a PREVIOUS move wrote into the pod template:
+    the hostname nodeSelector and any hostname-keyed matchExpressions in
+    the required nodeAffinity (the hazard NotIn rules). User-authored
+    affinity on other keys is left untouched."""
+    tmpl_spec["nodeSelector"] = None
+    affinity = tmpl_spec.get("affinity")
+    node_aff = (affinity or {}).get("nodeAffinity") or {}
+    req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    terms = req.get("nodeSelectorTerms") or []
+    new_terms = []
+    for term in terms:
+        exprs = [
+            e
+            for e in (term.get("matchExpressions") or [])
+            if e.get("key") != "kubernetes.io/hostname"
+        ]
+        if exprs or term.get("matchFields"):
+            new_terms.append({**term, "matchExpressions": exprs})
+    if terms and not new_terms:
+        node_aff.pop("requiredDuringSchedulingIgnoredDuringExecution", None)
+    elif new_terms:
+        req["nodeSelectorTerms"] = new_terms
+    if affinity and not node_aff:
+        affinity.pop("nodeAffinity", None)
+    if affinity is not None and not affinity:
+        tmpl_spec["affinity"] = None
+
+
 _KEPT_CONTAINER_KEYS = (
     "name",
     "image",
@@ -239,11 +268,7 @@ class K8sBackend:
     def monitor(self) -> ClusterState:
         """Build the padded snapshot (reference podmonitor.py:7-125)."""
         nodes = self.core_api.list_node(watch=False)
-        node_names = [
-            _get(n, "metadata", "name")
-            for n in _get(nodes, "items", default=[])
-            if _get(n, "metadata", "name") not in self.control_plane_names
-        ]
+        node_names = self._worker_names(nodes)
         cap_cpu: dict[str, float] = {}
         cap_mem: dict[str, float] = {}
         for n in _get(nodes, "items", default=[]):
@@ -325,6 +350,64 @@ class K8sBackend:
             node_capacity=self.node_capacity,
             pod_capacity=self.pod_capacity,
         )
+
+    def _worker_names(self, nodes) -> list[str]:
+        """Control-plane filter shared by monitor() and node_names."""
+        return [
+            _get(n, "metadata", "name")
+            for n in _get(nodes, "items", default=[]) or []
+            if _get(n, "metadata", "name") not in self.control_plane_names
+        ]
+
+    @property
+    def node_names(self) -> list[str]:
+        """Worker node names (control plane excluded), freshly listed."""
+        return self._worker_names(self.core_api.list_node(watch=False))
+
+    def cordon(self, node: str) -> bool:
+        """``kubectl cordon``: mark the node unschedulable (reference
+        auto_full_pipeline_repeat.sh:48-50 cordons worker2/worker3 before
+        deploying so everything lands on worker1)."""
+        return self._set_unschedulable(node, True)
+
+    def uncordon(self, node: str) -> bool:
+        return self._set_unschedulable(node, False)
+
+    def _set_unschedulable(self, node: str, value: bool) -> bool:
+        try:
+            self.core_api.patch_node(node, {"spec": {"unschedulable": value}})
+            return True
+        except Exception as e:
+            logger.warning("cordon(%s, %s) failed: %s", node, value, e)
+            return False
+
+    def inject_imbalance(self, node: str) -> None:
+        """The reference pipeline's "Before" construction on a live
+        cluster: cordon every OTHER worker, re-create each tracked
+        Deployment unpinned (the scheduler can only choose ``node``), then
+        uncordon (reference auto_full_pipeline_repeat.sh:48-58 — cordon,
+        redeploy µBench, continue). Same call shape as the simulator's
+        ``inject_imbalance``, so the harness drives both backends
+        identically."""
+        workers = self.node_names
+        if node not in workers:
+            # matching the simulator's behavior: a typo'd target must fail
+            # loudly, not cordon EVERY worker and strand the pods Pending
+            raise ValueError(f"unknown node {node!r}; workers: {workers}")
+        others = [n for n in workers if n != node]
+        cordoned = [n for n in others if self.cordon(n)]
+        try:
+            for svc in self.workmodel.names:
+                # affinityOnly with no hazard list = plain delete+recreate
+                # with the scheduler choosing; only `node` is schedulable
+                self.apply_move(
+                    MoveRequest(
+                        service=svc, target_node=node, mechanism="affinityOnly"
+                    )
+                )
+        finally:
+            for n in cordoned:
+                self.uncordon(n)
 
     def _list_namespace_pods(self) -> list:
         """This namespace's pods: server-side filtering when the client
@@ -457,6 +540,12 @@ class K8sBackend:
         body = extract_redeployable_spec(dep)
 
         tmpl_spec = body["spec"]["template"]["spec"]
+        # each move expresses the CURRENT decision only: leftover pins from
+        # a previous move's mechanism (a nodeSelector, or a stale
+        # hostname-NotIn hazard rule) would otherwise survive re-creation
+        # and silently override this round's placement — e.g. an
+        # affinityOnly re-create staying pinned to a cordoned node
+        _strip_placement(tmpl_spec)
         if move.hazard_nodes:
             tmpl_spec["affinity"] = merge_affinity(
                 tmpl_spec.get("affinity"), exclude_hazard_affinity(list(move.hazard_nodes))

@@ -121,6 +121,7 @@ class FakeCluster:
         self.deployments = {}
         self.pods = {}
         self.deleted_gen = 0
+        self.cordoned = set()
         for i, name in enumerate(wm.names):
             node = self.nodes[1 + i % (len(self.nodes) - 1)]
             self.deployments[name] = self._dep_body(name)
@@ -210,6 +211,12 @@ class FakeCluster:
             del self.pods[pname]
         self.deleted_gen += 1
 
+    def patch_node(self, name, body):
+        if body.get("spec", {}).get("unschedulable"):
+            self.cordoned.add(name)
+        else:
+            self.cordoned.discard(name)
+
     def create_namespaced_deployment(self, namespace, body):
         name = body["metadata"]["name"]
         self.deployments[name] = body
@@ -217,6 +224,12 @@ class FakeCluster:
         node = spec.get("nodeName") or (spec.get("nodeSelector") or {}).get(
             "kubernetes.io/hostname"
         )
+        if node is None:
+            # unpinned: the fake "scheduler" places on the first
+            # schedulable (non-cordoned) worker
+            node = next(
+                (n for n in self.nodes[1:] if n not in self.cordoned), None
+            )
         self.pods[f"{name}-pod"] = {"deployment": name, "node": node}
 
     # CustomObjects-ish
@@ -574,3 +587,83 @@ def test_harness_k8s_measures_crash_restart_delta(tmp_path):
     # exactly one injected crash per delete, and deletes == services moved
     assert run["load"]["during"]["container_crashes"] == fc.deleted_gen
     assert run["load"]["during"]["restarts"] >= run["moves"]
+
+
+def test_k8s_inject_imbalance_cordons_and_piles_up(fake_backend):
+    """Live-cluster 'Before' construction (reference
+    auto_full_pipeline_repeat.sh:48-58): cordon every other worker,
+    recreate every Deployment unpinned so the scheduler can only choose
+    the target, then uncordon."""
+    backend, fc = fake_backend
+    assert set(backend.node_names) == {"worker1", "worker2"}
+    # target worker2 — NOT the fake scheduler's first pick, so the pile-up
+    # can only happen if worker1 was actually cordoned during injection
+    backend.inject_imbalance("worker2")
+    nodes = {info["node"] for info in fc.pods.values()}
+    assert nodes == {"worker2"}           # the pile-up
+    assert fc.cordoned == set()           # uncordoned afterwards
+    # and the snapshot sees it: every valid pod on worker2
+    state = backend.monitor()
+    pn = np.asarray(state.pod_node)[np.asarray(state.pod_valid)]
+    w2 = state.node_names.index("worker2")
+    assert (pn == w2).all()
+    # a typo'd target fails loudly instead of cordoning every worker
+    with pytest.raises(ValueError, match="unknown node"):
+        backend.inject_imbalance("worker-2")
+
+
+def test_apply_move_strips_previous_pins(fake_backend):
+    """A move expresses the CURRENT decision only: a nodeSelector pin and a
+    hazard NotIn rule written by one move must not survive into the next
+    re-creation (they would override the scheduler on affinityOnly)."""
+    backend, fc = fake_backend
+    assert backend.apply_move(
+        MoveRequest(
+            service="s0",
+            target_node="worker2",
+            hazard_nodes=("worker1",),
+            mechanism="nodeSelector",
+        )
+    )
+    spec = fc.deployments["s0"]["spec"]["template"]["spec"]
+    assert spec["nodeSelector"] == {"kubernetes.io/hostname": "worker2"}
+    assert "worker1" in str(spec["affinity"])
+    # now an unpinned re-create: old selector AND old hostname rule gone
+    assert backend.apply_move(
+        MoveRequest(service="s0", target_node="worker1", mechanism="affinityOnly")
+    )
+    spec = fc.deployments["s0"]["spec"]["template"]["spec"]
+    assert spec.get("nodeSelector") is None
+    assert "worker1" not in str(spec.get("affinity") or {})
+    # the fake scheduler chose freely (first schedulable worker)
+    assert fc.pods["s0-pod"]["node"] == "worker1"
+
+
+def test_harness_k8s_inject_imbalance(tmp_path):
+    """The matrix's cordon-style Before state now works in k8s mode too —
+    the same inject_imbalance call shape as the simulator."""
+    from kubernetes_rescheduling_tpu.bench.harness import (
+        ExperimentConfig,
+        run_experiment,
+    )
+    from kubernetes_rescheduling_tpu.bench.loadgen import LoadGenConfig
+
+    wm = mubench_workmodel_c()
+    fc = FakeCluster(wm)
+    cfg = ExperimentConfig(
+        algorithms=("communication",),
+        repeats=1,
+        rounds=1,
+        backend="k8s",
+        inject_imbalance=True,
+        out_dir=str(tmp_path),
+        load=LoadGenConfig(requests_per_phase=256, chunk=256),
+        seed=3,
+    )
+    summary = run_experiment(
+        cfg, core_api=fc, apps_api=fc, custom_api=fc, sleeper=lambda s: None
+    )
+    run = summary["runs"][0]
+    # the Before snapshot measured the pile-up the injection created
+    assert run["before"]["load_std"] > 0
+    assert run["load"]["before"]["sent"] > 0

@@ -161,6 +161,20 @@ def make_backend(
                 entry_rps=10.0, cost_per_req_m=0.1, idle_m=50.0, fanout_frac=0.5
             ),
         )
+    if scenario == "xlarge":
+        # 2× the north star on both axes: validates the documented dense-W
+        # scaling numbers (2.3 GiB at 20k services) on real hardware and
+        # gives a second perf point past the headline scale
+        wm = wm_override or _random_workmodel(20_000, rng, powerlaw=True, mean_degree=4.0)
+        return SimBackend(
+            workmodel=wm,
+            node_names=[f"worker{i:04d}" for i in range(2000)],
+            node_cpu_cap_m=2_000.0,
+            seed=seed,
+            load=LoadModel(
+                entry_rps=10.0, cost_per_req_m=0.05, idle_m=50.0, fanout_frac=0.5
+            ),
+        )
     raise ValueError(f"unknown scenario {scenario!r}")
 
 

@@ -58,7 +58,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="spread|binpack|random|kubescheduling|communication|car|global")
     r.add_argument("--backend", default="sim", choices=["sim", "k8s"])
     r.add_argument("--scenario", default="mubench",
-                   choices=["mubench", "dense", "powerlaw", "large"])
+                   choices=["mubench", "dense", "powerlaw", "large", "xlarge"])
     r.add_argument("--workmodel", default=None, help=workmodel_help)
     r.add_argument("--rounds", type=int, default=10)
     r.add_argument("--threshold", type=float, default=30.0)
@@ -89,7 +89,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "the reference's auto_full_pipeline_repeat.sh")
     b.add_argument("--namespace", default="default")
     b.add_argument("--scenario", default="mubench",
-                   choices=["mubench", "dense", "powerlaw", "large"])
+                   choices=["mubench", "dense", "powerlaw", "large", "xlarge"])
     b.add_argument("--workmodel", default=None, help=workmodel_help)
     b.add_argument("--algorithms", default="spread,binpack,random,kubescheduling,communication,global")
     b.add_argument("--repeats", type=int, default=5)
@@ -149,7 +149,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("solve", help="one-shot global solve")
     s.add_argument("--scenario", default="mubench",
-                   choices=["mubench", "dense", "powerlaw", "large"])
+                   choices=["mubench", "dense", "powerlaw", "large", "xlarge"])
     s.add_argument("--workmodel", default=None, help=workmodel_help)
     s.add_argument("--sweeps", type=int, default=9)
     s.add_argument("--balance-weight", type=float, default=0.0)

@@ -117,10 +117,13 @@ def merge_affinity(orig: dict | None, patch: dict) -> dict:
 
 def _strip_placement(tmpl_spec: dict) -> None:
     """Remove placement state a PREVIOUS move wrote into the pod template:
-    the hostname nodeSelector and any hostname-keyed matchExpressions in
-    the required nodeAffinity (the hazard NotIn rules). User-authored
-    affinity on other keys is left untouched."""
-    tmpl_spec["nodeSelector"] = None
+    the hostname nodeSelector key and any hostname-keyed matchExpressions
+    in the required nodeAffinity (the hazard NotIn rules). User-authored
+    constraints on other keys (e.g. ``disktype: ssd``) are left
+    untouched."""
+    selector = dict(tmpl_spec.get("nodeSelector") or {})
+    selector.pop("kubernetes.io/hostname", None)
+    tmpl_spec["nodeSelector"] = selector or None
     affinity = tmpl_spec.get("affinity")
     node_aff = (affinity or {}).get("nodeAffinity") or {}
     req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
@@ -507,13 +510,16 @@ class K8sBackend:
                 dep = self.apps_api.read_namespaced_deployment(
                     name=name, namespace=self.namespace
                 )
-                want = _get(dep, "spec", "replicas", default=1) or 1
+                want = _get(dep, "spec", "replicas")
+                want = 1 if want is None else int(want)
+                if want <= 0:
+                    return True  # scaled to zero: nothing to wait for
                 ready = (
                     _get(dep, "status", "ready_replicas")
                     or _get(dep, "status", "readyReplicas")
                     or 0
                 )
-                if int(ready) >= int(want):
+                if int(ready) >= want:
                     return True
             except Exception as e:
                 logger.warning("wait_ready(%s): error while polling: %s", name, e)

@@ -29,7 +29,10 @@ from kubernetes_rescheduling_tpu.utils.checkpoint import CheckpointManager
 from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
 from kubernetes_rescheduling_tpu.utils.profiling import LatencyHistogram
 from kubernetes_rescheduling_tpu.parallel.sharded import solve_with_restarts
-from kubernetes_rescheduling_tpu.solver.global_solver import GlobalSolverConfig
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    pct_balance_terms,
+)
 from kubernetes_rescheduling_tpu.solver.round_loop import decide
 
 
@@ -304,12 +307,12 @@ def _top_gain_moves(
     used = np.asarray(state.node_cpu_used())
 
     def balance_terms(loads):
-        pct = np.where(node_valid, loads / cap * 100.0, 0.0)
-        n = max(int(node_valid.sum()), 1)
-        mean = pct.sum() / n
-        std = float(np.sqrt(np.sum(np.where(node_valid, (pct - mean) ** 2, 0.0)) / n))
-        over = float(np.sum(np.maximum(pct - 100.0, 0.0)))
-        return solver_cfg.balance_weight * std + ow * over
+        # the solver's OWN expression, evaluated host-side (xp=np)
+        return float(
+            pct_balance_terms(
+                loads, cap, node_valid, solver_cfg.balance_weight, ow, xp=np
+            )
+        )
 
     bal0 = balance_terms(used)
     gains = []

@@ -561,7 +561,9 @@ class LoadGenerator:
         updates = self.observed_weights(edge_counts, sent)
         adj = np.asarray(base.adj)
         names = list(base.names)
-        for i, j in np.argwhere(np.triu(adj, k=1) > 0):
+        for i, j in np.argwhere(adj > 0):  # no S×S triangle copy
+            if i >= j:
+                continue
             pair = tuple(sorted((names[int(i)], names[int(j)])))
             updates.setdefault(pair, 0.0)
         return with_weights(base, updates)

@@ -187,6 +187,28 @@ def sweep_composition(perm_key: jax.Array, SP: int, C: int, n_chunks: int):
     return ids.reshape(n_chunks, C), bp.reshape(n_chunks, C // B)
 
 
+def pct_balance_terms(
+    loads, cap, node_valid, balance_weight, overload_weight, xp=jnp
+):
+    """The objective's balance + over-budget terms — ONE definition.
+
+    ``cap`` must already be ``capacity_frac``-scaled (the packing budget):
+    ``balance_weight·std(pct-of-budget) + overload_weight·Σ relu(pct−100)``.
+    ``xp`` selects the array namespace: the solver traces it with jnp; the
+    controller's wave-cap ranking evaluates the SAME expression host-side
+    with numpy (per-candidate device dispatches through the tunnel would
+    cost more than the solve) — so a future objective edit cannot
+    desynchronize the cap's gain ranking from what the solver optimizes.
+    The node-sharded solver's psum'd form in parallel/sharded_solver.py
+    mirrors this distributively (parity-tested)."""
+    pct = xp.where(node_valid, loads / cap * 100.0, 0.0)
+    n = xp.maximum(xp.sum(node_valid), 1)
+    mean = xp.sum(pct) / n
+    var = xp.sum(xp.where(node_valid, (pct - mean) ** 2, 0.0)) / n
+    over = xp.sum(xp.maximum(pct - 100.0, 0.0))
+    return balance_weight * xp.sqrt(var) + overload_weight * over
+
+
 def check_weight_budget(SP: int, config: "GlobalSolverConfig") -> None:
     """Fail with a SIZING error — not a mid-compile OOM — when the dense
     pair-weight matrix exceeds ``config.max_weight_bytes``. Shared by the
@@ -286,12 +308,9 @@ def global_assign(
         return base_cpu + svc_cpu @ oh, base_mem + svc_mem @ oh
 
     def _balance_terms(cpu_load):
-        pct = jnp.where(state.node_valid, cpu_load / cap * 100.0, 0.0)
-        nvalid = jnp.maximum(jnp.sum(state.node_valid), 1)
-        mean = jnp.sum(pct) / nvalid
-        var = jnp.sum(jnp.where(state.node_valid, (pct - mean) ** 2, 0.0)) / nvalid
-        overload = jnp.sum(jnp.maximum(pct - 100.0, 0.0))
-        return config.balance_weight * jnp.sqrt(var) + ow * overload
+        return pct_balance_terms(
+            cpu_load, cap, state.node_valid, config.balance_weight, ow
+        )
 
     def objective(assign):
         """EXACT objective (f32 comm, fresh loads) — the adopt gate and

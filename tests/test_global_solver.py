@@ -189,3 +189,23 @@ def test_weight_budget_raises_clear_sizing_error():
     check_weight_budget(20480, GlobalSolverConfig())
     with pytest.raises(ValueError):
         check_weight_budget(50_000, GlobalSolverConfig())
+
+
+def test_pct_balance_terms_np_jnp_agree():
+    """One balance/overload definition serves the traced solver (jnp) and
+    the wave-cap's host-side ranking (np) — they must agree numerically."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubernetes_rescheduling_tpu.solver.global_solver import pct_balance_terms
+
+    rng = np.random.default_rng(0)
+    loads = rng.random(16).astype(np.float32) * 200
+    cap = np.full(16, 150.0, np.float32)
+    valid = rng.random(16) < 0.9
+    a = float(pct_balance_terms(loads, cap, valid, 0.5, 10.0, xp=np))
+    b = float(pct_balance_terms(
+        jnp.asarray(loads), jnp.asarray(cap), jnp.asarray(valid), 0.5, 10.0
+    ))
+    assert a == pytest.approx(b, rel=1e-6)
+    assert a > 0

@@ -13,7 +13,7 @@ is baseline/value, so >1 means faster than target.
 
 Environment knobs:
   BENCH_SCENARIO  large (default) | powerlaw | dense | mubench
-  BENCH_SWEEPS    solver sweeps per round (default 8)
+  BENCH_SWEEPS    solver sweeps per round (default 9)
   BENCH_REPS      timed repetitions (default 5)
   BENCH_RESTARTS  best-of-N solves over the device mesh (default 1)
   BENCH_TRACE_DIR write a jax.profiler trace of the timed loop here

@@ -9,7 +9,8 @@ Subcommands:
   reschedule  run the control loop once (reference ``python3 main.py <algo>``)
   bench       run the experiment matrix (reference auto_full_pipeline_repeat.sh)
   solve       one-shot global solve on a scenario, printing objectives
-  trace       streaming trace replay (Bookinfo canary; BASELINE config 5)
+  trace       streaming trace replay (external workmodel/trace streams
+              or the builtin Bookinfo canary; BASELINE config 5)
 """
 
 from __future__ import annotations

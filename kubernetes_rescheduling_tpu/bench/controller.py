@@ -111,7 +111,10 @@ def run_controller(
     ``graph`` overrides the backend's declared comm graph for the DECISION
     kernels — the harness passes traffic-estimated weights here
     (``LoadGenerator.observed_graph``) so the solver optimizes what the
-    request stream actually does, not what the workmodel claims.
+    request stream actually does, not what the workmodel claims. A
+    zero-arg CALLABLE is re-evaluated at every round, so an estimator fed
+    by the sustained load keeps the decision graph tracking the traffic
+    as it drifts (shapes are static — no retrace).
 
     ``on_round(record, state)`` — if given — is called after each round with
     the completed record and the post-move snapshot; the harness uses it to
@@ -133,7 +136,12 @@ def run_controller(
     # the backend's declared graph so round costs stay comparable across
     # configurations (and with the harness's before/after metrics)
     metric_graph = backend.comm_graph()
-    graph = graph if graph is not None else metric_graph
+    if graph is None:
+        graph_src = lambda: metric_graph  # noqa: E731
+    elif callable(graph):
+        graph_src = graph
+    else:
+        graph_src = lambda: graph  # noqa: E731
     result = ControllerResult()
 
     mgr = CheckpointManager(checkpoint_dir) if checkpoint_dir else None
@@ -156,6 +164,7 @@ def run_controller(
     state = backend.monitor()
     for rnd in range(start_round, config.max_rounds + 1):
         sub = jax.random.fold_in(key, rnd)
+        graph = graph_src()  # fresh estimate per round when streaming
 
         if config.algorithm == "global" or config.moves_per_round == "all":
             record = _global_round(backend, state, graph, config, sub, rnd)

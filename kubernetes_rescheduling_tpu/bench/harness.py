@@ -298,14 +298,23 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 )
 
             # traffic-estimated weights for the DECISION graph: the solver
-            # optimizes what the phase-r1 request stream actually traversed;
-            # reported communication_cost metrics stay on the declared graph
-            # for comparability across configurations
-            solve_graph = (
-                loadgen.observed_graph(edge_counts, obs_sent, graph)
-                if cfg.observe_weights
-                else graph
-            )
+            # optimizes what the request stream actually traversed —
+            # seeded by phase r1 and RE-ESTIMATED each round from the
+            # sustained load's accumulating counts (`during` below), so
+            # decisions track drifting traffic. Reported
+            # communication_cost metrics stay on the declared graph for
+            # comparability across configurations.
+            def solve_graph(_counts=edge_counts, _sent=obs_sent):
+                total = _counts
+                n = _sent
+                if during.edge_counts is not None:
+                    total = (
+                        during.edge_counts
+                        if total is None
+                        else total + during.edge_counts
+                    )
+                    n += during.sent
+                return loadgen.observed_graph(total, n, graph)
 
             # phase r2: the control loop under sustained load — per round,
             # simulate the segment's requests with teardown outages for every
@@ -324,6 +333,8 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 capacity_frac=cfg.capacity_frac,
                 seed=seed,
             )
+            # solve_graph (above) closes over this accumulator; bound here,
+            # before the controller ever calls the estimator
             during = new_samples()
 
             def clock(_backend=backend):

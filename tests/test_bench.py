@@ -504,3 +504,31 @@ def test_cli_trace_external_workmodel_and_trace(tmp_path, capsys):
     # solved cost is <= the cost the new weights found it at
     for s in out["steps"]:
         assert s["cost_after_solve"] <= s["cost_before_solve"] + 1e-6
+
+
+def test_observe_weights_streams_per_round(monkeypatch, tmp_path):
+    """The decision graph is RE-estimated every round from the accumulated
+    traffic (phase r1 + the sustained load), not frozen at phase r1."""
+    import kubernetes_rescheduling_tpu.bench.loadgen as lg
+
+    calls = {"n": 0}
+    real = lg.LoadGenerator.observed_graph
+
+    def counting(self, counts, sent, base):
+        calls["n"] += 1
+        return real(self, counts, sent, base)
+
+    monkeypatch.setattr(lg.LoadGenerator, "observed_graph", counting)
+    cfg = ExperimentConfig(
+        algorithms=("global",),
+        repeats=1,
+        rounds=3,
+        scenario="mubench",
+        out_dir=str(tmp_path),
+        observe_weights=True,
+        seed=5,
+    )
+    summary = run_experiment(cfg)
+    assert len(summary["runs"]) == 1
+    # one estimate per round (3), each folding in the traffic so far
+    assert calls["n"] >= 3

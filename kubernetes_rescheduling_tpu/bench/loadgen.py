@@ -559,14 +559,27 @@ class LoadGenerator:
         if edge_counts is None or sent <= 0:
             return base
         updates = self.observed_weights(edge_counts, sent)
-        adj = np.asarray(base.adj)
-        names = list(base.names)
-        for i, j in np.argwhere(adj > 0):  # no S×S triangle copy
-            if i >= j:
-                continue
-            pair = tuple(sorted((names[int(i)], names[int(j)])))
+        for pair in self._declared_pairs(base):
             updates.setdefault(pair, 0.0)
         return with_weights(base, updates)
+
+    def _declared_pairs(self, base) -> list[tuple[str, str]]:
+        """The base graph's nonzero pairs, enumerated ONCE per graph object
+        and cached — the streaming estimator calls observed_graph every
+        controller round against the same declared graph, and re-pulling
+        the S×S adjacency to host each round would dominate the loop."""
+        cached = getattr(self, "_declared_cache", None)
+        if cached is not None and cached[0] is base:
+            return cached[1]
+        adj = np.asarray(base.adj)
+        names = list(base.names)
+        pairs = [
+            tuple(sorted((names[int(i)], names[int(j)])))
+            for i, j in np.argwhere(adj > 0)
+            if i < j
+        ]
+        self._declared_cache = (base, pairs)
+        return pairs
 
 
 def new_samples() -> _Samples:

@@ -6,8 +6,9 @@ solve — the node axis shards over the mesh's ``tp`` dimension:
 
 - sharded: the occupancy matrix ``X [SP, N/tp]``, per-node loads and
   capacities. Each shard scores its own node columns.
-- replicated: ``W`` (service×service weights), service vectors, and the
-  assignment (global node ids) — every shard agrees on every decision.
+- replicated: the pair weights (``adj``/``rv``/``W_mm`` — the f32 W matrix
+  is never materialized), service vectors, and the assignment (global node
+  ids) — every shard agrees on every decision.
 - collectives per chunk step, all O(C) scalars over ICI:
   ``all_gather`` of each shard's local top-1 (score, global index) and
   ``psum`` of the current-node score / landing-slack contributions (only
@@ -41,8 +42,11 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     _pad_to,
     _service_aggregates,
     auto_chunk,
+    build_pair_weights,
     check_weight_budget,
+    exact_comm_cost,
     sweep_composition,
+    total_pair_weight,
 )
 
 _NEG_INF = float("-inf")
@@ -61,12 +65,13 @@ def _dims(config: GlobalSolverConfig, S: int, N: int, tp: int):
 _SOLVE_CACHE: dict = {}
 
 # shard_map argument layout shared by the single-restart and dp×tp wrappers:
-# replicated problem data, node-axis-sharded per-node vectors, then keys.
-# W/W_mm and service vectors are replicated ARGUMENTS, not closures: a
-# closed-over array becomes an HLO constant, and a 10k×10k weight matrix
-# baked into the program overflows compile-request limits.
+# replicated problem data (assign0, adj, rv, W_mm, service vectors),
+# node-axis-sharded per-node vectors, then keys. adj/W_mm and service
+# vectors are replicated ARGUMENTS, not closures: a closed-over array
+# becomes an HLO constant, and a 10k×10k weight matrix baked into the
+# program overflows compile-request limits.
 _IN_SPECS_COMMON = (
-    P(), P(), P(), P(), P(), P(),
+    P(), P(), P(), P(), P(), P(), P(),
     P("tp"), P("tp"), P("tp"), P("tp"), P("tp"),
 )
 
@@ -74,8 +79,8 @@ _IN_SPECS_COMMON = (
 def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
     """The shard-local solve body (collectives over the mesh's ``tp`` axis).
 
-    Returns ``solve_one(assign_init, W, W_mm, svc_valid, svc_cpu, svc_mem,
-    cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r) ->
+    Returns ``solve_one(assign_init, adj, rv, W_mm, svc_valid, svc_cpu,
+    svc_mem, cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r) ->
     (best_assign, best_obj)``; must run under ``shard_map`` on a mesh with a
     ``tp`` axis. Both the single-restart and the dp-restarts-of-tp-solves
     wrappers are thin shard_map shells around this one body, so the decision
@@ -88,7 +93,7 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
     )
 
     def solve_one(
-        assign_init, W, W_mm, svc_valid, svc_cpu, svc_mem,
+        assign_init, adj, rv, W_mm, svc_valid, svc_cpu, svc_mem,
         cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r,
     ):
         shard = lax.axis_index("tp")
@@ -112,16 +117,18 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
             over = lax.psum(jnp.sum(jnp.maximum(pct - 100.0, 0.0)), "tp")
             return config.balance_weight * jnp.sqrt(var) + ow * over
 
+        # THE shared pair-weight helpers (global_solver) — one definition,
+        # so the exact gate cannot fork between the two solvers
+        w_total = total_pair_weight(adj, rv)
+
         def objective(assign, cpu_l):
-            """EXACT (f32 comm) — the final adopted/reported value."""
-            same = assign[:, None] == assign[None, :]
-            comm = 0.5 * jnp.sum(W * (1.0 - same.astype(jnp.float32)))
-            return comm + _balance_terms(cpu_l)
+            """EXACT (direct cut-sum via exact_comm_cost) — the final
+            adopted/reported value."""
+            return exact_comm_cost(adj, rv, assign) + _balance_terms(cpu_l)
 
         # per-sweep selection on the bf16 kept-mass form — same trade and
         # same expression as global_solver.objective_fast (exact for
         # integer weights; exact f32 re-evaluation after the scan)
-        w_total = jnp.sum(W)
 
         def objective_fast(assign, cpu_l):
             same = assign[:, None] == assign[None, :]
@@ -318,12 +325,12 @@ def _build_solve_restarts(
         check_vma=False,
     )
     def solve_r(
-        assign_init, W, W_mm, svc_valid, svc_cpu, svc_mem,
+        assign_init, adj, rv, W_mm, svc_valid, svc_cpu, svc_mem,
         cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_block,
     ):
         def body(carry, keys_r):
             ba, bo = solve_one(
-                assign_init, W, W_mm, svc_valid, svc_cpu, svc_mem,
+                assign_init, adj, rv, W_mm, svc_valid, svc_cpu, svc_mem,
                 cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r,
             )
             return carry, (ba, bo)
@@ -365,10 +372,11 @@ def _prep(state, graph, config, S, N, SP):
     replicas = _pad_to(replicas, SP)
     cur_node = _pad_to(cur_node, SP, -1)
 
-    W = graph.adj * replicas[:S, None] * replicas[None, :S]
-    W = jnp.pad(W, ((0, SP - S), (0, SP - S)))
-    W = W * svc_valid[:, None] * svc_valid[None, :]
-    W_mm = W.astype(jnp.dtype(config.matmul_dtype))
+    # f32 W is never materialized: the shared jitted builder fuses
+    # multiply+pad+convert into one mm-dtype write (an eager op-by-op
+    # build here would transiently allocate the full f32 SP² product)
+    rv = (replicas * svc_valid)[:S]
+    W_mm = build_pair_weights(graph.adj, rv, SP=SP, dtype=config.matmul_dtype)
 
     cpu_cap = jnp.where(state.node_valid, state.node_cpu_cap, 0.0)
     mem_cap_raw = jnp.where(state.node_valid, state.node_mem_cap, 0.0)
@@ -377,7 +385,7 @@ def _prep(state, graph, config, S, N, SP):
 
     assign0 = jnp.where(svc_valid, jnp.clip(cur_node, 0, N - 1), 0)
     return (
-        assign0, W, W_mm, svc_valid, svc_cpu, svc_mem,
+        assign0, graph.adj, rv, W_mm, svc_valid, svc_cpu, svc_mem,
         cap, mem_cap, state.node_base_cpu, state.node_base_mem, state.node_valid,
     )
 
@@ -419,9 +427,10 @@ def sharded_global_assign(
     """
     tp, S, N, SP = _check_and_dims(state, graph, config, mesh)
     args = _prep(state, graph, config, S, N, SP)
+    cap = args[7]  # the budget-scaled CPU capacities (see _prep's order)
     keys = jax.random.split(key, config.sweeps)
     best_assign, best_obj = _build_solve(mesh, config, S, N)(*args, keys)
-    new_state, info = _finalize(state, graph, config, best_assign, best_obj, SP, args[6])
+    new_state, info = _finalize(state, graph, config, best_assign, best_obj, SP, cap)
     info["tp"] = jnp.asarray(tp)
     return new_state, info
 
@@ -459,7 +468,8 @@ def sharded_solve_with_restarts(
     best_assign, best_obj, all_objs = _build_solve_restarts(
         mesh, config, S, N, r_local
     )(*args, keys_block)
-    new_state, info = _finalize(state, graph, config, best_assign, best_obj, SP, args[6])
+    cap = args[7]  # the budget-scaled CPU capacities (see _prep's order)
+    new_state, info = _finalize(state, graph, config, best_assign, best_obj, SP, cap)
     info.update(
         restart_objectives=all_objs,
         best_restart=jnp.argmin(all_objs),

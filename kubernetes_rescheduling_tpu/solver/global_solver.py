@@ -114,13 +114,15 @@ class GlobalSolverConfig:
     # off elsewhere (parity-tested in interpret mode; annealing noise uses
     # the TPU core PRNG, a different stream than jax.random).
     fused_epilogue: str = struct.field(pytree_node=False, default="auto")
-    # The dense S×S pair-weight matrix is this solver's scale wall: W (f32)
-    # plus its matmul copy (matmul_dtype) live per device and are
-    # REPLICATED even under tp node-sharding (tp shards nodes, not
-    # services). 12 GiB ≈ the comfortable budget on a 16 GB v5e chip:
-    # 10k services ≈ 0.59 GiB, 20k ≈ 2.3 GiB, ~46k hits the budget. Past
-    # it the solver raises a clear sizing error instead of OOM-crashing
-    # mid-compile; raise the budget on larger-HBM parts.
+    # The dense pair weights are this solver's scale wall: the mm-dtype
+    # matmul copy (the f32 W product itself is never materialized — exact
+    # objectives contract the input adj directly) PLUS the f32 input
+    # adjacency, both live per device and REPLICATED even under tp
+    # node-sharding (tp shards nodes, not services). The budget counts
+    # both (6 bytes/pair at bf16): 12 GiB ≈ the comfortable budget on a
+    # 16 GB v5e chip — 0.59 GiB at 10k services, 2.3 GiB at 20k, ~46k at
+    # the budget. Past it the solver raises a clear sizing error instead
+    # of OOM-crashing mid-compile; raise it on larger-HBM parts.
     max_weight_bytes: int = struct.field(
         pytree_node=False, default=12 * 1024**3
     )
@@ -211,20 +213,59 @@ def pct_balance_terms(
 
 def check_weight_budget(SP: int, config: "GlobalSolverConfig") -> None:
     """Fail with a SIZING error — not a mid-compile OOM — when the dense
-    pair-weight matrix exceeds ``config.max_weight_bytes``. Shared by the
-    single-chip and node-sharded solvers (W is replicated under tp)."""
+    pair-weight residency exceeds ``config.max_weight_bytes``. Counts what
+    is actually LIVE per device during a solve: the mm-dtype matmul copy
+    AND the f32 input adjacency it is built from (both replicated under
+    tp) — admitting only the copy would pass sizes that then OOM
+    mid-compile, the exact failure this check exists to prevent."""
     mm_bytes = jnp.dtype(config.matmul_dtype).itemsize
-    need = SP * SP * (4 + mm_bytes)
+    need = SP * SP * (mm_bytes + 4)
     if need > config.max_weight_bytes:
         raise ValueError(
-            f"dense pair-weight matrix needs {need / 2**30:.2f} GiB "
-            f"({SP} padded services, f32 + {config.matmul_dtype}) — over "
+            f"dense pair weights need {need / 2**30:.2f} GiB "
+            f"({SP} padded services: {config.matmul_dtype} matmul copy + "
+            f"f32 adjacency) — over "
             f"max_weight_bytes={config.max_weight_bytes / 2**30:.2f} GiB. "
-            "The dense W formulation is the documented scale wall (README "
-            "scaling notes); tp node-sharding does NOT shard W. Raise "
+            "The dense-W formulation is the documented scale wall (README "
+            "scaling notes); tp node-sharding does NOT shard it. Raise "
             "max_weight_bytes on larger-HBM devices or reduce the service "
             "count."
         )
+
+
+def build_pair_weights(adj, rv, *, SP: int, dtype):
+    """The mm-dtype pair-weight matrix ``pad(adj·rv·rvᵀ)`` as ONE fused
+    multiply+pad+convert (jitted): no f32 SP×SP product ever materializes
+    — only the final SP²·itemsize write. Shared by both solvers."""
+    return _build_pair_weights(adj, rv, SP=SP, dtype=jnp.dtype(dtype).name)
+
+
+@partial(jax.jit, static_argnames=("SP", "dtype"))
+def _build_pair_weights(adj, rv, *, SP, dtype):
+    S = adj.shape[0]
+    return jnp.pad(
+        adj * rv[:, None] * rv[None, :], ((0, SP - S), (0, SP - S))
+    ).astype(dtype)
+
+
+def total_pair_weight(adj, rv):
+    """ΣW as one fused pass over the input adjacency."""
+    return jnp.einsum(
+        "st,s,t->", adj, rv, rv, preferred_element_type=jnp.float32
+    )
+
+
+def exact_comm_cost(adj, rv, assign):
+    """0.5·Σ adj·rv·rvᵀ over CUT pairs — a DIRECT sum (error ~ eps·cut),
+    deliberately not the ``(ΣW − kept)/2`` subtraction form whose error
+    scales with ulp(ΣW) and could understate a near-colocated result
+    enough to flip the never-worse adopt gate. One definition for the
+    single-chip and node-sharded exact objectives."""
+    S = adj.shape[0]
+    cut = (assign[:S, None] != assign[None, :S]).astype(jnp.float32)
+    return 0.5 * jnp.einsum(
+        "st,s,t,st->", adj, rv, rv, cut, preferred_element_type=jnp.float32
+    )
 
 
 def auto_chunk(S: int, chunk_size: int = 0) -> int:
@@ -280,16 +321,16 @@ def global_assign(
     replicas = _pad_to(replicas, SP)
     cur_node = _pad_to(cur_node, SP, -1)
 
-    W = graph.adj * replicas[:S, None] * replicas[None, :S]
-    W = jnp.pad(W, ((0, SP - S), (0, SP - S)))
-    W = W * svc_valid[:, None] * svc_valid[None, :]
     mm_dtype = jnp.dtype(config.matmul_dtype)
-    # Persistent low-precision copy for the chunk matmuls (W itself stays
-    # f32 for the objective). Costs SP×SP/2 bytes of HBM (~200 MB at 10k
-    # services) but saves ~7 ms/round over casting each gathered slice; at
-    # most one copy lives per device even under restarts (they scan
-    # sequentially within a shard), so the trade is safe.
-    W_mm = W.astype(mm_dtype)
+    # rv = replica count per service, zeroed for invalid services — the
+    # pair weight is W[s,t] = adj[s,t]·rv[s]·rv[t]. The f32 W matrix is
+    # NEVER materialized: the chunk matmuls read the persistent mm_dtype
+    # copy below (built in one fused pad+multiply+convert pass), and the
+    # exact objective contracts adj directly (einsum — one pass over the
+    # input graph). Saves SP²·4 bytes of HBM (~400 MB at 10k services)
+    # plus a full build pass per solve.
+    rv = (replicas * svc_valid)[:S]
+    W_mm = build_pair_weights(graph.adj, rv, SP=SP, dtype=mm_dtype)
 
     cpu_cap = jnp.where(state.node_valid, state.node_cpu_cap, 0.0)
     mem_cap_raw = jnp.where(state.node_valid, state.node_mem_cap, 0.0)
@@ -312,11 +353,12 @@ def global_assign(
             cpu_load, cap, state.node_valid, config.balance_weight, ow
         )
 
+    w_total = total_pair_weight(graph.adj, rv)
+
     def objective(assign):
-        """EXACT objective (f32 comm, fresh loads) — the adopt gate and
-        reported values."""
-        same = assign[:, None] == assign[None, :]
-        comm = 0.5 * jnp.sum(W * (1.0 - same.astype(jnp.float32)))
+        """EXACT objective (direct cut-sum over adj, fresh loads) — the
+        adopt gate and reported values."""
+        comm = exact_comm_cost(graph.adj, rv, assign)
         cpu_load, _ = loads(assign)
         return comm + _balance_terms(cpu_load)
 
@@ -325,7 +367,6 @@ def global_assign(
     # EXACT for integer pair weights (every scenario graph; only fractional
     # trace weights round). The returned objective is re-evaluated with the
     # exact f32 form after the scan, so the never-worse gate cannot drift.
-    w_total = jnp.sum(W)
 
     def objective_fast(assign, cpu_load):
         same = assign[:, None] == assign[None, :]

@@ -182,13 +182,17 @@ def test_weight_budget_raises_clear_sizing_error():
             scn.state, scn.graph, jax.random.PRNGKey(0),
             make_mesh(8, shape=(2, 4)), tiny,
         )
-    # the default budget admits the north-star scale (10240 padded: 0.59 GiB)
+    # the default budget admits the north-star scale (10240 padded:
+    # 0.20 GiB bf16 matmul copy; the f32 W is never materialized)
     from kubernetes_rescheduling_tpu.solver.global_solver import check_weight_budget
 
     check_weight_budget(10240, GlobalSolverConfig())
     check_weight_budget(20480, GlobalSolverConfig())
     with pytest.raises(ValueError):
-        check_weight_budget(50_000, GlobalSolverConfig())
+        check_weight_budget(90_000, GlobalSolverConfig())
+    # float32 matmuls hit the wall sooner (4 bytes vs 2 per pair)
+    with pytest.raises(ValueError):
+        check_weight_budget(60_000, GlobalSolverConfig(matmul_dtype="float32"))
 
 
 def test_pct_balance_terms_np_jnp_agree():

@@ -5,6 +5,7 @@ input. Randomized far wider than the seeded fixtures elsewhere."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 from hypothesis import given, settings, strategies as st
 
@@ -185,3 +186,87 @@ def test_solver_never_worse_and_capacity_safe(seed):
     used1 = np.asarray(new_state.node_cpu_used())[:n_nodes]
     ok0 = used0 <= cap
     assert (used1[ok0] <= cap + 1e-3).all()
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    s=st.integers(min_value=2, max_value=24),
+    n=st.integers(min_value=2, max_value=8),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+)
+def test_exact_comm_cost_matches_bruteforce(s, n, seed):
+    """The shared exact cut-sum (the never-worse gate's comm term) equals a
+    double-loop reference on arbitrary weighted graphs."""
+    from kubernetes_rescheduling_tpu.solver.global_solver import exact_comm_cost
+
+    rng = np.random.default_rng(seed)
+    adj = rng.random((s, s)).astype(np.float32) * (rng.random((s, s)) < 0.5)
+    adj = (adj + adj.T) / 2
+    np.fill_diagonal(adj, 0.0)
+    rv = rng.integers(0, 4, s).astype(np.float32)
+    assign = rng.integers(0, n, s)
+    got = float(exact_comm_cost(jnp.asarray(adj), jnp.asarray(rv), jnp.asarray(assign)))
+    want = 0.5 * sum(
+        float(adj[i, j]) * float(rv[i]) * float(rv[j])
+        for i in range(s)
+        for j in range(s)
+        if assign[i] != assign[j]
+    )
+    assert got == pytest.approx(want, rel=1e-4, abs=1e-4)
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    c=st.sampled_from([2, 4, 8, 256]),
+    n_chunks=st.integers(min_value=1, max_value=6),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+)
+def test_sweep_composition_is_partition(c, n_chunks, seed):
+    """Every composition (B=1 full permutation AND B=256 block-granular)
+    partitions [0, SP) exactly once per sweep."""
+    from kubernetes_rescheduling_tpu.solver.global_solver import sweep_composition
+
+    sp = c * n_chunks
+    ids, _ = sweep_composition(jax.random.PRNGKey(seed), sp, c, n_chunks)
+    assert ids.shape == (n_chunks, c)
+    flat = np.asarray(ids).reshape(-1)
+    assert sorted(flat.tolist()) == list(range(sp))
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    k=st.integers(min_value=1, max_value=6),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+)
+def test_top_gain_moves_invariants(k, seed):
+    """Wave cap invariants: result ⊆ changed, ≤ k entries, only
+    strictly-improving moves, original relative order preserved."""
+    from kubernetes_rescheduling_tpu.bench.controller import _top_gain_moves
+    from kubernetes_rescheduling_tpu.core.state import CommGraph
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
+
+    rng = np.random.default_rng(seed)
+    s, n = 8, 3
+    names = [f"s{i}" for i in range(s)]
+    rel = {names[i]: [names[j] for j in range(s) if i != j and rng.random() < 0.4]
+           for i in range(s)}
+    graph = CommGraph.from_relation(rel, names=names)
+    state = ClusterState.build(
+        node_names=[f"n{i}" for i in range(n)],
+        node_cpu_cap=[1000.0] * n,
+        node_mem_cap=[2**30] * n,
+        pod_services=list(range(s)),
+        pod_nodes=rng.integers(0, n, s).tolist(),
+        pod_cpu=(rng.random(s) * 100).tolist(),
+        pod_mem=[0.0] * s,
+        pod_names=[f"{nm}-0" for nm in names],
+    )
+    changed = [
+        (i, int(rng.integers(0, n))) for i in rng.permutation(s)[: rng.integers(1, s)]
+    ]
+    cfg = GlobalSolverConfig(balance_weight=0.5, enforce_capacity=False)
+    out = _top_gain_moves(changed, state, graph, cfg, k)
+    assert len(out) <= k
+    assert all(m in changed for m in out)
+    idxs = [changed.index(m) for m in out]
+    assert idxs == sorted(idxs)  # stable original order

@@ -169,18 +169,28 @@ def test_solver_never_worse_and_capacity_safe(seed):
         cap=cap,
     )
     graph = mubench_workmodel_c().comm_graph()
-    cost0 = float(communication_cost(state, graph))
-    std0 = float(jnp.std(state.node_cpu_pct()[: n_nodes]))
     lam = 0.5
+    cfg = GlobalSolverConfig(sweeps=3, balance_weight=lam, enforce_capacity=True)
+
+    def combined(st):
+        # the solver's FULL objective: comm + λ·std + overload repulsion.
+        # Omitting the overload term makes the invariant falsifiable — the
+        # solver may correctly trade comm/std for draining an over-budget
+        # node (hypothesis found seed 33631 doing exactly that).
+        pct = np.asarray(st.node_cpu_pct())[:n_nodes]
+        over = float(np.maximum(pct - 100.0, 0.0).sum())
+        return (
+            float(communication_cost(st, graph))
+            + lam * float(np.std(pct))
+            + cfg.overload_weight * over
+        )
+
+    before = combined(state)
     new_state, info = global_assign(
-        state, graph, jax.random.PRNGKey(seed % 997),
-        GlobalSolverConfig(sweeps=3, balance_weight=lam, enforce_capacity=True),
+        state, graph, jax.random.PRNGKey(seed % 997), cfg
     )
-    cost1 = float(communication_cost(new_state, graph))
-    # never worse on the combined objective (the solver's guarantee)
-    assert cost1 + lam * float(
-        jnp.std(new_state.node_cpu_pct()[: n_nodes])
-    ) <= cost0 + lam * std0 + 1e-3
+    # never worse on the solver's combined objective (its guarantee)
+    assert combined(new_state) <= before + 1e-3
     # capacity respected wherever the input respected it
     used0 = np.asarray(state.node_cpu_used())[:n_nodes]
     used1 = np.asarray(new_state.node_cpu_used())[:n_nodes]

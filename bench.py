@@ -100,10 +100,13 @@ def main() -> int:
     def timed_chain(k):
         _, objs = chained(state, graph, jax.random.PRNGKey(7), k)
         float(objs[-1])  # warm-up/compile
-        t = time.perf_counter()
-        _, objs = chained(state, graph, jax.random.PRNGKey(8), k)
-        float(objs[-1])  # completion fence
-        return time.perf_counter() - t
+        best = float("inf")
+        for rep in range(3):  # min-of-3: tunnel contention only ever ADDS time
+            t = time.perf_counter()
+            _, objs = chained(state, graph, jax.random.PRNGKey(8 + rep), k)
+            float(objs[-1])  # completion fence
+            best = min(best, time.perf_counter() - t)
+        return best
 
     k1, k2 = 2, 12
     device_ms = (timed_chain(k2) - timed_chain(k1)) / (k2 - k1) * 1e3

@@ -173,16 +173,27 @@ def test_solver_never_worse_and_capacity_safe(seed):
     cfg = GlobalSolverConfig(sweeps=3, balance_weight=lam, enforce_capacity=True)
 
     def combined(st):
-        # the solver's FULL objective: comm + λ·std + overload repulsion.
-        # Omitting the overload term makes the invariant falsifiable — the
-        # solver may correctly trade comm/std for draining an over-budget
-        # node (hypothesis found seed 33631 doing exactly that).
-        pct = np.asarray(st.node_cpu_pct())[:n_nodes]
-        over = float(np.maximum(pct - 100.0, 0.0).sum())
-        return (
-            float(communication_cost(st, graph))
-            + lam * float(np.std(pct))
-            + cfg.overload_weight * over
+        # the solver's FULL objective: comm + λ·std + overload repulsion,
+        # via the solver's OWN balance-terms helper (one definition —
+        # hand-rolling it here would silently diverge under capacity_frac
+        # or a future objective edit). Omitting the overload term makes
+        # the invariant falsifiable — the solver may correctly trade
+        # comm/std for draining an over-budget node (hypothesis found
+        # seed 33631 doing exactly that).
+        from kubernetes_rescheduling_tpu.solver.global_solver import (
+            pct_balance_terms,
+        )
+
+        budget_cap = np.asarray(st.node_cpu_cap)[:n_nodes] * cfg.capacity_frac
+        return float(communication_cost(st, graph)) + float(
+            pct_balance_terms(
+                np.asarray(st.node_cpu_used())[:n_nodes],
+                budget_cap,
+                np.ones(n_nodes, bool),
+                lam,
+                cfg.overload_weight,
+                xp=np,
+            )
         )
 
     before = combined(state)

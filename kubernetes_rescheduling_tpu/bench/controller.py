@@ -277,33 +277,45 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
 def _top_gain_moves(
     changed: list[tuple[int, int]], state, graph, solver_cfg, k: int
 ) -> list[tuple[int, int]]:
-    """The ≤``k`` strictly-improving moves with the largest single-move
-    objective gain, using the SOLVER's own accounting (``solver_cfg`` is
-    the round's GlobalSolverConfig): comm + λ·std of CPU-% **of the
-    packing budget** (``capacity_frac``-scaled, exactly as the solver's
-    objective measures load) + the over-budget repulsion term when
-    capacity is enforced.
+    """≤``k`` strictly-improving moves selected GREEDILY AND SEQUENTIALLY,
+    using the SOLVER's own accounting (``solver_cfg`` is the round's
+    GlobalSolverConfig): comm + λ·std of CPU-% **of the packing budget**
+    (``capacity_frac``-scaled, exactly as the solver's objective measures
+    load) + the over-budget repulsion term when capacity is enforced.
 
-    Comm gain of relocating service ``s`` to ``t`` with every other
+    Each accepted move updates the working placement and node loads, and
+    every remaining candidate is re-scored against that updated state —
+    so the wave is jointly consistent: two moves cannot cumulatively
+    over-budget one node (while capacity is enforced, a candidate whose
+    target would newly exceed the CPU or memory budget is skipped — the
+    solver's own feasibility rule), and a move the solver admitted only
+    because an earlier move vacates its target is scored with that
+    vacancy visible.
+
+    Comm gain of relocating service ``s`` to ``t`` with every *unmoved*
     service fixed: ``Σ_j W[s,j]·([node_j ≠ cur_s] − [node_j ≠ t])`` on the
     replica-weighted pair matrix (row-wise host-side — only the changed
-    services' adjacency rows are touched). Moves whose individual gain is
-    ≤ 0 are dropped — they only pay off in combination, and applying them
-    alone is churn (the convergence criterion: a capped loop stops when
-    no single move helps)."""
+    services' adjacency rows are touched). Candidates whose gain at their
+    evaluation state is ≤ 0 are never selected — they only pay off in
+    combination with moves this wave did not take, and applying them alone
+    is churn (the convergence criterion: a capped loop stops when no
+    single next move helps)."""
     S = graph.num_services
     svc_arr = np.asarray(state.pod_service)
     valid = np.asarray(state.pod_valid)
     old_nodes = np.asarray(state.pod_node)
     pod_cpu = np.asarray(state.pod_cpu)
+    pod_mem = np.asarray(state.pod_mem)
     svc_node = np.full(S, -1, dtype=np.int64)
     svc_cpu = np.zeros(S)
+    svc_mem = np.zeros(S)
     for i in np.flatnonzero(valid):
         s = int(svc_arr[i])
         if 0 <= s < S:
             if svc_node[s] < 0:
                 svc_node[s] = old_nodes[i]
             svc_cpu[s] += float(pod_cpu[i])
+            svc_mem[s] += float(pod_mem[i])
     replicas = np.bincount(svc_arr[valid & (svc_arr >= 0) & (svc_arr < S)], minlength=S)
     adj = np.asarray(graph.adj)
     placed = svc_node >= 0
@@ -313,7 +325,13 @@ def _top_gain_moves(
     cap = np.where(
         np.asarray(state.node_cpu_cap) > 0, np.asarray(state.node_cpu_cap), 1.0
     ) * solver_cfg.capacity_frac
+    mem_cap_raw = np.asarray(state.node_mem_cap)
+    mem_cap = (
+        np.where(mem_cap_raw > 0, mem_cap_raw, np.inf)
+        * solver_cfg.capacity_frac
+    )
     used = np.asarray(state.node_cpu_used())
+    mem_used = np.asarray(state.node_mem_used())
 
     def balance_terms(loads):
         # the solver's OWN expression, evaluated host-side (xp=np)
@@ -323,21 +341,48 @@ def _top_gain_moves(
             )
         )
 
-    bal0 = balance_terms(used)
-    gains = []
-    for s, t in changed:
-        w = adj[s] * replicas[s] * replicas
-        cut_before = float(np.sum(w[placed & (svc_node != svc_node[s])]))
-        cut_after = float(np.sum(w[placed & (svc_node != t)]))
-        loads = used.copy()
-        if 0 <= svc_node[s] < len(loads):
-            loads[svc_node[s]] -= svc_cpu[s]
+    work_node = svc_node.copy()
+    loads = used.copy()
+    mem_loads = mem_used.copy()
+    picked: list[int] = []
+    remaining = list(range(len(changed)))
+    for _ in range(min(k, len(changed))):
+        bal_now = balance_terms(loads)
+        best_i, best_gain = None, 1e-9
+        for i in remaining:
+            s, t = changed[i]
+            w = adj[s] * replicas[s] * replicas
+            cut_before = float(np.sum(w[placed & (work_node != work_node[s])]))
+            cut_after = float(np.sum(w[placed & (work_node != t)]))
+            new_loads = loads.copy()
+            if 0 <= work_node[s] < len(new_loads):
+                new_loads[work_node[s]] -= svc_cpu[s]
+            new_loads[t] += svc_cpu[s]
+            if (
+                solver_cfg.enforce_capacity
+                and t != work_node[s]
+                and (
+                    new_loads[t] > cap[t]
+                    or mem_loads[t] + svc_mem[s] > mem_cap[t]
+                )
+            ):
+                continue  # would newly exceed a budget at the CURRENT loads
+            gain = cut_before - cut_after + bal_now - balance_terms(new_loads)
+            # strict >: ties go to the earliest candidate (lower position)
+            if gain > best_gain:
+                best_i, best_gain = i, gain
+        if best_i is None:
+            break  # no remaining move helps on its own — wave converged
+        s, t = changed[best_i]
+        if 0 <= work_node[s] < len(loads):
+            loads[work_node[s]] -= svc_cpu[s]
+            mem_loads[work_node[s]] -= svc_mem[s]
         loads[t] += svc_cpu[s]
-        gains.append(cut_before - cut_after + bal0 - balance_terms(loads))
-    gains = np.asarray(gains)
-    # ties -> lower service index (stable sort on negated gains)
-    order = [i for i in np.argsort(-gains, kind="stable")[:k] if gains[i] > 1e-9]
-    return [changed[i] for i in sorted(order)]
+        mem_loads[t] += svc_mem[s]
+        work_node[s] = t
+        picked.append(best_i)
+        remaining.remove(best_i)
+    return [changed[i] for i in sorted(picked)]
 
 
 def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:

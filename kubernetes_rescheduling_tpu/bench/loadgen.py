@@ -545,39 +545,59 @@ class LoadGenerator:
         return out
 
     def observed_graph(
-        self, edge_counts: np.ndarray | None, sent: int, base
+        self,
+        edge_counts: np.ndarray | None,
+        sent: int,
+        base,
+        *,
+        prior_requests: float = 50.0,
     ):
         """``base`` CommGraph with its edge weights replaced by observed
         traffic rates (untraversed declared edges drop toward 0 — stale
-        topology stops steering the solver). Declared pairs the request
-        model can never traverse (cycle-broken back-edges dropped by
-        ``kahn_traversal``) are zeroed too, so an unobservable edge cannot
-        keep its full declared weight and dominate the rescaled graph.
-        Returns ``base`` unchanged when there is nothing observed yet."""
+        topology stops steering the solver).
+
+        Observed rates are blended with the declared weight through a
+        pseudo-count prior: ``(count + prior_requests·declared) /
+        (sent + prior_requests)`` — a genuinely live low-rate edge is not
+        hard-zeroed by a small sample (zero traversals out of 50 requests
+        is weak evidence; out of 50k it isn't); the declared weight decays
+        only as evidence accumulates. ``prior_requests=0`` restores the
+        raw observed rates. Declared pairs the request model can never
+        traverse (cycle-broken back-edges dropped by ``kahn_traversal``)
+        are zeroed regardless — no amount of traffic can ever produce
+        evidence for them, so the prior would pin them at the declared
+        weight forever. Returns ``base`` unchanged when there is nothing
+        observed yet."""
         from kubernetes_rescheduling_tpu.bench.trace import with_weights
 
         if edge_counts is None or sent <= 0:
             return base
-        updates = self.observed_weights(edge_counts, sent)
-        for pair in self._declared_pairs(base):
+        declared = self._declared_pairs(base)
+        k = max(float(prior_requests), 0.0)
+        updates = {
+            pair: (rate * sent + k * declared.get(pair, 0.0)) / (sent + k)
+            for pair, rate in self.observed_weights(edge_counts, sent).items()
+        }
+        for pair in declared:
             updates.setdefault(pair, 0.0)
         return with_weights(base, updates)
 
-    def _declared_pairs(self, base) -> list[tuple[str, str]]:
-        """The base graph's nonzero pairs, enumerated ONCE per graph object
-        and cached — the streaming estimator calls observed_graph every
-        controller round against the same declared graph, and re-pulling
-        the S×S adjacency to host each round would dominate the loop."""
+    def _declared_pairs(self, base) -> dict[tuple[str, str], float]:
+        """The base graph's nonzero pairs (with their declared weights —
+        the blending prior), enumerated ONCE per graph object and cached —
+        the streaming estimator calls observed_graph every controller
+        round against the same declared graph, and re-pulling the S×S
+        adjacency to host each round would dominate the loop."""
         cached = getattr(self, "_declared_cache", None)
         if cached is not None and cached[0] is base:
             return cached[1]
         adj = np.asarray(base.adj)
         names = list(base.names)
-        pairs = [
-            tuple(sorted((names[int(i)], names[int(j)])))
+        pairs = {
+            tuple(sorted((names[int(i)], names[int(j)]))): float(adj[i, j])
             for i, j in np.argwhere(adj > 0)
             if i < j
-        ]
+        }
         self._declared_cache = (base, pairs)
         return pairs
 

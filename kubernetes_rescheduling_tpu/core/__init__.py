@@ -1,6 +1,13 @@
 """Array-world cluster state, quantity parsing, workload models, topologies."""
 
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.core.sparsegraph import SparseCommGraph
 from kubernetes_rescheduling_tpu.core.quantities import cpu_to_millicores, mem_to_bytes
 
-__all__ = ["ClusterState", "CommGraph", "cpu_to_millicores", "mem_to_bytes"]
+__all__ = [
+    "ClusterState",
+    "CommGraph",
+    "SparseCommGraph",
+    "cpu_to_millicores",
+    "mem_to_bytes",
+]

@@ -1,0 +1,238 @@
+"""Neighbor-mass kernels over block-local sparse pair weights.
+
+The dense solver's hot step is ``M = W[chunk rows] @ one_hot(assign)`` — an
+MXU matmul with contraction length SP (ops/fused_admission.py,
+``fused_neighbor_mass``). With the block-local storage of
+``core.sparsegraph`` the contraction shrinks to each block's distinct
+neighbor set: for a 256-row block b,
+
+    M_b = w_local[b] @ (one_hot(tgt_b) · rv_u_b)        # [256, U_b] @ [U_b, N]
+
+where ``tgt_b = assign[u_ids[b]]`` (pre-gathered in XLA — a few hundred KB
+per chunk) and ``rv_u`` carries the neighbor replica counts (the row-side
+replica factor is applied by the caller; the pair weight
+``adj·rv_s·rv_t`` factorizes). The one-hot tile is regenerated in VMEM
+from ``tgt`` exactly like the dense inline-mass kernel — it never exists
+in HBM.
+
+Two kernels, one body:
+
+- ``sparse_neighbor_mass`` — the per-chunk kernel. Grid ``(KB, reg_tiles)``
+  over the chunk's (traced) regular block ids; a scalar-prefetched offset
+  table locates each block's uniform-width column strip. No ragged
+  bookkeeping in the hot loop — regular blocks share one width by
+  construction.
+- ``hub_neighbor_mass`` — the once-per-sweep hub pass. Hub blocks (the few
+  degree-sorted leading blocks whose neighbor sets exceed the regular
+  width) have *static* ids, so their ragged tile list is flattened at
+  build time into (column-tile, output-block, is-first) arrays and the
+  grid walks it 1D with zero wasted steps.
+
+``reference_sparse_mass`` / ``reference_hub_mass`` are the plain-XLA twins
+(production path on CPU, parity oracle for the kernels).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R
+
+
+def _mass_body(w_ref, tgt_ref, rvu_ref, m_ref, *, first):
+    """Shared accumulate step: one ``[256, BU] @ [BU, N]`` tile."""
+    bu = w_ref.shape[1]
+    n = m_ref.shape[1]
+    tgt = tgt_ref[:].reshape(bu, 1)
+    rvu = rvu_ref[:].reshape(bu, 1)
+    col = jax.lax.broadcasted_iota(jnp.int32, (bu, n), 1)
+    # the one-hot occupancy tile scaled by neighbor replicas, in VMEM only.
+    # rv values are small integers — exact in bf16 (≤ 256), and padding
+    # columns carry rvu = 0 so they contribute nothing.
+    oh = jnp.where(tgt == col, rvu, 0.0).astype(w_ref.dtype)
+    acc = jnp.dot(w_ref[:], oh, preferred_element_type=jnp.float32)
+
+    @pl.when(first)
+    def _():
+        m_ref[:] = acc
+
+    @pl.when(jnp.logical_not(first))
+    def _():
+        m_ref[:] += acc
+
+
+def _chunk_kernel(blocks_ref, toff_ref, w_ref, tgt_ref, rvu_ref, m_ref):
+    del blocks_ref, toff_ref  # consumed by the index_map
+    _mass_body(w_ref, tgt_ref, rvu_ref, m_ref, first=pl.program_id(1) == 0)
+
+
+def _hub_kernel(tcol_ref, tout_ref, tfirst_ref, w_ref, tgt_ref, rvu_ref, m_ref):
+    del tcol_ref, tout_ref
+    first = tfirst_ref[pl.program_id(0)] == 1
+    _mass_body(w_ref, tgt_ref, rvu_ref, m_ref, first=first)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_nodes", "bu", "reg_tiles", "interpret")
+)
+def sparse_neighbor_mass(
+    w_mm,     # [256, TU] block-local weights in matmul dtype
+    tgt_u,    # i32[TU] assign[u_ids] (pre-gathered, padding → anything)
+    rvu,      # f32[TU] replica count per neighbor column (0 on padding)
+    blocks,   # i32[KB] chunk's block ids (regular or dummy)
+    toff,     # i32[NBX] per-block first column tile (incl. dummy entries)
+    *,
+    num_nodes: int,
+    bu: int,
+    reg_tiles: int,
+    interpret: bool = False,
+):
+    """``M[KB·256, N]`` for one chunk of regular-width blocks."""
+    TU = w_mm.shape[1]
+    KB = blocks.shape[0]
+    N = int(num_nodes)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(KB, reg_tiles),
+        in_specs=[
+            pl.BlockSpec(
+                (BLOCK_R, bu), lambda i, j, blocks, toff: (0, toff[blocks[i]] + j)
+            ),
+            pl.BlockSpec((1, bu), lambda i, j, blocks, toff: (0, toff[blocks[i]] + j)),
+            pl.BlockSpec((1, bu), lambda i, j, blocks, toff: (0, toff[blocks[i]] + j)),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_R, N), lambda i, j, blocks, toff: (i, 0)),
+    )
+    return pl.pallas_call(
+        _chunk_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((KB * BLOCK_R, N), jnp.float32),
+        interpret=interpret,
+    )(
+        blocks.astype(jnp.int32),
+        toff.astype(jnp.int32),
+        w_mm,
+        tgt_u.reshape(1, TU).astype(jnp.int32),
+        rvu.reshape(1, TU).astype(jnp.float32),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_nodes", "num_hub_blocks", "bu", "interpret")
+)
+def hub_neighbor_mass(
+    w_mm,        # [256, TU]
+    tgt_u,       # i32[TU]
+    rvu,         # f32[TU]
+    tile_col,    # i32[T] static flattened hub tile list: column tile
+    tile_out,    # i32[T] output block slot (0..NHB-1), block-major order
+    tile_first,  # i32[T] 1 on each output block's first tile
+    *,
+    num_nodes: int,
+    num_hub_blocks: int,
+    bu: int,
+    interpret: bool = False,
+):
+    """``M[NHB·256, N]`` for the (static) hub blocks — ragged widths walked
+    as a flat 1D tile list, zero wasted grid steps."""
+    TU = w_mm.shape[1]
+    T = tile_col.shape[0]
+    N = int(num_nodes)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_R, bu), lambda t, tc, to, tf: (0, tc[t])),
+            pl.BlockSpec((1, bu), lambda t, tc, to, tf: (0, tc[t])),
+            pl.BlockSpec((1, bu), lambda t, tc, to, tf: (0, tc[t])),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_R, N), lambda t, tc, to, tf: (to[t], 0)),
+    )
+    return pl.pallas_call(
+        _hub_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (num_hub_blocks * BLOCK_R, N), jnp.float32
+        ),
+        interpret=interpret,
+    )(
+        tile_col.astype(jnp.int32),
+        tile_out.astype(jnp.int32),
+        tile_first.astype(jnp.int32),
+        w_mm,
+        tgt_u.reshape(1, TU).astype(jnp.int32),
+        rvu.reshape(1, TU).astype(jnp.float32),
+    )
+
+
+def reference_sparse_mass(
+    w_mm, tgt_u, rvu, blocks, toff, *, num_nodes: int, bu: int, reg_tiles: int
+):
+    """Plain-XLA twin of :func:`sparse_neighbor_mass` (gather + matmul —
+    no scatter, so it is TPU- and vmap-safe). Term-for-term the same f32
+    operation order as the kernel body."""
+    U = reg_tiles * bu
+    N = int(num_nodes)
+
+    def per_block(b):
+        start = toff[b] * bu
+        wb = lax.dynamic_slice(w_mm, (0, start), (BLOCK_R, U))
+        tgt = lax.dynamic_slice(tgt_u, (start,), (U,))
+        rv = lax.dynamic_slice(rvu, (start,), (U,))
+        oh = jnp.where(
+            tgt[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :],
+            rv[:, None],
+            0.0,
+        ).astype(w_mm.dtype)
+        return jnp.dot(wb, oh, preferred_element_type=jnp.float32)
+
+    M = jax.vmap(per_block)(blocks)
+    return M.reshape(blocks.shape[0] * BLOCK_R, N)
+
+
+def reference_hub_mass(sgraph, w_mm, tgt_u, rvu, *, num_nodes: int, blocks=None):
+    """Plain-XLA twin of :func:`hub_neighbor_mass` — hub offsets/widths are
+    static, so this is a Python loop over static slices."""
+    N = int(num_nodes)
+    outs = []
+    for b in blocks if blocks is not None else sgraph.hub_blocks:
+        off = sgraph.block_toff[b] * sgraph.bu
+        width = sgraph.block_ntiles[b] * sgraph.bu
+        wb = w_mm[:, off : off + width]
+        tgt = tgt_u[off : off + width]
+        rv = rvu[off : off + width]
+        oh = jnp.where(
+            tgt[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :],
+            rv[:, None],
+            0.0,
+        ).astype(w_mm.dtype)
+        outs.append(jnp.dot(wb, oh, preferred_element_type=jnp.float32))
+    return jnp.concatenate(outs, axis=0)
+
+
+def hub_tile_arrays(sgraph, blocks=None):
+    """Flatten hub blocks' ragged tile lists into the static
+    (column-tile, output-slot, is-first) arrays the 1D hub grid walks,
+    in output-block-major order (accumulation revisits each output block
+    consecutively). ``blocks`` selects a subset (the solver processes
+    hubs in chunk-sized groups so the admission race never exceeds the
+    regular chunk width)."""
+    import numpy as np
+
+    cols, outs, firsts = [], [], []
+    for slot, b in enumerate(blocks if blocks is not None else sgraph.hub_blocks):
+        for j in range(sgraph.block_ntiles[b]):
+            cols.append(sgraph.block_toff[b] + j)
+            outs.append(slot)
+            firsts.append(1 if j == 0 else 0)
+    return (
+        jnp.asarray(np.asarray(cols, dtype=np.int32)),
+        jnp.asarray(np.asarray(outs, dtype=np.int32)),
+        jnp.asarray(np.asarray(firsts, dtype=np.int32)),
+    )

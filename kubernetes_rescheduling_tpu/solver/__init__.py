@@ -17,6 +17,10 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     GlobalSolverConfig,
     global_assign,
 )
+from kubernetes_rescheduling_tpu.solver.sparse_solver import (
+    global_assign_sparse,
+    sparse_pod_comm_cost,
+)
 
 __all__ = [
     "RoundTelemetry",
@@ -24,4 +28,6 @@ __all__ = [
     "run_rounds",
     "GlobalSolverConfig",
     "global_assign",
+    "global_assign_sparse",
+    "sparse_pod_comm_cost",
 ]

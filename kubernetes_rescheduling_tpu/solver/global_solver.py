@@ -163,24 +163,24 @@ def _pad_to(x: jax.Array, size: int, fill=0):
 COMPOSITION_BLOCK = 256
 
 
-def sweep_composition(perm_key: jax.Array, SP: int, C: int, n_chunks: int):
+def sweep_composition(
+    perm_key: jax.Array, SP: int, C: int, n_chunks: int, block: int = 1
+):
     """Random per-sweep chunk composition: which services move together.
 
     Returns ``(chunk_ids [n_chunks, C], block_rows [n_chunks, C // B])``
-    where B is the composition granularity: 256 when the padded sizes tile
-    (every auto-chunked large instance), else 1. At B=256 a chunk is a
-    random set of 256-service blocks — the TPU mass kernel gathers W
-    row-blocks directly by id (scalar prefetch), so randomizing composition
-    costs no W permute/copy at all. At B=1 this is exactly the historical
-    full permutation (`jax.random.permutation(key, SP)` — same key stream).
-    Shared by the single-chip and node-sharded solvers so their chunk
-    composition (and hence decisions) stay equal.
+    where B is the composition granularity. Callers request ``block`` > 1
+    ONLY where a kernel constraint demands it: the inline-mass Pallas path
+    gathers W row-blocks by id (scalar prefetch), which is what makes
+    randomized composition free there — so it passes B=256 and accepts the
+    coarser neighborhood structure (services in the same fixed 256-id
+    block always co-chunk; objective parity measured at 10k×1k, round 3).
+    The XLA/materialized fallback and the node-sharded solver have no such
+    constraint and keep the historical full permutation (B=1,
+    `jax.random.permutation(key, SP)` — same key stream), preserving full
+    neighborhood diversity on the paths where it costs nothing.
     """
-    B = (
-        COMPOSITION_BLOCK
-        if C % COMPOSITION_BLOCK == 0 and SP % COMPOSITION_BLOCK == 0
-        else 1
-    )
+    B = block if block > 1 and C % block == 0 and SP % block == 0 else 1
     NB = SP // B
     bp = jax.random.permutation(perm_key, NB)
     if B == 1:
@@ -363,10 +363,13 @@ def global_assign(
         return comm + _balance_terms(cpu_load)
 
     # per-sweep best-seen selection uses the kept-mass form on the bf16 W
-    # copy: comm = (ΣW − Σ W·[same])/2 reads 200 MB instead of 400+ and is
-    # EXACT for integer pair weights (every scenario graph; only fractional
-    # trace weights round). The returned objective is re-evaluated with the
-    # exact f32 form after the scan, so the never-worse gate cannot drift.
+    # copy: comm = (ΣW − Σ W·[same])/2 reads 200 MB instead of 400+. The
+    # bf16 entries are exact only for integer pair weights ≤ 256
+    # (adj·rv_s·rv_t — replica-weighted hubs can exceed that) and the SP²
+    # contraction accumulates in f32, so per-sweep best-seen ranking can
+    # drift near ties; adoption stays safe because the returned objective
+    # is re-evaluated with the exact f32 form after the scan, so the
+    # never-worse gate cannot drift.
 
     def objective_fast(assign, cpu_load):
         same = assign[:, None] == assign[None, :]
@@ -429,6 +432,9 @@ def global_assign(
         # together varies, so repeated sweeps (and parallel restarts with
         # different keys) explore different neighborhoods of the search space.
         perm_key, noise_key = jax.random.split(sweep_key)
+        # B=1: the materialized-X paths gather W rows by arbitrary id, so
+        # the full permutation costs nothing and keeps neighborhood
+        # diversity (block granularity is an inline-mass-kernel constraint)
         chunk_ids, _ = sweep_composition(perm_key, SP, C, n_chunks)
         chunk_keys = jax.random.split(noise_key, n_chunks)
 
@@ -517,7 +523,9 @@ def global_assign(
         sweep_key, temp = xs
         assign, cpu_load, mem_load, best_assign, best_obj = carry
         perm_key, noise_key = jax.random.split(sweep_key)
-        chunk_ids, block_rows = sweep_composition(perm_key, SP, C, n_chunks)
+        chunk_ids, block_rows = sweep_composition(
+            perm_key, SP, C, n_chunks, block=COMPOSITION_BLOCK
+        )
         chunk_keys = jax.random.split(noise_key, n_chunks)
 
         def chunk_step(inner, xs_c):

@@ -1,0 +1,424 @@
+"""Global solver over the sparse (block-local) pair-weight form.
+
+Same optimization as ``solver.global_solver.global_assign`` — chunked
+synchronous best-response over service placements, minimizing exact
+cut cost + load-balance terms, never worse than the input — but the pair
+weights live in ``core.sparsegraph.SparseCommGraph``'s degree-sorted
+block-local storage instead of a dense SP×SP matrix:
+
+- memory is O(S·Ū) (Ū = per-block distinct-neighbor width, ~1k for the
+  power-law meshes) instead of O(S²) — the ~46k-service sizing wall of the
+  dense form becomes headroom (50k services ≈ 0.4 GB vs ≈ 14 GB dense);
+- the per-sweep matmul work drops by the same sparsity factor, because the
+  MXU contraction runs over each block's neighbor set, not over all SP
+  services (ops/sparse_mass.py).
+
+Search structure differences vs the dense solver, both deliberate:
+
+1. **Hub pass.** The degree-sorted *hub blocks* (neighbor sets wider than
+   the regular block width) are re-placed once per sweep as their own
+   chunk, before the randomized chunks — their tile list is static, so the
+   ragged widths cost zero wasted grid steps. Hubs are the highest-impact
+   movers, so they also benefit from seeing the freshest loads.
+2. **Composition granularity.** Chunks are random sets of 256-service
+   blocks (exactly the dense inline-mass path's B=256 composition), and
+   the blocks group services of similar degree rather than arbitrary ids.
+   With ``degree_sort=False`` (identity relabeling) and no hub blocks the
+   decisions are BIT-EQUAL to the dense solver's inline path — the parity
+   test pins this.
+
+The per-sweep best-seen objective here is the *exact* f32 cut-sum over the
+COO edge list (O(E) — cheap enough that the dense path's bf16 kept-mass
+approximation is unnecessary), plus the shared balance terms.
+
+Reference objective being optimized: communicationcost.py:40-45 (the
+relation dict there IS a sparse adjacency — this module just stores it the
+way the TPU wants to eat it).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubernetes_rescheduling_tpu.core.sparsegraph import (
+    BLOCK_R,
+    SparseCommGraph,
+    sparse_pair_comm_cost,
+)
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+from kubernetes_rescheduling_tpu.objectives.metrics import load_std
+from kubernetes_rescheduling_tpu.ops.fused_admission import (
+    fused_score_admission,
+    reference_score_admission,
+)
+from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+    hub_neighbor_mass,
+    hub_tile_arrays,
+    reference_hub_mass,
+    reference_sparse_mass,
+    sparse_neighbor_mass,
+)
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    _pad_to,
+    _service_aggregates,
+    auto_chunk,
+    pct_balance_terms,
+)
+
+
+def sparse_pod_comm_cost(
+    state: ClusterState, sgraph: SparseCommGraph, *, edge_chunk: int = 16384
+) -> jax.Array:
+    """Pod-level communication cost of the ACTUAL placement (replicas may
+    be split across nodes — not representable as a service assignment).
+
+    Per sorted-space edge (s, t, w): cross-node pod pairs =
+    ``rv_s·rv_t − Σ_n cnt[s,n]·cnt[t,n]``, subtracted PER EDGE (values are
+    small, so f32 error stays per-edge-tiny — never the global ΣW
+    subtraction whose ulp error could flip the adopt gate). Halved because
+    the COO list carries each undirected edge twice. Scans the edge list
+    in chunks to bound the gather footprint at scale."""
+    SP = sgraph.sp
+    N = state.num_nodes
+    pod_slot = sgraph.inv[
+        jnp.clip(state.pod_service, 0, sgraph.num_services - 1)
+    ]
+    slot = jnp.where(state.pod_valid, pod_slot, SP)
+    node = jnp.clip(jnp.where(state.pod_valid, state.pod_node, N), -1, N)
+    cnt = (
+        jnp.zeros((SP + 1, N + 1), jnp.float32)
+        .at[slot, node]
+        .add(1.0)[:SP, :N]
+    )
+    rv = jnp.sum(cnt, axis=1)
+
+    E2 = sgraph.edges_src.shape[0]
+    EC = min(edge_chunk, max(E2, 1))
+    n_ec = -(-E2 // EC)
+    src = _pad_to(sgraph.edges_src, n_ec * EC, 0).reshape(n_ec, EC)
+    dst = _pad_to(sgraph.edges_dst, n_ec * EC, 0).reshape(n_ec, EC)
+    w = _pad_to(sgraph.edges_w, n_ec * EC, 0.0).reshape(n_ec, EC)
+
+    def step(acc, xs):
+        s, t, we = xs
+        kept = jnp.sum(cnt[s] * cnt[t], axis=1)
+        cross = jnp.maximum(rv[s] * rv[t] - kept, 0.0)
+        return acc + jnp.sum(we * cross), None
+
+    total, _ = lax.scan(step, jnp.float32(0.0), (src, dst, w))
+    return 0.5 * total
+
+
+@partial(jax.jit, static_argnames=("config",))
+def global_assign_sparse(
+    state: ClusterState,
+    sgraph: SparseCommGraph,
+    key: jax.Array,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """Sparse twin of ``global_assign`` — same contract: returns the new
+    state and solve info; the result never degrades the true objective of
+    the input placement."""
+    if not config.capacity_frac > 0:
+        raise ValueError(
+            f"capacity_frac must be > 0, got {config.capacity_frac}"
+        )
+    ow = config.overload_weight if config.enforce_capacity else 0.0
+    S = sgraph.num_services
+    N = state.num_nodes
+    SP = sgraph.sp
+    NB = sgraph.num_blocks
+    hub_blocks = sgraph.hub_blocks
+    regular = sgraph.regular_blocks
+    NHB = len(hub_blocks)
+    NBR = len(regular)
+    if sgraph.weight_bytes() > config.max_weight_bytes:
+        raise ValueError(
+            f"sparse pair weights need {sgraph.weight_bytes() / 2**30:.2f} "
+            f"GiB — over max_weight_bytes; the graph is too dense for the "
+            "sparse form (use the dense solver)."
+        )
+
+    # chunk = KB 256-service blocks of the NBR regular blocks; dummy
+    # (all-zero, all-invalid) blocks pad the last chunk
+    C = min(auto_chunk(S, config.chunk_size), S)
+    KB = max(1, C // BLOCK_R)
+    n_chunks = max(1, -(-NBR // KB)) if NBR else 0
+    ndummy = n_chunks * KB - NBR
+    SPX = SP + ndummy * BLOCK_R  # service-array size incl. dummy blocks
+
+    # ---- sorted-space per-service arrays ----
+    replicas, svc_cpu, svc_mem, cur_node, has_pods = _service_aggregates(
+        state, S
+    )
+    pclip = jnp.clip(sgraph.perm, 0, S - 1)
+    ok = sgraph.perm < S
+
+    def sort_pad(x, fill=0.0):
+        return _pad_to(
+            jnp.where(ok, x[pclip], fill), SPX, fill
+        )
+
+    svc_valid = _pad_to(
+        ok & has_pods[pclip] & sgraph.service_valid, SPX, False
+    )
+    svc_cpu_s = sort_pad(svc_cpu) * svc_valid
+    svc_mem_s = sort_pad(svc_mem) * svc_valid
+    cur_s = jnp.where(svc_valid, sort_pad(cur_node, -1), -1)
+    rv_s = sort_pad(replicas) * svc_valid
+    # neighbor-column replica factor (0 on padding columns — the mass
+    # kernels rely on this as the padding mask)
+    rvu = jnp.where(
+        sgraph.u_ids < SP,
+        rv_s[jnp.clip(sgraph.u_ids, 0, SPX - 1)],
+        0.0,
+    )
+
+    mm_dtype = jnp.dtype(config.matmul_dtype)
+    w_mm = sgraph.w_local.astype(mm_dtype)
+
+    cpu_cap = jnp.where(state.node_valid, state.node_cpu_cap, 0.0)
+    mem_cap_raw = jnp.where(state.node_valid, state.node_mem_cap, 0.0)
+    mem_cap = (
+        jnp.where(mem_cap_raw > 0, mem_cap_raw, jnp.inf) * config.capacity_frac
+    )
+    cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0) * config.capacity_frac
+
+    assign0 = jnp.where(svc_valid, jnp.clip(cur_s, 0, N - 1), 0)
+
+    def loads(assign):
+        a = jnp.where(svc_valid, assign, N)
+        cpu = (
+            jnp.zeros((N + 1,), jnp.float32).at[a].add(svc_cpu_s)[:N]
+        )
+        mem = (
+            jnp.zeros((N + 1,), jnp.float32).at[a].add(svc_mem_s)[:N]
+        )
+        return state.node_base_cpu + cpu, state.node_base_mem + mem
+
+    def _balance_terms(cpu_load):
+        return pct_balance_terms(
+            cpu_load, cap, state.node_valid, config.balance_weight, ow
+        )
+
+    def objective(assign, cpu_load):
+        """EXACT objective — the sparse cut-sum is O(E), cheap enough to
+        be both the per-sweep best-seen ranking AND the adopt gate (no
+        bf16 fast-form needed, unlike the dense path)."""
+        comm = sparse_pair_comm_cost(sgraph, assign[:SP], rv_s[:SP])
+        return comm + _balance_terms(cpu_load)
+
+    # ---- lowering selection (mirrors the dense solver) ----
+    fused_interpret = config.fused_epilogue == "interpret"
+    on_tpu = jax.default_backend() == "tpu"
+    use_kernels = on_tpu or fused_interpret
+    use_fused = config.fused_epilogue in ("on", "interpret") or (
+        config.fused_epilogue == "auto" and on_tpu and C >= 128 and N >= 128
+    )
+
+    toff_ext = jnp.asarray(
+        np.asarray(
+            list(sgraph.block_toff) + [sgraph.zero_toff] * ndummy,
+            dtype=np.int32,
+        )
+    )
+    reg_ext = jnp.asarray(
+        np.asarray(
+            list(regular) + [NB + d for d in range(ndummy)], dtype=np.int32
+        )
+    )
+    # hub blocks are processed in chunk-sized groups (≤ KB blocks each):
+    # the [BC, C]-tile admission race is quadratic in the chunk width and
+    # a single all-hubs chunk blows the VMEM scoped limit past ~8 blocks
+    hub_groups = []
+    for g in range(0, NHB, KB):
+        blocks_g = hub_blocks[g : g + KB]
+        ids_g = jnp.asarray(
+            np.concatenate(
+                [
+                    np.arange(BLOCK_R, dtype=np.int32) + b * BLOCK_R
+                    for b in blocks_g
+                ]
+            )
+        )
+        hub_groups.append((blocks_g, ids_g, hub_tile_arrays(sgraph, blocks_g)))
+
+    def chunk_mass(assign, blocks, ids):
+        tgt_u = assign[jnp.clip(sgraph.u_ids, 0, SPX - 1)]
+        if use_kernels:
+            raw = sparse_neighbor_mass(
+                w_mm, tgt_u, rvu, blocks, toff_ext,
+                num_nodes=N, bu=sgraph.bu, reg_tiles=sgraph.reg_tiles,
+                interpret=fused_interpret or not on_tpu,
+            )
+        else:
+            raw = reference_sparse_mass(
+                w_mm, tgt_u, rvu, blocks, toff_ext,
+                num_nodes=N, bu=sgraph.bu, reg_tiles=sgraph.reg_tiles,
+            )
+        return raw * rv_s[ids][:, None]
+
+    def hub_mass(assign, group):
+        blocks_g, ids_g, (h_col, h_out, h_first) = group
+        tgt_u = assign[jnp.clip(sgraph.u_ids, 0, SPX - 1)]
+        if use_kernels:
+            raw = hub_neighbor_mass(
+                w_mm, tgt_u, rvu, h_col, h_out, h_first,
+                num_nodes=N, num_hub_blocks=len(blocks_g), bu=sgraph.bu,
+                interpret=fused_interpret or not on_tpu,
+            )
+        else:
+            raw = reference_hub_mass(
+                sgraph, w_mm, tgt_u, rvu, num_nodes=N, blocks=blocks_g
+            )
+        return raw * rv_s[ids_g][:, None]
+
+    def place(inner, ids, M, chunk_key, temp):
+        """Score → argmax → admission → commit for one id set (shared by
+        the hub pass and the randomized chunks)."""
+        assign, cpu_load, mem_load = inner
+        valid_c = svc_valid[ids]
+        c_cpu = svc_cpu_s[ids]
+        c_mem = svc_mem_s[ids]
+        cur = assign[ids]
+        if use_fused:
+            seed = jax.random.randint(chunk_key, (), 0, 2**31 - 1)
+            new_node, admitted, d_cpu, d_mem = fused_score_admission(
+                M, cur, c_cpu, c_mem, valid_c,
+                cpu_load, mem_load, cap, mem_cap, state.node_valid,
+                config.balance_weight, temp, seed,
+                overload_weight=ow,
+                enforce_capacity=config.enforce_capacity,
+                use_noise=config.noise_temp > 0 and not fused_interpret,
+                interpret=fused_interpret,
+                emit_x_rows=False,
+            )
+            return (
+                (
+                    assign.at[ids].set(new_node),
+                    cpu_load + d_cpu,
+                    mem_load + d_mem,
+                ),
+                jnp.sum(admitted),
+            )
+        noise = (
+            temp * jax.random.gumbel(chunk_key, M.shape)
+            if config.noise_temp > 0
+            else None
+        )
+        new_node, admitted = reference_score_admission(
+            M, cur, c_cpu, c_mem, valid_c,
+            cpu_load, mem_load, cap, mem_cap, state.node_valid,
+            config.balance_weight, noise,
+            overload_weight=ow,
+            enforce_capacity=config.enforce_capacity,
+        )
+        d_cpu = jnp.where(admitted, c_cpu, 0.0)
+        d_mem = jnp.where(admitted, c_mem, 0.0)
+        cpu_load = cpu_load.at[new_node].add(d_cpu).at[cur].add(-d_cpu)
+        mem_load = mem_load.at[new_node].add(d_mem).at[cur].add(-d_mem)
+        return (
+            (assign.at[ids].set(new_node), cpu_load, mem_load),
+            jnp.sum(admitted),
+        )
+
+    def sweep(carry, xs):
+        sweep_key, temp = xs
+        assign, cpu_load, mem_load, best_assign, best_obj = carry
+        perm_key, noise_key = jax.random.split(sweep_key)
+        # key-split structure matches the dense inline path when NHB == 0
+        # (the parity test relies on identical chunk_keys)
+        hub_moves = jnp.int32(0)
+        if hub_groups:
+            keys = jax.random.split(noise_key, n_chunks + len(hub_groups))
+            chunk_keys = keys[:n_chunks]
+            inner = (assign, cpu_load, mem_load)
+            # hubs first, freshest loads; each group re-reads the assign
+            # vector, so later groups see earlier groups' moves
+            for g, group in enumerate(hub_groups):
+                assign = inner[0]
+                M = hub_mass(assign, group)
+                inner, g_moves = place(
+                    inner, group[1], M, keys[n_chunks + g], temp
+                )
+                hub_moves = hub_moves + g_moves
+            assign, cpu_load, mem_load = inner
+        else:
+            chunk_keys = jax.random.split(noise_key, n_chunks)
+        bp = jax.random.permutation(perm_key, n_chunks * KB)
+        chunk_blocks = reg_ext[bp].reshape(n_chunks, KB)
+        chunk_ids = (
+            chunk_blocks[:, :, None] * BLOCK_R
+            + jnp.arange(BLOCK_R, dtype=jnp.int32)[None, None, :]
+        ).reshape(n_chunks, KB * BLOCK_R)
+
+        def chunk_step(inner, xs_c):
+            blocks, ids, chunk_key = xs_c
+            assign = inner[0]
+            M = chunk_mass(assign, blocks, ids)
+            return place(inner, ids, M, chunk_key, temp)
+
+        (assign, _, _), moves = lax.scan(
+            chunk_step, (assign, cpu_load, mem_load),
+            (chunk_blocks, chunk_ids, chunk_keys),
+        )
+        # refresh carried loads each sweep boundary — bounds incremental
+        # f32 drift to one sweep, matching the dense paths
+        cpu_fresh, mem_fresh = loads(assign)
+        obj = objective(assign, cpu_fresh)
+        better = obj < best_obj
+        best_assign = jnp.where(better, assign, best_assign)
+        best_obj = jnp.where(better, obj, best_obj)
+        return (
+            (assign, cpu_fresh, mem_fresh, best_assign, best_obj),
+            jnp.sum(moves) + hub_moves,
+        )
+
+    # true objective of the INPUT placement (replicas may be split across
+    # nodes); the adopt gate compares against this, so "never worse than
+    # the input" holds even when the first-pod collapse of assign0 is worse
+    pct_true0 = jnp.where(
+        state.node_valid, state.node_cpu_used() / cap * 100.0, 0.0
+    )
+    obj_true0 = (
+        sparse_pod_comm_cost(state, sgraph)
+        + config.balance_weight * (load_std(state) / config.capacity_frac)
+        + ow * jnp.sum(jnp.maximum(pct_true0 - 100.0, 0.0))
+    )
+    cpu0, mem0 = loads(assign0)
+    obj0 = objective(assign0, cpu0)
+    keys = jax.random.split(key, config.sweeps)
+    temps = config.noise_temp * (
+        1.0
+        - jnp.arange(config.sweeps, dtype=jnp.float32)
+        / max(config.sweeps - 1, 1)
+    )
+    (_, _, _, best_assign, best_obj), moves_per_sweep = lax.scan(
+        sweep, (assign0, cpu0, mem0, assign0, obj0), (keys, temps)
+    )
+
+    improved = best_obj < obj_true0
+    pod_slot = jnp.clip(
+        sgraph.inv[jnp.clip(state.pod_service, 0, S - 1)], 0, SPX - 1
+    )
+    new_pod_node = jnp.where(
+        improved & state.pod_valid, best_assign[pod_slot], state.pod_node
+    )
+    new_state = state.replace(pod_node=new_pod_node)
+    info = {
+        "objective_before": obj_true0,
+        "objective_after": jnp.minimum(best_obj, obj_true0),
+        "improved": improved,
+        "moves_per_sweep": moves_per_sweep,
+        "communication_cost": sparse_pod_comm_cost(new_state, sgraph),
+        "load_std": load_std(new_state),
+        "hub_pass": jnp.asarray(NHB > 0),
+    }
+    return new_state, info

@@ -1,0 +1,101 @@
+"""Optimality-gap oracles: brute force and MILP agree with each other,
+and the global solver's gap against the TRUE optimum is pinned."""
+
+import numpy as np
+import jax
+import pytest
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.oracle.optimum import (
+    brute_force_optimum,
+    milp_optimum,
+)
+from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+from kubernetes_rescheduling_tpu.solver.global_solver import exact_comm_cost
+
+
+def _tiny_instance(S, N, seed, cap_m=1e9):
+    rng = np.random.default_rng(seed)
+    rel = {
+        f"s{i}": [f"s{j}" for j in range(S) if j != i and rng.random() < 0.5]
+        for i in range(S)
+    }
+    graph = CommGraph.from_relation(rel, names=[f"s{i}" for i in range(S)])
+    state = ClusterState.build(
+        node_names=[f"n{i}" for i in range(N)],
+        node_cpu_cap=[cap_m] * N,
+        node_mem_cap=[2**33] * N,
+        pod_services=list(range(S)),
+        pod_nodes=rng.integers(0, N, S).tolist(),
+        pod_cpu=[100.0] * S,
+        pod_mem=[0.0] * S,
+        pod_names=[f"s{i}-0" for i in range(S)],
+    )
+    return state, graph
+
+
+def test_brute_force_matches_milp_on_comm():
+    for seed in range(4):
+        state, graph = _tiny_instance(7, 3, seed)
+        _, bf = brute_force_optimum(
+            state, graph, balance_weight=0.0, overload_weight=0.0
+        )
+        milp, proven = milp_optimum(state, graph)
+        assert proven
+        assert bf == pytest.approx(milp, abs=1e-6)
+
+
+def test_brute_force_capacity_binding():
+    # 6 services x 100m, nodes cap 250m -> min 3 nodes needed; the
+    # unconstrained optimum (all on one node, cut 0) must be excluded
+    state, graph = _tiny_instance(6, 3, seed=1, cap_m=250.0)
+    a, obj = brute_force_optimum(
+        state, graph, balance_weight=0.0, overload_weight=0.0
+    )
+    loads = np.bincount(a, weights=np.full(6, 100.0), minlength=3)
+    assert (loads <= 250.0).all()
+    assert obj > 0.0
+    milp, proven = milp_optimum(state, graph)
+    assert proven
+    assert obj == pytest.approx(milp, abs=1e-6)
+
+
+def test_solver_gap_small_instances():
+    """Regression pin: across 10 tiny instances the solver's comm cost is
+    within 10% of the true optimum in aggregate (and never worse than the
+    input, which is separately guaranteed). Measured at round 4: the
+    default config finds the exact optimum on most seeds."""
+    total_solver = 0.0
+    total_opt = 0.0
+    exact_hits = 0
+    seeds = range(10)
+    for seed in seeds:
+        state, graph = _tiny_instance(8, 3, seed, cap_m=350.0)
+        cfg = GlobalSolverConfig(sweeps=9, balance_weight=0.0)
+        new_state, info = global_assign(
+            state, graph, jax.random.PRNGKey(seed), cfg
+        )
+        # service-level comm of the solver result
+        S = graph.num_services
+        svc = np.asarray(new_state.pod_service)
+        node = np.asarray(new_state.pod_node)
+        assign = np.zeros(S, dtype=np.int64)
+        for i in range(S):
+            assign[svc[i]] = node[i]
+        rv = np.ones(S, dtype=np.float32)
+        solver_cost = float(
+            exact_comm_cost(
+                graph.adj[:S, :S], jax.numpy.asarray(rv),
+                jax.numpy.asarray(assign),
+            )
+        )
+        _, opt = brute_force_optimum(
+            state, graph, balance_weight=0.0, overload_weight=0.0,
+        )
+        assert solver_cost >= opt - 1e-6  # sanity: oracle really is a bound
+        total_solver += solver_cost
+        total_opt += opt
+        if solver_cost <= opt + 1e-6:
+            exact_hits += 1
+    assert total_solver <= total_opt * 1.10
+    assert exact_hits >= 5

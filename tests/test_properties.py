@@ -243,15 +243,19 @@ def test_exact_comm_cost_matches_bruteforce(s, n, seed):
     seed=st.integers(min_value=0, max_value=2**31 - 1),
 )
 def test_sweep_composition_is_partition(c, n_chunks, seed):
-    """Every composition (B=1 full permutation AND B=256 block-granular)
-    partitions [0, SP) exactly once per sweep."""
+    """Every composition (B=1 full permutation AND B=256 block-granular —
+    the latter only engages when a caller requests it, i.e. the
+    inline-mass path) partitions [0, SP) exactly once per sweep."""
     from kubernetes_rescheduling_tpu.solver.global_solver import sweep_composition
 
     sp = c * n_chunks
-    ids, _ = sweep_composition(jax.random.PRNGKey(seed), sp, c, n_chunks)
-    assert ids.shape == (n_chunks, c)
-    flat = np.asarray(ids).reshape(-1)
-    assert sorted(flat.tolist()) == list(range(sp))
+    for block in (1, 256):
+        ids, _ = sweep_composition(
+            jax.random.PRNGKey(seed), sp, c, n_chunks, block=block
+        )
+        assert ids.shape == (n_chunks, c)
+        flat = np.asarray(ids).reshape(-1)
+        assert sorted(flat.tolist()) == list(range(sp))
 
 
 @settings(max_examples=20, deadline=None)

@@ -1,0 +1,297 @@
+"""Sparse pair-weight path: graph storage round-trips, mass-kernel parity
+against the dense matmul, and solver parity / invariants vs the dense
+solver (which is the reference implementation)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_rescheduling_tpu.core import sparsegraph
+from kubernetes_rescheduling_tpu.core.sparsegraph import (
+    BLOCK_R,
+    sparse_pair_comm_cost,
+)
+from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+from kubernetes_rescheduling_tpu.objectives import communication_cost
+from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+    hub_neighbor_mass,
+    hub_tile_arrays,
+    reference_hub_mass,
+    reference_sparse_mass,
+    sparse_neighbor_mass,
+)
+from kubernetes_rescheduling_tpu.solver import (
+    GlobalSolverConfig,
+    global_assign,
+    global_assign_sparse,
+)
+from kubernetes_rescheduling_tpu.solver.global_solver import exact_comm_cost
+from kubernetes_rescheduling_tpu.solver.sparse_solver import sparse_pod_comm_cost
+
+
+def _random_graph(S, mean_degree, seed, weights=False):
+    rng = np.random.default_rng(seed)
+    E = int(S * mean_degree / 2)
+    src = rng.integers(0, S, size=E)
+    dst = rng.integers(0, S, size=E)
+    w = rng.integers(1, 5, size=E).astype(np.float64) if weights else np.ones(E)
+    return src, dst, w
+
+
+# ---------------------------------------------------------------- storage
+
+
+def test_round_trip_dense():
+    scn = synthetic_scenario(n_pods=300, n_nodes=8, powerlaw=True, seed=1)
+    sg = sparsegraph.from_comm_graph(scn.graph)
+    dense = sg.to_dense()
+    S = sg.num_services
+    np.testing.assert_array_equal(
+        np.asarray(dense.adj)[:S, :S], np.asarray(scn.graph.adj)[:S, :S]
+    )
+
+
+def test_workmodel_builder_matches_dense_route():
+    wm = mubench_workmodel_c()
+    via_wm = sparsegraph.from_workmodel(wm)
+    via_dense = sparsegraph.from_comm_graph(wm.comm_graph())
+    np.testing.assert_array_equal(
+        np.asarray(via_wm.to_dense().adj), np.asarray(via_dense.to_dense().adj)
+    )
+
+
+def test_perm_is_degree_sorted_permutation():
+    src, dst, w = _random_graph(700, 4.0, seed=2)
+    sg = sparsegraph.from_edges(src, dst, w, 700)
+    perm = np.asarray(sg.perm)
+    S = sg.num_services
+    assert sorted(perm[perm < S].tolist()) == list(range(S))
+    inv = np.asarray(sg.inv)
+    np.testing.assert_array_equal(perm[inv], np.arange(S))
+    # degrees are non-increasing along sorted slots
+    adj = np.asarray(sg.to_dense().adj) > 0
+    deg = adj.sum(1)
+    sorted_deg = deg[perm[perm < S]]
+    assert (np.diff(sorted_deg) <= 0).all()
+
+
+def test_star_graph_becomes_hub_block():
+    # one service talks to 300 others: neighbor set exceeds u_reg=128
+    S = 512
+    src = np.zeros(300, dtype=np.int64)
+    dst = np.arange(1, 301, dtype=np.int64)
+    sg = sparsegraph.from_edges(src, dst, np.ones(300), S, bu=128, reg_tiles=1)
+    assert len(sg.hub_blocks) == 1
+    # the hub (degree-300 service 0) landed in the hub block
+    assert np.asarray(sg.perm)[sg.hub_blocks[0] * BLOCK_R] == 0
+    assert len(sg.regular_blocks) == sg.num_blocks - 1
+
+
+def test_sparse_comm_cost_matches_dense_exact():
+    scn = synthetic_scenario(n_pods=200, n_nodes=10, powerlaw=True, seed=3)
+    sg = sparsegraph.from_comm_graph(scn.graph)
+    S = sg.num_services
+    rng = np.random.default_rng(0)
+    for trial in range(3):
+        assign_orig = jnp.asarray(rng.integers(0, 10, size=S), jnp.int32)
+        rv_orig = jnp.asarray(rng.integers(1, 4, size=S), jnp.float32)
+        dense_cost = exact_comm_cost(
+            scn.graph.adj[:S, :S], rv_orig, assign_orig
+        )
+        # map to sorted space
+        perm = jnp.clip(sg.perm, 0, S - 1)
+        sparse_cost = sparse_pair_comm_cost(
+            sg, assign_orig[perm], rv_orig[perm] * (sg.perm < S)
+        )
+        assert float(dense_cost) == pytest.approx(float(sparse_cost), rel=1e-6)
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _sorted_dense_W(sg, rv_sorted):
+    """Dense pair-weight matrix in sorted space, from the COO list."""
+    SP = sg.sp
+    W = np.zeros((SP, SP), dtype=np.float64)
+    src = np.asarray(sg.edges_src)
+    dst = np.asarray(sg.edges_dst)
+    w = np.asarray(sg.edges_w)
+    W[src, dst] = w
+    return W * rv_sorted[:, None] * rv_sorted[None, :]
+
+
+def test_sparse_mass_kernel_matches_dense_matmul():
+    src, dst, w = _random_graph(600, 4.0, seed=5, weights=True)
+    sg = sparsegraph.from_edges(src, dst, w, 600, bu=128, reg_tiles=8)
+    assert not sg.hub_blocks  # wide regular blocks: everything regular
+    SP = sg.sp
+    N = 16
+    rng = np.random.default_rng(1)
+    assign = rng.integers(0, N, size=SP).astype(np.int32)
+    rv = rng.integers(1, 3, size=SP).astype(np.float32)
+    W = _sorted_dense_W(sg, rv)
+    blocks = jnp.asarray([2, 0, 1], jnp.int32)
+    ids = (
+        np.asarray(blocks)[:, None] * BLOCK_R + np.arange(BLOCK_R)[None, :]
+    ).reshape(-1)
+    # expected: rows of the dense sorted-space W times one-hot occupancy
+    X = np.zeros((SP, N))
+    X[np.arange(SP), assign] = 1.0
+    expected = W[ids] @ X
+
+    tgt_u = jnp.asarray(assign)[jnp.clip(sg.u_ids, 0, SP - 1)]
+    rvu = jnp.where(
+        sg.u_ids < SP, jnp.asarray(rv)[jnp.clip(sg.u_ids, 0, SP - 1)], 0.0
+    )
+    toff = jnp.asarray(sg.block_toff, jnp.int32)
+    kw = dict(num_nodes=N, bu=sg.bu, reg_tiles=sg.reg_tiles)
+    got_k = sparse_neighbor_mass(
+        sg.w_local, tgt_u, rvu, blocks, toff, interpret=True, **kw
+    )
+    got_x = reference_sparse_mass(sg.w_local, tgt_u, rvu, blocks, toff, **kw)
+    row_rv = rv[ids][:, None]
+    np.testing.assert_allclose(np.asarray(got_k) * row_rv, expected, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_x) * row_rv, expected, rtol=1e-5)
+    # kernel and XLA twin agree bit-for-bit (same f32 operation order)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(got_x))
+
+
+def test_hub_mass_kernel_matches_dense_matmul():
+    # star + random background → one hub block with ragged width
+    S = 600
+    rng = np.random.default_rng(7)
+    star_src = np.zeros(260, dtype=np.int64)
+    star_dst = np.arange(1, 261, dtype=np.int64)
+    bg_src, bg_dst, _ = _random_graph(S, 3.0, seed=8)
+    src = np.concatenate([star_src, bg_src])
+    dst = np.concatenate([star_dst, bg_dst])
+    sg = sparsegraph.from_edges(src, dst, np.ones(len(src)), S, bu=128, reg_tiles=1)
+    assert sg.hub_blocks
+    SP = sg.sp
+    N = 16
+    assign = rng.integers(0, N, size=SP).astype(np.int32)
+    rv = np.ones(SP, dtype=np.float32)
+    W = _sorted_dense_W(sg, rv)
+    hub_ids = np.concatenate(
+        [np.arange(BLOCK_R) + b * BLOCK_R for b in sg.hub_blocks]
+    )
+    X = np.zeros((SP, N))
+    X[np.arange(SP), assign] = 1.0
+    expected = W[hub_ids] @ X
+
+    tgt_u = jnp.asarray(assign)[jnp.clip(sg.u_ids, 0, SP - 1)]
+    rvu = jnp.where(
+        sg.u_ids < SP, jnp.asarray(rv)[jnp.clip(sg.u_ids, 0, SP - 1)], 0.0
+    )
+    h_col, h_out, h_first = hub_tile_arrays(sg)
+    got_k = hub_neighbor_mass(
+        sg.w_local, tgt_u, rvu, h_col, h_out, h_first,
+        num_nodes=N, num_hub_blocks=len(sg.hub_blocks), bu=sg.bu,
+        interpret=True,
+    )
+    got_x = reference_hub_mass(sg, sg.w_local, tgt_u, rvu, num_nodes=N)
+    np.testing.assert_allclose(np.asarray(got_k), expected, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(got_x))
+
+
+# ---------------------------------------------------------------- solver
+
+
+def test_sparse_pod_comm_cost_matches_dense_metric():
+    scn = synthetic_scenario(
+        n_pods=240, n_nodes=8, powerlaw=True, seed=4, replicas=2
+    )
+    sg = sparsegraph.from_comm_graph(scn.graph)
+    dense = float(communication_cost(scn.state, scn.graph))
+    sparse = float(sparse_pod_comm_cost(scn.state, sg))
+    assert dense == pytest.approx(sparse, rel=1e-6)
+
+
+def test_sparse_solver_never_worse_and_improves():
+    scn = synthetic_scenario(n_pods=512, n_nodes=8, powerlaw=True, seed=6)
+    sg = sparsegraph.from_comm_graph(scn.graph)
+    before = float(communication_cost(scn.state, scn.graph))
+    new_state, info = global_assign_sparse(
+        scn.state, sg, jax.random.PRNGKey(0), GlobalSolverConfig(sweeps=4)
+    )
+    after = float(communication_cost(new_state, scn.graph))
+    assert after <= before
+    assert after < before  # plenty of slack on this instance
+    assert float(info["objective_after"]) <= float(info["objective_before"]) + 1e-4
+
+
+def test_sparse_solver_with_hub_blocks_never_worse():
+    # star-heavy graph → hub pass engaged
+    S = 512
+    rng = np.random.default_rng(9)
+    star_src = np.zeros(300, dtype=np.int64)
+    star_dst = np.arange(1, 301, dtype=np.int64)
+    bg_src, bg_dst, _ = _random_graph(S, 3.0, seed=10)
+    sg = sparsegraph.from_edges(
+        np.concatenate([star_src, bg_src]),
+        np.concatenate([star_dst, bg_dst]),
+        np.ones(300 + len(bg_src)),
+        S, bu=128, reg_tiles=1,
+    )
+    assert sg.hub_blocks
+    scn = synthetic_scenario(n_pods=512, n_nodes=8, seed=6)
+    dense = sg.to_dense()
+    before = float(communication_cost(scn.state, dense))
+    new_state, info = global_assign_sparse(
+        scn.state, sg, jax.random.PRNGKey(1), GlobalSolverConfig(sweeps=4)
+    )
+    assert bool(info["hub_pass"])
+    after = float(communication_cost(new_state, dense))
+    assert after <= before
+
+
+def test_sparse_solver_bit_parity_with_dense_inline_path():
+    """With identity relabeling, no hub blocks, f32 matmuls and integer
+    weights, the sparse solver's decisions are BIT-EQUAL to the dense
+    solver's inline-mass path: same chunk composition (same key stream),
+    same M (exact integer arithmetic), same score/admission kernels."""
+    scn = synthetic_scenario(n_pods=1024, n_nodes=8, powerlaw=True, seed=12)
+    sg = sparsegraph.from_comm_graph(
+        scn.graph, reg_tiles=4, degree_sort=False
+    )
+    assert not sg.hub_blocks
+    # identity relabeling
+    np.testing.assert_array_equal(
+        np.asarray(sg.perm)[: sg.num_services], np.arange(sg.num_services)
+    )
+    cfg = GlobalSolverConfig(
+        sweeps=3,
+        chunk_size=256,
+        matmul_dtype="float32",
+        fused_epilogue="interpret",
+    )
+    dense_state, dense_info = global_assign(
+        scn.state, scn.graph, jax.random.PRNGKey(3), cfg
+    )
+    assert bool(dense_info["inline_mass"])  # the path we claim parity with
+    sparse_state, sparse_info = global_assign_sparse(
+        scn.state, sg, jax.random.PRNGKey(3), cfg
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dense_state.pod_node), np.asarray(sparse_state.pod_node)
+    )
+    assert float(dense_info["objective_after"]) == pytest.approx(
+        float(sparse_info["objective_after"]), rel=1e-6
+    )
+
+
+def test_sparse_solver_respects_capacity():
+    from kubernetes_rescheduling_tpu.objectives import capacity_violation
+
+    scn = synthetic_scenario(
+        n_pods=512, n_nodes=8, seed=5, node_cpu_cap_m=8000.0,
+        imbalance_frac=0.5, powerlaw=True,
+    )
+    sg = sparsegraph.from_comm_graph(scn.graph)
+    v_before = float(capacity_violation(scn.state))
+    new_state, _ = global_assign_sparse(
+        scn.state, sg, jax.random.PRNGKey(1), GlobalSolverConfig(sweeps=4)
+    )
+    assert float(capacity_violation(new_state)) <= v_before + 1e-3

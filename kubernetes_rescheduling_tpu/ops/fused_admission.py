@@ -50,6 +50,9 @@ def _score_kernel(
     seed_ref,       # SMEM (1, 1) i32
     m_ref,          # VMEM (BC, N) f32 — neighbor mass for this C tile
     cur_ref,        # VMEM (BC, 1) i32
+    home_ref,       # VMEM (BC, 1) i32 — ROUND-START node (move-cost anchor)
+    pen_ref,        # VMEM (BC, 1) f32 — move cost (comm units per restart
+                    # × restarts) charged at every node except home
     c_cpu_ref,      # VMEM (BC, 1) f32
     c_mem_ref,      # VMEM (BC, 1) f32
     valid_ref,      # VMEM (BC, 1) i32
@@ -66,6 +69,7 @@ def _score_kernel(
     *,
     enforce_capacity: bool,
     use_noise: bool,
+    use_move_pen: bool,
 ):
     bc, n = m_ref.shape
     lam = lam_ref[0, 0]
@@ -82,6 +86,13 @@ def _score_kernel(
         - lam * proj_pct
         - ow_ref[0, 0] * jnp.maximum(proj_pct - 100.0, 0.0)
     )
+    if use_move_pen:
+        # disruption cost: residency anywhere but the round-start node
+        # costs pen (staying moved keeps paying; moving back recovers it),
+        # so a relocation must beat home by more than its restart cost.
+        # Static flag (like use_noise): zero-cost callers keep the exact
+        # pre-pricing kernel.
+        score = score - jnp.where(col == home_ref[:], 0.0, pen_ref[:])
     if use_noise:
         pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
         bits = pltpu.prng_random_bits((bc, n))
@@ -244,6 +255,8 @@ def fused_score_admission(
     temp,         # f32 scalar: gumbel temperature
     seed,         # i32 scalar: PRNG seed for this chunk
     overload_weight=0.0,  # f32 scalar: repulsion per % beyond the budget
+    home=None,    # i32[C] round-start node (move-cost anchor; default cur)
+    move_pen=None,  # f32[C] disruption cost charged off-home (default 0)
     *,
     enforce_capacity: bool,
     use_noise: bool,
@@ -262,6 +275,11 @@ def fused_score_admission(
     C, N = M.shape
     bc = min(block_c, C)
     grid = (pl.cdiv(C, bc),)
+    use_move_pen = move_pen is not None
+    if home is None:
+        home = cur
+    if move_pen is None:
+        move_pen = jnp.zeros((C,), jnp.float32)
 
     col_i32 = lambda x: x.reshape(C, 1).astype(jnp.int32)
     col_f32 = lambda x: x.reshape(C, 1).astype(jnp.float32)
@@ -277,13 +295,14 @@ def fused_score_admission(
 
     prop, gain, wants, slack_cpu, slack_mem = pl.pallas_call(
         functools.partial(
-            _score_kernel, enforce_capacity=enforce_capacity, use_noise=use_noise
+            _score_kernel, enforce_capacity=enforce_capacity,
+            use_noise=use_noise, use_move_pen=use_move_pen,
         ),
         grid=grid,
         in_specs=[
             smem, smem, smem, smem,
             pl.BlockSpec((bc, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            cvec, cvec, cvec, cvec,
+            cvec, cvec, cvec, cvec, cvec, cvec,
             nvec, nvec, nvec, nvec, nvec,
         ],
         out_specs=[cvec, cvec, cvec, cvec, cvec],
@@ -296,6 +315,8 @@ def fused_score_admission(
         jnp.asarray(seed, jnp.int32).reshape(1, 1),
         M.astype(jnp.float32),
         col_i32(cur),
+        col_i32(home),
+        col_f32(move_pen),
         col_f32(c_cpu),
         col_f32(c_mem),
         col_i32(valid_c),
@@ -478,7 +499,8 @@ def pairwise_admission(gain, prop, wants, c_cpu, c_mem, slack_cpu, slack_mem):
 
 def reference_score_admission(
     M, cur, c_cpu, c_mem, valid_c, cpu_load, mem_load, cap, mem_cap,
-    node_valid, lam, noise=None, overload_weight=0.0, *, enforce_capacity: bool,
+    node_valid, lam, noise=None, overload_weight=0.0, home=None,
+    move_pen=None, *, enforce_capacity: bool,
 ):
     """Plain-XLA twin of :func:`fused_score_admission` — and the solver's
     production XLA epilogue (one implementation, two lowerings).
@@ -497,6 +519,11 @@ def reference_score_admission(
         M - lam * proj_pct
         - overload_weight * jnp.maximum(proj_pct - 100.0, 0.0)
     )
+    if move_pen is not None:
+        anchor = cur if home is None else home
+        score = score - jnp.where(
+            jnp.arange(N)[None, :] == anchor[:, None], 0.0, move_pen[:, None]
+        )
     if noise is not None:
         score = score + noise
     if enforce_capacity:

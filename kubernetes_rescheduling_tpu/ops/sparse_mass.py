@@ -8,25 +8,33 @@ neighbor set: for a 256-row block b,
 
     M_b = w_local[b] @ (one_hot(tgt_b) · rv_u_b)        # [256, U_b] @ [U_b, N]
 
-where ``tgt_b = assign[u_ids[b]]`` (pre-gathered in XLA — a few hundred KB
-per chunk) and ``rv_u`` carries the neighbor replica counts (the row-side
-replica factor is applied by the caller; the pair weight
-``adj·rv_s·rv_t`` factorizes). The one-hot tile is regenerated in VMEM
-from ``tgt`` exactly like the dense inline-mass kernel — it never exists
-in HBM.
+where ``tgt_b = assign[u_ids[b]]`` and ``rv_u`` carries the neighbor
+replica counts (the row-side replica factor is applied by the caller; the
+pair weight ``adj·rv_s·rv_t`` factorizes). The one-hot tile is regenerated
+in VMEM from ``tgt`` exactly like the dense inline-mass kernel — it never
+exists in HBM.
+
+The caller pre-gathers ``tgt`` CHUNK-LOCALLY: XLA's TPU gather runs
+element-at-a-time (~12 ns/element measured), so gathering the full
+neighbor table per chunk costs more than every matmul combined (0.63 ms
+for 52k entries at 10k services — the round-4 ablation that motivated
+this layout). Regular blocks have a uniform column width, so a chunk's id
+columns are KB contiguous slices of ``u_ids`` (cheap DMA), and only the
+resulting few-thousand-entry slab hits the gather path. The kernels
+therefore take chunk-local ``tgt``/``rvu`` slabs indexed directly by grid
+position; only the (large, weight-carrying) W tiles are gathered by id
+via scalar prefetch.
 
 Two kernels, one body:
 
 - ``sparse_neighbor_mass`` — the per-chunk kernel. Grid ``(KB, reg_tiles)``
   over the chunk's (traced) regular block ids; a scalar-prefetched offset
-  table locates each block's uniform-width column strip. No ragged
-  bookkeeping in the hot loop — regular blocks share one width by
-  construction.
+  table locates each block's uniform-width column strip of W.
 - ``hub_neighbor_mass`` — the once-per-sweep hub pass. Hub blocks (the few
   degree-sorted leading blocks whose neighbor sets exceed the regular
   width) have *static* ids, so their ragged tile list is flattened at
-  build time into (column-tile, output-block, is-first) arrays and the
-  grid walks it 1D with zero wasted steps.
+  build time into (W column-tile, local column-tile, output-block,
+  is-first) arrays and the grid walks it 1D with zero wasted steps.
 
 ``reference_sparse_mass`` / ``reference_hub_mass`` are the plain-XLA twins
 (production path on CPU, parity oracle for the kernels).
@@ -72,8 +80,10 @@ def _chunk_kernel(blocks_ref, toff_ref, w_ref, tgt_ref, rvu_ref, m_ref):
     _mass_body(w_ref, tgt_ref, rvu_ref, m_ref, first=pl.program_id(1) == 0)
 
 
-def _hub_kernel(tcol_ref, tout_ref, tfirst_ref, w_ref, tgt_ref, rvu_ref, m_ref):
-    del tcol_ref, tout_ref
+def _hub_kernel(
+    tcol_ref, tlcol_ref, tout_ref, tfirst_ref, w_ref, tgt_ref, rvu_ref, m_ref
+):
+    del tcol_ref, tlcol_ref, tout_ref
     first = tfirst_ref[pl.program_id(0)] == 1
     _mass_body(w_ref, tgt_ref, rvu_ref, m_ref, first=first)
 
@@ -83,10 +93,10 @@ def _hub_kernel(tcol_ref, tout_ref, tfirst_ref, w_ref, tgt_ref, rvu_ref, m_ref):
 )
 def sparse_neighbor_mass(
     w_mm,     # [256, TU] block-local weights in matmul dtype
-    tgt_u,    # i32[TU] assign[u_ids] (pre-gathered, padding → anything)
-    rvu,      # f32[TU] replica count per neighbor column (0 on padding)
+    tgt_c,    # i32[KB·u_reg] CHUNK-LOCAL assign[u_ids] slab, block-major
+    rvu_c,    # f32[KB·u_reg] chunk-local neighbor replicas (0 on padding)
     blocks,   # i32[KB] chunk's block ids (regular or dummy)
-    toff,     # i32[NBX] per-block first column tile (incl. dummy entries)
+    toff,     # i32[NBX] per-block first W column tile (incl. dummy entries)
     *,
     num_nodes: int,
     bu: int,
@@ -94,7 +104,6 @@ def sparse_neighbor_mass(
     interpret: bool = False,
 ):
     """``M[KB·256, N]`` for one chunk of regular-width blocks."""
-    TU = w_mm.shape[1]
     KB = blocks.shape[0]
     N = int(num_nodes)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -104,8 +113,13 @@ def sparse_neighbor_mass(
             pl.BlockSpec(
                 (BLOCK_R, bu), lambda i, j, blocks, toff: (0, toff[blocks[i]] + j)
             ),
-            pl.BlockSpec((1, bu), lambda i, j, blocks, toff: (0, toff[blocks[i]] + j)),
-            pl.BlockSpec((1, bu), lambda i, j, blocks, toff: (0, toff[blocks[i]] + j)),
+            # chunk-local slabs: block slot i's tiles sit at i·reg_tiles + j
+            pl.BlockSpec(
+                (1, bu), lambda i, j, blocks, toff: (0, i * reg_tiles + j)
+            ),
+            pl.BlockSpec(
+                (1, bu), lambda i, j, blocks, toff: (0, i * reg_tiles + j)
+            ),
         ],
         out_specs=pl.BlockSpec((BLOCK_R, N), lambda i, j, blocks, toff: (i, 0)),
     )
@@ -118,8 +132,8 @@ def sparse_neighbor_mass(
         blocks.astype(jnp.int32),
         toff.astype(jnp.int32),
         w_mm,
-        tgt_u.reshape(1, TU).astype(jnp.int32),
-        rvu.reshape(1, TU).astype(jnp.float32),
+        tgt_c.reshape(1, -1).astype(jnp.int32),
+        rvu_c.reshape(1, -1).astype(jnp.float32),
     )
 
 
@@ -128,9 +142,10 @@ def sparse_neighbor_mass(
 )
 def hub_neighbor_mass(
     w_mm,        # [256, TU]
-    tgt_u,       # i32[TU]
-    rvu,         # f32[TU]
-    tile_col,    # i32[T] static flattened hub tile list: column tile
+    tgt_l,       # i32[W_g] GROUP-LOCAL assign[u_ids] slab (static columns)
+    rvu_l,       # f32[W_g]
+    tile_col,    # i32[T] static flattened hub tile list: W column tile
+    tile_lcol,   # i32[T] group-local column tile (into tgt_l/rvu_l)
     tile_out,    # i32[T] output block slot (0..NHB-1), block-major order
     tile_first,  # i32[T] 1 on each output block's first tile
     *,
@@ -139,20 +154,21 @@ def hub_neighbor_mass(
     bu: int,
     interpret: bool = False,
 ):
-    """``M[NHB·256, N]`` for the (static) hub blocks — ragged widths walked
-    as a flat 1D tile list, zero wasted grid steps."""
-    TU = w_mm.shape[1]
+    """``M[NHB·256, N]`` for a (static) group of hub blocks — ragged widths
+    walked as a flat 1D tile list, zero wasted grid steps."""
     T = tile_col.shape[0]
     N = int(num_nodes)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(T,),
         in_specs=[
-            pl.BlockSpec((BLOCK_R, bu), lambda t, tc, to, tf: (0, tc[t])),
-            pl.BlockSpec((1, bu), lambda t, tc, to, tf: (0, tc[t])),
-            pl.BlockSpec((1, bu), lambda t, tc, to, tf: (0, tc[t])),
+            pl.BlockSpec((BLOCK_R, bu), lambda t, tc, tl, to, tf: (0, tc[t])),
+            pl.BlockSpec((1, bu), lambda t, tc, tl, to, tf: (0, tl[t])),
+            pl.BlockSpec((1, bu), lambda t, tc, tl, to, tf: (0, tl[t])),
         ],
-        out_specs=pl.BlockSpec((BLOCK_R, N), lambda t, tc, to, tf: (to[t], 0)),
+        out_specs=pl.BlockSpec(
+            (BLOCK_R, N), lambda t, tc, tl, to, tf: (to[t], 0)
+        ),
     )
     return pl.pallas_call(
         _hub_kernel,
@@ -163,28 +179,44 @@ def hub_neighbor_mass(
         interpret=interpret,
     )(
         tile_col.astype(jnp.int32),
+        tile_lcol.astype(jnp.int32),
         tile_out.astype(jnp.int32),
         tile_first.astype(jnp.int32),
         w_mm,
-        tgt_u.reshape(1, TU).astype(jnp.int32),
-        rvu.reshape(1, TU).astype(jnp.float32),
+        tgt_l.reshape(1, -1).astype(jnp.int32),
+        rvu_l.reshape(1, -1).astype(jnp.float32),
     )
 
 
+def chunk_local_slabs(u_ids, rvu, starts, width: int):
+    """Slice a chunk's neighbor-id and replica columns out of the full
+    table as KB contiguous slices (regular blocks share ``width``), ready
+    for the small chunk-local gather. Returns ``(u_c[KB·width],
+    rvu_c[KB·width])``."""
+    u_c = jax.vmap(
+        lambda s: lax.dynamic_slice(u_ids, (s,), (width,))
+    )(starts)
+    rvu_c = jax.vmap(
+        lambda s: lax.dynamic_slice(rvu, (s,), (width,))
+    )(starts)
+    return u_c.reshape(-1), rvu_c.reshape(-1)
+
+
 def reference_sparse_mass(
-    w_mm, tgt_u, rvu, blocks, toff, *, num_nodes: int, bu: int, reg_tiles: int
+    w_mm, tgt_c, rvu_c, blocks, toff, *, num_nodes: int, bu: int, reg_tiles: int
 ):
     """Plain-XLA twin of :func:`sparse_neighbor_mass` (gather + matmul —
     no scatter, so it is TPU- and vmap-safe). Term-for-term the same f32
     operation order as the kernel body."""
     U = reg_tiles * bu
     N = int(num_nodes)
+    KB = blocks.shape[0]
+    tgt_b = tgt_c.reshape(KB, U)
+    rvu_b = rvu_c.reshape(KB, U)
 
-    def per_block(b):
+    def per_block(b, tgt, rv):
         start = toff[b] * bu
         wb = lax.dynamic_slice(w_mm, (0, start), (BLOCK_R, U))
-        tgt = lax.dynamic_slice(tgt_u, (start,), (U,))
-        rv = lax.dynamic_slice(rvu, (start,), (U,))
         oh = jnp.where(
             tgt[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :],
             rv[:, None],
@@ -192,21 +224,26 @@ def reference_sparse_mass(
         ).astype(w_mm.dtype)
         return jnp.dot(wb, oh, preferred_element_type=jnp.float32)
 
-    M = jax.vmap(per_block)(blocks)
-    return M.reshape(blocks.shape[0] * BLOCK_R, N)
+    M = jax.vmap(per_block)(blocks, tgt_b, rvu_b)
+    return M.reshape(KB * BLOCK_R, N)
 
 
-def reference_hub_mass(sgraph, w_mm, tgt_u, rvu, *, num_nodes: int, blocks=None):
+def reference_hub_mass(
+    sgraph, w_mm, tgt_l, rvu_l, *, num_nodes: int, blocks=None
+):
     """Plain-XLA twin of :func:`hub_neighbor_mass` — hub offsets/widths are
-    static, so this is a Python loop over static slices."""
+    static, so this is a Python loop over static slices of the group-local
+    slab."""
     N = int(num_nodes)
     outs = []
+    lo = 0
     for b in blocks if blocks is not None else sgraph.hub_blocks:
-        off = sgraph.block_toff[b] * sgraph.bu
         width = sgraph.block_ntiles[b] * sgraph.bu
+        tgt = tgt_l[lo : lo + width]
+        rv = rvu_l[lo : lo + width]
+        off = sgraph.block_toff[b] * sgraph.bu
         wb = w_mm[:, off : off + width]
-        tgt = tgt_u[off : off + width]
-        rv = rvu[off : off + width]
+        lo += width
         oh = jnp.where(
             tgt[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :],
             rv[:, None],
@@ -218,21 +255,25 @@ def reference_hub_mass(sgraph, w_mm, tgt_u, rvu, *, num_nodes: int, blocks=None)
 
 def hub_tile_arrays(sgraph, blocks=None):
     """Flatten hub blocks' ragged tile lists into the static
-    (column-tile, output-slot, is-first) arrays the 1D hub grid walks,
-    in output-block-major order (accumulation revisits each output block
-    consecutively). ``blocks`` selects a subset (the solver processes
-    hubs in chunk-sized groups so the admission race never exceeds the
-    regular chunk width)."""
+    (W column-tile, group-local column-tile, output-slot, is-first) arrays
+    the 1D hub grid walks, in output-block-major order (accumulation
+    revisits each output block consecutively). ``blocks`` selects a subset
+    (the solver processes hubs in chunk-sized groups so the admission race
+    never exceeds the regular chunk width)."""
     import numpy as np
 
-    cols, outs, firsts = [], [], []
+    cols, lcols, outs, firsts = [], [], [], []
+    lcol = 0
     for slot, b in enumerate(blocks if blocks is not None else sgraph.hub_blocks):
         for j in range(sgraph.block_ntiles[b]):
             cols.append(sgraph.block_toff[b] + j)
+            lcols.append(lcol)
             outs.append(slot)
             firsts.append(1 if j == 0 else 0)
+            lcol += 1
     return (
         jnp.asarray(np.asarray(cols, dtype=np.int32)),
+        jnp.asarray(np.asarray(lcols, dtype=np.int32)),
         jnp.asarray(np.asarray(outs, dtype=np.int32)),
         jnp.asarray(np.asarray(firsts, dtype=np.int32)),
     )

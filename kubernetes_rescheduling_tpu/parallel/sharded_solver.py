@@ -353,6 +353,11 @@ def _build_solve_restarts(
 def _check_and_dims(state, graph, config, mesh):
     if not config.capacity_frac > 0:
         raise ValueError(f"capacity_frac must be > 0, got {config.capacity_frac}")
+    if config.move_cost > 0:
+        raise ValueError(
+            "move_cost (disruption pricing) is not implemented in the "
+            "node-sharded solver yet — use tp=1 or move_cost=0"
+        )
     tp = mesh.shape["tp"]
     S = graph.num_services
     N = state.num_nodes

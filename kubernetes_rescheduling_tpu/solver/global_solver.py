@@ -100,6 +100,15 @@ class GlobalSolverConfig:
     # partition objective; the best-seen tracking below means noise can only
     # ever improve the returned solution. Units = comm-weight (pod pairs).
     noise_temp: float = struct.field(pytree_node=False, default=1.0)
+    # Disruption cost INSIDE the objective: comm-weight units charged per
+    # restarted pod (a service's move restarts all its replicas — the
+    # reference's restart metric, release1.sh:101-102). The score charges
+    # it at every node except the service's ROUND-START node, so staying
+    # moved keeps paying and moving back recovers it — a relocation must
+    # beat home by more than its restart bill, and the move budget is
+    # emergent instead of a post-hoc wave cap. 0 (default) = moves are
+    # free, the historical objective.
+    move_cost: float = struct.field(pytree_node=False, default=0.0)
     # dtype of the neighbor-mass matmul. bfloat16 feeds the MXU at full
     # rate with f32 accumulation (a modest win — the round is launch-bound,
     # see chunk_size above; measured 69→66 ms at 10k×1k). W weights and
@@ -343,6 +352,33 @@ def global_assign(
     base_mem = state.node_base_mem
 
     assign0 = jnp.where(svc_valid, jnp.clip(cur_node, 0, N - 1), 0)
+    # disruption pricing (config.move_cost): per-service restart bill =
+    # cost × replica count, anchored at the ROUND-START placement
+    mc_on = config.move_cost > 0
+    pen_vec = config.move_cost * replicas * svc_valid if mc_on else None
+
+    def move_penalty(assign):
+        """Service-level restart bill vs the assign0 collapse — the cheap
+        per-sweep RANKING form. It undercounts when the input has a
+        service's replicas split across nodes (consolidating them to
+        assign0 restarts pods this cannot see), so the adopt gate uses
+        the exact pod-level bill below instead."""
+        return config.move_cost * jnp.sum(
+            jnp.where(svc_valid & (assign != assign0), replicas, 0.0)
+        )
+
+    def pod_restart_bill(assign):
+        """EXACT restart bill of adopting ``assign``: every already-placed
+        pod whose node would change (including split replicas being
+        consolidated). Unplaced pods are creations, not restarts."""
+        tgt = assign[jnp.clip(state.pod_service, 0, SP - 1)]
+        return config.move_cost * jnp.sum(
+            jnp.where(
+                state.pod_valid & (state.pod_node >= 0) & (state.pod_node != tgt),
+                1.0,
+                0.0,
+            )
+        )
 
     def loads(assign):
         oh = jax.nn.one_hot(assign, N, dtype=jnp.float32) * svc_valid[:, None]
@@ -378,7 +414,11 @@ def global_assign(
             preferred_element_type=jnp.float32,
         )
         comm = 0.5 * (w_total - kept)
-        return comm + _balance_terms(cpu_load)
+        obj = comm + _balance_terms(cpu_load)
+        # with disruption pricing, per-sweep best-seen ranks the PENALIZED
+        # objective — a sweep that wins on comm but spends more restarts
+        # than the win is worth must not be selected
+        return obj + move_penalty(assign) if mc_on else obj
 
     # fused Pallas epilogue: on for real TPU at kernel-worthy sizes;
     # "interpret" runs the same kernels through the interpreter (tests)
@@ -469,6 +509,8 @@ def global_assign(
                     cpu_load, mem_load, cap, mem_cap, state.node_valid,
                     config.balance_weight, temp, seed,
                     overload_weight=ow,
+                    home=assign0[ids] if mc_on else None,
+                    move_pen=pen_vec[ids] if mc_on else None,
                     enforce_capacity=config.enforce_capacity,
                     # the TPU core PRNG has no interpret-mode lowering
                     use_noise=config.noise_temp > 0 and not fused_interpret,
@@ -495,6 +537,8 @@ def global_assign(
                     cpu_load, mem_load, cap, mem_cap, state.node_valid,
                     config.balance_weight, noise,
                     overload_weight=ow,
+                    home=assign0[ids] if mc_on else None,
+                    move_pen=pen_vec[ids] if mc_on else None,
                     enforce_capacity=config.enforce_capacity,
                 )
             return _commit(inner, ids, valid_c, c_cpu, c_mem, cur,
@@ -546,6 +590,8 @@ def global_assign(
                 cpu_load, mem_load, cap, mem_cap, state.node_valid,
                 config.balance_weight, temp, seed,
                 overload_weight=ow,
+                home=assign0[ids] if mc_on else None,
+                move_pen=pen_vec[ids] if mc_on else None,
                 enforce_capacity=config.enforce_capacity,
                 use_noise=config.noise_temp > 0 and not fused_interpret,
                 interpret=fused_interpret,
@@ -606,11 +652,14 @@ def global_assign(
     # adopted value is re-evaluated EXACTLY so the never-worse gate and the
     # reported objective carry no bf16 rounding
     best_obj = objective(best_assign)
+    best_pen = pod_restart_bill(best_assign) if mc_on else jnp.float32(0.0)
 
     # scatter service assignment back to pods — but only when the solve
     # strictly beats the true input placement; otherwise keep the input
     # (prevents pointless cluster churn when no improvement was found).
-    improved = best_obj < obj_true0
+    # Under disruption pricing the improvement must also cover the
+    # restart bill (raw objective never-worse still follows a fortiori).
+    improved = best_obj + best_pen < obj_true0
     new_pod_node = jnp.where(
         improved & state.pod_valid,
         best_assign[jnp.clip(state.pod_service, 0, SP - 1)],
@@ -619,9 +668,10 @@ def global_assign(
     new_state = state.replace(pod_node=new_pod_node)
     info = {
         "objective_before": obj_true0,
-        "objective_after": jnp.minimum(best_obj, obj_true0),
+        "objective_after": jnp.where(improved, best_obj, obj_true0),
         "improved": improved,
         "moves_per_sweep": moves_per_sweep,
+        "move_penalty": jnp.where(improved, best_pen, 0.0),
         "communication_cost": communication_cost(new_state, graph),
         "load_std": load_std(new_state),
         # which epilogue lowering ran (static): tests assert the inline

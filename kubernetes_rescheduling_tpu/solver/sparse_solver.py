@@ -58,6 +58,7 @@ from kubernetes_rescheduling_tpu.ops.fused_admission import (
     reference_score_admission,
 )
 from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+    chunk_local_slabs,
     hub_neighbor_mass,
     hub_tile_arrays,
     reference_hub_mass,
@@ -192,6 +193,32 @@ def global_assign_sparse(
     cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0) * config.capacity_frac
 
     assign0 = jnp.where(svc_valid, jnp.clip(cur_s, 0, N - 1), 0)
+    # disruption pricing (config.move_cost): restart bill per service,
+    # anchored at the round-start placement (see GlobalSolverConfig)
+    mc_on = config.move_cost > 0
+    pen_vec = config.move_cost * rv_s if mc_on else None
+
+    def move_penalty(assign):
+        """Service-level restart bill vs the assign0 collapse — the cheap
+        per-sweep RANKING form; the adopt gate uses the exact pod-level
+        bill (split replicas consolidating to assign0 restart pods this
+        form cannot see)."""
+        return config.move_cost * jnp.sum(
+            jnp.where(svc_valid & (assign != assign0), rv_s, 0.0)
+        )
+
+    def pod_restart_bill(assign):
+        slot = jnp.clip(
+            sgraph.inv[jnp.clip(state.pod_service, 0, S - 1)], 0, SPX - 1
+        )
+        tgt = assign[slot]
+        return config.move_cost * jnp.sum(
+            jnp.where(
+                state.pod_valid & (state.pod_node >= 0) & (state.pod_node != tgt),
+                1.0,
+                0.0,
+            )
+        )
 
     def loads(assign):
         a = jnp.where(svc_valid, assign, N)
@@ -208,12 +235,18 @@ def global_assign_sparse(
             cpu_load, cap, state.node_valid, config.balance_weight, ow
         )
 
-    def objective(assign, cpu_load):
-        """EXACT objective — the sparse cut-sum is O(E), cheap enough to
-        be both the per-sweep best-seen ranking AND the adopt gate (no
-        bf16 fast-form needed, unlike the dense path)."""
+    def objective_raw(assign, cpu_load):
+        """EXACT comm+balance objective — the sparse cut-sum is O(E),
+        cheap enough to be both the per-sweep best-seen ranking AND the
+        adopt gate (no bf16 fast-form needed, unlike the dense path)."""
         comm = sparse_pair_comm_cost(sgraph, assign[:SP], rv_s[:SP])
         return comm + _balance_terms(cpu_load)
+
+    def objective(assign, cpu_load):
+        obj = objective_raw(assign, cpu_load)
+        # penalized ranking under disruption pricing: a sweep that wins on
+        # comm but spends more restarts than the win is worth loses
+        return obj + move_penalty(assign) if mc_on else obj
 
     # ---- lowering selection (mirrors the dense solver) ----
     fused_interpret = config.fused_epilogue == "interpret"
@@ -236,7 +269,9 @@ def global_assign_sparse(
     )
     # hub blocks are processed in chunk-sized groups (≤ KB blocks each):
     # the [BC, C]-tile admission race is quadratic in the chunk width and
-    # a single all-hubs chunk blows the VMEM scoped limit past ~8 blocks
+    # a single all-hubs chunk blows the VMEM scoped limit past ~8 blocks.
+    # Each group's neighbor-id columns are STATIC slices of u_ids, so only
+    # the group-local slab (not the full table) hits the gather path.
     hub_groups = []
     for g in range(0, NHB, KB):
         blocks_g = hub_blocks[g : g + KB]
@@ -248,35 +283,54 @@ def global_assign_sparse(
                 ]
             )
         )
-        hub_groups.append((blocks_g, ids_g, hub_tile_arrays(sgraph, blocks_g)))
+        u_g = jnp.concatenate(
+            [
+                sgraph.u_ids[
+                    sgraph.block_toff[b] * sgraph.bu :
+                    (sgraph.block_toff[b] + sgraph.block_ntiles[b]) * sgraph.bu
+                ]
+                for b in blocks_g
+            ]
+        )
+        rvu_g = jnp.where(
+            u_g < SP, rv_s[jnp.clip(u_g, 0, SPX - 1)], 0.0
+        )
+        hub_groups.append(
+            (blocks_g, ids_g, u_g, rvu_g, hub_tile_arrays(sgraph, blocks_g))
+        )
 
     def chunk_mass(assign, blocks, ids):
-        tgt_u = assign[jnp.clip(sgraph.u_ids, 0, SPX - 1)]
+        # gather only the chunk's columns: KB contiguous id slices, then a
+        # few-thousand-entry gather (full-table gathers cost more than all
+        # the matmuls combined — see ops/sparse_mass.py docstring)
+        starts = toff_ext[blocks] * sgraph.bu
+        u_c, rvu_c = chunk_local_slabs(sgraph.u_ids, rvu, starts, sgraph.u_reg)
+        tgt_c = assign[jnp.clip(u_c, 0, SPX - 1)]
         if use_kernels:
             raw = sparse_neighbor_mass(
-                w_mm, tgt_u, rvu, blocks, toff_ext,
+                w_mm, tgt_c, rvu_c, blocks, toff_ext,
                 num_nodes=N, bu=sgraph.bu, reg_tiles=sgraph.reg_tiles,
                 interpret=fused_interpret or not on_tpu,
             )
         else:
             raw = reference_sparse_mass(
-                w_mm, tgt_u, rvu, blocks, toff_ext,
+                w_mm, tgt_c, rvu_c, blocks, toff_ext,
                 num_nodes=N, bu=sgraph.bu, reg_tiles=sgraph.reg_tiles,
             )
         return raw * rv_s[ids][:, None]
 
     def hub_mass(assign, group):
-        blocks_g, ids_g, (h_col, h_out, h_first) = group
-        tgt_u = assign[jnp.clip(sgraph.u_ids, 0, SPX - 1)]
+        blocks_g, ids_g, u_g, rvu_g, (h_col, h_lcol, h_out, h_first) = group
+        tgt_l = assign[jnp.clip(u_g, 0, SPX - 1)]
         if use_kernels:
             raw = hub_neighbor_mass(
-                w_mm, tgt_u, rvu, h_col, h_out, h_first,
+                w_mm, tgt_l, rvu_g, h_col, h_lcol, h_out, h_first,
                 num_nodes=N, num_hub_blocks=len(blocks_g), bu=sgraph.bu,
                 interpret=fused_interpret or not on_tpu,
             )
         else:
             raw = reference_hub_mass(
-                sgraph, w_mm, tgt_u, rvu, num_nodes=N, blocks=blocks_g
+                sgraph, w_mm, tgt_l, rvu_g, num_nodes=N, blocks=blocks_g
             )
         return raw * rv_s[ids_g][:, None]
 
@@ -288,6 +342,8 @@ def global_assign_sparse(
         c_cpu = svc_cpu_s[ids]
         c_mem = svc_mem_s[ids]
         cur = assign[ids]
+        home = assign0[ids] if mc_on else None
+        pen = pen_vec[ids] if mc_on else None
         if use_fused:
             seed = jax.random.randint(chunk_key, (), 0, 2**31 - 1)
             new_node, admitted, d_cpu, d_mem = fused_score_admission(
@@ -295,6 +351,8 @@ def global_assign_sparse(
                 cpu_load, mem_load, cap, mem_cap, state.node_valid,
                 config.balance_weight, temp, seed,
                 overload_weight=ow,
+                home=home,
+                move_pen=pen,
                 enforce_capacity=config.enforce_capacity,
                 use_noise=config.noise_temp > 0 and not fused_interpret,
                 interpret=fused_interpret,
@@ -318,6 +376,8 @@ def global_assign_sparse(
             cpu_load, mem_load, cap, mem_cap, state.node_valid,
             config.balance_weight, noise,
             overload_weight=ow,
+            home=home,
+            move_pen=pen,
             enforce_capacity=config.enforce_capacity,
         )
         d_cpu = jnp.where(admitted, c_cpu, 0.0)
@@ -404,7 +464,14 @@ def global_assign_sparse(
         sweep, (assign0, cpu0, mem0, assign0, obj0), (keys, temps)
     )
 
-    improved = best_obj < obj_true0
+    # under disruption pricing the adopt gate re-prices with the EXACT
+    # pod-level restart bill (the scan ranked with the cheap service-level
+    # form); the reported objective stays raw
+    raw_after = (
+        objective_raw(best_assign, loads(best_assign)[0]) if mc_on else best_obj
+    )
+    best_pen = pod_restart_bill(best_assign) if mc_on else jnp.float32(0.0)
+    improved = raw_after + best_pen < obj_true0
     pod_slot = jnp.clip(
         sgraph.inv[jnp.clip(state.pod_service, 0, S - 1)], 0, SPX - 1
     )
@@ -414,9 +481,10 @@ def global_assign_sparse(
     new_state = state.replace(pod_node=new_pod_node)
     info = {
         "objective_before": obj_true0,
-        "objective_after": jnp.minimum(best_obj, obj_true0),
+        "objective_after": jnp.where(improved, raw_after, obj_true0),
         "improved": improved,
         "moves_per_sweep": moves_per_sweep,
+        "move_penalty": jnp.where(improved, best_pen, 0.0),
         "communication_cost": sparse_pod_comm_cost(new_state, sgraph),
         "load_std": load_std(new_state),
         "hub_pass": jnp.asarray(NHB > 0),

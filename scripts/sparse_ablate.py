@@ -1,0 +1,151 @@
+"""Attribute sparse-solver device time to components at 10k x 1k.
+
+Each component runs K times inside one jitted scan with a true data
+dependency (carry folded into the inputs), fenced once — per-iteration
+cost = total / K. Chain length is sized so total device work is well
+over the tunnel RTT (memory discipline: micro-probes under the RTT
+window read as zero).
+"""
+
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_rescheduling_tpu.core import sparsegraph
+from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R
+from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+from kubernetes_rescheduling_tpu.ops.fused_admission import fused_score_admission
+from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+    chunk_local_slabs,
+    hub_neighbor_mass,
+    hub_tile_arrays,
+    sparse_neighbor_mass,
+)
+from kubernetes_rescheduling_tpu.solver.sparse_solver import sparse_pod_comm_cost
+from kubernetes_rescheduling_tpu.core.sparsegraph import sparse_pair_comm_cost
+
+scn = synthetic_scenario(
+    n_pods=10_000, n_nodes=1_000, powerlaw=True, mean_degree=4.0, seed=0,
+    node_cpu_cap_m=2_000.0,
+)
+sg = sparsegraph.from_comm_graph(scn.graph)
+SP = sg.sp
+N = 1000
+NHB = len(sg.hub_blocks)
+print(f"blocks={sg.num_blocks} hub={NHB} TU={sg.w_local.shape[1]}")
+
+rng = np.random.default_rng(0)
+assign0 = jnp.asarray(rng.integers(0, N, size=SP), jnp.int32)
+rv = jnp.asarray((rng.random(SP) > 0.02).astype(np.float32))
+rvu = jnp.where(sg.u_ids < SP, rv[jnp.clip(sg.u_ids, 0, SP - 1)], 0.0)
+w_mm = sg.w_local.astype(jnp.bfloat16)
+toff = jnp.asarray(sg.block_toff, jnp.int32)
+blocks = jnp.asarray(sg.regular_blocks[:4], jnp.int32)
+ids = (np.asarray(blocks)[:, None] * BLOCK_R + np.arange(BLOCK_R)).reshape(-1)
+ids_j = jnp.asarray(ids)
+h_col, h_lcol, h_out, h_first = hub_tile_arrays(sg)
+u_g = jnp.concatenate(
+    [
+        sg.u_ids[
+            sg.block_toff[b] * sg.bu :
+            (sg.block_toff[b] + sg.block_ntiles[b]) * sg.bu
+        ]
+        for b in sg.hub_blocks
+    ]
+)
+rvu_g = jnp.where(u_g < SP, rv[jnp.clip(u_g, 0, SP - 1)], 0.0)
+
+cpu_load = jnp.asarray(rng.random(N) * 1000, jnp.float32)
+mem_load = jnp.zeros(N)
+cap = jnp.full(N, 2000.0)
+mem_cap = jnp.full(N, jnp.inf)
+node_valid = jnp.ones(N, bool)
+c_cpu = jnp.asarray(rng.random(1024) * 100, jnp.float32)
+c_mem = jnp.zeros(1024)
+valid_c = jnp.ones(1024, bool)
+
+
+def timeit(name, step, k=400):
+    @partial(jax.jit, static_argnames=("kk",))
+    def run(a0, kk):
+        def body(a, i):
+            return step(a, i), 0
+        a, _ = jax.lax.scan(body, a0, jnp.arange(kk))
+        return a
+
+    out = run(assign0, k)
+    jnp.sum(out).item()  # warm + fence
+    best = float("inf")
+    for _ in range(3):
+        t = time.perf_counter()
+        out = run(assign0, k)
+        jnp.sum(out).item()
+        best = min(best, time.perf_counter() - t)
+    print(f"{name:28s} {best / k * 1e3:8.4f} ms/iter")
+
+
+# 1. the per-chunk tgt gather
+timeit(
+    "tgt gather (52k)",
+    lambda a, i: a.at[0].set(jnp.sum(a[jnp.clip(sg.u_ids, 0, SP - 1)]) % N),
+)
+
+# 2. regular-chunk mass kernel (4 blocks x 2 tiles, chunk-local slabs)
+def mass_step(a, i):
+    starts = toff[blocks] * sg.bu
+    u_c, rvu_c = chunk_local_slabs(sg.u_ids, rvu, starts, sg.u_reg)
+    tgt_c = a[jnp.clip(u_c, 0, SP - 1)]
+    M = sparse_neighbor_mass(
+        w_mm, tgt_c, rvu_c, blocks, toff,
+        num_nodes=N, bu=sg.bu, reg_tiles=sg.reg_tiles,
+    )
+    return a.at[0].set(jnp.sum(M).astype(jnp.int32) % N)
+
+timeit("chunk mass (slab+kernel)", mass_step)
+
+# 3. hub mass (all hub tiles, group-local slab)
+def hub_step(a, i):
+    tgt_l = a[jnp.clip(u_g, 0, SP - 1)]
+    M = hub_neighbor_mass(
+        w_mm, tgt_l, rvu_g, h_col, h_lcol, h_out, h_first,
+        num_nodes=N, num_hub_blocks=NHB, bu=sg.bu,
+    )
+    return a.at[0].set(jnp.sum(M).astype(jnp.int32) % N)
+
+timeit("hub mass (slab+kernel)", hub_step)
+
+# 4. score+admission epilogue (C=1024)
+def place_step(a, i):
+    M = (a[ids_j][:, None] * jnp.ones((1, N))).astype(jnp.float32)
+    new_node, admitted, d_cpu, d_mem = fused_score_admission(
+        M, a[ids_j], c_cpu, c_mem, valid_c,
+        cpu_load, mem_load, cap, mem_cap, node_valid,
+        0.0, 0.5, i.astype(jnp.int32),
+        enforce_capacity=True, use_noise=True, emit_x_rows=False,
+    )
+    return a.at[ids_j].set(new_node)
+
+timeit("score+admission (C=1024)", place_step)
+
+# 5. per-sweep exact objective (COO)
+def obj_step(a, i):
+    c = sparse_pair_comm_cost(sg, a[:SP], rv[:SP])
+    return a.at[0].set(c.astype(jnp.int32) % N)
+
+timeit("objective COO", obj_step)
+
+# 6. loads refresh (scatter-add)
+svc_cpu = jnp.asarray(rng.random(SP) * 100, jnp.float32)
+def loads_step(a, i):
+    l = jnp.zeros((N + 1,), jnp.float32).at[jnp.where(rv > 0, a, N)].add(svc_cpu)[:N]
+    return a.at[0].set(jnp.sum(l).astype(jnp.int32) % N)
+
+timeit("loads scatter-add", loads_step)
+print("OK")

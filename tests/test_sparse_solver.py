@@ -16,6 +16,7 @@ from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
 from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
 from kubernetes_rescheduling_tpu.objectives import communication_cost
 from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+    chunk_local_slabs,
     hub_neighbor_mass,
     hub_tile_arrays,
     reference_hub_mass,
@@ -141,16 +142,18 @@ def test_sparse_mass_kernel_matches_dense_matmul():
     X[np.arange(SP), assign] = 1.0
     expected = W[ids] @ X
 
-    tgt_u = jnp.asarray(assign)[jnp.clip(sg.u_ids, 0, SP - 1)]
     rvu = jnp.where(
         sg.u_ids < SP, jnp.asarray(rv)[jnp.clip(sg.u_ids, 0, SP - 1)], 0.0
     )
     toff = jnp.asarray(sg.block_toff, jnp.int32)
+    starts = toff[blocks] * sg.bu
+    u_c, rvu_c = chunk_local_slabs(sg.u_ids, rvu, starts, sg.u_reg)
+    tgt_c = jnp.asarray(assign)[jnp.clip(u_c, 0, SP - 1)]
     kw = dict(num_nodes=N, bu=sg.bu, reg_tiles=sg.reg_tiles)
     got_k = sparse_neighbor_mass(
-        sg.w_local, tgt_u, rvu, blocks, toff, interpret=True, **kw
+        sg.w_local, tgt_c, rvu_c, blocks, toff, interpret=True, **kw
     )
-    got_x = reference_sparse_mass(sg.w_local, tgt_u, rvu, blocks, toff, **kw)
+    got_x = reference_sparse_mass(sg.w_local, tgt_c, rvu_c, blocks, toff, **kw)
     row_rv = rv[ids][:, None]
     np.testing.assert_allclose(np.asarray(got_k) * row_rv, expected, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(got_x) * row_rv, expected, rtol=1e-5)
@@ -181,17 +184,27 @@ def test_hub_mass_kernel_matches_dense_matmul():
     X[np.arange(SP), assign] = 1.0
     expected = W[hub_ids] @ X
 
-    tgt_u = jnp.asarray(assign)[jnp.clip(sg.u_ids, 0, SP - 1)]
-    rvu = jnp.where(
-        sg.u_ids < SP, jnp.asarray(rv)[jnp.clip(sg.u_ids, 0, SP - 1)], 0.0
+    # group-local slab: static concatenation of the hub blocks' columns
+    u_g = jnp.concatenate(
+        [
+            sg.u_ids[
+                sg.block_toff[b] * sg.bu :
+                (sg.block_toff[b] + sg.block_ntiles[b]) * sg.bu
+            ]
+            for b in sg.hub_blocks
+        ]
     )
-    h_col, h_out, h_first = hub_tile_arrays(sg)
+    tgt_l = jnp.asarray(assign)[jnp.clip(u_g, 0, SP - 1)]
+    rvu_l = jnp.where(
+        u_g < SP, jnp.asarray(rv)[jnp.clip(u_g, 0, SP - 1)], 0.0
+    )
+    h_col, h_lcol, h_out, h_first = hub_tile_arrays(sg)
     got_k = hub_neighbor_mass(
-        sg.w_local, tgt_u, rvu, h_col, h_out, h_first,
+        sg.w_local, tgt_l, rvu_l, h_col, h_lcol, h_out, h_first,
         num_nodes=N, num_hub_blocks=len(sg.hub_blocks), bu=sg.bu,
         interpret=True,
     )
-    got_x = reference_hub_mass(sg, sg.w_local, tgt_u, rvu, num_nodes=N)
+    got_x = reference_hub_mass(sg, sg.w_local, tgt_l, rvu_l, num_nodes=N)
     np.testing.assert_allclose(np.asarray(got_k), expected, rtol=1e-5)
     np.testing.assert_array_equal(np.asarray(got_k), np.asarray(got_x))
 

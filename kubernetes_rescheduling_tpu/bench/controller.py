@@ -391,6 +391,7 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         balance_weight=config.balance_weight,
         enforce_capacity=config.enforce_capacity,
         capacity_frac=config.capacity_frac,
+        move_cost=config.move_cost,
     )
     t0 = time.perf_counter()
     new_state, info = jax.block_until_ready(

@@ -83,6 +83,7 @@ class ExperimentConfig:
     balance_weight: float = 0.5
     solver_restarts: int = 1           # best-of-N global solves per round
     solver_tp: int = 1                 # node-axis devices per solve (SPMD solver)
+    move_cost: float = 0.0             # disruption pricing in the global solve
     moves_per_round: int | str = 1     # k per greedy round, or "all"
     global_moves_cap: int | str = "all"  # wave cap for global rounds
     # Packing budget for the global solver's feasibility (fraction of node
@@ -325,6 +326,7 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 hazard_threshold_pct=cfg.hazard_threshold_pct,
                 sleep_after_action_s=cfg.pacing_s,  # simulated clock, not wall
                 balance_weight=cfg.balance_weight,
+                move_cost=cfg.move_cost,
                 solver_restarts=cfg.solver_restarts,
                 solver_tp=cfg.solver_tp,
                 moves_per_round=cfg.moves_per_round,

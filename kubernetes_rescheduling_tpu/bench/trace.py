@@ -208,3 +208,79 @@ def replay(
         )
         state = new_state
     return state, records
+
+
+def drift_multipliers(
+    graph: CommGraph, steps: int, *, sigma: float = 0.5, seed: int = 0
+):
+    """Synthetic traffic drift at scale: per-step lognormal multipliers for
+    every declared pair. Returns ``(ii, jj, mults[steps, E])`` — the raw
+    material for :func:`replay_on_device`. Mean-one multipliers keep total
+    traffic stationary while individual edges heat and cool, the regime
+    where a placement tuned to last step's weights goes stale."""
+    adj = np.asarray(graph.adj)
+    ii, jj = np.nonzero(np.triu(adj, k=1))
+    rng = np.random.default_rng(seed)
+    mults = np.exp(
+        rng.normal(-0.5 * sigma * sigma, sigma, size=(steps, len(ii)))
+    ).astype(np.float32)
+    return ii.astype(np.int32), jj.astype(np.int32), mults
+
+
+def _replay_run(st0, graph, ii, jj, mults, key0, config):
+    from kubernetes_rescheduling_tpu.solver.global_solver import global_assign
+
+    base_adj = graph.adj
+
+    def step(st, xs):
+        m, k = xs
+        w = base_adj[ii, jj] * m
+        adj_t = base_adj.at[ii, jj].set(w).at[jj, ii].set(w)
+        g = graph.replace(adj=adj_t)
+        before = communication_cost(st, g)
+        st_n, inf = global_assign(st, g, k, config)
+        return st_n, (inf["objective_after"], before)
+
+    keys = jax.random.split(key0, mults.shape[0])
+    st_f, (objs, befores) = jax.lax.scan(step, st0, (mults, keys))
+    return st_f, objs, befores
+
+
+# module-level jit: repeated calls with the same shapes hit the cache —
+# a per-call closure would retrace the whole k-step scan every call, and
+# the benchmark's timed reps would silently include full recompiles
+_replay_run_jit = jax.jit(_replay_run, static_argnames=("config",))
+
+
+def replay_on_device(
+    state: ClusterState,
+    graph: CommGraph,
+    ii,
+    jj,
+    mults,
+    key: jax.Array,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+):
+    """The streaming-trace benchmark path: ALL steps run inside one jitted
+    ``lax.scan`` on the device — per step, the edge weights are updated by
+    that step's multipliers (a scatter into the base adjacency; weights
+    are data, shapes are static, so the solver never retraces) and the
+    same compiled solve consumes the previous step's placement.
+
+    This is BASELINE.md config 5 at full scale: the reference cannot
+    express it at all (its relation graph is a hardcoded constant), and a
+    host-side replay loop would pay a tunnel round trip per step. Returns
+    ``(final_state, objs[steps], costs_before[steps])`` — the tracking
+    record: cost under each step's NEW weights before and after its solve.
+    """
+    import jax.numpy as jnp
+
+    return _replay_run_jit(
+        state,
+        graph,
+        jnp.asarray(ii),
+        jnp.asarray(jj),
+        jnp.asarray(mults),
+        key,
+        config,
+    )

@@ -80,6 +80,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="best-of-N global solves per round over the mesh")
     r.add_argument("--tp", type=int, default=1,
                    help="node-axis devices per solve (SPMD sharded solver)")
+    r.add_argument("--move-cost", type=float, default=0.0,
+                   help="disruption pricing: comm-weight units per restarted "
+                        "pod inside the global solve (0 = moves are free)")
     r.add_argument("--global-moves-cap", type=_moves_per_round, default="all",
                    help="apply only the k highest-gain improving moves per "
                         "global round ('all' = uncapped)")
@@ -100,6 +103,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="named session: re-running with the same name "
                         "resumes a crashed matrix instead of restarting")
     b.add_argument("--moves-per-round", type=_moves_per_round, default=1)
+    b.add_argument("--move-cost", type=float, default=0.0,
+                   help="disruption pricing in the global solve (see "
+                        "reschedule --move-cost)")
     b.add_argument("--global-moves-cap", type=_moves_per_round, default="all",
                    help="wave cap for global rounds: apply only the k "
                         "highest-gain moves per round ('all' = uncapped); "
@@ -164,6 +170,20 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--tp", type=int, default=1,
                    help="node-axis devices per solve (SPMD node-sharded "
                         "solver; composes with --restarts as a dp×tp mesh)")
+    s.add_argument("--move-cost", type=float, default=0.0,
+                   help="disruption pricing: comm-weight units per restarted "
+                        "pod (0 = moves are free)")
+    s.add_argument("--sparse", action="store_true",
+                   help="solve over the sparse block-local pair-weight form "
+                        "(breaks the dense-W memory wall; single-solve only)")
+    s.add_argument("--placement-unit", default="service",
+                   choices=["service", "pod"],
+                   help="'pod' places each replica independently (replicas "
+                        "may split across nodes — the capability the "
+                        "reference's whole-Deployment teardown cannot have)")
+    s.add_argument("--latency-budget", type=float, default=None,
+                   help="auto-tune the sweep count to fill this many ms of "
+                        "device time per round (overrides --sweeps)")
     return p
 
 
@@ -200,6 +220,7 @@ def cmd_reschedule(args) -> dict:
         moves_per_round=args.moves_per_round,
         global_moves_cap=args.global_moves_cap,
         balance_weight=args.balance_weight,
+        move_cost=args.move_cost,
         enforce_capacity=args.capacity_frac is not None,
         capacity_frac=args.capacity_frac if args.capacity_frac is not None else 1.0,
         solver_restarts=args.restarts,
@@ -230,6 +251,7 @@ def cmd_bench(args) -> dict:
         session_name=args.session,
         moves_per_round=args.moves_per_round,
         global_moves_cap=args.global_moves_cap,
+        move_cost=args.move_cost,
         solver_restarts=args.restarts,
         solver_tp=args.tp,
         observe_weights=args.observe_weights,
@@ -308,15 +330,62 @@ def cmd_solve(args) -> dict:
         sweeps=args.sweeps,
         balance_weight=args.balance_weight,
         capacity_frac=args.capacity_frac,
+        move_cost=args.move_cost,
     )
-    new_state, info = solve_with_restarts(
-        state,
-        graph,
-        jax.random.PRNGKey(args.seed),
-        n_restarts=args.restarts,
-        config=cfg,
-        tp=args.tp,
-    )
+    # `solve_graph` is whatever pytree the chosen solver consumes as its
+    # graph ARGUMENT — it must flow through call signatures, never a
+    # closure: a closed-over sparse/pod graph would be baked into the
+    # autotuner's jit as HLO constants (tens of MB → remote-compile 413)
+    tune_info = None
+    solve_graph = graph
+    if args.placement_unit == "pod":
+        if args.restarts > 1 or args.tp > 1:
+            raise SystemExit(
+                "--placement-unit pod supports a single solve "
+                "(no --restarts/--tp yet)"
+            )
+        from kubernetes_rescheduling_tpu.solver.pod_mode import (
+            global_assign_pods,
+            pod_level_graph,
+        )
+
+        solve_graph = pod_level_graph(state, graph)
+
+        def solver(st, g, k, c):
+            return global_assign_pods(st, None, k, c, pod_graph=g)
+
+    elif args.sparse:
+        if args.restarts > 1 or args.tp > 1:
+            raise SystemExit(
+                "--sparse supports a single solve (no --restarts/--tp yet)"
+            )
+        from kubernetes_rescheduling_tpu.core import sparsegraph
+        from kubernetes_rescheduling_tpu.solver import global_assign_sparse
+
+        solve_graph = sparsegraph.from_comm_graph(graph)
+        solver = global_assign_sparse
+    else:
+        from kubernetes_rescheduling_tpu.solver import global_assign as solver
+    if args.latency_budget is not None:
+        from kubernetes_rescheduling_tpu.solver.autotune import tune_sweeps
+
+        cfg, tune_info = tune_sweeps(
+            state, solve_graph, cfg, args.latency_budget, solver=solver
+        )
+    if args.sparse or args.placement_unit == "pod":
+        new_state, info = solver(
+            state, solve_graph, jax.random.PRNGKey(args.seed), cfg
+        )
+        info = dict(info, restarts=1)
+    else:
+        new_state, info = solve_with_restarts(
+            state,
+            graph,
+            jax.random.PRNGKey(args.seed),
+            n_restarts=args.restarts,
+            config=cfg,
+            tp=args.tp,
+        )
     out = {
         "scenario": args.scenario,
         "restarts": int(info["restarts"]),
@@ -330,6 +399,16 @@ def cmd_solve(args) -> dict:
         out["moves_per_sweep"] = [int(m) for m in info["moves_per_sweep"]]
     if "restart_objectives" in info:
         out["restart_objectives"] = [float(o) for o in info["restart_objectives"]]
+    if args.move_cost > 0 and "move_penalty" in info:
+        out["move_cost"] = args.move_cost
+        out["move_penalty"] = float(info["move_penalty"])
+    if args.sparse:
+        out["sparse"] = True
+    if args.placement_unit != "service":
+        out["placement_unit"] = args.placement_unit
+    if tune_info is not None:
+        out["autotune"] = tune_info
+        out["sweeps"] = tune_info["sweeps"]
     return out
 
 

@@ -60,6 +60,11 @@ class RescheduleConfig:
     capacity_frac: float = 1.0             # packing budget as a fraction of capacity
     global_solver_iters: int = 9           # best-response sweeps per solve
     balance_weight: float = 0.0            # λ for load-balance term in global solver
+    # Disruption pricing inside the global solve: comm-weight units per
+    # restarted pod (0 = moves are free). The principled alternative to
+    # global_moves_cap — the solver itself stops proposing moves that do
+    # not pay for their restarts, so the move budget is emergent.
+    move_cost: float = 0.0
     solver_restarts: int = 1               # best-of-N solves over the device mesh
     solver_tp: int = 1                     # node-axis sharding of each solve (devices per solve)
     seed: int = 0

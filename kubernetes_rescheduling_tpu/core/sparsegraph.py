@@ -69,6 +69,12 @@ class SparseCommGraph:
     perm: jax.Array       # i32[SP] sorted slot -> original id (S = padding)
     inv: jax.Array        # i32[S]  original id -> sorted slot
     service_valid: jax.Array  # bool[SP] sorted-space validity
+    # ORIGINAL-space dense adjacency, carried ONLY for single-block graphs
+    # (≤ 256 services): the sparse chunked search degenerates there (one
+    # chunk per sweep — no Gauss-Seidel sequencing), so the solver
+    # delegates to the dense form, and this field lets that happen inside
+    # a jit trace (host-side to_dense() cannot run on tracers)
+    dense_adj: jax.Array | None = None
     # ---- static metadata (part of the jit cache key; one graph per run) ----
     # per-block first column tile (units of `bu` columns), len NB
     block_toff: tuple[int, ...] = struct.field(pytree_node=False, default=())
@@ -225,6 +231,12 @@ def from_edges(
     valid = np.zeros((SP,), dtype=bool)
     valid[:S] = True
 
+    dense_adj = None
+    if NB <= 1:
+        da = np.zeros((S, S), dtype=np.float32)
+        da[src, dst] = w  # sym list: both directions present
+        dense_adj = jnp.asarray(da)
+
     return SparseCommGraph(
         w_local=jnp.asarray(np.concatenate(strips, axis=1)),
         u_ids=jnp.asarray(np.concatenate(uids)),
@@ -234,6 +246,7 @@ def from_edges(
         perm=jnp.asarray(perm),
         inv=jnp.asarray(pos.astype(np.int32)),
         service_valid=jnp.asarray(valid),
+        dense_adj=dense_adj,
         block_toff=tuple(toff),
         block_ntiles=tuple(ntiles),
         hub_blocks=tuple(hub),

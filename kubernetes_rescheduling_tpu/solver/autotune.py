@@ -1,0 +1,93 @@
+"""Latency-budget autotuning: spend a time budget, not a sweep count.
+
+Rounds 2-3 each made the solver faster and then re-spent the savings by
+hand-editing the default sweep count. This module turns that manual loop
+into a knob: measure the actual per-sweep device cost of THIS config on
+THIS hardware at THIS problem size, then pick the sweep count that fills
+a ``--latency-budget`` (default 100 ms — the BASELINE.md solve-latency
+target). Every future kernel speedup then buys solution quality
+automatically.
+
+Measurement discipline (see RESULTS.md): per-sweep cost is a DOUBLE slope
+— chained solves inside one jitted scan isolate device time from
+dispatch+tunnel RTT, and differencing two sweep counts isolates the
+per-sweep cost from the per-round fixed cost (objective epilogue, W build,
+pod scatter). Four compilations, one-time; the tuned config itself is
+what the controller then reuses every round.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    global_assign,
+)
+
+
+def _device_ms_per_round(solver, state, graph, config, k1=2, k2=8):
+    """Slope-method device latency of one solver round (min-of-2 reps)."""
+
+    @partial(jax.jit, static_argnames=("k", "cfg"))
+    def chained(st0, g, key0, k, cfg):
+        def body(st_c, i):
+            st_n, inf = solver(st_c, g, jax.random.fold_in(key0, i), cfg)
+            return st_n, inf["objective_after"]
+
+        return jax.lax.scan(body, st0, jnp.arange(k))
+
+    def timed(k):
+        _, objs = chained(state, graph, jax.random.PRNGKey(7), k, config)
+        float(objs[-1])  # compile + warm
+        best = float("inf")
+        for rep in range(2):
+            t = time.perf_counter()
+            _, objs = chained(state, graph, jax.random.PRNGKey(8 + rep), k, config)
+            float(objs[-1])  # completion fence
+            best = min(best, time.perf_counter() - t)
+        return best
+
+    return (timed(k2) - timed(k1)) / (k2 - k1) * 1e3
+
+
+def tune_sweeps(
+    state,
+    graph,
+    config: GlobalSolverConfig,
+    budget_ms: float,
+    *,
+    solver=global_assign,
+    lo: int = 3,
+    hi: int = 9,
+    max_sweeps: int = 64,
+) -> tuple[GlobalSolverConfig, dict]:
+    """Pick the sweep count that fills ``budget_ms`` of device time.
+
+    Returns ``(tuned_config, info)`` where info carries the measured
+    per-sweep and fixed costs so the decision is auditable. ``solver`` is
+    the round function to measure — ``global_assign`` (default) or a
+    sparse/sharded wrapper with the same signature.
+    """
+    if budget_ms <= 0:
+        raise ValueError(f"latency budget must be > 0 ms, got {budget_ms}")
+    d_lo = _device_ms_per_round(solver, state, graph, config.replace(sweeps=lo))
+    d_hi = _device_ms_per_round(solver, state, graph, config.replace(sweeps=hi))
+    per_sweep = max((d_hi - d_lo) / (hi - lo), 1e-3)
+    fixed = max(d_lo - lo * per_sweep, 0.0)
+    sweeps = int((budget_ms - fixed) // per_sweep)
+    sweeps = max(1, min(max_sweeps, sweeps))
+    info = {
+        "budget_ms": float(budget_ms),
+        "per_sweep_ms": round(per_sweep, 3),
+        "fixed_ms": round(fixed, 3),
+        "measured_lo": (lo, round(d_lo, 3)),
+        "measured_hi": (hi, round(d_hi, 3)),
+        "sweeps": sweeps,
+        "predicted_round_ms": round(fixed + sweeps * per_sweep, 3),
+    }
+    return config.replace(sweeps=sweeps), info

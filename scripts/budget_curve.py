@@ -1,0 +1,42 @@
+"""Objective vs latency budget at 10k x 1k (TPU).
+
+Measures (a) the autotuner's per-sweep/fixed cost model, (b) the solve
+objective as a function of sweep count — composing them gives the
+objective-vs-budget curve that justifies the --latency-budget default and
+re-justifies the 9-sweep default against the measured quality curve.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+from kubernetes_rescheduling_tpu.bench.harness import make_backend
+from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+from kubernetes_rescheduling_tpu.solver.autotune import (
+    _device_ms_per_round,
+    tune_sweeps,
+)
+
+backend = make_backend("large", seed=0)
+state = backend.monitor()
+graph = backend.comm_graph()
+cfg = GlobalSolverConfig()
+
+tuned, info = tune_sweeps(state, graph, cfg, 100.0)
+print("autotune@100ms:", json.dumps(info))
+
+for s in (3, 6, 9, 18, 36):
+    c = cfg.replace(sweeps=s)
+    # objective after a 3-round chain (the controller regime), exact value
+    st = state
+    inf = None
+    for i in range(3):
+        st, inf = global_assign(st, graph, jax.random.PRNGKey(40 + i), c)
+    obj = float(inf["objective_after"])
+    ms = info["fixed_ms"] + s * info["per_sweep_ms"]
+    print(json.dumps({"sweeps": s, "pred_ms": round(ms, 1), "objective_3rounds": obj}))

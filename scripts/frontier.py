@@ -1,0 +1,64 @@
+"""Disruption/quality frontier: move-cost pricing vs wave capping.
+
+Runs the µBench experiment matrix (global algorithm, load sustained
+through the loop — reference release2.sh semantics) across a sweep of
+``move_cost`` values and a sweep of ``global_moves_cap`` values, and
+prints the measured frontier: pods restarted, request error rate during
+rescheduling, and final communication cost. This is the evidence behind
+RESULTS.md's operator guidance on pricing restarts inside the solve
+versus capping the wave after it.
+
+CPU-friendly (sim backend at µBench scale): JAX_PLATFORMS=cpu recommended.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# Force the CPU platform even when a site hook pre-imported jax and pinned
+# the tunneled TPU (env var alone is not enough — every eager op would pay
+# a ~0.1 s tunnel round trip and this matrix would take hours)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from kubernetes_rescheduling_tpu.bench.harness import (
+    ExperimentConfig,
+    run_experiment,
+)
+
+
+def run(tag, **kw):
+    cfg = ExperimentConfig(
+        algorithms=("global",),
+        repeats=3,
+        rounds=20,
+        scenario="mubench",
+        out_dir=f"/tmp/frontier/{tag}",
+        session_name=tag,
+        seed=2,
+        **kw,
+    )
+    agg = run_experiment(cfg)["aggregate"]["global"]
+    return {
+        "config": tag,
+        "restarts": round(agg["restarts"], 1),
+        "error_rate_during": round(agg["error_rate_during"], 4),
+        "communication_cost": round(agg["communication_cost"], 2),
+        # the point of rescheduling: a config that avoids all disruption by
+        # never moving leaves the pile-up's queueing latency in place
+        "response_time_ms": round(agg["response_time_ms"], 2),
+        "load_std": round(agg["load_std"], 2),
+    }
+
+
+rows = []
+rows.append(run("uncapped"))
+for k in (1, 2, 4):
+    rows.append(run(f"cap{k}", global_moves_cap=k))
+for mc in (0.5, 2.0, 4.0, 8.0):
+    rows.append(run(f"mc{mc}", move_cost=mc))
+for r in rows:
+    print(json.dumps(r))

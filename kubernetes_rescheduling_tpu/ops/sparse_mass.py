@@ -203,22 +203,26 @@ def chunk_local_slabs(u_ids, rvu, starts, width: int):
 
 
 def reference_sparse_mass(
-    w_mm, tgt_c, rvu_c, blocks, toff, *, num_nodes: int, bu: int, reg_tiles: int
+    w_mm, tgt_c, rvu_c, blocks, toff, *, num_nodes: int, bu: int,
+    reg_tiles: int, col_offset=0,
 ):
     """Plain-XLA twin of :func:`sparse_neighbor_mass` (gather + matmul —
     no scatter, so it is TPU- and vmap-safe). Term-for-term the same f32
-    operation order as the kernel body."""
+    operation order as the kernel body. ``col_offset`` shifts the node
+    columns (the node-sharded solver computes M for its shard's columns:
+    ``num_nodes`` = local width, offset = ``shard · Nl``)."""
     U = reg_tiles * bu
     N = int(num_nodes)
     KB = blocks.shape[0]
     tgt_b = tgt_c.reshape(KB, U)
     rvu_b = rvu_c.reshape(KB, U)
+    cols = col_offset + jnp.arange(N, dtype=jnp.int32)
 
     def per_block(b, tgt, rv):
         start = toff[b] * bu
         wb = lax.dynamic_slice(w_mm, (0, start), (BLOCK_R, U))
         oh = jnp.where(
-            tgt[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :],
+            tgt[:, None] == cols[None, :],
             rv[:, None],
             0.0,
         ).astype(w_mm.dtype)
@@ -229,12 +233,13 @@ def reference_sparse_mass(
 
 
 def reference_hub_mass(
-    sgraph, w_mm, tgt_l, rvu_l, *, num_nodes: int, blocks=None
+    sgraph, w_mm, tgt_l, rvu_l, *, num_nodes: int, blocks=None, col_offset=0
 ):
     """Plain-XLA twin of :func:`hub_neighbor_mass` — hub offsets/widths are
     static, so this is a Python loop over static slices of the group-local
-    slab."""
+    slab. ``col_offset`` as in :func:`reference_sparse_mass`."""
     N = int(num_nodes)
+    cols = col_offset + jnp.arange(N, dtype=jnp.int32)
     outs = []
     lo = 0
     for b in blocks if blocks is not None else sgraph.hub_blocks:
@@ -245,7 +250,7 @@ def reference_hub_mass(
         wb = w_mm[:, off : off + width]
         lo += width
         oh = jnp.where(
-            tgt[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :],
+            tgt[:, None] == cols[None, :],
             rv[:, None],
             0.0,
         ).astype(w_mm.dtype)

@@ -29,12 +29,16 @@ from kubernetes_rescheduling_tpu.parallel.sharded_solver import (
     sharded_global_assign,
     sharded_solve_with_restarts,
 )
+from kubernetes_rescheduling_tpu.parallel.sharded_sparse import (
+    sharded_sparse_assign,
+)
 
 __all__ = [
     "make_mesh",
     "parallel_restarts",
     "sharded_choose_node",
     "sharded_global_assign",
+    "sharded_sparse_assign",
     "sharded_solve_with_restarts",
     "solve_with_restarts",
 ]

@@ -52,6 +52,111 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
 _NEG_INF = float("-inf")
 
 
+def sharded_place(
+    M, cur, valid_c, c_cpu, c_mem, cpu_l, mem_l, cap_l, mem_cap_l,
+    valid_l, gcol, N, config, ow, chunk_key, temp, shard,
+):
+    """Shard-local score → global first-max → admission → per-node load
+    deltas for one chunk, under a mesh with a ``tp`` axis.
+
+    ``M`` is the chunk's neighbor mass for THIS shard's node columns —
+    the only input whose computation differs between the dense
+    (materialized-X matmul) and sparse (block-local slab) node-sharded
+    solvers; everything downstream is THIS one function, so the decision
+    math cannot fork between them. Collectives: ``all_gather`` of each
+    shard's top-1 (score, global index), ``psum`` of the current-node
+    score and the landing slack (only the owning shard's term is
+    nonzero). Returns ``(new_node, admitted, is_new, d_cpu, d_mem)``.
+    """
+    is_cur = gcol == cur[:, None]                     # (C, Nl)
+    proj_cpu = cpu_l[None, :] + jnp.where(is_cur, 0.0, c_cpu[:, None])
+    proj_pct = proj_cpu / cap_l[None, :] * 100.0
+    score = (
+        M
+        - config.balance_weight * proj_pct
+        - ow * jnp.maximum(proj_pct - 100.0, 0.0)
+    )
+    if config.noise_temp > 0:
+        # keys are replicated; fold in the shard so each node column
+        # block draws its own stream (matches nothing — annealing
+        # noise carries no parity requirement)
+        noise_key = jax.random.fold_in(chunk_key, shard)
+        score = score + temp * jax.random.gumbel(noise_key, score.shape)
+
+    if config.enforce_capacity:
+        proj_mem = mem_l[None, :] + jnp.where(is_cur, 0.0, c_mem[:, None])
+        fits = (proj_cpu <= cap_l[None, :]) & (proj_mem <= mem_cap_l[None, :])
+        feasible = (fits | is_cur) & valid_l[None, :]
+    else:
+        feasible = jnp.broadcast_to(valid_l[None, :], score.shape)
+
+    masked = jnp.where(feasible, score, _NEG_INF)
+    loc_val = jnp.max(masked, axis=1)                 # (C,)
+    at_max = masked == loc_val[:, None]
+    loc_idx = jnp.min(jnp.where(at_max, gcol, N), axis=1)
+    cur_score = lax.psum(
+        jnp.sum(jnp.where(is_cur, score, 0.0), axis=1), "tp"
+    )
+
+    # global first-max: gather each shard's top-1, then among the
+    # shards achieving the max score take the lowest global index
+    all_val = lax.all_gather(loc_val, "tp")           # (tp, C)
+    all_idx = lax.all_gather(loc_idx, "tp")           # (tp, C)
+    best_val = jnp.max(all_val, axis=0)
+    prop = jnp.min(
+        jnp.where(all_val == best_val[None, :], all_idx, N), axis=0
+    ).astype(jnp.int32)
+    prop = jnp.minimum(prop, N - 1)
+    gain = best_val - cur_score
+    wants = valid_c & (gain > 0) & (prop != cur)
+
+    # landing slack lives on the owning shard; psum the masked term
+    is_prop = gcol == prop[:, None]                   # (C, Nl)
+    slack_cpu = lax.psum(
+        jnp.sum(jnp.where(is_prop, cap_l[None, :] - cpu_l[None, :], 0.0), axis=1),
+        "tp",
+    ) - c_cpu
+    slack_mem = lax.psum(
+        jnp.sum(
+            jnp.where(
+                is_prop,
+                jnp.where(
+                    jnp.isinf(mem_cap_l), 3.4e38, mem_cap_l
+                )[None, :]
+                - mem_l[None, :],
+                0.0,
+            ),
+            axis=1,
+        ),
+        "tp",
+    ) - c_mem
+
+    if config.enforce_capacity:
+        # replicated vectors -> the shared race, bit-identical to
+        # the single-device reference path
+        admitted = pairwise_admission(
+            gain, prop, wants, c_cpu, c_mem, slack_cpu, slack_mem
+        )
+    else:
+        admitted = wants
+
+    new_node = jnp.where(admitted, prop, cur)
+    is_new = gcol == new_node[:, None]
+    a_cpu = jnp.where(admitted, c_cpu, 0.0)
+    a_mem = jnp.where(admitted, c_mem, 0.0)
+    d_cpu = jnp.sum(
+        jnp.where(is_new, a_cpu[:, None], 0.0)
+        - jnp.where(is_cur, a_cpu[:, None], 0.0),
+        axis=0,
+    )
+    d_mem = jnp.sum(
+        jnp.where(is_new, a_mem[:, None], 0.0)
+        - jnp.where(is_cur, a_mem[:, None], 0.0),
+        axis=0,
+    )
+    return new_node, admitted, is_new, d_cpu, d_mem
+
+
 def _dims(config: GlobalSolverConfig, S: int, N: int, tp: int):
     C = min(auto_chunk(S, config.chunk_size), S)
     n_chunks = -(-S // C)
@@ -147,95 +252,16 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
             cur = assign[ids]
 
             M = jnp.matmul(W_mm[ids], X_l, preferred_element_type=jnp.float32)
-            is_cur = gcol == cur[:, None]                     # (C, Nl)
-            proj_cpu = cpu_l[None, :] + jnp.where(is_cur, 0.0, c_cpu[:, None])
-            proj_pct = proj_cpu / cap_l[None, :] * 100.0
-            score = (
-                M
-                - config.balance_weight * proj_pct
-                - ow * jnp.maximum(proj_pct - 100.0, 0.0)
+            # everything after M is the SHARED shard-local placement (also
+            # used by the sparse node-sharded solver)
+            new_node, admitted, is_new, d_cpu, d_mem = sharded_place(
+                M, cur, valid_c, c_cpu, c_mem, cpu_l, mem_l,
+                cap_l, mem_cap_l, valid_l, gcol, N, config, ow,
+                chunk_key, temp, shard,
             )
-            if config.noise_temp > 0:
-                # keys are replicated; fold in the shard so each node column
-                # block draws its own stream (matches nothing — annealing
-                # noise carries no parity requirement)
-                noise_key = jax.random.fold_in(chunk_key, shard)
-                score = score + temp * jax.random.gumbel(noise_key, score.shape)
-
-            if config.enforce_capacity:
-                proj_mem = mem_l[None, :] + jnp.where(is_cur, 0.0, c_mem[:, None])
-                fits = (proj_cpu <= cap_l[None, :]) & (proj_mem <= mem_cap_l[None, :])
-                feasible = (fits | is_cur) & valid_l[None, :]
-            else:
-                feasible = jnp.broadcast_to(valid_l[None, :], score.shape)
-
-            masked = jnp.where(feasible, score, _NEG_INF)
-            loc_val = jnp.max(masked, axis=1)                 # (C,)
-            at_max = masked == loc_val[:, None]
-            loc_idx = jnp.min(jnp.where(at_max, gcol, N), axis=1)
-            cur_score = lax.psum(
-                jnp.sum(jnp.where(is_cur, score, 0.0), axis=1), "tp"
-            )
-
-            # global first-max: gather each shard's top-1, then among the
-            # shards achieving the max score take the lowest global index
-            all_val = lax.all_gather(loc_val, "tp")           # (tp, C)
-            all_idx = lax.all_gather(loc_idx, "tp")           # (tp, C)
-            best_val = jnp.max(all_val, axis=0)
-            prop = jnp.min(
-                jnp.where(all_val == best_val[None, :], all_idx, N), axis=0
-            ).astype(jnp.int32)
-            prop = jnp.minimum(prop, N - 1)
-            gain = best_val - cur_score
-            wants = valid_c & (gain > 0) & (prop != cur)
-
-            # landing slack lives on the owning shard; psum the masked term
-            is_prop = gcol == prop[:, None]                   # (C, Nl)
-            slack_cpu = lax.psum(
-                jnp.sum(jnp.where(is_prop, cap_l[None, :] - cpu_l[None, :], 0.0), axis=1),
-                "tp",
-            ) - c_cpu
-            slack_mem = lax.psum(
-                jnp.sum(
-                    jnp.where(
-                        is_prop,
-                        jnp.where(
-                            jnp.isinf(mem_cap_l), 3.4e38, mem_cap_l
-                        )[None, :]
-                        - mem_l[None, :],
-                        0.0,
-                    ),
-                    axis=1,
-                ),
-                "tp",
-            ) - c_mem
-
-            if config.enforce_capacity:
-                # replicated vectors -> the shared race, bit-identical to
-                # the single-device reference path
-                admitted = pairwise_admission(
-                    gain, prop, wants, c_cpu, c_mem, slack_cpu, slack_mem
-                )
-            else:
-                admitted = wants
-
-            new_node = jnp.where(admitted, prop, cur)
             new_assign = assign.at[ids].set(new_node)
-            is_new = gcol == new_node[:, None]
             X_l = X_l.at[ids].set(
                 (is_new & valid_c[:, None]).astype(X_l.dtype)
-            )
-            a_cpu = jnp.where(admitted, c_cpu, 0.0)
-            a_mem = jnp.where(admitted, c_mem, 0.0)
-            d_cpu = jnp.sum(
-                jnp.where(is_new, a_cpu[:, None], 0.0)
-                - jnp.where(is_cur, a_cpu[:, None], 0.0),
-                axis=0,
-            )
-            d_mem = jnp.sum(
-                jnp.where(is_new, a_mem[:, None], 0.0)
-                - jnp.where(is_cur, a_mem[:, None], 0.0),
-                axis=0,
             )
             return (new_assign, X_l, cpu_l + d_cpu, mem_l + d_mem), jnp.sum(admitted)
 

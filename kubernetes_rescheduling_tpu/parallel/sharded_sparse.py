@@ -1,0 +1,389 @@
+"""Node-axis-sharded SPARSE solver: the block-local form as SPMD.
+
+The sparse single-chip solver (solver/sparse_solver.py) breaks the dense
+SP² weight wall; this module shards its NODE axis over the mesh's ``tp``
+dimension the same way the dense ``sharded_global_assign`` does:
+
+- sharded: per-node loads/capacities; each shard computes the chunk's
+  neighbor mass for ITS node columns only (the block-local matmul twins
+  take a ``col_offset`` — contraction work divides by tp).
+- replicated: the block-local weights (``w_local`` is small — that is the
+  whole point of the sparse form: 388 MB at 50k services, so replication
+  is cheap where the dense form could not even be allocated), neighbor
+  ids, service vectors, the assignment, and the COO edge list.
+- collectives per chunk step: the SHARED ``sharded_place``
+  (parallel/sharded_solver.py) — all_gather of per-shard top-1, psum of
+  cur-score and landing slack. The decision math cannot fork from the
+  dense sharded solver because it IS the same function.
+
+Sweep structure mirrors the single-chip sparse solver exactly (hub groups
+first with the same key stream, then randomized regular chunks over the
+same composition), so with annealing noise off and balance_weight 0 the
+sharded solve makes bit-identical decisions (parity-tested at tp=4).
+
+Plain shard_map + XLA, like the dense sharded solver — the Pallas kernels
+optimize single-chip launch count; here the structure exists to scale
+FLOPs across chips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubernetes_rescheduling_tpu.core.sparsegraph import (
+    BLOCK_R,
+    SparseCommGraph,
+)
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+from kubernetes_rescheduling_tpu.objectives.metrics import load_std
+from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+    chunk_local_slabs,
+    reference_hub_mass,
+    reference_sparse_mass,
+)
+from kubernetes_rescheduling_tpu.parallel.sharded_solver import sharded_place
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    auto_chunk,
+)
+from kubernetes_rescheduling_tpu.solver.sparse_solver import (
+    hub_slab,
+    sorted_problem_arrays,
+    sparse_pod_comm_cost,
+)
+
+_SOLVE_CACHE: dict = {}
+
+
+def _geometry(sgraph: SparseCommGraph, config: GlobalSolverConfig):
+    S = sgraph.num_services
+    C = min(auto_chunk(S, config.chunk_size), S)
+    KB = max(1, C // BLOCK_R)
+    NBR = len(sgraph.regular_blocks)
+    n_chunks = max(1, -(-NBR // KB)) if NBR else 0
+    ndummy = n_chunks * KB - NBR
+    SPX = sgraph.sp + ndummy * BLOCK_R
+    hub_groups = [
+        tuple(sgraph.hub_blocks[g : g + KB])
+        for g in range(0, len(sgraph.hub_blocks), KB)
+    ]
+    return C, KB, n_chunks, ndummy, SPX, hub_groups
+
+
+def _solve_factory(
+    config: GlobalSolverConfig, sgraph_meta, S: int, N: int, tp: int
+):
+    """Shard-local sparse solve body. ``sgraph_meta`` carries only STATIC
+    graph structure (block offsets/widths, hub groups) — all arrays arrive
+    as shard_map arguments."""
+    (
+        C, KB, n_chunks, ndummy, SPX, hub_groups,
+        block_toff, block_ntiles, bu, reg_tiles,
+    ) = sgraph_meta
+    Nl = N // tp
+    ow = config.overload_weight if config.enforce_capacity else 0.0
+    temps = config.noise_temp * (
+        1.0
+        - jnp.arange(config.sweeps, dtype=jnp.float32)
+        / max(config.sweeps - 1, 1)
+    )
+    # static slab boundaries for the hub groups' concatenated columns
+    group_widths = [
+        sum(block_ntiles[b] * bu for b in g) for g in hub_groups
+    ]
+    group_lo = np.concatenate([[0], np.cumsum(group_widths)]).astype(int)
+
+    class _Meta:  # duck-typed sgraph for reference_hub_mass (static fields)
+        pass
+
+    meta = _Meta()
+    meta.block_toff = block_toff
+    meta.block_ntiles = block_ntiles
+    meta.bu = bu
+    meta.hub_blocks = tuple(b for g in hub_groups for b in g)
+
+    def solve_one(
+        assign_init, w_mm, u_ids, rvu, rv_s, svc_valid, svc_cpu, svc_mem,
+        toff_ext, reg_ext, hub_ids_all, u_hub_all, rvu_hub_all,
+        e_src, e_dst, e_w,
+        cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r,
+    ):
+        shard = lax.axis_index("tp")
+        col0 = shard * Nl
+        gcol = col0 + lax.broadcasted_iota(jnp.int32, (1, Nl), 1)
+        nvalid = jnp.maximum(lax.psum(jnp.sum(valid_l), "tp"), 1)
+
+        def local_loads(assign):
+            owned = (assign[:, None] == gcol) & svc_valid[:, None]
+            of = owned.astype(jnp.float32)
+            return base_cpu_l + svc_cpu @ of, base_mem_l + svc_mem @ of
+
+        def _balance_terms(cpu_l):
+            pct = jnp.where(valid_l, cpu_l / cap_l * 100.0, 0.0)
+            s1 = lax.psum(jnp.sum(pct), "tp")
+            s2 = lax.psum(jnp.sum(pct * pct), "tp")
+            mean = s1 / nvalid
+            var = jnp.maximum(s2 / nvalid - mean * mean, 0.0)
+            over = lax.psum(jnp.sum(jnp.maximum(pct - 100.0, 0.0)), "tp")
+            return config.balance_weight * jnp.sqrt(var) + ow * over
+
+        def objective(assign, cpu_l):
+            """EXACT sparse cut-sum (replicated — every shard computes the
+            same value from the replicated edge list) + psum'd balance."""
+            cut = (assign[e_src] != assign[e_dst]).astype(jnp.float32)
+            comm = 0.5 * jnp.sum(e_w * rv_s[e_src] * rv_s[e_dst] * cut)
+            return comm + _balance_terms(cpu_l)
+
+        def place(inner, ids, M, chunk_key, temp):
+            assign, cpu_l, mem_l = inner
+            valid_c = svc_valid[ids]
+            c_cpu = svc_cpu[ids]
+            c_mem = svc_mem[ids]
+            cur = assign[ids]
+            new_node, admitted, _, d_cpu, d_mem = sharded_place(
+                M, cur, valid_c, c_cpu, c_mem, cpu_l, mem_l,
+                cap_l, mem_cap_l, valid_l, gcol, N, config, ow,
+                chunk_key, temp, shard,
+            )
+            return (
+                (assign.at[ids].set(new_node), cpu_l + d_cpu, mem_l + d_mem),
+                jnp.sum(admitted),
+            )
+
+        def chunk_mass(assign, blocks, ids):
+            starts = toff_ext[blocks] * bu
+            u_c, rvu_c = chunk_local_slabs(u_ids, rvu, starts, reg_tiles * bu)
+            tgt_c = assign[jnp.clip(u_c, 0, SPX - 1)]
+            raw = reference_sparse_mass(
+                w_mm, tgt_c, rvu_c, blocks, toff_ext,
+                num_nodes=Nl, bu=bu, reg_tiles=reg_tiles, col_offset=col0,
+            )
+            return raw * rv_s[ids][:, None]
+
+        def sweep(carry, xs):
+            sweep_key, temp = xs
+            assign, cpu_l, mem_l, best_assign, best_obj = carry
+            perm_key, noise_key = jax.random.split(sweep_key)
+            hub_moves = jnp.int32(0)
+            if hub_groups:
+                keys = jax.random.split(noise_key, n_chunks + len(hub_groups))
+                chunk_keys = keys[:n_chunks]
+                inner = (assign, cpu_l, mem_l)
+                hub_cursor = 0
+                for g, blocks_g in enumerate(hub_groups):
+                    assign = inner[0]
+                    lo, hi = int(group_lo[g]), int(group_lo[g + 1])
+                    u_g = u_hub_all[lo:hi]
+                    rvu_g = rvu_hub_all[lo:hi]
+                    tgt_g = assign[jnp.clip(u_g, 0, SPX - 1)]
+                    ids_g = lax.dynamic_slice(
+                        hub_ids_all,
+                        (hub_cursor,),
+                        (len(blocks_g) * BLOCK_R,),
+                    )
+                    raw = reference_hub_mass(
+                        meta, w_mm, tgt_g, rvu_g,
+                        num_nodes=Nl, blocks=blocks_g, col_offset=col0,
+                    )
+                    M = raw * rv_s[ids_g][:, None]
+                    inner, g_moves = place(
+                        inner, ids_g, M, keys[n_chunks + g], temp
+                    )
+                    hub_moves = hub_moves + g_moves
+                    hub_cursor += len(blocks_g) * BLOCK_R
+                assign, cpu_l, mem_l = inner
+            else:
+                chunk_keys = jax.random.split(noise_key, n_chunks)
+            bp = jax.random.permutation(perm_key, n_chunks * KB)
+            chunk_blocks = reg_ext[bp].reshape(n_chunks, KB)
+            chunk_ids = (
+                chunk_blocks[:, :, None] * BLOCK_R
+                + jnp.arange(BLOCK_R, dtype=jnp.int32)[None, None, :]
+            ).reshape(n_chunks, KB * BLOCK_R)
+
+            def chunk_step(inner, xs_c):
+                blocks, ids, chunk_key = xs_c
+                M = chunk_mass(inner[0], blocks, ids)
+                return place(inner, ids, M, chunk_key, temp)
+
+            (assign, _, _), moves = lax.scan(
+                chunk_step, (assign, cpu_l, mem_l),
+                (chunk_blocks, chunk_ids, chunk_keys),
+            )
+            cpu_fresh, mem_fresh = local_loads(assign)
+            obj = objective(assign, cpu_fresh)
+            better = obj < best_obj
+            best_assign = jnp.where(better, assign, best_assign)
+            best_obj = jnp.where(better, obj, best_obj)
+            return (
+                (assign, cpu_fresh, mem_fresh, best_assign, best_obj),
+                jnp.sum(moves) + hub_moves,
+            )
+
+        cpu0, mem0 = local_loads(assign_init)
+        obj0 = objective(assign_init, cpu0)
+        (_, _, _, best_assign, best_obj), _ = lax.scan(
+            sweep, (assign_init, cpu0, mem0, assign_init, obj0),
+            (keys_r, temps),
+        )
+        return best_assign, best_obj
+
+    return solve_one
+
+
+_IN_SPECS = (
+    # replicated problem data
+    P(), P(), P(), P(), P(), P(), P(), P(),
+    P(), P(), P(), P(), P(),
+    P(), P(), P(),
+    # node-axis-sharded per-node vectors
+    P("tp"), P("tp"), P("tp"), P("tp"), P("tp"),
+    # keys (replicated)
+    P(),
+)
+
+
+def _build_solve(mesh, config, sgraph_meta, S, N):
+    # the FULL meta (incl. per-block offsets/widths) keys the cache: the
+    # factory bakes group_lo slab boundaries and the chunk slab width into
+    # the compiled closure, so two graphs agreeing only on counts must not
+    # share a solver
+    cache_key = (mesh, config, sgraph_meta, S, N)
+    fn = _SOLVE_CACHE.get(cache_key)
+    if fn is not None:
+        return fn
+    solve_one = _solve_factory(config, sgraph_meta, S, N, mesh.shape["tp"])
+    fn = jax.jit(
+        partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=_IN_SPECS,
+            out_specs=(P(), P()),
+            check_vma=False,
+        )(solve_one)
+    )
+    _SOLVE_CACHE[cache_key] = fn
+    return fn
+
+
+def sharded_sparse_assign(
+    state: ClusterState,
+    sgraph: SparseCommGraph,
+    key: jax.Array,
+    mesh: Mesh,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """``global_assign_sparse`` with the node axis sharded over ``mesh``'s
+    ``tp``. Requires ``num_nodes % tp == 0`` and ≥ 2 blocks (single-block
+    graphs belong to the dense solver — same rule as the single-chip
+    sparse path). Never worse than the input placement."""
+    if not config.capacity_frac > 0:
+        raise ValueError(f"capacity_frac must be > 0, got {config.capacity_frac}")
+    if config.move_cost > 0:
+        raise ValueError(
+            "move_cost is not implemented in the node-sharded sparse "
+            "solver yet — use tp=1 or move_cost=0"
+        )
+    if sgraph.num_blocks <= 1:
+        raise ValueError(
+            "single-block sparse graphs delegate to the dense solver; use "
+            "global_assign_sparse (or sharded_global_assign) instead"
+        )
+    if sgraph.weight_bytes() > config.max_weight_bytes:
+        # same sizing contract as the single-chip sparse solver — w_local
+        # is REPLICATED per shard, so the budget matters at least as much
+        raise ValueError(
+            f"sparse pair weights need {sgraph.weight_bytes() / 2**30:.2f} "
+            f"GiB — over max_weight_bytes; the graph is too dense for the "
+            "sparse form (use the dense solver)."
+        )
+    tp = mesh.shape["tp"]
+    S = sgraph.num_services
+    N = state.num_nodes
+    if N % tp:
+        raise ValueError(f"num_nodes {N} must be a multiple of tp={tp}")
+    C, KB, n_chunks, ndummy, SPX, hub_groups = _geometry(sgraph, config)
+    sgraph_meta = (
+        C, KB, n_chunks, ndummy, SPX, tuple(hub_groups),
+        sgraph.block_toff, sgraph.block_ntiles, sgraph.bu, sgraph.reg_tiles,
+    )
+
+    # ---- sorted-space arrays: THE single-chip sparse solver's preamble
+    # (one definition — the tp=4/8 bit-parity test pins the two paths) ----
+    svc_valid, svc_cpu_s, svc_mem_s, cur_s, rv_s, rvu = sorted_problem_arrays(
+        state, sgraph, SPX
+    )
+    w_mm = sgraph.w_local.astype(jnp.dtype(config.matmul_dtype))
+    assign0 = jnp.where(svc_valid, jnp.clip(cur_s, 0, N - 1), 0)
+
+    toff_ext = jnp.asarray(
+        np.asarray(
+            list(sgraph.block_toff) + [sgraph.zero_toff] * ndummy,
+            dtype=np.int32,
+        )
+    )
+    NB = sgraph.num_blocks
+    reg_ext = jnp.asarray(
+        np.asarray(
+            list(sgraph.regular_blocks) + [NB + d for d in range(ndummy)],
+            dtype=np.int32,
+        )
+    )
+    flat_hubs = [b for g in hub_groups for b in g]
+    if flat_hubs:
+        hub_ids_all = jnp.asarray(
+            np.concatenate(
+                [np.arange(BLOCK_R, dtype=np.int32) + b * BLOCK_R for b in flat_hubs]
+            )
+        )
+        u_hub_all, rvu_hub_all = hub_slab(sgraph, flat_hubs, rv_s, SPX)
+    else:
+        hub_ids_all = jnp.zeros((0,), jnp.int32)
+        u_hub_all = jnp.zeros((0,), jnp.int32)
+        rvu_hub_all = jnp.zeros((0,), jnp.float32)
+
+    cpu_cap = jnp.where(state.node_valid, state.node_cpu_cap, 0.0)
+    mem_cap_raw = jnp.where(state.node_valid, state.node_mem_cap, 0.0)
+    mem_cap = (
+        jnp.where(mem_cap_raw > 0, mem_cap_raw, jnp.inf) * config.capacity_frac
+    )
+    cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0) * config.capacity_frac
+
+    keys = jax.random.split(key, config.sweeps)
+    best_assign, best_obj = _build_solve(mesh, config, sgraph_meta, S, N)(
+        assign0, w_mm, sgraph.u_ids, rvu, rv_s, svc_valid, svc_cpu_s,
+        svc_mem_s, toff_ext, reg_ext, hub_ids_all, u_hub_all, rvu_hub_all,
+        sgraph.edges_src, sgraph.edges_dst, sgraph.edges_w,
+        cap, mem_cap, state.node_base_cpu, state.node_base_mem,
+        state.node_valid, keys,
+    )
+
+    # ---- never-worse gate vs the TRUE input placement ----
+    ow = config.overload_weight if config.enforce_capacity else 0.0
+    pct0 = jnp.where(state.node_valid, state.node_cpu_used() / cap * 100.0, 0.0)
+    obj_true0 = (
+        sparse_pod_comm_cost(state, sgraph)
+        + config.balance_weight * (load_std(state) / config.capacity_frac)
+        + ow * jnp.sum(jnp.maximum(pct0 - 100.0, 0.0))
+    )
+    improved = best_obj < obj_true0
+    pod_slot = jnp.clip(
+        sgraph.inv[jnp.clip(state.pod_service, 0, S - 1)], 0, SPX - 1
+    )
+    new_pod_node = jnp.where(
+        improved & state.pod_valid, best_assign[pod_slot], state.pod_node
+    )
+    info = {
+        "objective_before": obj_true0,
+        "objective_after": jnp.minimum(best_obj, obj_true0),
+        "improved": improved,
+        "tp": jnp.asarray(tp),
+    }
+    return state.replace(pod_node=new_pod_node), info

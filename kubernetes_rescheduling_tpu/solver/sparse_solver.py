@@ -152,6 +152,57 @@ def global_assign_sparse(
     return _global_assign_sparse(state, sgraph, key, config)
 
 
+def sorted_problem_arrays(state: ClusterState, sgraph: SparseCommGraph, SPX: int):
+    """Sorted-space per-service arrays + neighbor replica columns — ONE
+    definition shared by the single-chip and node-sharded sparse solvers.
+    The tp bit-parity contract depends on these staying identical; edit
+    here, never in one solver alone. Returns ``(svc_valid, svc_cpu_s,
+    svc_mem_s, cur_s, rv_s, rvu)``, all padded to ``SPX`` (the service
+    count incl. dummy chunk-padding blocks)."""
+    S = sgraph.num_services
+    replicas, svc_cpu, svc_mem, cur_node, has_pods = _service_aggregates(
+        state, S
+    )
+    pclip = jnp.clip(sgraph.perm, 0, S - 1)
+    ok = sgraph.perm < S
+
+    def sort_pad(x, fill=0.0):
+        return _pad_to(jnp.where(ok, x[pclip], fill), SPX, fill)
+
+    svc_valid = _pad_to(ok & has_pods[pclip] & sgraph.service_valid, SPX, False)
+    svc_cpu_s = sort_pad(svc_cpu) * svc_valid
+    svc_mem_s = sort_pad(svc_mem) * svc_valid
+    cur_s = jnp.where(svc_valid, sort_pad(cur_node, -1), -1)
+    rv_s = sort_pad(replicas) * svc_valid
+    # neighbor-column replica factor (0 on padding columns — the mass
+    # kernels rely on this as the padding mask)
+    rvu = jnp.where(
+        sgraph.u_ids < sgraph.sp,
+        rv_s[jnp.clip(sgraph.u_ids, 0, SPX - 1)],
+        0.0,
+    )
+    return svc_valid, svc_cpu_s, svc_mem_s, cur_s, rv_s, rvu
+
+
+def hub_slab(sgraph: SparseCommGraph, blocks, rv_s, SPX: int):
+    """Concatenated group-local neighbor columns (ids + replica factors)
+    for the given hub ``blocks`` — static slices of ``u_ids``, shared by
+    both sparse solvers."""
+    u_g = jnp.concatenate(
+        [
+            sgraph.u_ids[
+                sgraph.block_toff[b] * sgraph.bu :
+                (sgraph.block_toff[b] + sgraph.block_ntiles[b]) * sgraph.bu
+            ]
+            for b in blocks
+        ]
+    )
+    rvu_g = jnp.where(
+        u_g < sgraph.sp, rv_s[jnp.clip(u_g, 0, SPX - 1)], 0.0
+    )
+    return u_g, rvu_g
+
+
 @partial(jax.jit, static_argnames=("config",))
 def _global_assign_sparse(
     state: ClusterState,
@@ -187,31 +238,10 @@ def _global_assign_sparse(
     ndummy = n_chunks * KB - NBR
     SPX = SP + ndummy * BLOCK_R  # service-array size incl. dummy blocks
 
-    # ---- sorted-space per-service arrays ----
-    replicas, svc_cpu, svc_mem, cur_node, has_pods = _service_aggregates(
-        state, S
-    )
-    pclip = jnp.clip(sgraph.perm, 0, S - 1)
-    ok = sgraph.perm < S
-
-    def sort_pad(x, fill=0.0):
-        return _pad_to(
-            jnp.where(ok, x[pclip], fill), SPX, fill
-        )
-
-    svc_valid = _pad_to(
-        ok & has_pods[pclip] & sgraph.service_valid, SPX, False
-    )
-    svc_cpu_s = sort_pad(svc_cpu) * svc_valid
-    svc_mem_s = sort_pad(svc_mem) * svc_valid
-    cur_s = jnp.where(svc_valid, sort_pad(cur_node, -1), -1)
-    rv_s = sort_pad(replicas) * svc_valid
-    # neighbor-column replica factor (0 on padding columns — the mass
-    # kernels rely on this as the padding mask)
-    rvu = jnp.where(
-        sgraph.u_ids < SP,
-        rv_s[jnp.clip(sgraph.u_ids, 0, SPX - 1)],
-        0.0,
+    # ---- sorted-space per-service arrays (SHARED with the node-sharded
+    # sparse solver — the tp bit-parity contract) ----
+    svc_valid, svc_cpu_s, svc_mem_s, cur_s, rv_s, rvu = sorted_problem_arrays(
+        state, sgraph, SPX
     )
 
     mm_dtype = jnp.dtype(config.matmul_dtype)
@@ -315,18 +345,7 @@ def _global_assign_sparse(
                 ]
             )
         )
-        u_g = jnp.concatenate(
-            [
-                sgraph.u_ids[
-                    sgraph.block_toff[b] * sgraph.bu :
-                    (sgraph.block_toff[b] + sgraph.block_ntiles[b]) * sgraph.bu
-                ]
-                for b in blocks_g
-            ]
-        )
-        rvu_g = jnp.where(
-            u_g < SP, rv_s[jnp.clip(u_g, 0, SPX - 1)], 0.0
-        )
+        u_g, rvu_g = hub_slab(sgraph, blocks_g, rv_s, SPX)
         hub_groups.append(
             (blocks_g, ids_g, u_g, rvu_g, hub_tile_arrays(sgraph, blocks_g))
         )

@@ -308,3 +308,21 @@ def test_move_cost_sparse_matches_dense_semantics():
     if bool(info2["improved"]):
         gain = float(info2["objective_before"]) - float(info2["objective_after"])
         assert gain > float(info2["move_penalty"])
+
+
+def test_prepared_weights_identical_solve():
+    """Injecting prepare_weights' matrix gives bit-identical decisions to
+    the self-built path (it IS the same matrix)."""
+    from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+    from kubernetes_rescheduling_tpu.solver.global_solver import prepare_weights
+
+    scn = synthetic_scenario(n_pods=300, n_nodes=8, seed=4, mean_degree=4.0)
+    cfg = GlobalSolverConfig(sweeps=4)
+    key = jax.random.PRNGKey(2)
+    w_mm = prepare_weights(scn.state, scn.graph, cfg)
+    st_a, info_a = global_assign(scn.state, scn.graph, key, cfg)
+    st_b, info_b = global_assign(scn.state, scn.graph, key, cfg, w_mm=w_mm)
+    np.testing.assert_array_equal(
+        np.asarray(st_a.pod_node), np.asarray(st_b.pod_node)
+    )
+    assert float(info_a["objective_after"]) == float(info_b["objective_after"])

@@ -84,6 +84,7 @@ class ExperimentConfig:
     solver_restarts: int = 1           # best-of-N global solves per round
     solver_tp: int = 1                 # node-axis devices per solve (SPMD solver)
     move_cost: float = 0.0             # disruption pricing in the global solve
+    solver_backend: str = "dense"      # "dense" | "sparse" pair weights
     moves_per_round: int | str = 1     # k per greedy round, or "all"
     global_moves_cap: int | str = "all"  # wave cap for global rounds
     # Packing budget for the global solver's feasibility (fraction of node
@@ -99,6 +100,19 @@ class ExperimentConfig:
     # declared workmodel topology (reference README.md:47 — the objective
     # is defined on actual deployed traffic).
     observe_weights: bool = False
+
+    def __post_init__(self):
+        # fail invalid solver combinations in milliseconds at construction,
+        # not after minutes of phase-r1 load simulation when run_controller
+        # first validates its per-run RescheduleConfig
+        RescheduleConfig(
+            algorithm="global",
+            solver_backend=self.solver_backend,
+            solver_restarts=self.solver_restarts,
+            solver_tp=self.solver_tp,
+            moves_per_round=self.moves_per_round,
+            global_moves_cap=self.global_moves_cap,
+        ).validate()
 
 
 def make_backend(
@@ -327,6 +341,7 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 sleep_after_action_s=cfg.pacing_s,  # simulated clock, not wall
                 balance_weight=cfg.balance_weight,
                 move_cost=cfg.move_cost,
+                solver_backend=cfg.solver_backend,
                 solver_restarts=cfg.solver_restarts,
                 solver_tp=cfg.solver_tp,
                 moves_per_round=cfg.moves_per_round,

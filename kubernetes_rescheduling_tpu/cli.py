@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--move-cost", type=float, default=0.0,
                    help="disruption pricing: comm-weight units per restarted "
                         "pod inside the global solve (0 = moves are free)")
+    r.add_argument("--solver-backend", default="dense",
+                   choices=["dense", "sparse"],
+                   help="pair-weight storage for global rounds (sparse = "
+                        "block-local form, breaks the dense memory wall)")
     r.add_argument("--global-moves-cap", type=_moves_per_round, default="all",
                    help="apply only the k highest-gain improving moves per "
                         "global round ('all' = uncapped)")
@@ -106,6 +110,9 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--move-cost", type=float, default=0.0,
                    help="disruption pricing in the global solve (see "
                         "reschedule --move-cost)")
+    b.add_argument("--solver-backend", default="dense",
+                   choices=["dense", "sparse"],
+                   help="pair-weight storage for global rounds")
     b.add_argument("--global-moves-cap", type=_moves_per_round, default="all",
                    help="wave cap for global rounds: apply only the k "
                         "highest-gain moves per round ('all' = uncapped); "
@@ -221,6 +228,7 @@ def cmd_reschedule(args) -> dict:
         global_moves_cap=args.global_moves_cap,
         balance_weight=args.balance_weight,
         move_cost=args.move_cost,
+        solver_backend=args.solver_backend,
         enforce_capacity=args.capacity_frac is not None,
         capacity_frac=args.capacity_frac if args.capacity_frac is not None else 1.0,
         solver_restarts=args.restarts,
@@ -252,6 +260,7 @@ def cmd_bench(args) -> dict:
         moves_per_round=args.moves_per_round,
         global_moves_cap=args.global_moves_cap,
         move_cost=args.move_cost,
+        solver_backend=args.solver_backend,
         solver_restarts=args.restarts,
         solver_tp=args.tp,
         observe_weights=args.observe_weights,

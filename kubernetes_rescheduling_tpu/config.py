@@ -67,6 +67,11 @@ class RescheduleConfig:
     move_cost: float = 0.0
     solver_restarts: int = 1               # best-of-N solves over the device mesh
     solver_tp: int = 1                     # node-axis sharding of each solve (devices per solve)
+    # "dense" (default) | "sparse": pair-weight storage for global rounds.
+    # sparse = the block-local form (memory O(S·Ū), breaks the ~46k dense
+    # wall); single-solve only for now (no restarts; tp via the sharded
+    # sparse path is not yet routed here).
+    solver_backend: str = "dense"
     seed: int = 0
 
     # Scale (array capacities; 0 = size to the scenario)
@@ -95,6 +100,18 @@ class RescheduleConfig:
         if not (gmc == "all" or (isinstance(gmc, int) and gmc >= 1)):
             raise ValueError(
                 f"global_moves_cap must be a positive int or 'all', got {gmc!r}"
+            )
+        if self.solver_backend not in ("dense", "sparse"):
+            raise ValueError(
+                f"solver_backend must be 'dense' or 'sparse', got "
+                f"{self.solver_backend!r}"
+            )
+        if self.solver_backend == "sparse" and (
+            self.solver_restarts > 1 or self.solver_tp > 1
+        ):
+            raise ValueError(
+                "solver_backend='sparse' supports a single solve per round "
+                "(no solver_restarts/solver_tp yet)"
             )
         return self
 

@@ -548,3 +548,56 @@ def test_observe_weights_streams_per_round(monkeypatch, tmp_path):
     assert len(summary["runs"]) == 1
     # one estimate per round (3), each folding in the traffic so far
     assert calls["n"] >= 3
+
+
+def test_controller_sparse_backend_routes_and_improves():
+    """solver_backend='sparse' drives global rounds through the block-local
+    solver (graph cached per backend) with the same improving behavior."""
+    from kubernetes_rescheduling_tpu.core.topology import _random_workmodel
+    from kubernetes_rescheduling_tpu.objectives import load_std
+
+    rng = np.random.default_rng(5)
+    wm = _random_workmodel(600, rng, powerlaw=True, mean_degree=4.0)
+    backend = SimBackend(
+        workmodel=wm,
+        node_names=[f"w{i}" for i in range(8)],
+        node_cpu_cap_m=20_000.0,
+        seed=5,
+    )
+    backend.inject_imbalance("w0")
+    graph = backend.comm_graph()
+    st0 = backend.monitor()
+    before = float(communication_cost(st0, graph)) + 0.5 * float(load_std(st0))
+    cfg = RescheduleConfig(
+        algorithm="global",
+        max_rounds=3,
+        sleep_after_action_s=0.0,
+        balance_weight=0.5,
+        solver_backend="sparse",
+        seed=5,
+    )
+    res = run_controller(backend, cfg)
+    assert any(r.services_moved for r in res.rounds)
+    # the sparse graph was built once and cached on the backend
+    assert getattr(backend, "_sparse_graph_cache", None) is not None
+    # objective (comm + λ·std) improves vs the piled-up Before state
+    last = res.rounds[-1]
+    assert last.communication_cost + 0.5 * last.load_std < before
+
+
+def test_config_rejects_sparse_with_restarts():
+    import pytest
+
+    with pytest.raises(ValueError, match="sparse"):
+        RescheduleConfig(
+            algorithm="global", solver_backend="sparse", solver_restarts=2
+        ).validate()
+    with pytest.raises(ValueError, match="solver_backend"):
+        RescheduleConfig(algorithm="global", solver_backend="bogus").validate()
+
+
+def test_experiment_config_rejects_sparse_restarts_early():
+    """The invalid combination fails at construction, not after minutes of
+    phase-r1 load simulation."""
+    with pytest.raises(ValueError, match="sparse"):
+        ExperimentConfig(solver_backend="sparse", solver_restarts=4)

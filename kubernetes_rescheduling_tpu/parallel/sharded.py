@@ -59,11 +59,17 @@ def parallel_restarts(
         raise ValueError(f"n_restarts {r} must be a multiple of dp={dp}")
     keys = jax.random.split(key, r)  # [r, 2]
 
-    pod_nodes, objs = _run_shard(mesh, config)(state, graph, keys)
-    best = jnp.argmin(objs)
+    pod_nodes, objs, pens = _run_shard(mesh, config)(state, graph, keys)
+    # selection ranks the GATED PENALIZED value: objective_after is the
+    # raw objective when a restart improved (else the input objective) and
+    # move_penalty its restart bill — so under disruption pricing a
+    # cheap-but-heavily-disruptive restart cannot mask a net-better one.
+    # With move_cost=0 the penalties are all zero (historical behavior).
+    best = jnp.argmin(objs + pens)
     best_state = state.replace(pod_node=pod_nodes[best])
     info = {
         "objective_after": objs[best],
+        "move_penalty": pens[best],
         "restart_objectives": objs,
         "best_restart": best,
     }
@@ -85,16 +91,20 @@ def _run_shard(mesh: Mesh, config: GlobalSolverConfig):
             shard_map,
             mesh=mesh,
             in_specs=(P(), P(), P("dp")),
-            out_specs=(P("dp"), P("dp")),
+            out_specs=(P("dp"), P("dp"), P("dp")),
             check_vma=False,
         )
         def run_shard(st, g, keys_block):
             def body(carry, k):
                 new_state, info = global_assign(st, g, k, config)
-                return carry, (new_state.pod_node, info["objective_after"])
+                return carry, (
+                    new_state.pod_node,
+                    info["objective_after"],
+                    info["move_penalty"],
+                )
 
-            _, (pods, objs) = jax.lax.scan(body, 0, keys_block)
-            return pods, objs
+            _, (pods, objs, pens) = jax.lax.scan(body, 0, keys_block)
+            return pods, objs, pens
 
         fn = jax.jit(run_shard)
         _RUN_SHARD_CACHE[cache_key] = fn

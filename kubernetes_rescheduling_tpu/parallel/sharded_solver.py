@@ -45,6 +45,8 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     build_pair_weights,
     check_weight_budget,
     exact_comm_cost,
+    pod_restart_bill,
+    restart_bill_from_arrays,
     sweep_composition,
     total_pair_weight,
 )
@@ -55,6 +57,7 @@ _NEG_INF = float("-inf")
 def sharded_place(
     M, cur, valid_c, c_cpu, c_mem, cpu_l, mem_l, cap_l, mem_cap_l,
     valid_l, gcol, N, config, ow, chunk_key, temp, shard,
+    home=None, move_pen=None,
 ):
     """Shard-local score → global first-max → admission → per-node load
     deltas for one chunk, under a mesh with a ``tp`` axis.
@@ -76,6 +79,13 @@ def sharded_place(
         - config.balance_weight * proj_pct
         - ow * jnp.maximum(proj_pct - 100.0, 0.0)
     )
+    if move_pen is not None:
+        # disruption pricing: residency anywhere but the round-start node
+        # costs the restart bill (same term as the single-chip score
+        # kernels — global node ids, so the shard owning `home` exempts it)
+        score = score - jnp.where(
+            gcol == home[:, None], 0.0, move_pen[:, None]
+        )
     if config.noise_temp > 0:
         # keys are replicated; fold in the shard so each node column
         # block draws its own stream (matches nothing — annealing
@@ -226,6 +236,17 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
         # so the exact gate cannot fork between the two solvers
         w_total = total_pair_weight(adj, rv)
 
+        # disruption pricing (config.move_cost): restart bill per service,
+        # anchored at the round-start placement (mirrors global_assign)
+        mc_on = config.move_cost > 0
+        rv_sp = _pad_to(rv, SP)
+        pen_vec = config.move_cost * rv_sp if mc_on else None
+
+        def move_penalty(assign):
+            return config.move_cost * jnp.sum(
+                jnp.where(svc_valid & (assign != assign_init), rv_sp, 0.0)
+            )
+
         def objective(assign, cpu_l):
             """EXACT (direct cut-sum via exact_comm_cost) — the final
             adopted/reported value."""
@@ -241,7 +262,9 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
                 "ij,ij->", W_mm, same.astype(W_mm.dtype),
                 preferred_element_type=jnp.float32,
             )
-            return 0.5 * (w_total - kept) + _balance_terms(cpu_l)
+            obj = 0.5 * (w_total - kept) + _balance_terms(cpu_l)
+            # penalized ranking under disruption pricing (see global_solver)
+            return obj + move_penalty(assign) if mc_on else obj
 
         def chunk_step(inner, xs_c):
             ids, chunk_key, temp = xs_c
@@ -258,6 +281,8 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
                 M, cur, valid_c, c_cpu, c_mem, cpu_l, mem_l,
                 cap_l, mem_cap_l, valid_l, gcol, N, config, ow,
                 chunk_key, temp, shard,
+                home=assign_init[ids] if mc_on else None,
+                move_pen=pen_vec[ids] if mc_on else None,
             )
             new_assign = assign.at[ids].set(new_node)
             X_l = X_l.at[ids].set(
@@ -346,13 +371,14 @@ def _build_solve_restarts(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(*_IN_SPECS_COMMON, P("dp")),
+        in_specs=(*_IN_SPECS_COMMON, P(), P(), P(), P(), P("dp")),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
     def solve_r(
         assign_init, adj, rv, W_mm, svc_valid, svc_cpu, svc_mem,
-        cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_block,
+        cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l,
+        pod_slot, pod_node0, pod_mask, obj_true0, keys_block,
     ):
         def body(carry, keys_r):
             ba, bo = solve_one(
@@ -362,13 +388,28 @@ def _build_solve_restarts(
             return carry, (ba, bo)
 
         _, (assigns, objs) = lax.scan(body, 0, keys_block)
+        # selection ranks the GATED PENALIZED value — min(raw + exact pod
+        # restart bill, input objective) — matching what the dp-only
+        # parallel_restarts path ranks (each of its restarts is internally
+        # gated, and its selection adds move_penalty). Without this, a
+        # cheap-but-heavily-disruptive restart could mask a net-better one
+        # under disruption pricing. move_cost=0 → bills are 0 and the
+        # minimum reduces to min(raw, true0): the historical ranking.
+        tgts = assigns[:, pod_slot]                               # [r, P]
+        bills = jax.vmap(
+            lambda t: restart_bill_from_arrays(
+                pod_mask, pod_node0, t, config.move_cost
+            )
+        )(tgts)
+        gated = jnp.minimum(objs + bills, obj_true0)
         # global restart order = dp-shard-major (shard d owns restarts
         # [d·r_local, (d+1)·r_local)), matching how the caller split the
         # keys — so argmin tie-breaking (first minimum) agrees with the
         # dp-only parallel_restarts path
+        all_gated = lax.all_gather(gated, "dp", tiled=True)       # [R]
         all_objs = lax.all_gather(objs, "dp", tiled=True)         # [R]
         all_assigns = lax.all_gather(assigns, "dp", tiled=True)   # [R, SP]
-        best = jnp.argmin(all_objs)
+        best = jnp.argmin(all_gated)
         return all_assigns[best], all_objs[best], all_objs
 
     fn = jax.jit(solve_r)
@@ -379,11 +420,6 @@ def _build_solve_restarts(
 def _check_and_dims(state, graph, config, mesh):
     if not config.capacity_frac > 0:
         raise ValueError(f"capacity_frac must be > 0, got {config.capacity_frac}")
-    if config.move_cost > 0:
-        raise ValueError(
-            "move_cost (disruption pricing) is not implemented in the "
-            "node-sharded solver yet — use tp=1 or move_cost=0"
-        )
     tp = mesh.shape["tp"]
     S = graph.num_services
     N = state.num_nodes
@@ -421,25 +457,39 @@ def _prep(state, graph, config, S, N, SP):
     )
 
 
-def _finalize(state, graph, config, best_assign, best_obj, SP, cap):
-    """Best-seen gating against the TRUE input objective + pod scatter —
-    identical to the single-chip solver's epilogue (global_solver.py)."""
+def _true_objective(state, graph, config, cap):
+    """The TRUE input objective (the adopt gate's reference point) —
+    computed once and shared between the gate and the restart-selection
+    ranking so the two cannot disagree."""
     ow = config.overload_weight if config.enforce_capacity else 0.0
     pct0 = jnp.where(state.node_valid, state.node_cpu_used() / cap * 100.0, 0.0)
-    obj_true0 = (
+    return (
         communication_cost(state, graph)
         + config.balance_weight * (load_std(state) / config.capacity_frac)
         + ow * jnp.sum(jnp.maximum(pct0 - 100.0, 0.0))
     )
-    improved = best_obj < obj_true0
-    new_pod_node = jnp.where(
-        improved & state.pod_valid,
-        best_assign[jnp.clip(state.pod_service, 0, SP - 1)],
-        state.pod_node,
+
+
+def _finalize(state, graph, config, best_assign, best_obj, SP, cap,
+              obj_true0=None):
+    """Best-seen gating against the TRUE input objective + pod scatter —
+    identical to the single-chip solver's epilogue (global_solver.py)."""
+    if obj_true0 is None:
+        obj_true0 = _true_objective(state, graph, config, cap)
+    # under disruption pricing the adopt gate re-prices with the EXACT
+    # pod-level restart bill (same contract as the single-chip solvers)
+    tgt = best_assign[jnp.clip(state.pod_service, 0, SP - 1)]
+    bill = (
+        pod_restart_bill(state, tgt, config.move_cost)
+        if config.move_cost > 0
+        else jnp.float32(0.0)
     )
+    improved = best_obj + bill < obj_true0
+    new_pod_node = jnp.where(improved & state.pod_valid, tgt, state.pod_node)
     info = {
         "objective_before": obj_true0,
-        "objective_after": jnp.minimum(best_obj, obj_true0),
+        "objective_after": jnp.where(improved, best_obj, obj_true0),
+        "move_penalty": jnp.where(improved, bill, 0.0),
     }
     return state.replace(pod_node=new_pod_node), info
 
@@ -492,15 +542,21 @@ def sharded_solve_with_restarts(
         raise ValueError(f"n_restarts {n_restarts} must be a multiple of dp={dp}")
     r_local = n_restarts // dp
     args = _prep(state, graph, config, S, N, SP)
+    cap = args[7]  # the budget-scaled CPU capacities (see _prep's order)
+    obj_true0 = _true_objective(state, graph, config, cap)
+    pod_slot = jnp.clip(state.pod_service, 0, SP - 1)
+    pod_mask = state.pod_valid & (state.pod_node >= 0)
     keys_all = jax.random.split(key, n_restarts)                    # [R, 2]
     keys_block = jax.vmap(
         lambda k: jax.random.split(k, config.sweeps)
     )(keys_all)                                                     # [R, sweeps, 2]
     best_assign, best_obj, all_objs = _build_solve_restarts(
         mesh, config, S, N, r_local
-    )(*args, keys_block)
-    cap = args[7]  # the budget-scaled CPU capacities (see _prep's order)
-    new_state, info = _finalize(state, graph, config, best_assign, best_obj, SP, cap)
+    )(*args, pod_slot, state.pod_node, pod_mask, obj_true0, keys_block)
+    new_state, info = _finalize(
+        state, graph, config, best_assign, best_obj, SP, cap,
+        obj_true0=obj_true0,
+    )
     info.update(
         restart_objectives=all_objs,
         best_restart=jnp.argmin(all_objs),

@@ -52,6 +52,7 @@ from kubernetes_rescheduling_tpu.parallel.sharded_solver import sharded_place
 from kubernetes_rescheduling_tpu.solver.global_solver import (
     GlobalSolverConfig,
     auto_chunk,
+    pod_restart_bill,
 )
 from kubernetes_rescheduling_tpu.solver.sparse_solver import (
     hub_slab,
@@ -141,6 +142,20 @@ def _solve_factory(
             comm = 0.5 * jnp.sum(e_w * rv_s[e_src] * rv_s[e_dst] * cut)
             return comm + _balance_terms(cpu_l)
 
+        # disruption pricing: penalized per-sweep ranking, raw exact return
+        # (mirrors the single-chip sparse solver)
+        mc_on = config.move_cost > 0
+        pen_vec = config.move_cost * rv_s if mc_on else None
+
+        def move_penalty(assign):
+            return config.move_cost * jnp.sum(
+                jnp.where(svc_valid & (assign != assign_init), rv_s, 0.0)
+            )
+
+        def objective_rank(assign, cpu_l):
+            obj = objective(assign, cpu_l)
+            return obj + move_penalty(assign) if mc_on else obj
+
         def place(inner, ids, M, chunk_key, temp):
             assign, cpu_l, mem_l = inner
             valid_c = svc_valid[ids]
@@ -151,6 +166,8 @@ def _solve_factory(
                 M, cur, valid_c, c_cpu, c_mem, cpu_l, mem_l,
                 cap_l, mem_cap_l, valid_l, gcol, N, config, ow,
                 chunk_key, temp, shard,
+                home=assign_init[ids] if mc_on else None,
+                move_pen=pen_vec[ids] if mc_on else None,
             )
             return (
                 (assign.at[ids].set(new_node), cpu_l + d_cpu, mem_l + d_mem),
@@ -218,7 +235,7 @@ def _solve_factory(
                 (chunk_blocks, chunk_ids, chunk_keys),
             )
             cpu_fresh, mem_fresh = local_loads(assign)
-            obj = objective(assign, cpu_fresh)
+            obj = objective_rank(assign, cpu_fresh)
             better = obj < best_obj
             best_assign = jnp.where(better, assign, best_assign)
             best_obj = jnp.where(better, obj, best_obj)
@@ -228,11 +245,16 @@ def _solve_factory(
             )
 
         cpu0, mem0 = local_loads(assign_init)
-        obj0 = objective(assign_init, cpu0)
+        obj0 = objective_rank(assign_init, cpu0)
         (_, _, _, best_assign, best_obj), _ = lax.scan(
             sweep, (assign_init, cpu0, mem0, assign_init, obj0),
             (keys_r, temps),
         )
+        # the scan ranked with the penalized objective; return the RAW
+        # exact value — the entry's adopt gate re-prices with the exact
+        # pod-level restart bill
+        if mc_on:
+            best_obj = objective(best_assign, local_loads(best_assign)[0])
         return best_assign, best_obj
 
     return solve_one
@@ -286,11 +308,6 @@ def sharded_sparse_assign(
     sparse path). Never worse than the input placement."""
     if not config.capacity_frac > 0:
         raise ValueError(f"capacity_frac must be > 0, got {config.capacity_frac}")
-    if config.move_cost > 0:
-        raise ValueError(
-            "move_cost is not implemented in the node-sharded sparse "
-            "solver yet — use tp=1 or move_cost=0"
-        )
     if sgraph.num_blocks <= 1:
         raise ValueError(
             "single-block sparse graphs delegate to the dense solver; use "
@@ -373,17 +390,25 @@ def sharded_sparse_assign(
         + config.balance_weight * (load_std(state) / config.capacity_frac)
         + ow * jnp.sum(jnp.maximum(pct0 - 100.0, 0.0))
     )
-    improved = best_obj < obj_true0
+    # under disruption pricing the adopt gate re-prices with the EXACT
+    # pod-level restart bill (the scan ranked with the service-level form;
+    # best_obj comes back RAW)
     pod_slot = jnp.clip(
         sgraph.inv[jnp.clip(state.pod_service, 0, S - 1)], 0, SPX - 1
     )
-    new_pod_node = jnp.where(
-        improved & state.pod_valid, best_assign[pod_slot], state.pod_node
+    tgt = best_assign[pod_slot]
+    bill = (
+        pod_restart_bill(state, tgt, config.move_cost)
+        if config.move_cost > 0
+        else jnp.float32(0.0)
     )
+    improved = best_obj + bill < obj_true0
+    new_pod_node = jnp.where(improved & state.pod_valid, tgt, state.pod_node)
     info = {
         "objective_before": obj_true0,
-        "objective_after": jnp.minimum(best_obj, obj_true0),
+        "objective_after": jnp.where(improved, best_obj, obj_true0),
         "improved": improved,
+        "move_penalty": jnp.where(improved, bill, 0.0),
         "tp": jnp.asarray(tp),
     }
     return state.replace(pod_node=new_pod_node), info

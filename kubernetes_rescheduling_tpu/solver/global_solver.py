@@ -277,6 +277,27 @@ def exact_comm_cost(adj, rv, assign):
     )
 
 
+def restart_bill_from_arrays(pod_mask, pod_node, tgt, move_cost):
+    """Array-level core of :func:`pod_restart_bill` — also used inside
+    shard_map bodies, where only the raw pod arrays are in scope."""
+    return move_cost * jnp.sum(
+        jnp.where(pod_mask & (pod_node != tgt), 1.0, 0.0)
+    )
+
+
+def pod_restart_bill(state, tgt, move_cost):
+    """EXACT restart bill of adopting per-pod target nodes ``tgt``: every
+    already-placed pod whose node would change (including split replicas
+    being consolidated) pays ``move_cost``. Unplaced pods are creations,
+    not restarts. ONE definition — the adopt gates of the single-chip and
+    node-sharded solvers (dense and sparse) and the restart-selection
+    ranking all price with this function, so the gate semantics cannot
+    fork between them."""
+    return restart_bill_from_arrays(
+        state.pod_valid & (state.pod_node >= 0), state.pod_node, tgt, move_cost
+    )
+
+
 def auto_chunk(S: int, chunk_size: int = 0) -> int:
     """Resolve the chunk size: explicit, or ~S/10 in [1, 1024] (see
     GlobalSolverConfig.chunk_size). Auto sizes >= 256 round UP to a
@@ -396,18 +417,11 @@ def global_assign(
             jnp.where(svc_valid & (assign != assign0), replicas, 0.0)
         )
 
-    def pod_restart_bill(assign):
-        """EXACT restart bill of adopting ``assign``: every already-placed
-        pod whose node would change (including split replicas being
-        consolidated). Unplaced pods are creations, not restarts."""
+    def _pod_bill(assign):
+        """The shared exact pod-level bill for this assignment (see
+        module-level :func:`pod_restart_bill`)."""
         tgt = assign[jnp.clip(state.pod_service, 0, SP - 1)]
-        return config.move_cost * jnp.sum(
-            jnp.where(
-                state.pod_valid & (state.pod_node >= 0) & (state.pod_node != tgt),
-                1.0,
-                0.0,
-            )
-        )
+        return pod_restart_bill(state, tgt, config.move_cost)
 
     def loads(assign):
         oh = jax.nn.one_hot(assign, N, dtype=jnp.float32) * svc_valid[:, None]
@@ -681,7 +695,7 @@ def global_assign(
     # adopted value is re-evaluated EXACTLY so the never-worse gate and the
     # reported objective carry no bf16 rounding
     best_obj = objective(best_assign)
-    best_pen = pod_restart_bill(best_assign) if mc_on else jnp.float32(0.0)
+    best_pen = _pod_bill(best_assign) if mc_on else jnp.float32(0.0)
 
     # scatter service assignment back to pods — but only when the solve
     # strictly beats the true input placement; otherwise keep the input

@@ -71,6 +71,7 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     _service_aggregates,
     auto_chunk,
     pct_balance_terms,
+    pod_restart_bill,
 )
 
 
@@ -269,18 +270,11 @@ def _global_assign_sparse(
             jnp.where(svc_valid & (assign != assign0), rv_s, 0.0)
         )
 
-    def pod_restart_bill(assign):
+    def _pod_bill(assign):
         slot = jnp.clip(
             sgraph.inv[jnp.clip(state.pod_service, 0, S - 1)], 0, SPX - 1
         )
-        tgt = assign[slot]
-        return config.move_cost * jnp.sum(
-            jnp.where(
-                state.pod_valid & (state.pod_node >= 0) & (state.pod_node != tgt),
-                1.0,
-                0.0,
-            )
-        )
+        return pod_restart_bill(state, assign[slot], config.move_cost)
 
     def loads(assign):
         a = jnp.where(svc_valid, assign, N)
@@ -521,7 +515,7 @@ def _global_assign_sparse(
     raw_after = (
         objective_raw(best_assign, loads(best_assign)[0]) if mc_on else best_obj
     )
-    best_pen = pod_restart_bill(best_assign) if mc_on else jnp.float32(0.0)
+    best_pen = _pod_bill(best_assign) if mc_on else jnp.float32(0.0)
     improved = raw_after + best_pen < obj_true0
     pod_slot = jnp.clip(
         sgraph.inv[jnp.clip(state.pod_service, 0, S - 1)], 0, SPX - 1

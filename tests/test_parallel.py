@@ -259,3 +259,64 @@ def test_sharded_choose_node_matches_unsharded(policy):
         sharded_choose_node(pid, scn.state, scn.graph, svc, hazard_mask, key, mesh)
     )
     assert got == expected
+
+
+def test_sharded_move_cost_parity_with_single_chip():
+    """Disruption pricing composes with tp: the node-sharded dense solver
+    makes the same decisions as global_assign under move_cost (noise off,
+    balance 0 — integer arithmetic), and its gate covers the restart bill."""
+    from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+    from kubernetes_rescheduling_tpu.parallel import make_mesh, sharded_global_assign
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
+
+    scn = synthetic_scenario(
+        n_pods=256, n_nodes=16, powerlaw=True, seed=11, mean_degree=4.0
+    )
+    cfg = GlobalSolverConfig(
+        sweeps=3, noise_temp=0.0, balance_weight=0.0, move_cost=1.0
+    )
+    key = jax.random.PRNGKey(3)
+    st_single, info_s = global_assign(scn.state, scn.graph, key, cfg)
+    mesh = make_mesh(8, shape=(2, 4))
+    st_shard, info_h = sharded_global_assign(scn.state, scn.graph, key, mesh, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(st_single.pod_node), np.asarray(st_shard.pod_node)
+    )
+    assert float(info_s["move_penalty"]) == float(info_h["move_penalty"])
+    # a priced-out solve (huge cost) stays put through the sharded path too
+    pricey = GlobalSolverConfig(
+        sweeps=3, noise_temp=0.0, balance_weight=0.0, move_cost=1e9
+    )
+    st_frozen, info_f = sharded_global_assign(
+        scn.state, scn.graph, key, mesh, pricey
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_frozen.pod_node), np.asarray(scn.state.pod_node)
+    )
+
+
+def test_restart_selection_parity_under_move_cost():
+    """Best-of-N selection ranks the gated penalized value on BOTH restart
+    paths: dp-only (tp=1) and dp×tp pick the same final placement under
+    disruption pricing (noise off — per-restart decisions are bit-equal,
+    so any divergence would be the selection rule)."""
+    from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+    from kubernetes_rescheduling_tpu.parallel import solve_with_restarts
+    from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
+
+    scn = synthetic_scenario(
+        n_pods=256, n_nodes=16, powerlaw=True, seed=13, mean_degree=4.0
+    )
+    cfg = GlobalSolverConfig(
+        sweeps=3, noise_temp=0.0, balance_weight=0.0, move_cost=1.0
+    )
+    key = jax.random.PRNGKey(9)
+    st_dp, info_dp = solve_with_restarts(
+        scn.state, scn.graph, key, n_restarts=2, config=cfg, tp=1
+    )
+    st_tp, info_tp = solve_with_restarts(
+        scn.state, scn.graph, key, n_restarts=2, config=cfg, tp=4
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_dp.pod_node), np.asarray(st_tp.pod_node)
+    )

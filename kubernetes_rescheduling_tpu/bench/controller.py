@@ -394,33 +394,30 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         move_cost=config.move_cost,
     )
     t0 = time.perf_counter()
+    sparse_graph = None
     if config.solver_backend == "sparse":
-        # block-local pair weights (no restarts/tp — config.validate()
-        # rejects the combination). The SparseCommGraph is cached per
+        # block-local pair weights. The SparseCommGraph is cached per
         # (backend, graph) pair: the controller re-solves the same declared
         # graph every round, and the host-side build pulls the full
         # adjacency; streaming re-estimated graphs rebuild each round.
         from kubernetes_rescheduling_tpu.core import sparsegraph
-        from kubernetes_rescheduling_tpu.solver import global_assign_sparse
 
         cache = getattr(backend, "_sparse_graph_cache", None)
         if cache is None or cache[0] is not graph:
             cache = (graph, sparsegraph.from_comm_graph(graph))
             backend._sparse_graph_cache = cache
-        new_state, info = jax.block_until_ready(
-            global_assign_sparse(state, cache[1], key, cfg)
+        sparse_graph = cache[1]
+    new_state, info = jax.block_until_ready(
+        solve_with_restarts(
+            state,
+            graph,
+            key,
+            n_restarts=config.solver_restarts,
+            config=cfg,
+            tp=config.solver_tp,
+            sparse_graph=sparse_graph,
         )
-    else:
-        new_state, info = jax.block_until_ready(
-            solve_with_restarts(
-                state,
-                graph,
-                key,
-                n_restarts=config.solver_restarts,
-                config=cfg,
-                tp=config.solver_tp,
-            )
-        )
+    )
     latency = time.perf_counter() - t0
 
     old_nodes = np.asarray(state.pod_node)

@@ -364,9 +364,9 @@ def cmd_solve(args) -> dict:
             return global_assign_pods(st, None, k, c, pod_graph=g)
 
     elif args.sparse:
-        if args.restarts > 1 or args.tp > 1:
+        if args.restarts > 1 and args.tp > 1:
             raise SystemExit(
-                "--sparse supports a single solve (no --restarts/--tp yet)"
+                "--sparse composes with --restarts OR --tp, not both yet"
             )
         from kubernetes_rescheduling_tpu.core import sparsegraph
         from kubernetes_rescheduling_tpu.solver import global_assign_sparse
@@ -378,10 +378,31 @@ def cmd_solve(args) -> dict:
     if args.latency_budget is not None:
         from kubernetes_rescheduling_tpu.solver.autotune import tune_sweeps
 
+        # tune against the ACTUAL production path: with --restarts/--tp the
+        # per-round program is the mesh solve, not the single-chip solver —
+        # budgeting the wrong (slower) program would systematically
+        # under-fill the latency budget
+        if args.placement_unit == "pod":
+            tune_solver = solver
+        elif args.sparse:
+
+            def tune_solver(st, g, k, c):
+                return solve_with_restarts(
+                    st, None, k, n_restarts=args.restarts, config=c,
+                    tp=args.tp, sparse_graph=g,
+                )
+
+        else:
+
+            def tune_solver(st, g, k, c):
+                return solve_with_restarts(
+                    st, g, k, n_restarts=args.restarts, config=c, tp=args.tp
+                )
+
         cfg, tune_info = tune_sweeps(
-            state, solve_graph, cfg, args.latency_budget, solver=solver
+            state, solve_graph, cfg, args.latency_budget, solver=tune_solver
         )
-    if args.sparse or args.placement_unit == "pod":
+    if args.placement_unit == "pod":
         new_state, info = solver(
             state, solve_graph, jax.random.PRNGKey(args.seed), cfg
         )
@@ -394,6 +415,7 @@ def cmd_solve(args) -> dict:
             n_restarts=args.restarts,
             config=cfg,
             tp=args.tp,
+            sparse_graph=solve_graph if args.sparse else None,
         )
     out = {
         "scenario": args.scenario,

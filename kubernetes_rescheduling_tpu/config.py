@@ -69,8 +69,7 @@ class RescheduleConfig:
     solver_tp: int = 1                     # node-axis sharding of each solve (devices per solve)
     # "dense" (default) | "sparse": pair-weight storage for global rounds.
     # sparse = the block-local form (memory O(S·Ū), breaks the ~46k dense
-    # wall); single-solve only for now (no restarts; tp via the sharded
-    # sparse path is not yet routed here).
+    # wall); composes with dp restarts OR tp node-sharding (not both yet).
     solver_backend: str = "dense"
     seed: int = 0
 
@@ -107,11 +106,11 @@ class RescheduleConfig:
                 f"{self.solver_backend!r}"
             )
         if self.solver_backend == "sparse" and (
-            self.solver_restarts > 1 or self.solver_tp > 1
+            self.solver_restarts > 1 and self.solver_tp > 1
         ):
             raise ValueError(
-                "solver_backend='sparse' supports a single solve per round "
-                "(no solver_restarts/solver_tp yet)"
+                "solver_backend='sparse' composes with restarts OR tp, "
+                "not both yet"
             )
         return self
 

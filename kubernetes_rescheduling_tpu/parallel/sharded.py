@@ -33,12 +33,14 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
 
 def parallel_restarts(
     state: ClusterState,
-    graph: CommGraph,
+    graph,
     key: jax.Array,
     mesh: Mesh,
     *,
     n_restarts: int | None = None,
     config: GlobalSolverConfig = GlobalSolverConfig(),
+    solver=global_assign,
+    solver_tag: str = "dense",
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """Run ``n_restarts`` independent global solves sharded over the mesh's
     ``dp`` axis and return the best (lowest-objective) result.
@@ -59,7 +61,9 @@ def parallel_restarts(
         raise ValueError(f"n_restarts {r} must be a multiple of dp={dp}")
     keys = jax.random.split(key, r)  # [r, 2]
 
-    pod_nodes, objs, pens = _run_shard(mesh, config)(state, graph, keys)
+    pod_nodes, objs, pens = _run_shard(mesh, config, solver, solver_tag)(
+        state, graph, keys
+    )
     # selection ranks the GATED PENALIZED value: objective_after is the
     # raw objective when a restart improved (else the input objective) and
     # move_penalty its restart bill — so under disruption pricing a
@@ -70,7 +74,11 @@ def parallel_restarts(
     info = {
         "objective_after": objs[best],
         "move_penalty": pens[best],
-        "restart_objectives": objs,
+        # the RANKED values (gated + bill) — identical semantics to the
+        # dp×tp path's report, so the named best restart is the adopted
+        # one on both paths; with move_cost=0 these are the historical
+        # gated objectives
+        "restart_objectives": objs + pens,
         "best_restart": best,
     }
     return best_state, info
@@ -82,8 +90,11 @@ def parallel_restarts(
 _RUN_SHARD_CACHE: dict = {}
 
 
-def _run_shard(mesh: Mesh, config: GlobalSolverConfig):
-    cache_key = (mesh, config)
+def _run_shard(mesh: Mesh, config: GlobalSolverConfig, solver=global_assign,
+               solver_tag: str = "dense"):
+    # solver_tag (not the function object) keys the cache: the sparse and
+    # dense round functions are distinct compiled programs
+    cache_key = (mesh, config, solver_tag)
     fn = _RUN_SHARD_CACHE.get(cache_key)
     if fn is None:
 
@@ -96,7 +107,7 @@ def _run_shard(mesh: Mesh, config: GlobalSolverConfig):
         )
         def run_shard(st, g, keys_block):
             def body(carry, k):
-                new_state, info = global_assign(st, g, k, config)
+                new_state, info = solver(st, g, k, config)
                 return carry, (
                     new_state.pod_node,
                     info["objective_after"],
@@ -126,9 +137,16 @@ def solve_with_restarts(
     config: GlobalSolverConfig = GlobalSolverConfig(),
     mesh: Mesh | None = None,
     tp: int = 1,
+    sparse_graph=None,
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """Production best-of-N global solve — the mesh-parallel path with
     graceful degradation.
+
+    ``sparse_graph`` (a SparseCommGraph) switches every solve to the
+    block-local sparse form: tp>1 routes to the node-sharded sparse
+    solver (single restart), tp=1 with restarts runs dp restarts of
+    single-chip sparse solves; sparse restarts OF tp-sharded solves are
+    not composed yet (clear error).
 
     ``tp > 1`` shards the NODE axis of every solve over the mesh's ``tp``
     dimension (``sharded_solver``): with ``n_restarts <= 1`` that is one
@@ -168,7 +186,21 @@ def solve_with_restarts(
                 )
             dp = _largest_divisor(max(n_restarts, 1), max(n_dev // tp, 1))
             mesh = make_mesh(dp * tp, shape=(dp, tp))
-        if n_restarts <= 1:
+        if sparse_graph is not None:
+            if n_restarts > 1:
+                raise ValueError(
+                    "sparse restarts of tp-sharded solves are not composed "
+                    "yet — use tp>1 with a single restart, or tp=1 with "
+                    "restarts"
+                )
+            from kubernetes_rescheduling_tpu.parallel.sharded_sparse import (
+                sharded_sparse_assign,
+            )
+
+            new_state, info = sharded_sparse_assign(
+                state, sparse_graph, key, mesh, config
+            )
+        elif n_restarts <= 1:
             new_state, info = sharded_global_assign(state, graph, key, mesh, config)
         else:
             new_state, info = sharded_solve_with_restarts(
@@ -177,8 +209,16 @@ def solve_with_restarts(
         info = dict(info)
         info["restarts"] = jnp.asarray(max(n_restarts, 1))
         return new_state, info
+    if sparse_graph is not None:
+        from kubernetes_rescheduling_tpu.solver.sparse_solver import (
+            global_assign_sparse,
+        )
+
+        solver, solve_graph, tag = global_assign_sparse, sparse_graph, "sparse"
+    else:
+        solver, solve_graph, tag = global_assign, graph, "dense"
     if n_restarts <= 1:
-        new_state, info = global_assign(state, graph, key, config)
+        new_state, info = solver(state, solve_graph, key, config)
         info = dict(info)
         info["restarts"] = jnp.asarray(1)
         return new_state, info
@@ -188,7 +228,8 @@ def solve_with_restarts(
         dp = _largest_divisor(n_restarts, len(jax.devices()))
         mesh = make_mesh(dp, shape=(dp, 1))
     best_state, info = parallel_restarts(
-        state, graph, key, mesh, n_restarts=n_restarts, config=config
+        state, solve_graph, key, mesh, n_restarts=n_restarts, config=config,
+        solver=solver, solver_tag=tag,
     )
     info = dict(info)
     info["restarts"] = jnp.asarray(n_restarts)

@@ -203,8 +203,14 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
     """
     C, n_chunks, SP, Nl = _dims(config, S, N, tp)
     ow = config.overload_weight if config.enforce_capacity else 0.0
+    # numpy, NOT jnp: the factory can run inside an outer trace (e.g. the
+    # latency-budget tuner jits around the whole solve) and a jnp value
+    # computed here would be a tracer captured by the CACHED closure —
+    # escaping its trace. A numpy constant is trace-agnostic.
+    import numpy as _np
+
     temps = config.noise_temp * (
-        1.0 - jnp.arange(config.sweeps, dtype=jnp.float32) / max(config.sweeps - 1, 1)
+        1.0 - _np.arange(config.sweeps, dtype=_np.float32) / max(config.sweeps - 1, 1)
     )
 
     def solve_one(
@@ -410,7 +416,12 @@ def _build_solve_restarts(
         all_objs = lax.all_gather(objs, "dp", tiled=True)         # [R]
         all_assigns = lax.all_gather(assigns, "dp", tiled=True)   # [R, SP]
         best = jnp.argmin(all_gated)
-        return all_assigns[best], all_objs[best], all_objs
+        # winner by GATED value; its RAW objective goes to the adopt gate
+        # (which re-adds the exact bill itself); the gated per-restart
+        # values are reported — they are what selection ranked (and what
+        # the dp-only path's objective_after+move_penalty equals), so the
+        # named best restart is always the adopted one
+        return all_assigns[best], all_objs[best], all_gated
 
     fn = jax.jit(solve_r)
     _SOLVE_CACHE[cache_key] = fn
@@ -550,16 +561,16 @@ def sharded_solve_with_restarts(
     keys_block = jax.vmap(
         lambda k: jax.random.split(k, config.sweeps)
     )(keys_all)                                                     # [R, sweeps, 2]
-    best_assign, best_obj, all_objs = _build_solve_restarts(
+    best_assign, best_raw, all_gated = _build_solve_restarts(
         mesh, config, S, N, r_local
     )(*args, pod_slot, state.pod_node, pod_mask, obj_true0, keys_block)
     new_state, info = _finalize(
-        state, graph, config, best_assign, best_obj, SP, cap,
+        state, graph, config, best_assign, best_raw, SP, cap,
         obj_true0=obj_true0,
     )
     info.update(
-        restart_objectives=all_objs,
-        best_restart=jnp.argmin(all_objs),
+        restart_objectives=all_gated,
+        best_restart=jnp.argmin(all_gated),
         tp=jnp.asarray(tp),
     )
     return new_state, info

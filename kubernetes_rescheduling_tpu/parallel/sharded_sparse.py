@@ -90,9 +90,12 @@ def _solve_factory(
     ) = sgraph_meta
     Nl = N // tp
     ow = config.overload_weight if config.enforce_capacity else 0.0
+    # numpy, NOT jnp — see sharded_solver._solve_factory: the factory can
+    # run inside an outer trace and the cached closure must not capture a
+    # tracer
     temps = config.noise_temp * (
         1.0
-        - jnp.arange(config.sweeps, dtype=jnp.float32)
+        - np.arange(config.sweeps, dtype=np.float32)
         / max(config.sweeps - 1, 1)
     )
     # static slab boundaries for the hub groups' concatenated columns

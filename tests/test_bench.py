@@ -585,19 +585,28 @@ def test_controller_sparse_backend_routes_and_improves():
     assert last.communication_cost + 0.5 * last.load_std < before
 
 
-def test_config_rejects_sparse_with_restarts():
-    import pytest
-
-    with pytest.raises(ValueError, match="sparse"):
+def test_config_sparse_composition_rules():
+    # sparse composes with restarts OR tp — but not both at once
+    RescheduleConfig(
+        algorithm="global", solver_backend="sparse", solver_restarts=2
+    ).validate()
+    RescheduleConfig(
+        algorithm="global", solver_backend="sparse", solver_tp=4
+    ).validate()
+    with pytest.raises(ValueError, match="not both"):
         RescheduleConfig(
-            algorithm="global", solver_backend="sparse", solver_restarts=2
+            algorithm="global", solver_backend="sparse",
+            solver_restarts=2, solver_tp=4,
         ).validate()
     with pytest.raises(ValueError, match="solver_backend"):
         RescheduleConfig(algorithm="global", solver_backend="bogus").validate()
 
 
-def test_experiment_config_rejects_sparse_restarts_early():
+def test_experiment_config_rejects_invalid_combo_early():
     """The invalid combination fails at construction, not after minutes of
     phase-r1 load simulation."""
-    with pytest.raises(ValueError, match="sparse"):
-        ExperimentConfig(solver_backend="sparse", solver_restarts=4)
+    with pytest.raises(ValueError, match="not both"):
+        ExperimentConfig(solver_backend="sparse", solver_restarts=4, solver_tp=2)
+    # the now-supported compositions construct fine
+    ExperimentConfig(solver_backend="sparse", solver_restarts=4)
+    ExperimentConfig(solver_backend="sparse", solver_tp=2)

@@ -143,3 +143,111 @@ def plot_summary(summary: dict | str | Path, out_dir: str | Path) -> list[Path]:
         plt.close(fig)
         written.append(path)
     return written
+
+
+# the two frontier hues, shared by both panels (and both new charts)
+_CAP_COLOR = "#1f77b4"   # wave-cap configs
+_MC_COLOR = "#d62728"    # move-cost (disruption pricing) configs
+
+
+def _is_move_cost(config_name: str) -> bool:
+    return config_name.startswith("mc")
+
+
+def plot_disruption_frontier(rows: list[dict], out_dir: str | Path) -> Path:
+    """The disruption/quality frontier: wave capping vs move-cost pricing.
+
+    ``rows`` are the measured µBench-matrix aggregates (scripts/frontier.py
+    output): each dict carries config / restarts / error_rate_during /
+    communication_cost / response_time_ms. Two panels — restarts vs final
+    comm cost (the frontier itself; marker AREA scales with the in-flight
+    error rate during rescheduling) and response time (the end-user view;
+    a config that avoids all disruption by never moving leaves the
+    pile-up's queueing latency in place)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    is_mc = [_is_move_cost(r["config"]) for r in rows]
+    colors = [_MC_COLOR if m else _CAP_COLOR for m in is_mc]
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4.2))
+    for r, m, color in zip(rows, is_mc, colors):
+        ax1.scatter(r["restarts"], r["communication_cost"], c=color,
+                    marker="o" if m else "s",
+                    s=40 + 600 * r.get("error_rate_during", 0.0), zorder=3)
+        ax1.annotate(r["config"], (r["restarts"], r["communication_cost"]),
+                     textcoords="offset points", xytext=(6, 4), fontsize=8)
+    ax1.set_xlabel("pods restarted during rescheduling")
+    ax1.set_ylabel("final communication cost")
+    ax1.set_title(
+        "disruption vs quality — marker area = error rate during\n"
+        "(red: --move-cost, blue: wave cap)"
+    )
+    ax1.grid(alpha=0.3)
+
+    labels = [r["config"] for r in rows]
+    lat = [r["response_time_ms"] for r in rows]
+    ax2.bar(range(len(rows)), lat, color=colors)
+    ax2.set_xticks(range(len(rows)))
+    ax2.set_xticklabels(labels, rotation=30, ha="right", fontsize=8)
+    ax2.set_ylabel("response time after (ms)")
+    ax2.set_title("what the user sees")
+    ax2.grid(axis="y", alpha=0.3)
+    fig.tight_layout()
+    path = out_dir / "disruption_frontier.png"
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def plot_scale_curve(points: list[dict], out_dir: str | Path) -> Path:
+    """Device ms/round vs problem scale for the dense and sparse solvers.
+
+    ``points``: dicts with scale (str label), services (int), solver
+    ("dense"/"sparse"), ms (float, 0.0 allowed) or None (= cannot
+    allocate)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    fig, ax = plt.subplots(figsize=(6.4, 4.2))
+    for solver, color in (("dense", _CAP_COLOR), ("sparse", _MC_COLOR)):
+        pts = [p for p in points if p["solver"] == solver and p["ms"] is not None]
+        ax.plot(
+            [p["services"] for p in pts],
+            [p["ms"] for p in pts],
+            "o-",
+            color=color,
+            label=f"{solver} pair weights",
+        )
+        for p in pts:
+            ax.annotate(
+                f"{p['scale']}\n{p['ms']:.0f} ms",
+                (p["services"], p["ms"]),
+                textcoords="offset points", xytext=(6, -2), fontsize=8,
+            )
+    dead = [p for p in points if p["ms"] is None]
+    for i, p in enumerate(dead):
+        ax.annotate(
+            f"{p['scale']}: {p['solver']} cannot allocate",
+            (0.02, 0.93 - 0.05 * i),
+            xycoords="axes fraction", fontsize=8, color="gray",
+        )
+    ax.set_xscale("log")
+    ax.set_yscale("log")
+    ax.set_xlabel("services")
+    ax.set_ylabel("device ms/round (9 sweeps)")
+    ax.set_title("solver scale curve (v5e-1)")
+    ax.grid(alpha=0.3, which="both")
+    ax.legend()
+    fig.tight_layout()
+    path = out_dir / "scale_curve.png"
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

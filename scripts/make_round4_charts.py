@@ -1,0 +1,60 @@
+"""Regenerate the round-4 charts.
+
+Frontier rows: pass a path to scripts/frontier.py's JSON-lines output as
+argv[1] to plot a fresh matrix run; with no argument the MEASURED
+2026-07-31 rows below are used (provenance in RESULTS.md — the full
+9-config run, including the redundant mc4.0/mc8.0 points that coincide
+with mc2.0). Scale points are the slope-method device readings.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kubernetes_rescheduling_tpu.bench.plots import (
+    plot_disruption_frontier,
+    plot_scale_curve,
+)
+
+FRONTIER = [
+    {"config": "uncapped", "restarts": 23.3, "error_rate_during": 0.1857,
+     "communication_cost": 3.67, "response_time_ms": 56.59},
+    {"config": "cap1", "restarts": 14.3, "error_rate_during": 0.1277,
+     "communication_cost": 5.67, "response_time_ms": 62.24},
+    {"config": "cap2", "restarts": 16.3, "error_rate_during": 0.1422,
+     "communication_cost": 5.33, "response_time_ms": 61.3},
+    {"config": "cap4", "restarts": 14.0, "error_rate_during": 0.1238,
+     "communication_cost": 5.67, "response_time_ms": 62.24},
+    {"config": "mc0.5", "restarts": 14.0, "error_rate_during": 0.1252,
+     "communication_cost": 4.0, "response_time_ms": 57.53},
+    {"config": "mc2.0", "restarts": 0.0, "error_rate_during": 0.0,
+     "communication_cost": 0.0, "response_time_ms": 205.78},
+    {"config": "mc4.0", "restarts": 0.0, "error_rate_during": 0.0,
+     "communication_cost": 0.0, "response_time_ms": 205.78},
+    {"config": "mc8.0", "restarts": 0.0, "error_rate_during": 0.0,
+     "communication_cost": 0.0, "response_time_ms": 205.78},
+]
+
+SCALE = [
+    {"scale": "2k×200", "services": 2_000, "solver": "dense", "ms": 4.2},
+    {"scale": "10k×1k", "services": 10_000, "solver": "dense", "ms": 30.7},
+    {"scale": "20k×2k", "services": 20_000, "solver": "dense", "ms": 159.0},
+    {"scale": "10k×1k", "services": 10_000, "solver": "sparse", "ms": 29.4},
+    {"scale": "20k×2k", "services": 20_000, "solver": "sparse", "ms": 72.3},
+    {"scale": "50k×2k", "services": 50_000, "solver": "sparse", "ms": 192.0},
+    {"scale": "50k×2k", "services": 50_000, "solver": "dense", "ms": None},
+]
+
+rows = FRONTIER
+if len(sys.argv) > 1:
+    rows = [
+        json.loads(line)
+        for line in Path(sys.argv[1]).read_text().splitlines()
+        if line.strip()
+    ]
+
+out = Path(__file__).resolve().parent.parent / "result" / "charts"
+print(plot_disruption_frontier(rows, out))
+print(plot_scale_curve(SCALE, out))

@@ -207,8 +207,8 @@ def plot_scale_curve(points: list[dict], out_dir: str | Path) -> Path:
     """Device ms/round vs problem scale for the dense and sparse solvers.
 
     ``points``: dicts with scale (str label), services (int), solver
-    ("dense"/"sparse"), ms (float, 0.0 allowed) or None (= cannot
-    allocate)."""
+    ("dense"/"sparse"), ms (positive float — the y axis is log-scale)
+    or None (= cannot allocate)."""
     import matplotlib
 
     matplotlib.use("Agg")

@@ -39,7 +39,7 @@ FRONTIER = [
 
 SCALE = [
     {"scale": "2k×200", "services": 2_000, "solver": "dense", "ms": 4.2},
-    {"scale": "10k×1k", "services": 10_000, "solver": "dense", "ms": 30.7},
+    {"scale": "10k×1k", "services": 10_000, "solver": "dense", "ms": 31.3},
     {"scale": "20k×2k", "services": 20_000, "solver": "dense", "ms": 159.0},
     {"scale": "10k×1k", "services": 10_000, "solver": "sparse", "ms": 29.4},
     {"scale": "20k×2k", "services": 20_000, "solver": "sparse", "ms": 72.3},

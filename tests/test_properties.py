@@ -295,3 +295,56 @@ def test_top_gain_moves_invariants(k, seed):
     assert all(m in changed for m in out)
     idxs = [changed.index(m) for m in out]
     assert idxs == sorted(idxs)  # stable original order
+
+
+@SETTINGS
+@given(
+    s=st.integers(min_value=2, max_value=120),
+    e=st.integers(min_value=0, max_value=300),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+    weighted=st.booleans(),
+)
+def test_sparse_graph_round_trip_and_cut_parity(s, e, seed, weighted):
+    """For ARBITRARY edge lists (dupes accumulated, self-loops dropped):
+    the block-local storage round-trips to the exact dense adjacency, and
+    the COO cut cost equals the dense exact cut for random assignments
+    and replica counts."""
+    from kubernetes_rescheduling_tpu.core import sparsegraph
+    from kubernetes_rescheduling_tpu.core.sparsegraph import (
+        sparse_pair_comm_cost,
+    )
+    from kubernetes_rescheduling_tpu.solver.global_solver import exact_comm_cost
+
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, s, size=e)
+    dst = rng.integers(0, s, size=e)
+    w = (
+        rng.integers(1, 6, size=e).astype(np.float64)
+        if weighted
+        else np.ones(e)
+    )
+    sg = sparsegraph.from_edges(src, dst, w, s, bu=128, reg_tiles=1)
+    # dense reconstruction: symmetrized, accumulated, zero diagonal
+    expect = np.zeros((s, s))
+    for a, b, ww in zip(src, dst, w):
+        if a != b:
+            expect[a, b] += ww
+            expect[b, a] += ww
+    got = np.asarray(sg.to_dense().adj)
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+    assign = jnp.asarray(rng.integers(0, 5, size=s), jnp.int32)
+    rv = jnp.asarray(rng.integers(1, 4, size=s), jnp.float32)
+    dense_cut = float(exact_comm_cost(jnp.asarray(expect, jnp.float32), rv, assign))
+    perm = jnp.clip(sg.perm, 0, s - 1)
+    sparse_cut = float(
+        sparse_pair_comm_cost(sg, assign[perm], rv[perm] * (sg.perm < s))
+    )
+    assert sparse_cut == pytest.approx(dense_cut, rel=1e-5, abs=1e-5)
+    # block partition invariants: every real service appears in exactly
+    # one block slot; hub/regular blocks partition the block ids
+    p = np.asarray(sg.perm)
+    assert sorted(p[p < s].tolist()) == list(range(s))
+    assert sorted(sg.hub_blocks + sg.regular_blocks) == list(
+        range(sg.num_blocks)
+    )

@@ -133,10 +133,6 @@ class SparseCommGraph:
         )
 
 
-def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
-    return np.pad(a, ((0, 0), (0, width - a.shape[1])))
-
-
 def from_edges(
     src,
     dst,

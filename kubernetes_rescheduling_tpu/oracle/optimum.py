@@ -73,6 +73,12 @@ def brute_force_optimum(
     without pods keep assignment 0 and contribute nothing.
     """
     W, cpu, placed, node_valid, cap, base = _problem_arrays(state, graph)
+    # mirror the solver's accounting: over-budget repulsion only exists
+    # alongside budget enforcement (global_solver.global_assign zeroes
+    # overload_weight when enforce_capacity=False) — without this gate the
+    # oracle would measure a different objective than the solver optimizes
+    if not enforce_capacity:
+        overload_weight = 0.0
     S = len(cpu)
     nodes = np.flatnonzero(node_valid)
     N = len(nodes)

@@ -92,9 +92,13 @@ _RUN_SHARD_CACHE: dict = {}
 
 def _run_shard(mesh: Mesh, config: GlobalSolverConfig, solver=global_assign,
                solver_tag: str = "dense"):
-    # solver_tag (not the function object) keys the cache: the sparse and
-    # dense round functions are distinct compiled programs
-    cache_key = (mesh, config, solver_tag)
+    # the tag AND the solver object key the cache: the sparse and dense
+    # round functions are distinct compiled programs, and a future caller
+    # reusing a tag with a different solver must not silently hit the
+    # other solver's compiled shard_map (module-level solver functions are
+    # hashable with stable identity, so the controller's repeated calls
+    # still hit the cache)
+    cache_key = (mesh, config, solver_tag, solver)
     fn = _RUN_SHARD_CACHE.get(cache_key)
     if fn is None:
 

@@ -147,10 +147,11 @@ def solve_with_restarts(
     graceful degradation.
 
     ``sparse_graph`` (a SparseCommGraph) switches every solve to the
-    block-local sparse form: tp>1 routes to the node-sharded sparse
-    solver (single restart), tp=1 with restarts runs dp restarts of
-    single-chip sparse solves; sparse restarts OF tp-sharded solves are
-    not composed yet (clear error).
+    block-local sparse form, with the same (dp, tp) composition matrix
+    as dense: tp>1 single-restart is one node-sharded sparse solve,
+    tp>1 with restarts runs dp restarts OF tp-sharded sparse solves,
+    and tp=1 with restarts runs dp restarts of single-chip sparse
+    solves.
 
     ``tp > 1`` shards the NODE axis of every solve over the mesh's ``tp``
     dimension (``sharded_solver``): with ``n_restarts <= 1`` that is one
@@ -191,19 +192,20 @@ def solve_with_restarts(
             dp = _largest_divisor(max(n_restarts, 1), max(n_dev // tp, 1))
             mesh = make_mesh(dp * tp, shape=(dp, tp))
         if sparse_graph is not None:
-            if n_restarts > 1:
-                raise ValueError(
-                    "sparse restarts of tp-sharded solves are not composed "
-                    "yet — use tp>1 with a single restart, or tp=1 with "
-                    "restarts"
-                )
             from kubernetes_rescheduling_tpu.parallel.sharded_sparse import (
                 sharded_sparse_assign,
+                sharded_sparse_solve_with_restarts,
             )
 
-            new_state, info = sharded_sparse_assign(
-                state, sparse_graph, key, mesh, config
-            )
+            if n_restarts > 1:
+                new_state, info = sharded_sparse_solve_with_restarts(
+                    state, sparse_graph, key, mesh,
+                    n_restarts=n_restarts, config=config,
+                )
+            else:
+                new_state, info = sharded_sparse_assign(
+                    state, sparse_graph, key, mesh, config
+                )
         elif n_restarts <= 1:
             new_state, info = sharded_global_assign(state, graph, key, mesh, config)
         else:

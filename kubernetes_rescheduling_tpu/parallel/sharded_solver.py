@@ -50,8 +50,61 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     sweep_composition,
     total_pair_weight,
 )
+from kubernetes_rescheduling_tpu.solver.swap import (
+    BIG_CAP,
+    cols_at,
+    swap_decisions,
+    swap_flags,
+)
 
 _NEG_INF = float("-inf")
+
+
+def sharded_swap(
+    M, Wc, cur, eligible, c_cpu, c_mem, cpu_l, mem_l, cap_l, mem_cap_l,
+    valid_l, gcol, config, ow, col0, home=None, move_pen=None,
+):
+    """The swap phase under a mesh with a ``tp`` axis — shard-local
+    reductions feeding the SAME replicated core (solver/swap.py
+    ``swap_decisions``) the single-chip solvers run, so the decisions
+    cannot fork. Per-node inputs are owned by exactly one shard; the
+    psum'd one-hot contractions reproduce the single-chip f32 values
+    bit-exactly (one nonzero term each). Shared by the dense and sparse
+    node-sharded solvers (``Wc`` is the only input whose computation
+    differs). Returns ``(new_node, swapped, n_swaps, d_cpu_l, d_mem_l)``.
+    """
+    is_cur = gcol == cur[:, None]                       # (C, Nl)
+    M_cur = lax.psum(cols_at(M, cur, col0=col0), "tp")  # (C, C)
+    m_own = lax.psum(jnp.sum(jnp.where(is_cur, M, 0.0), axis=1), "tp")
+
+    def at_cur(v):
+        return lax.psum(
+            jnp.sum(jnp.where(is_cur, v[None, :], 0.0), axis=1), "tp"
+        )
+
+    mem_cap_s = jnp.where(jnp.isinf(mem_cap_l), BIG_CAP, mem_cap_l)
+    cur_ok = at_cur(valid_l.astype(jnp.float32)) > 0
+    new_node, swapped, n_sw = swap_decisions(
+        M_cur, m_own, Wc, cur, eligible & cur_ok, c_cpu, c_mem,
+        at_cur(cpu_l), at_cur(mem_l), at_cur(cap_l), at_cur(mem_cap_s),
+        config.balance_weight, ow,
+        pen=move_pen, home=home,
+        enforce_capacity=config.enforce_capacity,
+    )
+    is_new = gcol == new_node[:, None]
+    sw_c = jnp.where(swapped, c_cpu, 0.0)
+    sw_m = jnp.where(swapped, c_mem, 0.0)
+    d_cpu = jnp.sum(
+        jnp.where(is_new, sw_c[:, None], 0.0)
+        - jnp.where(is_cur, sw_c[:, None], 0.0),
+        axis=0,
+    )
+    d_mem = jnp.sum(
+        jnp.where(is_new, sw_m[:, None], 0.0)
+        - jnp.where(is_cur, sw_m[:, None], 0.0),
+        axis=0,
+    )
+    return new_node, swapped, n_sw, d_cpu, d_mem
 
 
 def sharded_place(
@@ -212,6 +265,9 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
     temps = config.noise_temp * (
         1.0 - _np.arange(config.sweeps, dtype=_np.float32) / max(config.sweeps - 1, 1)
     )
+    # per-sweep swap-phase flags (numpy — same trace-agnostic reasoning)
+    swf = swap_flags(config.sweeps, config.swap_every)
+    use_swaps = config.swap_every > 0 and C >= 2
 
     def solve_one(
         assign_init, adj, rv, W_mm, svc_valid, svc_cpu, svc_mem,
@@ -273,14 +329,15 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
             return obj + move_penalty(assign) if mc_on else obj
 
         def chunk_step(inner, xs_c):
-            ids, chunk_key, temp = xs_c
+            ids, chunk_key, temp, do_swap = xs_c
             assign, X_l, cpu_l, mem_l = inner
             valid_c = svc_valid[ids]
             c_cpu = svc_cpu[ids]
             c_mem = svc_mem[ids]
             cur = assign[ids]
 
-            M = jnp.matmul(W_mm[ids], X_l, preferred_element_type=jnp.float32)
+            Wr = W_mm[ids]
+            M = jnp.matmul(Wr, X_l, preferred_element_type=jnp.float32)
             # everything after M is the SHARED shard-local placement (also
             # used by the sparse node-sharded solver)
             new_node, admitted, is_new, d_cpu, d_mem = sharded_place(
@@ -290,27 +347,71 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
                 home=assign_init[ids] if mc_on else None,
                 move_pen=pen_vec[ids] if mc_on else None,
             )
-            new_assign = assign.at[ids].set(new_node)
-            X_l = X_l.at[ids].set(
-                (is_new & valid_c[:, None]).astype(X_l.dtype)
+            inner = (
+                assign.at[ids].set(new_node),
+                X_l.at[ids].set((is_new & valid_c[:, None]).astype(X_l.dtype)),
+                cpu_l + d_cpu,
+                mem_l + d_mem,
             )
-            return (new_assign, X_l, cpu_l + d_cpu, mem_l + d_mem), jnp.sum(admitted)
+            n_moves = jnp.sum(admitted)
+            if not use_swaps:
+                return inner, (n_moves, jnp.int32(0))
+
+            def _sw(op):
+                assign2, X2, cpu2, mem2 = op
+                cur2 = assign2[ids]
+                # replicated chunk-local pair weights: one-hot contraction
+                # of the already-gathered W rows (HIGHEST keeps the values
+                # bit-equal to the single-chip column take)
+                pos = (
+                    jnp.full((SP,), C, jnp.int32)
+                    .at[ids]
+                    .set(jnp.arange(C, dtype=jnp.int32))
+                )
+                E = (
+                    pos[:, None] == jnp.arange(C, dtype=jnp.int32)[None, :]
+                ).astype(Wr.dtype)
+                Wc = jnp.dot(
+                    Wr, E,
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                new2, swapped, n_sw, d_c, d_m = sharded_swap(
+                    M, Wc, cur2, valid_c & ~admitted, c_cpu, c_mem,
+                    cpu2, mem2, cap_l, mem_cap_l, valid_l, gcol, config, ow,
+                    col0=shard * Nl,
+                    home=assign_init[ids] if mc_on else None,
+                    move_pen=pen_vec[ids] if mc_on else None,
+                )
+                assign2 = assign2.at[ids].set(new2)
+                X2 = X2.at[ids].set(
+                    ((gcol == new2[:, None]) & valid_c[:, None]).astype(
+                        X2.dtype
+                    )
+                )
+                return (assign2, X2, cpu2 + d_c, mem2 + d_m), n_sw
+
+            inner, n_sw = lax.cond(
+                do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+            )
+            return inner, (n_moves, n_sw)
 
         def sweep(carry, xs):
-            sweep_key, temp = xs
+            sweep_key, temp, do_swap = xs
             assign, best_assign, best_obj = carry
             perm_key, noise_key = jax.random.split(sweep_key)
             chunk_ids, _ = sweep_composition(perm_key, SP, C, n_chunks)
             chunk_keys = jax.random.split(noise_key, n_chunks)
             chunk_temps = jnp.full((n_chunks,), temp)
+            chunk_sw = jnp.full((n_chunks,), do_swap)
             X0 = (
                 (assign[:, None] == gcol) & svc_valid[:, None]
             ).astype(jnp.dtype(config.matmul_dtype))
             cpu_l, mem_l = local_loads(assign)
-            (assign, _, _, _), moves = lax.scan(
+            (assign, _, _, _), (moves, _) = lax.scan(
                 chunk_step,
                 (assign, X0, cpu_l, mem_l),
-                (chunk_ids, chunk_keys, chunk_temps),
+                (chunk_ids, chunk_keys, chunk_temps, chunk_sw),
             )
             # best-seen selection uses loads recomputed from the assignment,
             # not the incrementally-carried cpu_l: accumulated f32 drift in
@@ -326,7 +427,7 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
         cpu0, _ = local_loads(assign_init)
         obj0 = objective_fast(assign_init, cpu0)
         (_, best_assign, _), _ = lax.scan(
-            sweep, (assign_init, assign_init, obj0), (keys_r, temps)
+            sweep, (assign_init, assign_init, obj0), (keys_r, temps, swf)
         )
         # exact f32 re-evaluation of the adopted placement (same reason as
         # global_solver: the fast objective only ranks sweeps)

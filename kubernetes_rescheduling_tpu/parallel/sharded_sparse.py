@@ -48,12 +48,16 @@ from kubernetes_rescheduling_tpu.ops.sparse_mass import (
     reference_hub_mass,
     reference_sparse_mass,
 )
-from kubernetes_rescheduling_tpu.parallel.sharded_solver import sharded_place
+from kubernetes_rescheduling_tpu.parallel.sharded_solver import (
+    sharded_place,
+    sharded_swap,
+)
 from kubernetes_rescheduling_tpu.solver.global_solver import (
     GlobalSolverConfig,
     auto_chunk,
     pod_restart_bill,
 )
+from kubernetes_rescheduling_tpu.solver.swap import swap_flags
 from kubernetes_rescheduling_tpu.solver.sparse_solver import (
     hub_slab,
     sorted_problem_arrays,
@@ -98,6 +102,12 @@ def _solve_factory(
         - np.arange(config.sweeps, dtype=np.float32)
         / max(config.sweeps - 1, 1)
     )
+    # per-sweep swap-phase flags (numpy — same trace-agnostic reasoning);
+    # hub groups sit the swap phase out, mirroring the single-chip sparse
+    # solver
+    swf = swap_flags(config.sweeps, config.swap_every)
+    C_eff = KB * BLOCK_R
+    use_swaps = config.swap_every > 0
     # static slab boundaries for the hub groups' concatenated columns
     group_widths = [
         sum(block_ntiles[b] * bu for b in g) for g in hub_groups
@@ -174,21 +184,26 @@ def _solve_factory(
             )
             return (
                 (assign.at[ids].set(new_node), cpu_l + d_cpu, mem_l + d_mem),
-                jnp.sum(admitted),
+                admitted,
             )
 
-        def chunk_mass(assign, blocks, ids):
+        def chunk_slabs(blocks):
             starts = toff_ext[blocks] * bu
-            u_c, rvu_c = chunk_local_slabs(u_ids, rvu, starts, reg_tiles * bu)
-            tgt_c = assign[jnp.clip(u_c, 0, SPX - 1)]
+            return chunk_local_slabs(u_ids, rvu, starts, reg_tiles * bu)
+
+        def chunk_mass(tgt_c, rvu_c, blocks, ids, nn, off):
+            """Mass of the chunk's rows against targets ``tgt_c`` over
+            ``nn`` columns from ``off`` — the shard's node columns for M
+            (nn=Nl, off=col0), chunk position for the swap phase's
+            replicated Wc (nn=C_eff, off=0)."""
             raw = reference_sparse_mass(
                 w_mm, tgt_c, rvu_c, blocks, toff_ext,
-                num_nodes=Nl, bu=bu, reg_tiles=reg_tiles, col_offset=col0,
+                num_nodes=nn, bu=bu, reg_tiles=reg_tiles, col_offset=off,
             )
             return raw * rv_s[ids][:, None]
 
         def sweep(carry, xs):
-            sweep_key, temp = xs
+            sweep_key, temp, do_swap = xs
             assign, cpu_l, mem_l, best_assign, best_obj = carry
             perm_key, noise_key = jax.random.split(sweep_key)
             hub_moves = jnp.int32(0)
@@ -213,10 +228,10 @@ def _solve_factory(
                         num_nodes=Nl, blocks=blocks_g, col_offset=col0,
                     )
                     M = raw * rv_s[ids_g][:, None]
-                    inner, g_moves = place(
+                    inner, g_adm = place(
                         inner, ids_g, M, keys[n_chunks + g], temp
                     )
-                    hub_moves = hub_moves + g_moves
+                    hub_moves = hub_moves + jnp.sum(g_adm)
                     hub_cursor += len(blocks_g) * BLOCK_R
                 assign, cpu_l, mem_l = inner
             else:
@@ -230,10 +245,50 @@ def _solve_factory(
 
             def chunk_step(inner, xs_c):
                 blocks, ids, chunk_key = xs_c
-                M = chunk_mass(inner[0], blocks, ids)
-                return place(inner, ids, M, chunk_key, temp)
+                assign = inner[0]
+                u_c, rvu_c = chunk_slabs(blocks)
+                M = chunk_mass(
+                    assign[jnp.clip(u_c, 0, SPX - 1)], rvu_c, blocks, ids,
+                    Nl, col0,
+                )
+                inner, admitted = place(inner, ids, M, chunk_key, temp)
+                n_moves = jnp.sum(admitted)
+                if not use_swaps:
+                    return inner, (n_moves, jnp.int32(0))
 
-            (assign, _, _), moves = lax.scan(
+                def _sw(op):
+                    assign2, cpu2, mem2 = op
+                    cur2 = assign2[ids]
+                    pos = (
+                        jnp.full((SPX,), C_eff, jnp.int32)
+                        .at[ids]
+                        .set(jnp.arange(C_eff, dtype=jnp.int32))
+                    )
+                    # replicated Wc (chunk position as the "node" axis) —
+                    # every shard computes the same full [C_eff, C_eff]
+                    Wc = chunk_mass(
+                        pos[jnp.clip(u_c, 0, SPX - 1)], rvu_c, blocks,
+                        ids, C_eff, 0,
+                    )
+                    new2, swapped, n_sw, d_c, d_m = sharded_swap(
+                        M, Wc, cur2,
+                        svc_valid[ids] & ~admitted,
+                        svc_cpu[ids], svc_mem[ids],
+                        cpu2, mem2, cap_l, mem_cap_l, valid_l, gcol,
+                        config, ow, col0=col0,
+                        home=assign_init[ids] if mc_on else None,
+                        move_pen=pen_vec[ids] if mc_on else None,
+                    )
+                    return (
+                        assign2.at[ids].set(new2), cpu2 + d_c, mem2 + d_m
+                    ), n_sw
+
+                inner, n_sw = lax.cond(
+                    do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+                )
+                return inner, (n_moves, n_sw)
+
+            (assign, _, _), (moves, _) = lax.scan(
                 chunk_step, (assign, cpu_l, mem_l),
                 (chunk_blocks, chunk_ids, chunk_keys),
             )
@@ -251,7 +306,7 @@ def _solve_factory(
         obj0 = objective_rank(assign_init, cpu0)
         (_, _, _, best_assign, best_obj), _ = lax.scan(
             sweep, (assign_init, cpu0, mem0, assign_init, obj0),
-            (keys_r, temps),
+            (keys_r, temps, swf),
         )
         # the scan ranked with the penalized objective; return the RAW
         # exact value — the entry's adopt gate re-prices with the exact
@@ -298,17 +353,65 @@ def _build_solve(mesh, config, sgraph_meta, S, N):
     return fn
 
 
-def sharded_sparse_assign(
-    state: ClusterState,
-    sgraph: SparseCommGraph,
-    key: jax.Array,
-    mesh: Mesh,
-    config: GlobalSolverConfig = GlobalSolverConfig(),
-) -> tuple[ClusterState, dict[str, jax.Array]]:
-    """``global_assign_sparse`` with the node axis sharded over ``mesh``'s
-    ``tp``. Requires ``num_nodes % tp == 0`` and ≥ 2 blocks (single-block
-    graphs belong to the dense solver — same rule as the single-chip
-    sparse path). Never worse than the input placement."""
+def _build_solve_restarts(mesh, config, sgraph_meta, S, N, r_local):
+    """dp restarts of tp-sharded SPARSE solves — the sparse twin of
+    ``sharded_solver._build_solve_restarts`` (same selection semantics:
+    each dp slice scans its restarts sequentially, the winner is picked
+    by the GATED PENALIZED value min(raw + exact pod restart bill,
+    input objective) in global restart order)."""
+    from kubernetes_rescheduling_tpu.solver.global_solver import (
+        restart_bill_from_arrays,
+    )
+
+    cache_key = (mesh, config, sgraph_meta, S, N, r_local)
+    fn = _SOLVE_CACHE.get(cache_key)
+    if fn is not None:
+        return fn
+    solve_one = _solve_factory(config, sgraph_meta, S, N, mesh.shape["tp"])
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(*_IN_SPECS[:-1], P(), P(), P(), P(), P("dp")),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    def solve_r(
+        assign_init, w_mm, u_ids, rvu, rv_s, svc_valid, svc_cpu, svc_mem,
+        toff_ext, reg_ext, hub_ids_all, u_hub_all, rvu_hub_all,
+        e_src, e_dst, e_w,
+        cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l,
+        pod_slot, pod_node0, pod_mask, obj_true0, keys_block,
+    ):
+        def body(carry, keys_r):
+            ba, bo = solve_one(
+                assign_init, w_mm, u_ids, rvu, rv_s, svc_valid, svc_cpu,
+                svc_mem, toff_ext, reg_ext, hub_ids_all, u_hub_all,
+                rvu_hub_all, e_src, e_dst, e_w,
+                cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r,
+            )
+            return carry, (ba, bo)
+
+        _, (assigns, objs) = lax.scan(body, 0, keys_block)
+        tgts = assigns[:, pod_slot]                               # [r, P]
+        bills = jax.vmap(
+            lambda t: restart_bill_from_arrays(
+                pod_mask, pod_node0, t, config.move_cost
+            )
+        )(tgts)
+        gated = jnp.minimum(objs + bills, obj_true0)
+        all_gated = lax.all_gather(gated, "dp", tiled=True)       # [R]
+        all_objs = lax.all_gather(objs, "dp", tiled=True)         # [R]
+        all_assigns = lax.all_gather(assigns, "dp", tiled=True)   # [R, SPX]
+        best = jnp.argmin(all_gated)
+        return all_assigns[best], all_objs[best], all_gated
+
+    fn = jax.jit(solve_r)
+    _SOLVE_CACHE[cache_key] = fn
+    return fn
+
+
+def _validate(state, sgraph, config, mesh):
     if not config.capacity_frac > 0:
         raise ValueError(f"capacity_frac must be > 0, got {config.capacity_frac}")
     if sgraph.num_blocks <= 1:
@@ -325,10 +428,17 @@ def sharded_sparse_assign(
             "sparse form (use the dense solver)."
         )
     tp = mesh.shape["tp"]
-    S = sgraph.num_services
     N = state.num_nodes
     if N % tp:
         raise ValueError(f"num_nodes {N} must be a multiple of tp={tp}")
+    return tp, sgraph.num_services, N
+
+
+def _prep(state, sgraph, config, N):
+    """Problem arrays in the shard_map argument order (minus keys) plus
+    ``(sgraph_meta, cap, SPX)`` — ONE preamble for the single-restart and
+    dp-restarts entries (the decision parity between them depends on it).
+    """
     C, KB, n_chunks, ndummy, SPX, hub_groups = _geometry(sgraph, config)
     sgraph_meta = (
         C, KB, n_chunks, ndummy, SPX, tuple(hub_groups),
@@ -376,26 +486,32 @@ def sharded_sparse_assign(
     )
     cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0) * config.capacity_frac
 
-    keys = jax.random.split(key, config.sweeps)
-    best_assign, best_obj = _build_solve(mesh, config, sgraph_meta, S, N)(
+    args = (
         assign0, w_mm, sgraph.u_ids, rvu, rv_s, svc_valid, svc_cpu_s,
         svc_mem_s, toff_ext, reg_ext, hub_ids_all, u_hub_all, rvu_hub_all,
         sgraph.edges_src, sgraph.edges_dst, sgraph.edges_w,
         cap, mem_cap, state.node_base_cpu, state.node_base_mem,
-        state.node_valid, keys,
+        state.node_valid,
     )
+    return sgraph_meta, args, cap, SPX
 
-    # ---- never-worse gate vs the TRUE input placement ----
+
+def _true_objective(state, sgraph, config, cap):
     ow = config.overload_weight if config.enforce_capacity else 0.0
     pct0 = jnp.where(state.node_valid, state.node_cpu_used() / cap * 100.0, 0.0)
-    obj_true0 = (
+    return (
         sparse_pod_comm_cost(state, sgraph)
         + config.balance_weight * (load_std(state) / config.capacity_frac)
         + ow * jnp.sum(jnp.maximum(pct0 - 100.0, 0.0))
     )
-    # under disruption pricing the adopt gate re-prices with the EXACT
-    # pod-level restart bill (the scan ranked with the service-level form;
-    # best_obj comes back RAW)
+
+
+def _finalize(state, sgraph, config, best_assign, best_obj, SPX, obj_true0):
+    """Never-worse gate vs the TRUE input placement + pod scatter. Under
+    disruption pricing the gate re-prices with the EXACT pod-level
+    restart bill (the scans rank with the service-level form; best_obj
+    comes back RAW)."""
+    S = sgraph.num_services
     pod_slot = jnp.clip(
         sgraph.inv[jnp.clip(state.pod_service, 0, S - 1)], 0, SPX - 1
     )
@@ -412,6 +528,77 @@ def sharded_sparse_assign(
         "objective_after": jnp.where(improved, best_obj, obj_true0),
         "improved": improved,
         "move_penalty": jnp.where(improved, bill, 0.0),
-        "tp": jnp.asarray(tp),
     }
     return state.replace(pod_node=new_pod_node), info
+
+
+def sharded_sparse_assign(
+    state: ClusterState,
+    sgraph: SparseCommGraph,
+    key: jax.Array,
+    mesh: Mesh,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """``global_assign_sparse`` with the node axis sharded over ``mesh``'s
+    ``tp``. Requires ``num_nodes % tp == 0`` and ≥ 2 blocks (single-block
+    graphs belong to the dense solver — same rule as the single-chip
+    sparse path). Never worse than the input placement."""
+    tp, S, N = _validate(state, sgraph, config, mesh)
+    sgraph_meta, args, cap, SPX = _prep(state, sgraph, config, N)
+    keys = jax.random.split(key, config.sweeps)
+    best_assign, best_obj = _build_solve(mesh, config, sgraph_meta, S, N)(
+        *args, keys
+    )
+    obj_true0 = _true_objective(state, sgraph, config, cap)
+    new_state, info = _finalize(
+        state, sgraph, config, best_assign, best_obj, SPX, obj_true0
+    )
+    info["tp"] = jnp.asarray(tp)
+    return new_state, info
+
+
+def sharded_sparse_solve_with_restarts(
+    state: ClusterState,
+    sgraph: SparseCommGraph,
+    key: jax.Array,
+    mesh: Mesh,
+    *,
+    n_restarts: int = 1,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+) -> tuple[ClusterState, dict[str, jax.Array]]:
+    """dp restarts *of* tp-sharded SPARSE solves — completes the
+    (solver, dp, tp) production matrix (the dense twin is
+    ``sharded_solver.sharded_solve_with_restarts``). ``n_restarts`` must
+    be a multiple of the mesh's ``dp``; per-restart keys match
+    ``parallel_restarts`` (``split(key, n_restarts)``, each split into
+    per-sweep keys), so with annealing noise off each restart makes the
+    same decisions as the single-chip sparse solver and the best-of-N
+    selection (gated penalized value, first minimum in global restart
+    order) matches the dp-only path."""
+    tp, S, N = _validate(state, sgraph, config, mesh)
+    dp = mesh.shape.get("dp", 1)
+    if n_restarts % dp:
+        raise ValueError(f"n_restarts {n_restarts} must be a multiple of dp={dp}")
+    r_local = n_restarts // dp
+    sgraph_meta, args, cap, SPX = _prep(state, sgraph, config, N)
+    obj_true0 = _true_objective(state, sgraph, config, cap)
+    pod_slot = jnp.clip(
+        sgraph.inv[jnp.clip(state.pod_service, 0, S - 1)], 0, SPX - 1
+    )
+    pod_mask = state.pod_valid & (state.pod_node >= 0)
+    keys_all = jax.random.split(key, n_restarts)                    # [R, 2]
+    keys_block = jax.vmap(
+        lambda k: jax.random.split(k, config.sweeps)
+    )(keys_all)                                                     # [R, sweeps, 2]
+    best_assign, best_raw, all_gated = _build_solve_restarts(
+        mesh, config, sgraph_meta, S, N, r_local
+    )(*args, pod_slot, state.pod_node, pod_mask, obj_true0, keys_block)
+    new_state, info = _finalize(
+        state, sgraph, config, best_assign, best_raw, SPX, obj_true0
+    )
+    info.update(
+        restart_objectives=all_gated,
+        best_restart=jnp.argmin(all_gated),
+        tp=jnp.asarray(tp),
+    )
+    return new_state, info

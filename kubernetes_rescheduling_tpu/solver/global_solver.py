@@ -57,6 +57,12 @@ from kubernetes_rescheduling_tpu.ops.fused_admission import (
     fused_score_admission,
     reference_score_admission,
 )
+from kubernetes_rescheduling_tpu.solver.swap import (
+    BIG_CAP,
+    cols_at,
+    swap_decisions,
+    swap_flags,
+)
 
 
 @struct.dataclass
@@ -109,6 +115,17 @@ class GlobalSolverConfig:
     # emergent instead of a post-hoc wave cap. 0 (default) = moves are
     # free, the historical objective.
     move_cost: float = struct.field(pytree_node=False, default=0.0)
+    # Pairwise-exchange phase (solver/swap.py): every swap_every-th sweep,
+    # each chunk step follows single-move admission with capacity-feasible
+    # mutual-best swaps — the escape hatch for capacity deadlocks, where
+    # every improving single move is infeasible until another service
+    # vacates (the measured 15-25% optimality-gap regime of round 4).
+    # 3 = sweeps 2, 5, 8 under the default 9 (the polish sweeps, where
+    # annealing noise has decayed and deadlocks have formed) — the extra
+    # per-chunk cost (one more mass-sized contraction for the chunk-local
+    # pair weights + [C, C] vector math) is paid on a third of the sweeps.
+    # 1 = every sweep; 0 = off (the historical single-move-only search).
+    swap_every: int = struct.field(pytree_node=False, default=3)
     # dtype of the neighbor-mass matmul. bfloat16 feeds the MXU at full
     # rate with f32 accumulation (a modest win — the round is launch-bound,
     # see chunk_size above; measured 69→66 ms at 10k×1k). W weights and
@@ -491,6 +508,40 @@ def global_assign(
         and mass_bj is not None
     )
 
+    # pairwise-exchange phase (solver/swap.py): per chunk, after single-
+    # move admission, on the sweeps flagged by config.swap_every — the
+    # capacity-deadlock escape. Noise-free scores; protected end to end by
+    # the exact-objective best-seen selection and the adopt gate.
+    use_swaps = config.swap_every > 0 and C >= 2
+    sw_flags = jnp.asarray(swap_flags(config.sweeps, config.swap_every))
+    mem_cap_sw = jnp.where(jnp.isinf(mem_cap), BIG_CAP, mem_cap)
+
+    def _swap_phase(ids, M, Wc, assign, cpu_load, mem_load, admitted):
+        """Apply the chunk's swap phase to the post-singles state. ``M``
+        is the chunk-start neighbor mass — rows of services the single
+        phase just moved are stale, so those services sit out (they
+        already improved; swaps exist for the stuck ones)."""
+        cur = assign[ids]
+        valid_c = svc_valid[ids]
+        eligible = valid_c & ~admitted & state.node_valid[cur]
+        c_cpu = svc_cpu[ids]
+        c_mem = svc_mem[ids]
+        new_node, swapped, n_sw = swap_decisions(
+            cols_at(M, cur),
+            jnp.take_along_axis(M, cur[:, None], axis=1)[:, 0],
+            Wc, cur, eligible, c_cpu, c_mem,
+            cpu_load[cur], mem_load[cur], cap[cur], mem_cap_sw[cur],
+            config.balance_weight, ow,
+            pen=pen_vec[ids] if mc_on else None,
+            home=assign0[ids] if mc_on else None,
+            enforce_capacity=config.enforce_capacity,
+        )
+        d_c = jnp.where(swapped, c_cpu, 0.0)
+        d_m = jnp.where(swapped, c_mem, 0.0)
+        cpu_load = cpu_load.at[new_node].add(d_c).at[cur].add(-d_c)
+        mem_load = mem_load.at[new_node].add(d_m).at[cur].add(-d_m)
+        return assign.at[ids].set(new_node), cpu_load, mem_load, n_sw
+
     def _commit(inner, ids, valid_c, c_cpu, c_mem, cur, new_node, admitted):
         """Apply a chunk's admitted moves to the sweep state (XLA path only;
         the fused epilogue computes the equivalent occupancy rows and load
@@ -509,7 +560,7 @@ def global_assign(
         return (new_assign, X, cpu_load, mem_load), jnp.sum(admitted)
 
     def sweep(carry, xs):
-        sweep_key, temp = xs
+        sweep_key, temp, do_swap = xs
         assign, best_assign, best_obj = carry
         # Random chunk composition per sweep: which services get to move
         # together varies, so repeated sweeps (and parallel restarts with
@@ -527,8 +578,9 @@ def global_assign(
             valid_c = svc_valid[ids]
 
             # MXU matmul in mm_dtype (one-hot X is exact there), f32 accum
+            Wr = W_mm[ids]
             M = jnp.matmul(
-                W_mm[ids], X, preferred_element_type=jnp.float32
+                Wr, X, preferred_element_type=jnp.float32
             )                                                 # f32[C, N] kept-local mass
             c_cpu = svc_cpu[ids]
             c_mem = svc_mem[ids]
@@ -560,14 +612,11 @@ def global_assign(
                     interpret=fused_interpret,
                     x_dtype=mm_dtype,
                 )
-                return (
-                    (
-                        assign.at[ids].set(new_node),
-                        X.at[ids].set(x_rows),
-                        cpu_load + d_cpu,
-                        mem_load + d_mem,
-                    ),
-                    jnp.sum(admitted),
+                inner = (
+                    assign.at[ids].set(new_node),
+                    X.at[ids].set(x_rows),
+                    cpu_load + d_cpu,
+                    mem_load + d_mem,
                 )
             else:
                 noise = (
@@ -584,12 +633,35 @@ def global_assign(
                     move_pen=pen_vec[ids] if mc_on else None,
                     enforce_capacity=config.enforce_capacity,
                 )
-            return _commit(inner, ids, valid_c, c_cpu, c_mem, cur,
-                           new_node, admitted)
+                inner, _ = _commit(inner, ids, valid_c, c_cpu, c_mem, cur,
+                                   new_node, admitted)
+            n_moves = jnp.sum(admitted)
+            if not use_swaps:
+                return inner, (n_moves, jnp.int32(0))
+
+            def _sw(op):
+                assign2, X2, cpu2, mem2 = op
+                # chunk-local pair weights: W rows are already gathered
+                # for the mass matmul; a [C, C] column take is fine on
+                # the materialized-X lowerings (tests + CPU production)
+                Wc = jnp.take(Wr, ids, axis=1).astype(jnp.float32)
+                assign2, cpu2, mem2, n_sw = _swap_phase(
+                    ids, M, Wc, assign2, cpu2, mem2, admitted
+                )
+                X2 = X2.at[ids].set(
+                    jax.nn.one_hot(assign2[ids], N, dtype=mm_dtype)
+                    * valid_c[:, None]
+                )
+                return (assign2, X2, cpu2, mem2), n_sw
+
+            inner, n_sw = lax.cond(
+                do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+            )
+            return inner, (n_moves, n_sw)
 
         X0 = jax.nn.one_hot(assign, N, dtype=mm_dtype) * svc_valid[:, None]
         cpu_load, mem_load = loads(assign)
-        (assign, _, _, _), moves = lax.scan(
+        (assign, _, _, _), (moves, sws) = lax.scan(
             chunk_step, (assign, X0, cpu_load, mem_load),
             (chunk_ids, chunk_keys),
         )
@@ -597,7 +669,7 @@ def global_assign(
         better = obj < best_obj
         best_assign = jnp.where(better, assign, best_assign)
         best_obj = jnp.where(better, obj, best_obj)
-        return (assign, best_assign, best_obj), jnp.sum(moves)
+        return (assign, best_assign, best_obj), (jnp.sum(moves), jnp.sum(sws))
 
     def sweep_inline(carry, xs):
         """The TPU inline-mass sweep: same decisions as `sweep` (same chunk
@@ -607,7 +679,7 @@ def global_assign(
         canonical W, no per-sweep permute) and regenerates occupancy tiles
         from `assign` in VMEM; per-node loads are carried through the chunk
         scan and refreshed from the assignment at each sweep boundary."""
-        sweep_key, temp = xs
+        sweep_key, temp, do_swap = xs
         assign, cpu_load, mem_load, best_assign, best_obj = carry
         perm_key, noise_key = jax.random.split(sweep_key)
         chunk_ids, block_rows = sweep_composition(
@@ -640,12 +712,42 @@ def global_assign(
                 interpret=fused_interpret,
                 emit_x_rows=False,
             )
-            return (
-                (assign.at[ids].set(new_node), cpu_load + d_cpu, mem_load + d_mem),
-                jnp.sum(admitted),
+            inner = (
+                assign.at[ids].set(new_node),
+                cpu_load + d_cpu,
+                mem_load + d_mem,
             )
+            n_moves = jnp.sum(admitted)
+            if not use_swaps:
+                return inner, (n_moves, jnp.int32(0))
 
-        (assign, _, _), moves = lax.scan(
+            def _sw(op):
+                assign2, cpu2, mem2 = op
+                # chunk-local pair weights via the SAME mass kernel with
+                # "node" = chunk position: Wc[i, j] = W[i, ids_j] — W row
+                # blocks are gathered by id exactly as for M, and the
+                # [C, C] result never needs a column gather
+                pos = (
+                    jnp.full((SP,), C, jnp.int32)
+                    .at[ids]
+                    .set(jnp.arange(C, dtype=jnp.int32))
+                )
+                Wc = fused_neighbor_mass(
+                    W_mm, pos, svc_valid, blocks,
+                    num_nodes=C, block_b=COMPOSITION_BLOCK, block_j=mass_bj,
+                    interpret=fused_interpret,
+                )
+                assign2, cpu2, mem2, n_sw = _swap_phase(
+                    ids, M, Wc, assign2, cpu2, mem2, admitted
+                )
+                return (assign2, cpu2, mem2), n_sw
+
+            inner, n_sw = lax.cond(
+                do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+            )
+            return inner, (n_moves, n_sw)
+
+        (assign, _, _), (moves, sws) = lax.scan(
             chunk_step, (assign, cpu_load, mem_load),
             (chunk_ids, block_rows, chunk_keys),
         )
@@ -659,7 +761,10 @@ def global_assign(
         better = obj < best_obj
         best_assign = jnp.where(better, assign, best_assign)
         best_obj = jnp.where(better, obj, best_obj)
-        return (assign, cpu_fresh, mem_fresh, best_assign, best_obj), jnp.sum(moves)
+        return (
+            (assign, cpu_fresh, mem_fresh, best_assign, best_obj),
+            (jnp.sum(moves), jnp.sum(sws)),
+        )
 
     # True objective of the INPUT placement (which may have a service's
     # replicas split across nodes — not representable as a service-level
@@ -684,12 +789,13 @@ def global_assign(
         1.0 - jnp.arange(config.sweeps, dtype=jnp.float32) / max(config.sweeps - 1, 1)
     )
     if inline_mass:
-        (_, _, _, best_assign, _), moves_per_sweep = lax.scan(
-            sweep_inline, (assign0, cpu0, mem0, assign0, obj0), (keys, temps)
+        (_, _, _, best_assign, _), (moves_per_sweep, swaps_per_sweep) = lax.scan(
+            sweep_inline, (assign0, cpu0, mem0, assign0, obj0),
+            (keys, temps, sw_flags),
         )
     else:
-        (_, best_assign, _), moves_per_sweep = lax.scan(
-            sweep, (assign0, assign0, obj0), (keys, temps)
+        (_, best_assign, _), (moves_per_sweep, swaps_per_sweep) = lax.scan(
+            sweep, (assign0, assign0, obj0), (keys, temps, sw_flags)
         )
     # best-seen selection above ranks sweeps with the fast objective; the
     # adopted value is re-evaluated EXACTLY so the never-worse gate and the
@@ -714,6 +820,7 @@ def global_assign(
         "objective_after": jnp.where(improved, best_obj, obj_true0),
         "improved": improved,
         "moves_per_sweep": moves_per_sweep,
+        "swaps_per_sweep": swaps_per_sweep,
         "move_penalty": jnp.where(improved, best_pen, 0.0),
         "communication_cost": communication_cost(new_state, graph),
         "load_std": load_std(new_state),

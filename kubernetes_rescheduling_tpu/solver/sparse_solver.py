@@ -73,6 +73,12 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     pct_balance_terms,
     pod_restart_bill,
 )
+from kubernetes_rescheduling_tpu.solver.swap import (
+    BIG_CAP,
+    cols_at,
+    swap_decisions,
+    swap_flags,
+)
 
 
 def sparse_pod_comm_cost(
@@ -344,23 +350,27 @@ def _global_assign_sparse(
             (blocks_g, ids_g, u_g, rvu_g, hub_tile_arrays(sgraph, blocks_g))
         )
 
-    def chunk_mass(assign, blocks, ids):
+    def chunk_slabs(blocks):
         # gather only the chunk's columns: KB contiguous id slices, then a
         # few-thousand-entry gather (full-table gathers cost more than all
         # the matmuls combined — see ops/sparse_mass.py docstring)
         starts = toff_ext[blocks] * sgraph.bu
-        u_c, rvu_c = chunk_local_slabs(sgraph.u_ids, rvu, starts, sgraph.u_reg)
-        tgt_c = assign[jnp.clip(u_c, 0, SPX - 1)]
+        return chunk_local_slabs(sgraph.u_ids, rvu, starts, sgraph.u_reg)
+
+    def chunk_mass(tgt_c, rvu_c, blocks, ids, nn):
+        """Mass of the chunk's rows against arbitrary targets ``tgt_c``
+        over ``nn`` columns — node occupancy for M (nn=N), chunk position
+        for the swap phase's pair-weight block Wc (nn=C_eff)."""
         if use_kernels:
             raw = sparse_neighbor_mass(
                 w_mm, tgt_c, rvu_c, blocks, toff_ext,
-                num_nodes=N, bu=sgraph.bu, reg_tiles=sgraph.reg_tiles,
+                num_nodes=nn, bu=sgraph.bu, reg_tiles=sgraph.reg_tiles,
                 interpret=fused_interpret or not on_tpu,
             )
         else:
             raw = reference_sparse_mass(
                 w_mm, tgt_c, rvu_c, blocks, toff_ext,
-                num_nodes=N, bu=sgraph.bu, reg_tiles=sgraph.reg_tiles,
+                num_nodes=nn, bu=sgraph.bu, reg_tiles=sgraph.reg_tiles,
             )
         return raw * rv_s[ids][:, None]
 
@@ -409,7 +419,7 @@ def _global_assign_sparse(
                     cpu_load + d_cpu,
                     mem_load + d_mem,
                 ),
-                jnp.sum(admitted),
+                admitted,
             )
         noise = (
             temp * jax.random.gumbel(chunk_key, M.shape)
@@ -431,11 +441,45 @@ def _global_assign_sparse(
         mem_load = mem_load.at[new_node].add(d_mem).at[cur].add(-d_mem)
         return (
             (assign.at[ids].set(new_node), cpu_load, mem_load),
-            jnp.sum(admitted),
+            admitted,
         )
 
+    # pairwise-exchange phase (solver/swap.py): per regular chunk, after
+    # single-move admission, on sweeps flagged by config.swap_every. Hub
+    # groups sit the swap phase out: hubs are the highest-degree movers
+    # (rarely capacity-deadlocked — any node wants them) and their ragged
+    # Wc would need its own kernel plumbing for little gain.
+    C_eff = KB * BLOCK_R
+    use_swaps = config.swap_every > 0
+    sw_flags = jnp.asarray(swap_flags(config.sweeps, config.swap_every))
+    mem_cap_sw = jnp.where(jnp.isinf(mem_cap), BIG_CAP, mem_cap)
+
+    def _swap_phase(ids, M, Wc, assign, cpu_load, mem_load, admitted):
+        """Identical structure to the dense solver's swap phase, over the
+        sorted-space arrays (see global_solver._swap_phase)."""
+        cur = assign[ids]
+        valid_c = svc_valid[ids]
+        eligible = valid_c & ~admitted & state.node_valid[cur]
+        c_cpu = svc_cpu_s[ids]
+        c_mem = svc_mem_s[ids]
+        new_node, swapped, n_sw = swap_decisions(
+            cols_at(M, cur),
+            jnp.take_along_axis(M, cur[:, None], axis=1)[:, 0],
+            Wc, cur, eligible, c_cpu, c_mem,
+            cpu_load[cur], mem_load[cur], cap[cur], mem_cap_sw[cur],
+            config.balance_weight, ow,
+            pen=pen_vec[ids] if mc_on else None,
+            home=assign0[ids] if mc_on else None,
+            enforce_capacity=config.enforce_capacity,
+        )
+        d_c = jnp.where(swapped, c_cpu, 0.0)
+        d_m = jnp.where(swapped, c_mem, 0.0)
+        cpu_load = cpu_load.at[new_node].add(d_c).at[cur].add(-d_c)
+        mem_load = mem_load.at[new_node].add(d_m).at[cur].add(-d_m)
+        return assign.at[ids].set(new_node), cpu_load, mem_load, n_sw
+
     def sweep(carry, xs):
-        sweep_key, temp = xs
+        sweep_key, temp, do_swap = xs
         assign, cpu_load, mem_load, best_assign, best_obj = carry
         perm_key, noise_key = jax.random.split(sweep_key)
         # key-split structure matches the dense inline path when NHB == 0
@@ -450,10 +494,10 @@ def _global_assign_sparse(
             for g, group in enumerate(hub_groups):
                 assign = inner[0]
                 M = hub_mass(assign, group)
-                inner, g_moves = place(
+                inner, g_adm = place(
                     inner, group[1], M, keys[n_chunks + g], temp
                 )
-                hub_moves = hub_moves + g_moves
+                hub_moves = hub_moves + jnp.sum(g_adm)
             assign, cpu_load, mem_load = inner
         else:
             chunk_keys = jax.random.split(noise_key, n_chunks)
@@ -467,10 +511,38 @@ def _global_assign_sparse(
         def chunk_step(inner, xs_c):
             blocks, ids, chunk_key = xs_c
             assign = inner[0]
-            M = chunk_mass(assign, blocks, ids)
-            return place(inner, ids, M, chunk_key, temp)
+            u_c, rvu_c = chunk_slabs(blocks)
+            M = chunk_mass(
+                assign[jnp.clip(u_c, 0, SPX - 1)], rvu_c, blocks, ids, N
+            )
+            inner, admitted = place(inner, ids, M, chunk_key, temp)
+            n_moves = jnp.sum(admitted)
+            if not use_swaps:
+                return inner, (n_moves, jnp.int32(0))
 
-        (assign, _, _), moves = lax.scan(
+            def _sw(op):
+                assign2, cpu2, mem2 = op
+                # chunk-local pair weights via the SAME mass contraction
+                # with "node" = chunk position: Wc[i, j] = W[i, ids_j]
+                pos = (
+                    jnp.full((SPX,), C_eff, jnp.int32)
+                    .at[ids]
+                    .set(jnp.arange(C_eff, dtype=jnp.int32))
+                )
+                Wc = chunk_mass(
+                    pos[jnp.clip(u_c, 0, SPX - 1)], rvu_c, blocks, ids, C_eff
+                )
+                assign2, cpu2, mem2, n_sw = _swap_phase(
+                    ids, M, Wc, assign2, cpu2, mem2, admitted
+                )
+                return (assign2, cpu2, mem2), n_sw
+
+            inner, n_sw = lax.cond(
+                do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+            )
+            return inner, (n_moves, n_sw)
+
+        (assign, _, _), (moves, sws) = lax.scan(
             chunk_step, (assign, cpu_load, mem_load),
             (chunk_blocks, chunk_ids, chunk_keys),
         )
@@ -483,7 +555,7 @@ def _global_assign_sparse(
         best_obj = jnp.where(better, obj, best_obj)
         return (
             (assign, cpu_fresh, mem_fresh, best_assign, best_obj),
-            jnp.sum(moves) + hub_moves,
+            (jnp.sum(moves) + hub_moves, jnp.sum(sws)),
         )
 
     # true objective of the INPUT placement (replicas may be split across
@@ -505,8 +577,11 @@ def _global_assign_sparse(
         - jnp.arange(config.sweeps, dtype=jnp.float32)
         / max(config.sweeps - 1, 1)
     )
-    (_, _, _, best_assign, best_obj), moves_per_sweep = lax.scan(
-        sweep, (assign0, cpu0, mem0, assign0, obj0), (keys, temps)
+    (_, _, _, best_assign, best_obj), (moves_per_sweep, swaps_per_sweep) = (
+        lax.scan(
+            sweep, (assign0, cpu0, mem0, assign0, obj0),
+            (keys, temps, sw_flags),
+        )
     )
 
     # under disruption pricing the adopt gate re-prices with the EXACT
@@ -529,6 +604,7 @@ def _global_assign_sparse(
         "objective_after": jnp.where(improved, raw_after, obj_true0),
         "improved": improved,
         "moves_per_sweep": moves_per_sweep,
+        "swaps_per_sweep": swaps_per_sweep,
         "move_penalty": jnp.where(improved, best_pen, 0.0),
         "communication_cost": sparse_pod_comm_cost(new_state, sgraph),
         "load_std": load_std(new_state),

@@ -1,0 +1,263 @@
+"""Pairwise-exchange (swap) phase for the chunked best-response solvers.
+
+Single-service best-response deadlocks when capacity binds: every
+improving move is infeasible until another service vacates — exactly the
+regime where the measured optimality gap was worst (15-25% above the MILP
+optimum on capacity-binding instances, RESULTS.md round 4). This module
+adds the second move type: **capacity-feasible pairwise swaps** — two
+services exchange nodes when the joint move improves the objective and
+both directions fit.
+
+Runs as a per-chunk phase after the single-move admission (on sweeps
+selected by ``GlobalSolverConfig.swap_every``). For chunk services with
+current nodes ``cur`` and chunk-start neighbor mass ``M[C, N]``, the
+exchange gain of services i and j (i → cur_j, j → cur_i, atomically) is
+
+    G[i, j] =  (M[i, cur_j] - M[i, cur_i])          # i's kept-mass delta
+             + (M[j, cur_i] - M[j, cur_j])          # j's kept-mass delta
+             - 2·W[i, j]                            # mutual-mass correction
+             + Δbalance/overload terms + Δmove-cost terms
+
+The ``-2·W[i, j]`` corrects the double-counted mutual mass: ``M[i,
+cur_j]`` counts j's mass at cur_j, but after the swap j has left
+(symmetrically for ``M[j, cur_i]``; the (i, j) pair's own cut
+contribution is unchanged by an exchange). The load terms use the
+DEPARTURE-CORRECTED projection ``load[cur_j] - cpu_j + cpu_i`` — the
+single-move score's "node load plus me" projection would charge an
+arriving service for a resident that is leaving in the same exchange,
+vetoing precisely the full-node swaps this phase exists for. Move-cost
+pricing charges/credits each side against its round-start anchor exactly
+like the single-move score.
+
+Selection is **mutual-best matching**: each service points at its
+best-gain feasible partner, and exactly the pairs that point at each
+other swap — service-disjoint by construction. Node capacity across
+several admitted swaps touching the same node is resolved by the same
+sort-free pairwise-priority race as single-move admission, with
+higher-priority swaps' node deltas clamped at ≥ 0 (a rejected
+higher-priority swap then only makes the estimate conservative, never
+unsafe — mirroring the single-move race's departures-ignored rule).
+
+Everything here is replicated [C]- and [C, C]-vector math, shared
+verbatim by the single-chip solvers and the shard_map bodies of the
+node-sharded solvers — the swap decisions cannot fork between them. The
+node-column-dependent inputs (``M[i, cur_j]``, load/capacity at each
+member's current node) are reduced by the callers: direct one-hot
+contractions and [C] gathers single-chip, the same contractions psum'd
+over ``tp`` when node columns are sharded; both produce the exact f32
+value (one nonzero term per reduction), so the replicated core sees
+identical inputs.
+
+Reference objective being improved: communicationcost.py:40-45. The
+reference has no coordinated-move mechanism at all (one deployment per
+15 s round, main.py:27,100) — swaps exist because the solver-quality bar
+here is the MILP optimum, not the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# stand-in for an unbounded memory budget inside feasibility arithmetic:
+# inf would be correct in comparisons but can surface NaNs through masked
+# sums (inf·0); every caller sanitizes with the SAME constant so the
+# single-chip and sharded paths compare identical values
+BIG_CAP = 3.4e38
+
+
+def swap_flags(sweeps: int, swap_every: int) -> np.ndarray:
+    """Which sweeps run the swap phase: every ``swap_every``-th sweep,
+    counted so the LAST sweep of a default config is always included
+    (sweeps 2, 5, 8 for sweeps=9, swap_every=3 — polish sweeps, where
+    annealing noise has decayed and capacity deadlocks have formed).
+    numpy on purpose: factories close over it (trace-agnostic)."""
+    if swap_every <= 0:
+        return np.zeros((sweeps,), dtype=bool)
+    return (np.arange(sweeps) % swap_every) == (swap_every - 1)
+
+
+def cols_at(M, cur, col0=0):
+    """``M_cur[i, j] = M[i, cur_j]`` as a one-hot contraction (NOT a
+    [C, C] gather — XLA's TPU gather runs element-at-a-time and a 1M-
+    element gather would cost more than the whole chunk step). HIGHEST
+    precision keeps the one-hot product bit-exact in f32, so sharded
+    callers psum'ing per-shard partials (zero off-shard) reproduce the
+    single-chip values exactly."""
+    C, N = M.shape
+    gcol = col0 + jnp.arange(N, dtype=jnp.int32)
+    E = (gcol[:, None] == cur[None, :]).astype(M.dtype)  # [N, C]
+    return jnp.dot(
+        M, E,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def swap_decisions(
+    M_cur,        # f32[C, C]: M[i, cur_j] (psum'd when node-sharded)
+    m_own,        # f32[C]: M[i, cur_i]
+    Wc,           # f32[C, C]: pair weight between chunk members i and j
+    cur,          # i32[C] current node per service (post single-move phase)
+    eligible,     # bool[C]: valid AND not moved by this chunk's single phase
+    c_cpu,        # f32[C]
+    c_mem,        # f32[C]
+    load_cpu_at,  # f32[C]: node CPU load at cur_i (current, incl. i)
+    load_mem_at,  # f32[C]
+    cap_at,       # f32[C]: budget-scaled CPU capacity at cur_i
+    mem_cap_at,   # f32[C] (inf sanitized to BIG_CAP by the caller)
+    lam,          # balance weight
+    ow,           # overload (over-budget) weight
+    pen=None,     # f32[C] move-cost bill per service (None = pricing off)
+    home=None,    # i32[C] round-start anchor node (with pen)
+    *,
+    enforce_capacity: bool,
+):
+    """The replicated swap core: exchange-gain matrix → mutual-best
+    matching → pairwise-priority capacity race. Returns ``(new_node,
+    swapped, n_swaps)`` where ``swapped[k]`` marks both members of every
+    admitted pair and ``new_node[k] = cur[partner_k]`` there."""
+    C = m_own.shape[0]
+    idx = jnp.arange(C)
+
+    # kept-mass side of the gain
+    G = M_cur + M_cur.T - m_own[:, None] - m_own[None, :] - 2.0 * Wc
+
+    # balance/overload side, with the departure-corrected projection:
+    # i lands on cur_j whose load loses j and gains i
+    pct_new = (
+        (load_cpu_at[None, :] - c_cpu[None, :] + c_cpu[:, None])
+        / cap_at[None, :]
+        * 100.0
+    )                                                   # [i, j]: i at cur_j
+    pct_old = load_cpu_at / cap_at * 100.0              # [C]: i resident now
+    term_new = -lam * pct_new - ow * jnp.maximum(pct_new - 100.0, 0.0)
+    term_old = -lam * pct_old - ow * jnp.maximum(pct_old - 100.0, 0.0)
+    G = G + (term_new - term_old[:, None]) + (term_new.T - term_old[None, :])
+
+    # move-cost side: each member re-anchors against ITS round-start node
+    if pen is not None:
+        off_new = (cur[None, :] != home[:, None]).astype(jnp.float32)
+        off_old = (cur != home).astype(jnp.float32)
+        P = pen[:, None] * (off_new - off_old[:, None])  # i's bill delta
+        G = G - P - P.T
+
+    pair_ok = (
+        eligible[:, None] & eligible[None, :] & (cur[:, None] != cur[None, :])
+    )
+    # net load delta at cur_i if (i, j) swap: j arrives, i departs
+    d_cpu_a = c_cpu[None, :] - c_cpu[:, None]
+    d_mem_a = c_mem[None, :] - c_mem[:, None]
+    free_cpu_at = cap_at - load_cpu_at
+    free_mem_at = mem_cap_at - load_mem_at
+    if enforce_capacity:
+        # the swap is atomic, so its own feasibility uses NET deltas on
+        # both end nodes (fits at cur_j is the transpose of fits at cur_i)
+        fits_a = (d_cpu_a <= free_cpu_at[:, None]) & (
+            d_mem_a <= free_mem_at[:, None]
+        )
+        fits = fits_a & fits_a.T
+    else:
+        fits = jnp.broadcast_to(jnp.bool_(True), (C, C))
+    Gm = jnp.where(pair_ok & fits & (G > 0), G, -jnp.inf)
+
+    # mutual-best matching: first-max partner per row; pairs that pick
+    # each other swap. Service-disjoint by construction (a service is in
+    # at most one mutual pair), so commits never collide.
+    p = jnp.argmax(Gm, axis=1).astype(jnp.int32)
+    gbest = jnp.take_along_axis(Gm, p[:, None], axis=1)[:, 0]
+    has = gbest > 0
+    mutual = has & (p[p] == idx)
+    cand = mutual & (idx < p)  # one representative per pair: the lower id
+    gain_c = jnp.where(cand, gbest, -jnp.inf)
+    before = (gain_c[None, :] > gain_c[:, None]) | (
+        (gain_c[None, :] == gain_c[:, None]) & (idx[None, :] < idx[:, None])
+    )
+    pri = (before & cand[None, :]).astype(jnp.float32)  # [s, t]
+
+    # cross-swap mass coupling: each pair's gain assumed everyone else
+    # stays put, so two swaps whose members communicate can jointly undo
+    # what each promised alone (two tied symmetric pairs would otherwise
+    # rotate forever). The joint gain of swaps s=(i,j), t=(k,l) is
+    # G(s) + G(t) + I(s,t) with I the Σ W·D over their 4 cross edges,
+    # D(x,y) = [n'x==n'y] - [n'x==ny] - [nx==n'y] + [nx==ny]. A swap must
+    # keep a positive margin after the CLAMPED-NEGATIVE interactions of
+    # all higher-priority swaps (a rejected higher-priority swap then only
+    # wastes margin, never admits a losing exchange).
+    nprime = cur[p]
+    D = (
+        (nprime[:, None] == nprime[None, :]).astype(jnp.float32)
+        - (nprime[:, None] == cur[None, :]).astype(jnp.float32)
+        - (cur[:, None] == nprime[None, :]).astype(jnp.float32)
+        + (cur[:, None] == cur[None, :]).astype(jnp.float32)
+    )
+    A = Wc * D
+    # I[s, t] = A[i,k] + A[i,l] + A[j,k] + A[j,l] = ((E+Pm) A (E+Pm)ᵀ)[s,t]
+    # with Pm the partner permutation — one-hot matmuls, not [C,C] gathers
+    Pm = (p[:, None] == idx[None, :]).astype(jnp.float32)
+    B = jnp.eye(C, dtype=jnp.float32) + Pm
+    I_mat = jnp.dot(
+        jnp.dot(B, A, precision=jax.lax.Precision.HIGHEST),
+        B.T,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    neg_i = jnp.sum(pri * jnp.minimum(I_mat, 0.0), axis=1)
+    cand = cand & (gain_c + neg_i > 0)
+    gain_c = jnp.where(cand, gbest, -jnp.inf)
+
+    if enforce_capacity:
+        # cross-swap capacity race: swap s must fit with every strictly-
+        # higher-priority (greater gain, ties → lower index) swap's node
+        # deltas counted, clamped at ≥ 0 (an uncommitted higher-priority
+        # swap then leaves the estimate conservative, never unsafe).
+        # Priority is re-derived over the interaction-surviving candidates.
+        before = (gain_c[None, :] > gain_c[:, None]) | (
+            (gain_c[None, :] == gain_c[:, None]) & (idx[None, :] < idx[:, None])
+        )
+        pri = (before & cand[None, :]).astype(jnp.float32)  # [s, t]
+        in_a_cpu = c_cpu[p] - c_cpu       # net at own node a_t = cur_t
+        in_b_cpu = -in_a_cpu              # net at partner node b_t = cur_{p_t}
+        in_a_mem = c_mem[p] - c_mem
+        in_b_mem = -in_a_mem
+        a_of = cur
+        b_of = cur[p]
+        pos = lambda x: jnp.maximum(x, 0.0)
+
+        def others(node_of):
+            # Σ over higher-priority swaps t of their clamped delta at
+            # this swap's node (a_t and b_t are distinct, so at most one
+            # term is live per t)
+            hit_a = (a_of[None, :] == node_of[:, None]).astype(jnp.float32)
+            hit_b = (b_of[None, :] == node_of[:, None]).astype(jnp.float32)
+            oc = jnp.sum(
+                pri * (hit_a * pos(in_a_cpu)[None, :]
+                       + hit_b * pos(in_b_cpu)[None, :]),
+                axis=1,
+            )
+            om = jnp.sum(
+                pri * (hit_a * pos(in_a_mem)[None, :]
+                       + hit_b * pos(in_b_mem)[None, :]),
+                axis=1,
+            )
+            return oc, om
+
+        oa_cpu, oa_mem = others(a_of)
+        ob_cpu, ob_mem = others(b_of)
+        adm = (
+            cand
+            & (in_a_cpu + oa_cpu <= free_cpu_at)
+            & (in_a_mem + oa_mem <= free_mem_at)
+            & (in_b_cpu + ob_cpu <= free_cpu_at[p])
+            & (in_b_mem + ob_mem <= free_mem_at[p])
+        )
+    else:
+        adm = cand
+
+    # both members of an admitted pair move to each other's node; the
+    # higher-index member reads its representative's verdict through p
+    # (mutuality guarantees p[p[k]] == k exactly for pair members)
+    swapped = adm | (mutual & adm[p])
+    new_node = jnp.where(swapped, cur[p], cur)
+    return new_node, swapped, jnp.sum(adm)

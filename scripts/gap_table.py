@@ -1,0 +1,83 @@
+"""Mid-scale optimality-gap table: solver comm cost vs the MILP optimum.
+
+Reproduces RESULTS.md's round-4 methodology: power-law instances with
+capacity 1.4x the mean node load (binding), solver at the default config
+vs the HiGHS MILP optimum/incumbent (180 s cap). Adds the round-5 axis:
+the pairwise-swap phase (GlobalSolverConfig.swap_every) on/off at EQUAL
+sweep budget, plus a chunk-size sensitivity column (small instances
+auto-chunk to ~S/10, which limits how many pairs each swap phase can
+see).
+
+CPU-friendly. Run: JAX_PLATFORMS=cpu python scripts/gap_table.py
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost
+from kubernetes_rescheduling_tpu.oracle.optimum import milp_optimum
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    global_assign,
+)
+
+INSTANCES = [(40, 5), (60, 6), (100, 6)]
+MILP_CAP_S = 180.0
+
+
+def solve_comm(state, graph, sweeps, swap_every, chunk_size=0, seed=0):
+    cfg = GlobalSolverConfig(
+        sweeps=sweeps, swap_every=swap_every, chunk_size=chunk_size
+    )
+    new_state, _ = global_assign(state, graph, jax.random.PRNGKey(seed), cfg)
+    return float(communication_cost(new_state, graph))
+
+
+def main():
+    rows = []
+    for S, N in INSTANCES:
+        cap_m = 1.4 * S * 100.0 / N
+        sc = synthetic_scenario(
+            n_pods=S, n_nodes=N, powerlaw=True, mean_degree=4.0, seed=0,
+            node_cpu_cap_m=cap_m,
+        )
+        t0 = time.time()
+        milp, proven = milp_optimum(sc.state, sc.graph, time_limit_s=MILP_CAP_S)
+        milp_s = time.time() - t0
+        row = {
+            "instance": f"{S}x{N}",
+            "milp": milp,
+            "proven": bool(proven),
+            "milp_s": round(milp_s, 1),
+        }
+        for sweeps in (9, 27):
+            for tag, swap_every, chunk in [
+                ("nosw", 0, 0),
+                ("sw3", 3, 0),
+                ("sw1", 1, 0),
+                ("sw1_bigC", 1, S),
+            ]:
+                comm = solve_comm(sc.state, sc.graph, sweeps, swap_every, chunk)
+                row[f"s{sweeps}_{tag}"] = comm
+                row[f"s{sweeps}_{tag}_gap%"] = round(
+                    100.0 * (comm - milp) / max(milp, 1e-9), 1
+                )
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

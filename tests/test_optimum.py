@@ -62,9 +62,10 @@ def test_brute_force_capacity_binding():
 
 def test_solver_gap_small_instances():
     """Regression pin: across 10 tiny instances the solver's comm cost is
-    within 10% of the true optimum in aggregate (and never worse than the
-    input, which is separately guaranteed). Measured at round 4: the
-    default config finds the exact optimum on most seeds."""
+    within 5% of the true optimum in aggregate (and never worse than the
+    input, which is separately guaranteed). Round 4 measured >=5/10 exact
+    and <=10% aggregate; round 5's pairwise-swap phase lifted that to
+    9/10 exact and 0.7% aggregate — the pin tightens accordingly."""
     total_solver = 0.0
     total_opt = 0.0
     exact_hits = 0
@@ -97,5 +98,5 @@ def test_solver_gap_small_instances():
         total_opt += opt
         if solver_cost <= opt + 1e-6:
             exact_hits += 1
-    assert total_solver <= total_opt * 1.10
-    assert exact_hits >= 5
+    assert total_solver <= total_opt * 1.05
+    assert exact_hits >= 8

@@ -168,9 +168,32 @@ def test_sparse_restarts_through_production_entry():
         sparse_graph=sg,
     )
     assert int(tp_info["tp"]) == 4
-    # both-at-once is explicitly not composed yet
-    with pytest.raises(ValueError, match="not composed"):
-        solve_with_restarts(
-            scn.state, scn.graph, jax.random.PRNGKey(4), n_restarts=2,
-            config=cfg, tp=4, sparse_graph=sg,
-        )
+
+
+def test_sparse_dp_of_tp_restarts_decision_parity():
+    """The composed sparse path — dp restarts OF tp-sharded sparse solves
+    — makes the same decisions as dp-only restarts of single-chip sparse
+    solves (noise off): same per-restart key streams, bit-parity solves,
+    same gated best-of-N selection."""
+    from kubernetes_rescheduling_tpu.parallel import solve_with_restarts
+
+    scn, sg = _scn(seed=3)
+    cfg = GlobalSolverConfig(sweeps=3, balance_weight=0.0, noise_temp=0.0)
+    dp_only, dp_info = solve_with_restarts(
+        scn.state, scn.graph, jax.random.PRNGKey(4), n_restarts=2,
+        config=cfg, sparse_graph=sg,
+    )
+    composed, c_info = solve_with_restarts(
+        scn.state, scn.graph, jax.random.PRNGKey(4), n_restarts=2,
+        config=cfg, tp=4, sparse_graph=sg,
+    )
+    assert int(c_info["tp"]) == 4 and int(c_info["restarts"]) == 2
+    np.testing.assert_array_equal(
+        np.asarray(dp_only.pod_node), np.asarray(composed.pod_node)
+    )
+    np.testing.assert_allclose(
+        np.asarray(dp_info["restart_objectives"]),
+        np.asarray(c_info["restart_objectives"]),
+        rtol=1e-6,
+    )
+    assert int(dp_info["best_restart"]) == int(c_info["best_restart"])

@@ -22,7 +22,10 @@ Environment knobs:
                   sparse50k (50k services × 2k nodes, sparse solver —
                   a scale the dense form cannot allocate) |
                   trace (streaming weight drift at 10k×1k, all steps
-                  inside one compiled scan — BASELINE config 5 on chip)
+                  inside one compiled scan — BASELINE config 5 on chip;
+                  honors BENCH_SOLVER) |
+                  trace50k (the stream at 50k×2k — sparse-only: the
+                  dense [S, S] scatter cannot allocate there)
   BENCH_SOLVER    dense (default) | sparse — solver for the scenario
   BENCH_SWEEPS    solver sweeps per round (default 9)
   BENCH_REPS      timed repetitions (default 5)
@@ -81,38 +84,62 @@ def slope_device_ms(chained, state, graph, k1=2, k2=12):
     return (timed(k2) - timed(k1)) / (k2 - k1) * 1e3
 
 
-def bench_trace(sweeps: int, baseline_ms: float) -> dict:
+def bench_trace(
+    sweeps: int, baseline_ms: float, scenario: str, solver_kind: str
+) -> dict:
     """BASELINE config 5 at flagship scale: per-step cost of tracking
     drifting traffic weights with the compiled-once solver, all steps on
-    device (bench/trace.py replay_on_device)."""
-    from kubernetes_rescheduling_tpu.bench.harness import make_backend
+    device. ``trace`` runs the 10k×1k mesh with the dense or sparse
+    solver (BENCH_SOLVER); ``trace50k`` runs 50k×2k — only the sparse
+    form's static-structure/dynamic-weights layout can express a stream
+    at that scale (the dense [S, S] scatter cannot even allocate)."""
     from kubernetes_rescheduling_tpu.bench.trace import (
         drift_multipliers,
+        drift_multipliers_sparse,
         replay_on_device,
+        replay_on_device_sparse,
     )
     from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
 
-    backend = make_backend("large", seed=0)
-    state = backend.monitor()
-    graph = backend.comm_graph()
     cfg = GlobalSolverConfig(sweeps=sweeps)
-    ii, jj, mults_by_k = None, None, {}
+    if scenario == "trace50k":
+        solver_kind = "sparse"
+        state, graph = _sparse50k_problem()
+        sgraph = graph
+    else:
+        from kubernetes_rescheduling_tpu.bench.harness import make_backend
+
+        backend = make_backend("large", seed=0)
+        state = backend.monitor()
+        graph = backend.comm_graph()
+        if solver_kind == "sparse":
+            from kubernetes_rescheduling_tpu.core import sparsegraph
+
+            sgraph = sparsegraph.from_comm_graph(graph)
+
+    ii, jj, loc = None, None, None
+    mults_by_k = {}
 
     def timed(k):
-        nonlocal ii, jj
+        nonlocal ii, jj, loc
         if k not in mults_by_k:
-            ii, jj, mults_by_k[k] = drift_multipliers(graph, k, seed=3)
+            if solver_kind == "sparse":
+                loc, mults_by_k[k] = drift_multipliers_sparse(sgraph, k, seed=3)
+            else:
+                ii, jj, mults_by_k[k] = drift_multipliers(graph, k, seed=3)
         m = mults_by_k[k]
-        _, objs, befores = replay_on_device(
-            state, graph, ii, jj, m, jax.random.PRNGKey(5), cfg
-        )
+
+        def run(key):
+            if solver_kind == "sparse":
+                return replay_on_device_sparse(state, sgraph, loc, m, key, cfg)
+            return replay_on_device(state, graph, ii, jj, m, key, cfg)
+
+        _, objs, befores = run(jax.random.PRNGKey(5))
         float(objs[-1])  # warm
         best, tracking = float("inf"), None
         for rep in range(3):
             t0 = time.perf_counter()
-            _, objs, befores = replay_on_device(
-                state, graph, ii, jj, m, jax.random.PRNGKey(6 + rep), cfg
-            )
+            _, objs, befores = run(jax.random.PRNGKey(6 + rep))
             float(objs[-1])
             best = min(best, time.perf_counter() - t0)
             import numpy as np
@@ -128,18 +155,42 @@ def bench_trace(sweeps: int, baseline_ms: float) -> dict:
     t2, tracking = timed(k2)
     step_ms = (t2 - t1) / (k2 - k1) * 1e3
     return {
-        "metric": "trace_step_ms_large",
+        "metric": f"trace_step_ms_{scenario}",
         "value": round(step_ms, 3),
         "unit": "ms",
         "vs_baseline": round(baseline_ms / step_ms, 3),
         "extra": {
-            "scenario": "trace",
+            "scenario": scenario,
+            "solver": solver_kind,
             "sweeps": sweeps,
             "steps_timed": (k1, k2),
             "tracking_gain_frac": round(tracking, 4),
             "devices": [str(d) for d in jax.devices()],
         },
     }
+
+
+def _sparse50k_problem():
+    """50k services × 2k nodes: over the dense form's sizing wall — only
+    expressible with the block-local sparse storage."""
+    import numpy as np
+
+    from kubernetes_rescheduling_tpu.core import sparsegraph
+    from kubernetes_rescheduling_tpu.core.topology import (
+        _random_workmodel,
+        state_from_workmodel,
+    )
+
+    rng = np.random.default_rng(0)
+    wm = _random_workmodel(50_000, rng, powerlaw=True, mean_degree=4.0)
+    graph = sparsegraph.from_workmodel(wm)
+    state = state_from_workmodel(
+        wm,
+        node_names=[f"w{i:05d}" for i in range(2_000)],
+        node_cpu_cap_m=5_000.0,
+        seed=0,
+    )
+    return state, graph
 
 
 def main() -> int:
@@ -151,8 +202,8 @@ def main() -> int:
 
     baseline_ms = 100.0  # BASELINE.md: <100 ms/round at 10k x 1k
 
-    if scenario == "trace":
-        print(json.dumps(bench_trace(sweeps, baseline_ms)))
+    if scenario in ("trace", "trace50k"):
+        print(json.dumps(bench_trace(sweeps, baseline_ms, scenario, solver_kind)))
         return 0
 
     from kubernetes_rescheduling_tpu.objectives import communication_cost
@@ -166,26 +217,8 @@ def main() -> int:
     cfg = GlobalSolverConfig(sweeps=sweeps)
 
     if scenario == "sparse50k":
-        # 50k services × 2k nodes: over the dense form's sizing wall —
-        # only expressible with the block-local sparse storage
-        import numpy as np
-
-        from kubernetes_rescheduling_tpu.core import sparsegraph
-        from kubernetes_rescheduling_tpu.core.topology import (
-            _random_workmodel,
-            state_from_workmodel,
-        )
-
         solver_kind = "sparse"
-        rng = np.random.default_rng(0)
-        wm = _random_workmodel(50_000, rng, powerlaw=True, mean_degree=4.0)
-        graph = sparsegraph.from_workmodel(wm)
-        state = state_from_workmodel(
-            wm,
-            node_names=[f"w{i:05d}" for i in range(2_000)],
-            node_cpu_cap_m=5_000.0,
-            seed=0,
-        )
+        state, graph = _sparse50k_problem()
     else:
         from kubernetes_rescheduling_tpu.bench.harness import make_backend
 

@@ -17,12 +17,18 @@ from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 
 @dataclass(frozen=True)
 class MoveRequest:
-    """Move one service's Deployment to a target node."""
+    """Move one service's Deployment — or, with ``pod`` set, a single
+    replica — to a target node. Per-pod moves are the mechanism behind
+    ``placement_unit='pod'``; a backend that can only re-create whole
+    Deployments (the k8s Deployment mechanism, reference
+    delete_replaced_pod.py:173) must reject them with a clear error
+    rather than silently moving every replica."""
 
     service: str
     target_node: str
     hazard_nodes: tuple[str, ...] = ()
     mechanism: str = "nodeName"  # nodeName | nodeSelector | affinityOnly
+    pod: str | None = None  # move only this named replica
 
 
 class Backend(Protocol):

@@ -531,6 +531,17 @@ class K8sBackend:
         delete_replaced_pod.py:144-185 + rescheduling.py:57-73). Returns the
         landing node on success (the advisory target for ``affinityOnly`` —
         the live scheduler's pick is only observable at the next monitor)."""
+        if move.pod is not None:
+            # deleting one pod of a Deployment only makes its ReplicaSet
+            # re-create it wherever the scheduler likes — there is no
+            # Deployment-level mechanism to pin a single replica. Honest
+            # failure beats silently moving every replica.
+            raise ValueError(
+                "per-pod moves are not expressible through the k8s "
+                "Deployment mechanism (a deleted replica is re-created "
+                "unpinned by its ReplicaSet); run placement_unit='pod' "
+                "against the sim backend, or manage bare pods"
+            )
         name = move.service
         try:
             dep = self.apps_api.read_namespaced_deployment(

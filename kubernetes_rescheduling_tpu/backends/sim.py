@@ -172,7 +172,7 @@ class SimBackend:
         svc_idx = self._svc_index[move.service]
         moved = 0
         for pod in self._pods:
-            if pod[0] == svc_idx:
+            if pod[0] == svc_idx and (move.pod is None or pod[2] == move.pod):
                 pod[1] = target
                 moved += 1
         self.clock_s += self.reconcile_delay_s

@@ -385,6 +385,67 @@ def _top_gain_moves(
     return [changed[i] for i in sorted(picked)]
 
 
+def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
+    """Per-replica global round: solve on the expanded pod graph, apply
+    per-pod moves (MoveRequest.pod). The pod graph is cached per
+    (declared graph, pod set) — pod churn or a re-estimated graph
+    rebuilds it."""
+    from kubernetes_rescheduling_tpu.solver.pod_mode import (
+        global_assign_pods,
+        pod_level_graph,
+    )
+
+    t0 = time.perf_counter()
+    sig = (
+        np.asarray(state.pod_service).tobytes(),
+        np.asarray(state.pod_valid).tobytes(),
+    )
+    cache = getattr(backend, "_pod_graph_cache", None)
+    if cache is None or cache[0] is not graph or cache[1] != sig:
+        cache = (graph, sig, pod_level_graph(state, graph))
+        backend._pod_graph_cache = cache
+    pod_graph = cache[2]
+    new_state, info = jax.block_until_ready(
+        global_assign_pods(
+            state, graph, key, cfg,
+            pod_graph=pod_graph,
+            n_restarts=config.solver_restarts,
+            tp=config.solver_tp,
+        )
+    )
+    latency = time.perf_counter() - t0
+
+    old_nodes = np.asarray(state.pod_node)
+    new_nodes = np.asarray(new_state.pod_node)
+    valid = np.asarray(state.pod_valid)
+    svc_arr = np.asarray(state.pod_service)
+    moved_any = False
+    moved_names: list[str] = []
+    for i in np.flatnonzero(valid & (old_nodes != new_nodes)):
+        landed = backend.apply_move(
+            MoveRequest(
+                service=graph.names[int(svc_arr[i])],
+                pod=state.pod_names[int(i)],
+                target_node=new_state.node_names[int(new_nodes[i])],
+                mechanism=PlacementMechanism["global"],
+            )
+        )
+        moved_any = moved_any or landed is not None
+        if landed is not None:
+            moved_names.append(state.pod_names[int(i)])
+    return RoundRecord(
+        round=rnd,
+        moved=moved_any,
+        most_hazard=None,
+        service=None,
+        target=None,
+        communication_cost=0.0,  # filled by run_controller post-move
+        load_std=0.0,
+        services_moved=tuple(moved_names),
+        decision_latencies_s=(latency,),
+    )
+
+
 def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
     cfg = GlobalSolverConfig(
         sweeps=config.global_solver_iters,
@@ -393,6 +454,8 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         capacity_frac=config.capacity_frac,
         move_cost=config.move_cost,
     )
+    if config.placement_unit == "pod":
+        return _pod_round(backend, state, graph, config, cfg, key, rnd)
     t0 = time.perf_counter()
     sparse_graph = None
     if config.solver_backend == "sparse":

@@ -85,6 +85,7 @@ class ExperimentConfig:
     solver_tp: int = 1                 # node-axis devices per solve (SPMD solver)
     move_cost: float = 0.0             # disruption pricing in the global solve
     solver_backend: str = "dense"      # "dense" | "sparse" pair weights
+    placement_unit: str = "service"    # "service" | "pod" (per-replica)
     moves_per_round: int | str = 1     # k per greedy round, or "all"
     global_moves_cap: int | str = "all"  # wave cap for global rounds
     # Packing budget for the global solver's feasibility (fraction of node
@@ -108,11 +109,19 @@ class ExperimentConfig:
         RescheduleConfig(
             algorithm="global",
             solver_backend=self.solver_backend,
+            placement_unit=self.placement_unit,
             solver_restarts=self.solver_restarts,
             solver_tp=self.solver_tp,
             moves_per_round=self.moves_per_round,
             global_moves_cap=self.global_moves_cap,
         ).validate()
+        if self.placement_unit == "pod" and self.backend == "k8s":
+            # K8sBackend.apply_move rejects per-pod moves (the Deployment
+            # mechanism cannot pin one replica) — fail here, not mid-run
+            raise ValueError(
+                "placement_unit='pod' requires the sim backend: the k8s "
+                "Deployment mechanism cannot pin a single replica"
+            )
 
 
 def make_backend(
@@ -342,6 +351,7 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 balance_weight=cfg.balance_weight,
                 move_cost=cfg.move_cost,
                 solver_backend=cfg.solver_backend,
+                placement_unit=cfg.placement_unit,
                 solver_restarts=cfg.solver_restarts,
                 solver_tp=cfg.solver_tp,
                 moves_per_round=cfg.moves_per_round,

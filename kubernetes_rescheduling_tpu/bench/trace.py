@@ -252,6 +252,76 @@ def _replay_run(st0, graph, ii, jj, mults, key0, config):
 _replay_run_jit = jax.jit(_replay_run, static_argnames=("config",))
 
 
+def drift_multipliers_sparse(
+    sgraph, steps: int, *, sigma: float = 0.5, seed: int = 0
+):
+    """Sparse twin of :func:`drift_multipliers`: per-step mean-one
+    lognormal multipliers for every undirected edge of a
+    ``SparseCommGraph``, plus the static :class:`TraceLocator` that maps
+    them onto the block-local storage. Works at scales where the dense
+    adjacency cannot exist (50k services)."""
+    from kubernetes_rescheduling_tpu.core.sparsegraph import trace_locator
+
+    loc = trace_locator(sgraph)
+    rng = np.random.default_rng(seed)
+    mults = np.exp(
+        rng.normal(-0.5 * sigma * sigma, sigma, size=(steps, loc.num_edges))
+    ).astype(np.float32)
+    return loc, mults
+
+
+def _replay_sparse_run(st0, sgraph, loc, mults, key0, config):
+    from kubernetes_rescheduling_tpu.core.sparsegraph import with_edge_weights
+    from kubernetes_rescheduling_tpu.solver.sparse_solver import (
+        _global_assign_sparse,
+        sparse_pod_comm_cost,
+    )
+
+    def step(st, xs):
+        m, k = xs
+        # static structure + dynamic weights: the per-step update is one
+        # 2E-element scatter — no dense [S, S] rebuild (the dense path's
+        # measured ~9 ms/step streaming premium at 10k)
+        sg_t = with_edge_weights(sgraph, loc, loc.base_w * m)
+        before = sparse_pod_comm_cost(st, sg_t)
+        st_n, inf = _global_assign_sparse(st, sg_t, k, config)
+        return st_n, (inf["objective_after"], before)
+
+    keys = jax.random.split(key0, mults.shape[0])
+    st_f, (objs, befores) = jax.lax.scan(step, st0, (mults, keys))
+    return st_f, objs, befores
+
+
+_replay_sparse_jit = jax.jit(_replay_sparse_run, static_argnames=("config",))
+
+
+def replay_on_device_sparse(
+    state: ClusterState,
+    sgraph,
+    loc,
+    mults,
+    key: jax.Array,
+    config: GlobalSolverConfig = GlobalSolverConfig(),
+):
+    """Sparse-solver streaming replay: ALL steps inside one compiled
+    ``lax.scan``; per step the undirected-edge weights are scattered into
+    the block-local strips and COO list through the static
+    :class:`TraceLocator` and the SAME compiled sparse solve consumes the
+    previous step's placement. Requires a multi-block graph (the
+    single-block case belongs to the dense replay). Returns
+    ``(final_state, objs[steps], costs_before[steps])``."""
+    import jax.numpy as jnp
+
+    if sgraph.num_blocks <= 1:
+        raise ValueError(
+            "single-block sparse graphs delegate to the dense solver — "
+            "use replay_on_device with the dense graph instead"
+        )
+    return _replay_sparse_jit(
+        state, sgraph, loc, jnp.asarray(mults), key, config
+    )
+
+
 def replay_on_device(
     state: ClusterState,
     graph: CommGraph,

@@ -90,6 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--global-moves-cap", type=_moves_per_round, default="all",
                    help="apply only the k highest-gain improving moves per "
                         "global round ('all' = uncapped)")
+    r.add_argument("--placement-unit", default="service",
+                   choices=["service", "pod"],
+                   help="pod = every replica places independently (global "
+                        "algorithm, sim backend)")
 
     b = sub.add_parser("bench", help="run the experiment matrix")
     b.add_argument("--backend", default="sim", choices=["sim", "k8s"],
@@ -132,6 +136,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="estimate edge weights from the phase-r1 request "
                         "stream's traversal counts and solve on those "
                         "instead of the declared workmodel topology")
+    b.add_argument("--placement-unit", default="service",
+                   choices=["service", "pod"],
+                   help="pod = every replica places independently (global "
+                        "algorithm, sim backend)")
     b.add_argument("--seed", type=int, default=0)
 
     t = sub.add_parser(
@@ -229,6 +237,7 @@ def cmd_reschedule(args) -> dict:
         balance_weight=args.balance_weight,
         move_cost=args.move_cost,
         solver_backend=args.solver_backend,
+        placement_unit=args.placement_unit,
         enforce_capacity=args.capacity_frac is not None,
         capacity_frac=args.capacity_frac if args.capacity_frac is not None else 1.0,
         solver_restarts=args.restarts,
@@ -261,6 +270,7 @@ def cmd_bench(args) -> dict:
         global_moves_cap=args.global_moves_cap,
         move_cost=args.move_cost,
         solver_backend=args.solver_backend,
+        placement_unit=args.placement_unit,
         solver_restarts=args.restarts,
         solver_tp=args.tp,
         observe_weights=args.observe_weights,
@@ -348,11 +358,6 @@ def cmd_solve(args) -> dict:
     tune_info = None
     solve_graph = graph
     if args.placement_unit == "pod":
-        if args.restarts > 1 or args.tp > 1:
-            raise SystemExit(
-                "--placement-unit pod supports a single solve "
-                "(no --restarts/--tp yet)"
-            )
         from kubernetes_rescheduling_tpu.solver.pod_mode import (
             global_assign_pods,
             pod_level_graph,
@@ -361,13 +366,14 @@ def cmd_solve(args) -> dict:
         solve_graph = pod_level_graph(state, graph)
 
         def solver(st, g, k, c):
-            return global_assign_pods(st, None, k, c, pod_graph=g)
+            # the full production matrix: dp restarts, tp node-sharding,
+            # and their composition all route through the pod graph
+            return global_assign_pods(
+                st, None, k, c, pod_graph=g,
+                n_restarts=args.restarts, tp=args.tp,
+            )
 
     elif args.sparse:
-        if args.restarts > 1 and args.tp > 1:
-            raise SystemExit(
-                "--sparse composes with --restarts OR --tp, not both yet"
-            )
         from kubernetes_rescheduling_tpu.core import sparsegraph
         from kubernetes_rescheduling_tpu.solver import global_assign_sparse
 
@@ -406,7 +412,8 @@ def cmd_solve(args) -> dict:
         new_state, info = solver(
             state, solve_graph, jax.random.PRNGKey(args.seed), cfg
         )
-        info = dict(info, restarts=1)
+        info = dict(info)
+        info.setdefault("restarts", 1)
     else:
         new_state, info = solve_with_restarts(
             state,

@@ -69,8 +69,14 @@ class RescheduleConfig:
     solver_tp: int = 1                     # node-axis sharding of each solve (devices per solve)
     # "dense" (default) | "sparse": pair-weight storage for global rounds.
     # sparse = the block-local form (memory O(S·Ū), breaks the ~46k dense
-    # wall); composes with dp restarts OR tp node-sharding (not both yet).
+    # wall); composes with dp restarts, tp node-sharding, and both at once
+    # (dp restarts OF tp-sharded sparse solves).
     solver_backend: str = "dense"
+    # "service" (default): whole Deployments move as units (the reference
+    # mechanism). "pod": every replica places independently (the expanded
+    # sparse pod graph; global algorithm + sim backend — the k8s
+    # Deployment mechanism cannot pin a single replica).
+    placement_unit: str = "service"
     seed: int = 0
 
     # Scale (array capacities; 0 = size to the scenario)
@@ -105,13 +111,23 @@ class RescheduleConfig:
                 f"solver_backend must be 'dense' or 'sparse', got "
                 f"{self.solver_backend!r}"
             )
-        if self.solver_backend == "sparse" and (
-            self.solver_restarts > 1 and self.solver_tp > 1
-        ):
+        if self.placement_unit not in ("service", "pod"):
             raise ValueError(
-                "solver_backend='sparse' composes with restarts OR tp, "
-                "not both yet"
+                f"placement_unit must be 'service' or 'pod', got "
+                f"{self.placement_unit!r}"
             )
+        if self.placement_unit == "pod":
+            if self.algorithm != "global":
+                raise ValueError(
+                    "placement_unit='pod' requires algorithm='global' "
+                    "(the greedy policies score whole services)"
+                )
+            if isinstance(self.global_moves_cap, int):
+                raise ValueError(
+                    "placement_unit='pod' does not support global_moves_cap "
+                    "(use move_cost — disruption pricing measures strictly "
+                    "better than wave capping, RESULTS.md round 4)"
+                )
         return self
 
     @classmethod

@@ -291,6 +291,96 @@ def from_workmodel(wm, *, bu: int = 512, reg_tiles: int = 2) -> SparseCommGraph:
     )
 
 
+@struct.dataclass
+class TraceLocator:
+    """Static positions of every undirected edge's weight in a
+    ``SparseCommGraph`` — the bridge between streaming traces and the
+    block-local form. The sparse layout is *static structure + dynamic
+    weights*: each undirected edge lives at exactly two COO slots and two
+    ``w_local`` cells (row i / col j and row j / col i), all computed once
+    at build time, so a per-step weight update is one small scatter
+    instead of a dense [S, S] rebuild (bench/trace.py round-4 measured
+    that rebuild as the ~9 ms/step streaming premium of the dense path).
+
+    ``E`` is the undirected edge count; all arrays are device-resident so
+    the updater runs inside jit."""
+
+    coo: jax.Array      # i32[2E] COO indices (forward then reverse slots)
+    w_rows: jax.Array   # i32[2E] w_local row per slot
+    w_cols: jax.Array   # i32[2E] w_local column per slot
+    base_w: jax.Array   # f32[E] build-time weight per undirected edge
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.base_w.shape[0])
+
+
+def trace_locator(sgraph: SparseCommGraph) -> TraceLocator:
+    """Precompute a :class:`TraceLocator` (host-side, once per graph)."""
+    src = np.asarray(sgraph.edges_src).astype(np.int64)
+    dst = np.asarray(sgraph.edges_dst).astype(np.int64)
+    w = np.asarray(sgraph.edges_w)
+    E2 = len(src)
+    SP = sgraph.sp
+    bu = sgraph.bu
+
+    # w_local cell per directed COO entry: the row's block strip, column =
+    # position of dst in the block's ascending distinct-neighbor list
+    rows = (src % BLOCK_R).astype(np.int64)
+    cols = np.empty(E2, dtype=np.int64)
+    u_all = np.asarray(sgraph.u_ids)
+    blk = src // BLOCK_R
+    for b in np.unique(blk):
+        m = blk == b
+        lo = sgraph.block_toff[b] * bu
+        width = sgraph.block_ntiles[b] * bu
+        u = u_all[lo : lo + width]
+        nu = int(np.searchsorted(u, SP))  # distinct count (SP-padded tail)
+        cols[m] = lo + np.searchsorted(u[:nu], dst[m])
+
+    # pair the two directed slots of each undirected edge
+    lo_id = np.minimum(src, dst)
+    hi_id = np.maximum(src, dst)
+    key = lo_id * SP + hi_id
+    order = np.argsort(key, kind="stable")
+    fwd, rev = order[0::2], order[1::2]
+    if not np.array_equal(key[fwd], key[rev]):
+        raise AssertionError(
+            "COO list does not carry each undirected edge exactly twice"
+        )
+    both = np.concatenate([fwd, rev])
+    return TraceLocator(
+        coo=jnp.asarray(both.astype(np.int32)),
+        w_rows=jnp.asarray(rows[both].astype(np.int32)),
+        w_cols=jnp.asarray(cols[both].astype(np.int32)),
+        base_w=jnp.asarray(w[fwd].astype(np.float32)),
+    )
+
+
+def with_edge_weights(
+    sgraph: SparseCommGraph, loc: TraceLocator, new_w: jax.Array
+) -> SparseCommGraph:
+    """New graph with per-undirected-edge weights ``new_w`` (f32[E], in
+    the locator's canonical edge order) — a 2E-element scatter into the
+    COO list and the block-local strips; jit-safe (static structure,
+    dynamic weights)."""
+    if sgraph.dense_adj is not None:
+        # single-block graphs carry a dense twin for the solver's
+        # delegation path; updating only the sparse storage would leave
+        # that twin stale and the solver silently optimizing OLD weights.
+        # Streaming at <=256 services belongs to the dense replay anyway.
+        raise ValueError(
+            "with_edge_weights does not support single-block graphs "
+            "(their dense_adj delegation twin would go stale) — use the "
+            "dense trace path (bench.trace.replay_on_device) at this size"
+        )
+    w2 = jnp.concatenate([new_w, new_w])
+    return sgraph.replace(
+        w_local=sgraph.w_local.at[loc.w_rows, loc.w_cols].set(w2),
+        edges_w=sgraph.edges_w.at[loc.coo].set(w2),
+    )
+
+
 def sparse_pair_comm_cost(
     sgraph: SparseCommGraph, assign_sorted: jax.Array, rv_sorted: jax.Array
 ) -> jax.Array:

@@ -16,7 +16,16 @@ pod pairs; here each pair is its own decision). Capacity packs per pod.
 The sparse block-local form is what makes this affordable: the expanded
 graph has Σ_e rv_s·rv_t edges (~rv²·E), never an SP² matrix.
 
-`--placement-unit pod` on the solve CLI routes here.
+The expansion is fully vectorized and **sparse-direct**: it consumes
+either a dense ``CommGraph`` or a ``SparseCommGraph``'s COO edge list —
+at 50k services the dense adjacency cannot exist, and the pod graph is
+built straight from the sparse edges (no [S, S] array anywhere,
+host-side or device-side).
+
+Production routing: ``--placement-unit pod`` on the solve CLI and
+``RescheduleConfig.placement_unit='pod'`` on the controller/harness route
+here; restarts and tp shard exactly like the service-level sparse path
+(``parallel.solve_with_restarts(sparse_graph=pod_graph)``).
 """
 
 from __future__ import annotations
@@ -30,58 +39,94 @@ from kubernetes_rescheduling_tpu.core import sparsegraph
 from kubernetes_rescheduling_tpu.core.sparsegraph import SparseCommGraph
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 from kubernetes_rescheduling_tpu.solver.global_solver import GlobalSolverConfig
-from kubernetes_rescheduling_tpu.solver.sparse_solver import global_assign_sparse
 
 
-def pod_level_graph(state: ClusterState, graph: CommGraph) -> SparseCommGraph:
-    """Expand a service-level CommGraph to a pod-level SparseCommGraph:
-    one pseudo-service per valid pod; every service edge fans out to the
-    pods' cross product. Pseudo-service ids == pod indices (padding pods
-    included as invalid isolated services, so ids need no remapping)."""
-    P = state.num_pods
+def _pods_by_service(state: ClusterState, S: int):
+    """Valid pod ids grouped by service: ``(pid, starts, counts)`` where
+    service s's pods are ``pid[starts[s] : starts[s] + counts[s]]``."""
     svc = np.asarray(state.pod_service)
     valid = np.asarray(state.pod_valid)
-    adj = np.asarray(graph.adj)
-    S = graph.num_services
-    pods_of: dict[int, np.ndarray] = {}
-    for s in range(S):
-        pods_of[s] = np.flatnonzero(valid & (svc == s))
-    iu, ju = np.nonzero(np.triu(adj[:S, :S], k=1))
-    srcs, dsts, ws = [], [], []
-    for s, t in zip(iu, ju):
-        ps, pt = pods_of[int(s)], pods_of[int(t)]
-        if len(ps) == 0 or len(pt) == 0:
-            continue
-        grid = np.meshgrid(ps, pt, indexing="ij")
-        srcs.append(grid[0].ravel())
-        dsts.append(grid[1].ravel())
-        ws.append(np.full(len(ps) * len(pt), float(adj[s, t])))
-    if srcs:
-        src = np.concatenate(srcs)
-        dst = np.concatenate(dsts)
-        w = np.concatenate(ws)
+    pid = np.flatnonzero(valid & (svc >= 0) & (svc < S))
+    order = np.argsort(svc[pid], kind="stable")
+    pid = pid[order]
+    svs = svc[pid]
+    starts = np.searchsorted(svs, np.arange(S))
+    counts = np.searchsorted(svs, np.arange(S), side="right") - starts
+    return pid, starts, counts
+
+
+def pod_level_graph(
+    state: ClusterState, graph: CommGraph | SparseCommGraph
+) -> SparseCommGraph:
+    """Expand a service-level graph to a pod-level SparseCommGraph: one
+    pseudo-service per valid pod; every service edge fans out to the
+    pods' cross product (vectorized — no per-edge Python loop). Accepts
+    the dense ``CommGraph`` or, at scales where no dense adjacency can
+    exist, a ``SparseCommGraph`` (the COO list is consumed directly).
+    Pseudo-service ids == pod indices (padding pods are invalid isolated
+    services, so ids need no remapping)."""
+    P = state.num_pods
+    if isinstance(graph, SparseCommGraph):
+        S = graph.num_services
+        src_s = np.asarray(graph.edges_src)
+        dst_s = np.asarray(graph.edges_dst)
+        wts = np.asarray(graph.edges_w)
+        perm = np.asarray(graph.perm)
+        # canonical undirected edges (each edge is stored twice)
+        und = src_s < dst_s
+        iu = perm[src_s[und]]
+        ju = perm[dst_s[und]]
+        w = wts[und].astype(np.float64)
     else:
-        src = dst = np.zeros((0,), np.int64)
-        w = np.zeros((0,))
+        S = graph.num_services
+        adj = np.asarray(graph.adj)
+        iu, ju = np.nonzero(np.triu(adj[:S, :S], k=1))
+        w = adj[iu, ju].astype(np.float64)
+
+    pid, starts, counts = _pods_by_service(state, S)
+    ca = counts[iu]
+    cb = counts[ju]
+    m = ca * cb
+    keep = m > 0
+    iu, ju, w, ca, cb, m = (x[keep] for x in (iu, ju, w, ca, cb, m))
+    off = np.concatenate([[0], np.cumsum(m)])
+    total = int(off[-1])
+    # pair r of edge e is (pod r // cb of s, pod r % cb of t)
+    eidx = np.repeat(np.arange(len(m)), m)
+    r = np.arange(total) - off[eidx]
+    src = pid[starts[iu][eidx] + r // cb[eidx]]
+    dst = pid[starts[ju][eidx] + r % cb[eidx]]
     return sparsegraph.from_edges(
-        src, dst, w, P,
+        src, dst, w[eidx], P,
         names=tuple(state.pod_names) if state.pod_names else (),
     )
 
 
 def global_assign_pods(
     state: ClusterState,
-    graph: CommGraph,
+    graph: CommGraph | SparseCommGraph | None,
     key: jax.Array,
     config: GlobalSolverConfig = GlobalSolverConfig(),
     *,
     pod_graph: SparseCommGraph | None = None,
+    n_restarts: int = 1,
+    tp: int = 1,
+    mesh=None,
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """Re-place every POD independently. Same contract as the service
     solvers: never worse than the input (the gate compares pod-level comm
     + balance). Pass a prebuilt ``pod_graph`` (from
     :func:`pod_level_graph`) to amortize the host-side expansion across
-    controller rounds with an unchanged pod set."""
+    controller rounds with an unchanged pod set.
+
+    ``n_restarts``/``tp``/``mesh`` route through the SAME production
+    entry as the service-level solvers
+    (``parallel.solve_with_restarts(sparse_graph=...)``): dp restarts,
+    node-axis tp sharding, and their composition all work on the pod
+    graph — per-replica placement is a production path, not a demo.
+    """
+    from kubernetes_rescheduling_tpu.parallel.sharded import solve_with_restarts
+
     if pod_graph is None:
         pod_graph = pod_level_graph(state, graph)
     # each pod is its own pseudo-service; the sparse solver's aggregates
@@ -89,5 +134,9 @@ def global_assign_pods(
     view = state.replace(
         pod_service=jnp.arange(state.num_pods, dtype=jnp.int32)
     )
-    new_view, info = global_assign_sparse(view, pod_graph, key, config)
+    new_view, info = solve_with_restarts(
+        view, None, key,
+        n_restarts=n_restarts, config=config, mesh=mesh, tp=tp,
+        sparse_graph=pod_graph,
+    )
     return state.replace(pod_node=new_view.pod_node), info

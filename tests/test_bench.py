@@ -586,27 +586,28 @@ def test_controller_sparse_backend_routes_and_improves():
 
 
 def test_config_sparse_composition_rules():
-    # sparse composes with restarts OR tp — but not both at once
+    # sparse composes with restarts, tp, and (round 5) both at once —
+    # dp restarts OF tp-sharded sparse solves
     RescheduleConfig(
         algorithm="global", solver_backend="sparse", solver_restarts=2
     ).validate()
     RescheduleConfig(
         algorithm="global", solver_backend="sparse", solver_tp=4
     ).validate()
-    with pytest.raises(ValueError, match="not both"):
-        RescheduleConfig(
-            algorithm="global", solver_backend="sparse",
-            solver_restarts=2, solver_tp=4,
-        ).validate()
+    RescheduleConfig(
+        algorithm="global", solver_backend="sparse",
+        solver_restarts=2, solver_tp=4,
+    ).validate()
     with pytest.raises(ValueError, match="solver_backend"):
         RescheduleConfig(algorithm="global", solver_backend="bogus").validate()
 
 
 def test_experiment_config_rejects_invalid_combo_early():
-    """The invalid combination fails at construction, not after minutes of
-    phase-r1 load simulation."""
-    with pytest.raises(ValueError, match="not both"):
-        ExperimentConfig(solver_backend="sparse", solver_restarts=4, solver_tp=2)
-    # the now-supported compositions construct fine
+    """Invalid combinations fail at construction, not after minutes of
+    phase-r1 load simulation; every (sparse, dp, tp) combination is now a
+    supported composition."""
+    ExperimentConfig(solver_backend="sparse", solver_restarts=4, solver_tp=2)
     ExperimentConfig(solver_backend="sparse", solver_restarts=4)
     ExperimentConfig(solver_backend="sparse", solver_tp=2)
+    with pytest.raises(ValueError, match="placement_unit"):
+        ExperimentConfig(placement_unit="bogus")

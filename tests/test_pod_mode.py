@@ -91,3 +91,108 @@ def test_pod_mode_never_worse_at_scale():
     assert after <= before
     assert after < before  # improvement available on this instance
     assert float(info["objective_after"]) <= float(info["objective_before"]) + 1e-4
+
+
+def test_pod_graph_from_sparse_matches_dense():
+    """The sparse-direct expansion (COO in, no dense adjacency anywhere)
+    must produce the same pod graph as the dense-input expansion."""
+    from kubernetes_rescheduling_tpu.core import sparsegraph
+
+    scn = synthetic_scenario(
+        n_pods=300, n_nodes=6, powerlaw=True, seed=11, replicas=3
+    )
+    pg_dense = pod_level_graph(scn.state, scn.graph)
+    sg = sparsegraph.from_comm_graph(scn.graph)
+    pg_sparse = pod_level_graph(scn.state, sg)
+    np.testing.assert_array_equal(
+        np.asarray(pg_dense.u_ids), np.asarray(pg_sparse.u_ids)
+    )
+    np.testing.assert_allclose(
+        np.asarray(pg_dense.w_local), np.asarray(pg_sparse.w_local)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pg_dense.perm), np.asarray(pg_sparse.perm)
+    )
+
+
+def test_pod_mode_with_restarts_and_tp():
+    """Per-replica placement is a production path: restarts and tp route
+    through solve_with_restarts on the pod graph."""
+    scn = synthetic_scenario(
+        n_pods=512, n_nodes=8, powerlaw=True, seed=6, replicas=2,
+        node_cpu_cap_m=8_000.0,
+    )
+    before = float(communication_cost(scn.state, scn.graph))
+    cfg = GlobalSolverConfig(sweeps=3)
+    st_r, info_r = global_assign_pods(
+        scn.state, scn.graph, jax.random.PRNGKey(2), cfg, n_restarts=2
+    )
+    assert int(info_r["restarts"]) == 2
+    assert float(communication_cost(st_r, scn.graph)) <= before
+    st_t, info_t = global_assign_pods(
+        scn.state, scn.graph, jax.random.PRNGKey(2), cfg, tp=4
+    )
+    assert int(info_t["tp"]) == 4
+    assert float(communication_cost(st_t, scn.graph)) <= before
+
+
+def test_capacity_stuck_fixture_through_controller():
+    """The whole-Deployment-stuck fixture, driven through the CONTROLLER
+    (placement_unit='pod'): per-pod MoveRequests land on the sim backend
+    and the final cluster placement realizes the split that service mode
+    cannot reach."""
+    from kubernetes_rescheduling_tpu.backends.sim import SimBackend
+    from kubernetes_rescheduling_tpu.bench.controller import run_controller
+    from kubernetes_rescheduling_tpu.config import RescheduleConfig
+    from kubernetes_rescheduling_tpu.core.workmodel import ServiceSpec, Workmodel
+
+    wm = Workmodel(
+        services=(
+            ServiceSpec(name="A", callees=("B",), replicas=4,
+                        cpu_request_millicores=100),
+            ServiceSpec(name="B", replicas=1, cpu_request_millicores=100),
+        ),
+        source="test",
+    )
+    backend = SimBackend(
+        workmodel=wm,
+        node_names=["n0", "n1", "n2", "n3"],
+        node_cpu_cap_m=250.0,
+        seed=0,
+    )
+    # pin the stuck placement: all A pods away from B, no node can take
+    # the whole 400m Deployment under a 250m budget
+    for pod in backend._pods:
+        pod[1] = {"A-0": 1, "A-1": 1, "A-2": 2, "A-3": 2, "B-0": 0}[pod[2]]
+    graph = backend.comm_graph()
+    state0 = backend.monitor()
+    assert float(communication_cost(state0, graph)) == 4.0
+
+    cfg = RescheduleConfig(
+        algorithm="global",
+        placement_unit="pod",
+        max_rounds=3,
+        enforce_capacity=True,
+        capacity_frac=1.0,
+        balance_weight=0.0,
+        sleep_after_action_s=0.0,
+    )
+    result = run_controller(backend, cfg, key=jax.random.PRNGKey(0))
+    final = backend.monitor()
+    assert float(communication_cost(final, graph)) < 4.0
+    # budgets hold on the realized cluster, not just the solver's plan
+    assert np.all(np.asarray(final.node_cpu_used()) <= 250.0 + 1e-6)
+    assert result.moves >= 1
+
+
+def test_pod_mode_config_validation():
+    from kubernetes_rescheduling_tpu.config import RescheduleConfig
+
+    with pytest.raises(ValueError, match="algorithm='global'"):
+        RescheduleConfig(
+            algorithm="communication", placement_unit="pod"
+        ).validate()
+    with pytest.raises(ValueError, match="global_moves_cap"):
+        RescheduleConfig(
+            algorithm="global", placement_unit="pod", global_moves_cap=2
+        ).validate()

@@ -175,6 +175,8 @@ class SimBackend:
             if pod[0] == svc_idx and (move.pod is None or pod[2] == move.pod):
                 pod[1] = target
                 moved += 1
+                if move.pod is not None:
+                    break  # a pod name matches at most one entry
         self.clock_s += self.reconcile_delay_s
         landed = self.node_names[target]
         self.events.append(
@@ -224,6 +226,38 @@ class SimBackend:
             if used[i] < best_used:
                 best, best_used = i, float(used[i])
         return best
+
+    def apply_pod_moves(self, moves) -> int:
+        """Apply a batch of per-pod moves as ONE reconcile wave: a single
+        indexed pass over the pod table and one clock advance. Per-replica
+        placement moves many pods per round; issuing them as individual
+        ``apply_move`` calls would both cost O(moves × pods) host time and
+        charge one reconcile delay per replica — a clock model no real
+        cluster has (kubelets reconcile in parallel). Returns the number
+        of pods moved."""
+        target_of: dict[str, int] = {}
+        for mv in moves:
+            if mv.target_node not in self.node_names:
+                continue
+            t = self.node_names.index(mv.target_node)
+            if self._node_alive[t] and mv.pod is not None:
+                target_of[mv.pod] = t
+        moved = 0
+        for pod in self._pods:
+            t = target_of.get(pod[2])
+            if t is not None:
+                pod[1] = t
+                moved += 1
+        self.clock_s += self.reconcile_delay_s
+        self.events.append(
+            {
+                "t": self.clock_s,
+                "event": "pod_moves",
+                "pods": moved,
+                "requested": len(moves),
+            }
+        )
+        return moved
 
     def restore_placement(self, state: ClusterState) -> int:
         """Pin pods back to the placement recorded in a checkpoint snapshot

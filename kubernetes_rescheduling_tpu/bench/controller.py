@@ -419,10 +419,10 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
     new_nodes = np.asarray(new_state.pod_node)
     valid = np.asarray(state.pod_valid)
     svc_arr = np.asarray(state.pod_service)
-    moved_any = False
-    moved_names: list[str] = []
+    moves: list[MoveRequest] = []
+    moved_services: set[str] = set()
     for i in np.flatnonzero(valid & (old_nodes != new_nodes)):
-        landed = backend.apply_move(
+        moves.append(
             MoveRequest(
                 service=graph.names[int(svc_arr[i])],
                 pod=state.pod_names[int(i)],
@@ -430,9 +430,20 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
                 mechanism=PlacementMechanism["global"],
             )
         )
-        moved_any = moved_any or landed is not None
-        if landed is not None:
-            moved_names.append(state.pod_names[int(i)])
+        moved_services.add(graph.names[int(svc_arr[i])])
+    # batch path: one reconcile wave for the whole round's replica moves
+    # (per-call apply_move would scan the pod table and advance the sim
+    # clock once PER REPLICA); backends without it get individual calls
+    batch = getattr(backend, "apply_pod_moves", None)
+    if batch is not None:
+        moved_any = bool(moves) and batch(moves) > 0
+    else:
+        moved_any = False
+        for mv in moves:
+            moved_any = (backend.apply_move(mv) is not None) or moved_any
+    # services_moved carries SERVICE names: its consumers — the harness's
+    # teardown-outage injection and restart accounting — are service-
+    # granular, and a pod name there would silently no-op the outage
     return RoundRecord(
         round=rnd,
         moved=moved_any,
@@ -441,7 +452,7 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
         target=None,
         communication_cost=0.0,  # filled by run_controller post-move
         load_std=0.0,
-        services_moved=tuple(moved_names),
+        services_moved=tuple(sorted(moved_services)) if moved_any else (),
         decision_latencies_s=(latency,),
     )
 

@@ -210,6 +210,14 @@ def cmd_reschedule(args) -> dict:
     from kubernetes_rescheduling_tpu.config import RescheduleConfig
 
     algo = _norm_algo(args.algorithm)
+    if args.backend == "k8s" and args.placement_unit == "pod":
+        # fail before any cluster work: K8sBackend rejects per-pod moves
+        # (the Deployment mechanism cannot pin one replica), so the run
+        # would otherwise crash mid-round after solving the pod graph
+        raise SystemExit(
+            "--placement-unit pod requires the sim backend: the k8s "
+            "Deployment mechanism cannot pin a single replica"
+        )
     if args.backend == "k8s":
         from kubernetes_rescheduling_tpu.backends.k8s import K8sBackend
         from kubernetes_rescheduling_tpu.core.workmodel import (

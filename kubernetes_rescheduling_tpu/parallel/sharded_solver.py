@@ -53,8 +53,11 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
 from kubernetes_rescheduling_tpu.solver.swap import (
     BIG_CAP,
     cols_at,
+    scan_sweeps,
     swap_decisions,
+    swap_desire,
     swap_flags,
+    swap_subset,
 )
 
 _NEG_INF = float("-inf")
@@ -65,43 +68,70 @@ def sharded_swap(
     valid_l, gcol, config, ow, col0, home=None, move_pen=None,
 ):
     """The swap phase under a mesh with a ``tp`` axis — shard-local
-    reductions feeding the SAME replicated core (solver/swap.py
-    ``swap_decisions``) the single-chip solvers run, so the decisions
-    cannot fork. Per-node inputs are owned by exactly one shard; the
-    psum'd one-hot contractions reproduce the single-chip f32 values
-    bit-exactly (one nonzero term each). Shared by the dense and sparse
-    node-sharded solvers (``Wc`` is the only input whose computation
-    differs). Returns ``(new_node, swapped, n_swaps, d_cpu_l, d_mem_l)``.
-    """
+    reductions feeding the SAME replicated core (solver/swap.py) the
+    single-chip ``chunk_swap`` runs, including the desire-ranked top-k
+    candidate subset, so the decisions cannot fork. Per-node inputs are
+    owned by exactly one shard; the psum'd one-hot contractions reproduce
+    the single-chip f32 values bit-exactly (one nonzero term each).
+    Shared by the dense and sparse node-sharded solvers (``Wc`` is the
+    only input whose computation differs). Returns ``(new_node, swapped,
+    n_swaps, d_cpu_l, d_mem_l)``."""
+    C = cur.shape[0]
     is_cur = gcol == cur[:, None]                       # (C, Nl)
-    M_cur = lax.psum(cols_at(M, cur, col0=col0), "tp")  # (C, C)
-    m_own = lax.psum(jnp.sum(jnp.where(is_cur, M, 0.0), axis=1), "tp")
+    m_cur = lax.psum(jnp.sum(jnp.where(is_cur, M, 0.0), axis=1), "tp")
 
-    def at_cur(v):
+    def at_cur_of(is_at, v):
         return lax.psum(
-            jnp.sum(jnp.where(is_cur, v[None, :], 0.0), axis=1), "tp"
+            jnp.sum(jnp.where(is_at, v[None, :], 0.0), axis=1), "tp"
         )
 
     mem_cap_s = jnp.where(jnp.isinf(mem_cap_l), BIG_CAP, mem_cap_l)
-    cur_ok = at_cur(valid_l.astype(jnp.float32)) > 0
-    new_node, swapped, n_sw = swap_decisions(
-        M_cur, m_own, Wc, cur, eligible & cur_ok, c_cpu, c_mem,
-        at_cur(cpu_l), at_cur(mem_l), at_cur(cap_l), at_cur(mem_cap_s),
+    eligible = eligible & (at_cur_of(is_cur, valid_l.astype(jnp.float32)) > 0)
+
+    pen_home = (
+        move_pen * (cur == home).astype(jnp.float32)
+        if move_pen is not None
+        else 0.0
+    )
+    k = min(config.swap_k, C)
+    if k < C:
+        # replicated desire (local max pmax'd over shards) → the SHARED
+        # subset step: every shard selects the same candidates the
+        # single-chip solver would
+        desire = swap_desire(
+            lax.pmax(jnp.max(M, axis=1), "tp"), m_cur, pen_home
+        )
+        sel, M_k, Wc_k, sub = swap_subset(desire, eligible, M, Wc, k)
+    else:
+        sel = jnp.arange(C, dtype=jnp.int32)
+        M_k, Wc_k = M, Wc
+        sub = lambda v: v
+    cur_k = sub(cur)
+    is_cur_k = gcol == cur_k[:, None]
+    M_cur_k = lax.psum(cols_at(M_k, cur_k, col0=col0), "tp")  # (k, k)
+    new_k, swapped_k, n_sw = swap_decisions(
+        M_cur_k, sub(m_cur), Wc_k, cur_k, sub(eligible),
+        sub(c_cpu), sub(c_mem),
+        at_cur_of(is_cur_k, cpu_l), at_cur_of(is_cur_k, mem_l),
+        at_cur_of(is_cur_k, cap_l), at_cur_of(is_cur_k, mem_cap_s),
         config.balance_weight, ow,
-        pen=move_pen, home=home,
+        pen=sub(move_pen) if move_pen is not None else None,
+        home=sub(home) if home is not None else None,
         enforce_capacity=config.enforce_capacity,
     )
-    is_new = gcol == new_node[:, None]
-    sw_c = jnp.where(swapped, c_cpu, 0.0)
-    sw_m = jnp.where(swapped, c_mem, 0.0)
+    new_node = cur.at[sel].set(new_k)
+    swapped = jnp.zeros((C,), bool).at[sel].set(swapped_k)
+    is_new_k = gcol == new_k[:, None]
+    sw_c = jnp.where(swapped_k, sub(c_cpu), 0.0)
+    sw_m = jnp.where(swapped_k, sub(c_mem), 0.0)
     d_cpu = jnp.sum(
-        jnp.where(is_new, sw_c[:, None], 0.0)
-        - jnp.where(is_cur, sw_c[:, None], 0.0),
+        jnp.where(is_new_k, sw_c[:, None], 0.0)
+        - jnp.where(is_cur_k, sw_c[:, None], 0.0),
         axis=0,
     )
     d_mem = jnp.sum(
-        jnp.where(is_new, sw_m[:, None], 0.0)
-        - jnp.where(is_cur, sw_m[:, None], 0.0),
+        jnp.where(is_new_k, sw_m[:, None], 0.0)
+        - jnp.where(is_cur_k, sw_m[:, None], 0.0),
         axis=0,
     )
     return new_node, swapped, n_sw, d_cpu, d_mem
@@ -328,8 +358,8 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
             # penalized ranking under disruption pricing (see global_solver)
             return obj + move_penalty(assign) if mc_on else obj
 
-        def chunk_step(inner, xs_c):
-            ids, chunk_key, temp, do_swap = xs_c
+        def chunk_step(inner, xs_c, do_swap: bool = False):
+            ids, chunk_key, temp = xs_c
             assign, X_l, cpu_l, mem_l = inner
             valid_c = svc_valid[ids]
             c_cpu = svc_cpu[ids]
@@ -354,64 +384,60 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
                 mem_l + d_mem,
             )
             n_moves = jnp.sum(admitted)
-            if not use_swaps:
+            if not (use_swaps and do_swap):  # STATIC branch (scan_sweeps)
                 return inner, (n_moves, jnp.int32(0))
 
-            def _sw(op):
-                assign2, X2, cpu2, mem2 = op
-                cur2 = assign2[ids]
-                # replicated chunk-local pair weights: one-hot contraction
-                # of the already-gathered W rows (HIGHEST keeps the values
-                # bit-equal to the single-chip column take)
-                pos = (
-                    jnp.full((SP,), C, jnp.int32)
-                    .at[ids]
-                    .set(jnp.arange(C, dtype=jnp.int32))
-                )
-                E = (
-                    pos[:, None] == jnp.arange(C, dtype=jnp.int32)[None, :]
-                ).astype(Wr.dtype)
-                Wc = jnp.dot(
-                    Wr, E,
-                    preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST,
-                )
-                new2, swapped, n_sw, d_c, d_m = sharded_swap(
-                    M, Wc, cur2, valid_c & ~admitted, c_cpu, c_mem,
-                    cpu2, mem2, cap_l, mem_cap_l, valid_l, gcol, config, ow,
-                    col0=shard * Nl,
-                    home=assign_init[ids] if mc_on else None,
-                    move_pen=pen_vec[ids] if mc_on else None,
-                )
-                assign2 = assign2.at[ids].set(new2)
-                X2 = X2.at[ids].set(
-                    ((gcol == new2[:, None]) & valid_c[:, None]).astype(
-                        X2.dtype
-                    )
-                )
-                return (assign2, X2, cpu2 + d_c, mem2 + d_m), n_sw
-
-            inner, n_sw = lax.cond(
-                do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+            assign2, X2, cpu2, mem2 = inner
+            cur2 = assign2[ids]
+            # replicated chunk-local pair weights: one-hot contraction
+            # of the already-gathered W rows (HIGHEST keeps the values
+            # bit-equal to the single-chip column take)
+            pos = (
+                jnp.full((SP,), C, jnp.int32)
+                .at[ids]
+                .set(jnp.arange(C, dtype=jnp.int32))
             )
-            return inner, (n_moves, n_sw)
+            E = (
+                pos[:, None] == jnp.arange(C, dtype=jnp.int32)[None, :]
+            ).astype(Wr.dtype)
+            Wc = jnp.dot(
+                Wr, E,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            new2, swapped, n_sw, d_c, d_m = sharded_swap(
+                M, Wc, cur2, valid_c & ~admitted, c_cpu, c_mem,
+                cpu2, mem2, cap_l, mem_cap_l, valid_l, gcol, config, ow,
+                col0=shard * Nl,
+                home=assign_init[ids] if mc_on else None,
+                move_pen=pen_vec[ids] if mc_on else None,
+            )
+            assign2 = assign2.at[ids].set(new2)
+            X2 = X2.at[ids].set(
+                ((gcol == new2[:, None]) & valid_c[:, None]).astype(
+                    X2.dtype
+                )
+            )
+            return (assign2, X2, cpu2 + d_c, mem2 + d_m), (n_moves, n_sw)
 
-        def sweep(carry, xs):
-            sweep_key, temp, do_swap = xs
+        def make_sweep(do_swap: bool):
+            return partial(sweep, do_swap=do_swap)
+
+        def sweep(carry, xs, do_swap: bool = False):
+            sweep_key, temp = xs
             assign, best_assign, best_obj = carry
             perm_key, noise_key = jax.random.split(sweep_key)
             chunk_ids, _ = sweep_composition(perm_key, SP, C, n_chunks)
             chunk_keys = jax.random.split(noise_key, n_chunks)
             chunk_temps = jnp.full((n_chunks,), temp)
-            chunk_sw = jnp.full((n_chunks,), do_swap)
             X0 = (
                 (assign[:, None] == gcol) & svc_valid[:, None]
             ).astype(jnp.dtype(config.matmul_dtype))
             cpu_l, mem_l = local_loads(assign)
             (assign, _, _, _), (moves, _) = lax.scan(
-                chunk_step,
+                partial(chunk_step, do_swap=do_swap),
                 (assign, X0, cpu_l, mem_l),
-                (chunk_ids, chunk_keys, chunk_temps, chunk_sw),
+                (chunk_ids, chunk_keys, chunk_temps),
             )
             # best-seen selection uses loads recomputed from the assignment,
             # not the incrementally-carried cpu_l: accumulated f32 drift in
@@ -426,8 +452,8 @@ def _solve_factory(config: GlobalSolverConfig, S: int, N: int, tp: int):
 
         cpu0, _ = local_loads(assign_init)
         obj0 = objective_fast(assign_init, cpu0)
-        (_, best_assign, _), _ = lax.scan(
-            sweep, (assign_init, assign_init, obj0), (keys_r, temps, swf)
+        (_, best_assign, _), _ = scan_sweeps(
+            make_sweep, (assign_init, assign_init, obj0), keys_r, temps, swf
         )
         # exact f32 re-evaluation of the adopted placement (same reason as
         # global_solver: the fast objective only ranks sweeps)

@@ -57,7 +57,7 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     auto_chunk,
     pod_restart_bill,
 )
-from kubernetes_rescheduling_tpu.solver.swap import swap_flags
+from kubernetes_rescheduling_tpu.solver.swap import scan_sweeps, swap_flags
 from kubernetes_rescheduling_tpu.solver.sparse_solver import (
     hub_slab,
     sorted_problem_arrays,
@@ -202,8 +202,11 @@ def _solve_factory(
             )
             return raw * rv_s[ids][:, None]
 
-        def sweep(carry, xs):
-            sweep_key, temp, do_swap = xs
+        def make_sweep(do_swap: bool):
+            return partial(sweep, do_swap=do_swap)
+
+        def sweep(carry, xs, do_swap: bool = False):
+            sweep_key, temp = xs
             assign, cpu_l, mem_l, best_assign, best_obj = carry
             perm_key, noise_key = jax.random.split(sweep_key)
             hub_moves = jnp.int32(0)
@@ -253,41 +256,36 @@ def _solve_factory(
                 )
                 inner, admitted = place(inner, ids, M, chunk_key, temp)
                 n_moves = jnp.sum(admitted)
-                if not use_swaps:
+                if not (use_swaps and do_swap):  # STATIC (scan_sweeps)
                     return inner, (n_moves, jnp.int32(0))
 
-                def _sw(op):
-                    assign2, cpu2, mem2 = op
-                    cur2 = assign2[ids]
-                    pos = (
-                        jnp.full((SPX,), C_eff, jnp.int32)
-                        .at[ids]
-                        .set(jnp.arange(C_eff, dtype=jnp.int32))
-                    )
-                    # replicated Wc (chunk position as the "node" axis) —
-                    # every shard computes the same full [C_eff, C_eff]
-                    Wc = chunk_mass(
-                        pos[jnp.clip(u_c, 0, SPX - 1)], rvu_c, blocks,
-                        ids, C_eff, 0,
-                    )
-                    new2, swapped, n_sw, d_c, d_m = sharded_swap(
-                        M, Wc, cur2,
-                        svc_valid[ids] & ~admitted,
-                        svc_cpu[ids], svc_mem[ids],
-                        cpu2, mem2, cap_l, mem_cap_l, valid_l, gcol,
-                        config, ow, col0=col0,
-                        home=assign_init[ids] if mc_on else None,
-                        move_pen=pen_vec[ids] if mc_on else None,
-                    )
-                    return (
-                        assign2.at[ids].set(new2), cpu2 + d_c, mem2 + d_m
-                    ), n_sw
-
-                inner, n_sw = lax.cond(
-                    do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+                assign2, cpu2, mem2 = inner
+                cur2 = assign2[ids]
+                pos = (
+                    jnp.full((SPX,), C_eff, jnp.int32)
+                    .at[ids]
+                    .set(jnp.arange(C_eff, dtype=jnp.int32))
                 )
-                return inner, (n_moves, n_sw)
+                # replicated Wc (chunk position as the "node" axis) —
+                # every shard computes the same full [C_eff, C_eff]
+                Wc = chunk_mass(
+                    pos[jnp.clip(u_c, 0, SPX - 1)], rvu_c, blocks,
+                    ids, C_eff, 0,
+                )
+                new2, swapped, n_sw, d_c, d_m = sharded_swap(
+                    M, Wc, cur2,
+                    svc_valid[ids] & ~admitted,
+                    svc_cpu[ids], svc_mem[ids],
+                    cpu2, mem2, cap_l, mem_cap_l, valid_l, gcol,
+                    config, ow, col0=col0,
+                    home=assign_init[ids] if mc_on else None,
+                    move_pen=pen_vec[ids] if mc_on else None,
+                )
+                return (
+                    assign2.at[ids].set(new2), cpu2 + d_c, mem2 + d_m
+                ), (n_moves, n_sw)
 
+            # chunk_step closes over the sweep's STATIC do_swap
             (assign, _, _), (moves, _) = lax.scan(
                 chunk_step, (assign, cpu_l, mem_l),
                 (chunk_blocks, chunk_ids, chunk_keys),
@@ -304,9 +302,9 @@ def _solve_factory(
 
         cpu0, mem0 = local_loads(assign_init)
         obj0 = objective_rank(assign_init, cpu0)
-        (_, _, _, best_assign, best_obj), _ = lax.scan(
-            sweep, (assign_init, cpu0, mem0, assign_init, obj0),
-            (keys_r, temps, swf),
+        (_, _, _, best_assign, best_obj), _ = scan_sweeps(
+            make_sweep, (assign_init, cpu0, mem0, assign_init, obj0),
+            keys_r, temps, swf,
         )
         # the scan ranked with the penalized objective; return the RAW
         # exact value — the entry's adopt gate re-prices with the exact

@@ -59,8 +59,8 @@ from kubernetes_rescheduling_tpu.ops.fused_admission import (
 )
 from kubernetes_rescheduling_tpu.solver.swap import (
     BIG_CAP,
-    cols_at,
-    swap_decisions,
+    chunk_swap,
+    scan_sweeps,
     swap_flags,
 )
 
@@ -126,6 +126,14 @@ class GlobalSolverConfig:
     # pair weights + [C, C] vector math) is paid on a third of the sweeps.
     # 1 = every sweep; 0 = off (the historical single-move-only search).
     swap_every: int = struct.field(pytree_node=False, default=3)
+    # Swap-candidate subset size: each swap phase considers the top-k
+    # services of the chunk by exchange desire (best kept mass anywhere −
+    # kept mass at the current node). A chunk rarely holds more than a
+    # handful of genuinely deadlocked services, and the [k, k]
+    # gain/interaction math at 256 is ~15× cheaper than at the full
+    # 1024-wide chunk (the phase would otherwise cost ~0.45 ms VPU per
+    # chunk). k ≥ chunk width = consider everyone (all small instances).
+    swap_k: int = struct.field(pytree_node=False, default=256)
     # dtype of the neighbor-mass matmul. bfloat16 feeds the MXU at full
     # rate with f32 accumulation (a modest win — the round is launch-bound,
     # see chunk_size above; measured 69→66 ms at 10k×1k). W weights and
@@ -513,7 +521,7 @@ def global_assign(
     # capacity-deadlock escape. Noise-free scores; protected end to end by
     # the exact-objective best-seen selection and the adopt gate.
     use_swaps = config.swap_every > 0 and C >= 2
-    sw_flags = jnp.asarray(swap_flags(config.sweeps, config.swap_every))
+    sw_flags = swap_flags(config.sweeps, config.swap_every)  # static numpy
     mem_cap_sw = jnp.where(jnp.isinf(mem_cap), BIG_CAP, mem_cap)
 
     def _swap_phase(ids, M, Wc, assign, cpu_load, mem_load, admitted):
@@ -526,14 +534,13 @@ def global_assign(
         eligible = valid_c & ~admitted & state.node_valid[cur]
         c_cpu = svc_cpu[ids]
         c_mem = svc_mem[ids]
-        new_node, swapped, n_sw = swap_decisions(
-            cols_at(M, cur),
-            jnp.take_along_axis(M, cur[:, None], axis=1)[:, 0],
-            Wc, cur, eligible, c_cpu, c_mem,
-            cpu_load[cur], mem_load[cur], cap[cur], mem_cap_sw[cur],
+        new_node, swapped, n_sw = chunk_swap(
+            M, Wc, cur, eligible, c_cpu, c_mem,
+            cpu_load, mem_load, cap, mem_cap_sw,
             config.balance_weight, ow,
-            pen=pen_vec[ids] if mc_on else None,
-            home=assign0[ids] if mc_on else None,
+            pen_vec[ids] if mc_on else None,
+            assign0[ids] if mc_on else None,
+            min(config.swap_k, C),
             enforce_capacity=config.enforce_capacity,
         )
         d_c = jnp.where(swapped, c_cpu, 0.0)
@@ -559,8 +566,11 @@ def global_assign(
         mem_load = mem_load.at[new_node].add(d_mem).at[cur].add(-d_mem)
         return (new_assign, X, cpu_load, mem_load), jnp.sum(admitted)
 
-    def sweep(carry, xs):
-        sweep_key, temp, do_swap = xs
+    def make_sweep(do_swap: bool):
+        return partial(sweep, do_swap=do_swap)
+
+    def sweep(carry, xs, do_swap: bool = False):
+        sweep_key, temp = xs
         assign, best_assign, best_obj = carry
         # Random chunk composition per sweep: which services get to move
         # together varies, so repeated sweeps (and parallel restarts with
@@ -636,34 +646,29 @@ def global_assign(
                 inner, _ = _commit(inner, ids, valid_c, c_cpu, c_mem, cur,
                                    new_node, admitted)
             n_moves = jnp.sum(admitted)
-            if not use_swaps:
+            if not (use_swaps and do_swap):  # STATIC branch (scan_sweeps)
                 return inner, (n_moves, jnp.int32(0))
 
-            def _sw(op):
-                assign2, X2, cpu2, mem2 = op
-                # chunk-local pair weights: W rows are already gathered
-                # for the mass matmul; a [C, C] column take is fine on
-                # the materialized-X lowerings (tests + CPU production)
-                Wc = jnp.take(Wr, ids, axis=1).astype(jnp.float32)
-                assign2, cpu2, mem2, n_sw = _swap_phase(
-                    ids, M, Wc, assign2, cpu2, mem2, admitted
-                )
-                X2 = X2.at[ids].set(
-                    jax.nn.one_hot(assign2[ids], N, dtype=mm_dtype)
-                    * valid_c[:, None]
-                )
-                return (assign2, X2, cpu2, mem2), n_sw
-
-            inner, n_sw = lax.cond(
-                do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+            assign2, X2, cpu2, mem2 = inner
+            # chunk-local pair weights: W rows are already gathered for
+            # the mass matmul; a [C, C] column take is fine on the
+            # materialized-X lowerings (tests + CPU production)
+            Wc = jnp.take(Wr, ids, axis=1).astype(jnp.float32)
+            assign2, cpu2, mem2, n_sw = _swap_phase(
+                ids, M, Wc, assign2, cpu2, mem2, admitted
             )
-            return inner, (n_moves, n_sw)
+            X2 = X2.at[ids].set(
+                jax.nn.one_hot(assign2[ids], N, dtype=mm_dtype)
+                * valid_c[:, None]
+            )
+            return (assign2, X2, cpu2, mem2), (n_moves, n_sw)
 
         X0 = jax.nn.one_hot(assign, N, dtype=mm_dtype) * svc_valid[:, None]
         cpu_load, mem_load = loads(assign)
         (assign, _, _, _), (moves, sws) = lax.scan(
             chunk_step, (assign, X0, cpu_load, mem_load),
             (chunk_ids, chunk_keys),
+            unroll=2,
         )
         obj = objective_fast(assign, loads(assign)[0])
         better = obj < best_obj
@@ -671,7 +676,10 @@ def global_assign(
         best_obj = jnp.where(better, obj, best_obj)
         return (assign, best_assign, best_obj), (jnp.sum(moves), jnp.sum(sws))
 
-    def sweep_inline(carry, xs):
+    def make_sweep_inline(do_swap: bool):
+        return partial(sweep_inline, do_swap=do_swap)
+
+    def sweep_inline(carry, xs, do_swap: bool = False):
         """The TPU inline-mass sweep: same decisions as `sweep` (same chunk
         composition / chunk keys / kernel math; M values are exact for
         integer weights), but the occupancy matrix never exists — the mass
@@ -679,7 +687,7 @@ def global_assign(
         canonical W, no per-sweep permute) and regenerates occupancy tiles
         from `assign` in VMEM; per-node loads are carried through the chunk
         scan and refreshed from the assignment at each sweep boundary."""
-        sweep_key, temp, do_swap = xs
+        sweep_key, temp = xs
         assign, cpu_load, mem_load, best_assign, best_obj = carry
         perm_key, noise_key = jax.random.split(sweep_key)
         chunk_ids, block_rows = sweep_composition(
@@ -718,38 +726,46 @@ def global_assign(
                 mem_load + d_mem,
             )
             n_moves = jnp.sum(admitted)
-            if not use_swaps:
+            if not (use_swaps and do_swap):  # STATIC branch (scan_sweeps)
                 return inner, (n_moves, jnp.int32(0))
 
-            def _sw(op):
-                assign2, cpu2, mem2 = op
-                # chunk-local pair weights via the SAME mass kernel with
-                # "node" = chunk position: Wc[i, j] = W[i, ids_j] — W row
-                # blocks are gathered by id exactly as for M, and the
-                # [C, C] result never needs a column gather
-                pos = (
-                    jnp.full((SP,), C, jnp.int32)
-                    .at[ids]
-                    .set(jnp.arange(C, dtype=jnp.int32))
-                )
-                Wc = fused_neighbor_mass(
-                    W_mm, pos, svc_valid, blocks,
-                    num_nodes=C, block_b=COMPOSITION_BLOCK, block_j=mass_bj,
-                    interpret=fused_interpret,
-                )
-                assign2, cpu2, mem2, n_sw = _swap_phase(
-                    ids, M, Wc, assign2, cpu2, mem2, admitted
-                )
-                return (assign2, cpu2, mem2), n_sw
-
-            inner, n_sw = lax.cond(
-                do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+            assign2, cpu2, mem2 = inner
+            # chunk-local pair weights WITHOUT any contraction: the
+            # inline composition is block-granular, so W[ids][:, ids]
+            # is exactly KB×KB contiguous 256×256 tiles of the
+            # canonical W — a ~2 MB slice assembly (a mass-kernel pass
+            # with "node"=position computes the same values but re-reads
+            # the chunk's full [C, SP] row blocks)
+            kb = C // COMPOSITION_BLOCK
+            Wc = jnp.concatenate(
+                [
+                    jnp.concatenate(
+                        [
+                            lax.dynamic_slice(
+                                W_mm,
+                                (
+                                    blocks[i] * COMPOSITION_BLOCK,
+                                    blocks[j] * COMPOSITION_BLOCK,
+                                ),
+                                (COMPOSITION_BLOCK, COMPOSITION_BLOCK),
+                            )
+                            for j in range(kb)
+                        ],
+                        axis=1,
+                    )
+                    for i in range(kb)
+                ],
+                axis=0,
+            ).astype(jnp.float32)
+            assign2, cpu2, mem2, n_sw = _swap_phase(
+                ids, M, Wc, assign2, cpu2, mem2, admitted
             )
-            return inner, (n_moves, n_sw)
+            return (assign2, cpu2, mem2), (n_moves, n_sw)
 
         (assign, _, _), (moves, sws) = lax.scan(
             chunk_step, (assign, cpu_load, mem_load),
             (chunk_ids, block_rows, chunk_keys),
+            unroll=2,
         )
         # refresh the carried loads from the assignment each sweep (the
         # objective needs fresh loads anyway): incremental-delta f32 drift
@@ -789,13 +805,15 @@ def global_assign(
         1.0 - jnp.arange(config.sweeps, dtype=jnp.float32) / max(config.sweeps - 1, 1)
     )
     if inline_mass:
-        (_, _, _, best_assign, _), (moves_per_sweep, swaps_per_sweep) = lax.scan(
-            sweep_inline, (assign0, cpu0, mem0, assign0, obj0),
-            (keys, temps, sw_flags),
+        (_, _, _, best_assign, _), (moves_per_sweep, swaps_per_sweep) = (
+            scan_sweeps(
+                make_sweep_inline, (assign0, cpu0, mem0, assign0, obj0),
+                keys, temps, sw_flags,
+            )
         )
     else:
-        (_, best_assign, _), (moves_per_sweep, swaps_per_sweep) = lax.scan(
-            sweep, (assign0, assign0, obj0), (keys, temps, sw_flags)
+        (_, best_assign, _), (moves_per_sweep, swaps_per_sweep) = scan_sweeps(
+            make_sweep, (assign0, assign0, obj0), keys, temps, sw_flags
         )
     # best-seen selection above ranks sweeps with the fast objective; the
     # adopted value is re-evaluated EXACTLY so the never-worse gate and the

@@ -75,8 +75,8 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
 )
 from kubernetes_rescheduling_tpu.solver.swap import (
     BIG_CAP,
-    cols_at,
-    swap_decisions,
+    chunk_swap,
+    scan_sweeps,
     swap_flags,
 )
 
@@ -451,7 +451,7 @@ def _global_assign_sparse(
     # Wc would need its own kernel plumbing for little gain.
     C_eff = KB * BLOCK_R
     use_swaps = config.swap_every > 0
-    sw_flags = jnp.asarray(swap_flags(config.sweeps, config.swap_every))
+    sw_flags = swap_flags(config.sweeps, config.swap_every)  # static numpy
     mem_cap_sw = jnp.where(jnp.isinf(mem_cap), BIG_CAP, mem_cap)
 
     def _swap_phase(ids, M, Wc, assign, cpu_load, mem_load, admitted):
@@ -462,14 +462,13 @@ def _global_assign_sparse(
         eligible = valid_c & ~admitted & state.node_valid[cur]
         c_cpu = svc_cpu_s[ids]
         c_mem = svc_mem_s[ids]
-        new_node, swapped, n_sw = swap_decisions(
-            cols_at(M, cur),
-            jnp.take_along_axis(M, cur[:, None], axis=1)[:, 0],
-            Wc, cur, eligible, c_cpu, c_mem,
-            cpu_load[cur], mem_load[cur], cap[cur], mem_cap_sw[cur],
+        new_node, swapped, n_sw = chunk_swap(
+            M, Wc, cur, eligible, c_cpu, c_mem,
+            cpu_load, mem_load, cap, mem_cap_sw,
             config.balance_weight, ow,
-            pen=pen_vec[ids] if mc_on else None,
-            home=assign0[ids] if mc_on else None,
+            pen_vec[ids] if mc_on else None,
+            assign0[ids] if mc_on else None,
+            min(config.swap_k, C_eff),
             enforce_capacity=config.enforce_capacity,
         )
         d_c = jnp.where(swapped, c_cpu, 0.0)
@@ -478,8 +477,11 @@ def _global_assign_sparse(
         mem_load = mem_load.at[new_node].add(d_m).at[cur].add(-d_m)
         return assign.at[ids].set(new_node), cpu_load, mem_load, n_sw
 
-    def sweep(carry, xs):
-        sweep_key, temp, do_swap = xs
+    def make_sweep(do_swap: bool):
+        return partial(sweep, do_swap=do_swap)
+
+    def sweep(carry, xs, do_swap: bool = False):
+        sweep_key, temp = xs
         assign, cpu_load, mem_load, best_assign, best_obj = carry
         perm_key, noise_key = jax.random.split(sweep_key)
         # key-split structure matches the dense inline path when NHB == 0
@@ -517,34 +519,31 @@ def _global_assign_sparse(
             )
             inner, admitted = place(inner, ids, M, chunk_key, temp)
             n_moves = jnp.sum(admitted)
-            if not use_swaps:
+            if not (use_swaps and do_swap):  # STATIC branch (scan_sweeps)
                 return inner, (n_moves, jnp.int32(0))
 
-            def _sw(op):
-                assign2, cpu2, mem2 = op
-                # chunk-local pair weights via the SAME mass contraction
-                # with "node" = chunk position: Wc[i, j] = W[i, ids_j]
-                pos = (
-                    jnp.full((SPX,), C_eff, jnp.int32)
-                    .at[ids]
-                    .set(jnp.arange(C_eff, dtype=jnp.int32))
-                )
-                Wc = chunk_mass(
-                    pos[jnp.clip(u_c, 0, SPX - 1)], rvu_c, blocks, ids, C_eff
-                )
-                assign2, cpu2, mem2, n_sw = _swap_phase(
-                    ids, M, Wc, assign2, cpu2, mem2, admitted
-                )
-                return (assign2, cpu2, mem2), n_sw
-
-            inner, n_sw = lax.cond(
-                do_swap, _sw, lambda op: (op, jnp.int32(0)), inner
+            assign2, cpu2, mem2 = inner
+            # chunk-local pair weights via the SAME mass contraction
+            # with "node" = chunk position: Wc[i, j] = W[i, ids_j] —
+            # reads only the chunk's own strips (cheap, unlike the dense
+            # form's full row blocks)
+            pos = (
+                jnp.full((SPX,), C_eff, jnp.int32)
+                .at[ids]
+                .set(jnp.arange(C_eff, dtype=jnp.int32))
             )
-            return inner, (n_moves, n_sw)
+            Wc = chunk_mass(
+                pos[jnp.clip(u_c, 0, SPX - 1)], rvu_c, blocks, ids, C_eff
+            )
+            assign2, cpu2, mem2, n_sw = _swap_phase(
+                ids, M, Wc, assign2, cpu2, mem2, admitted
+            )
+            return (assign2, cpu2, mem2), (n_moves, n_sw)
 
         (assign, _, _), (moves, sws) = lax.scan(
             chunk_step, (assign, cpu_load, mem_load),
             (chunk_blocks, chunk_ids, chunk_keys),
+            unroll=2,
         )
         # refresh carried loads each sweep boundary — bounds incremental
         # f32 drift to one sweep, matching the dense paths
@@ -578,9 +577,9 @@ def _global_assign_sparse(
         / max(config.sweeps - 1, 1)
     )
     (_, _, _, best_assign, best_obj), (moves_per_sweep, swaps_per_sweep) = (
-        lax.scan(
-            sweep, (assign0, cpu0, mem0, assign0, obj0),
-            (keys, temps, sw_flags),
+        scan_sweeps(
+            make_sweep, (assign0, cpu0, mem0, assign0, obj0),
+            keys, temps, sw_flags,
         )
     )
 

@@ -79,6 +79,118 @@ def swap_flags(sweeps: int, swap_every: int) -> np.ndarray:
     return (np.arange(sweeps) % swap_every) == (swap_every - 1)
 
 
+def scan_sweeps(make_body, carry, keys, temps, flags):
+    """Scan the sweep loop in contiguous same-flag segments so the swap
+    phase is a STATIC branch of each segment's body — never a traced
+    ``lax.cond`` inside the chunk scan. A per-chunk cond costs real money
+    even on non-swap sweeps: it splits the chunk step into separate
+    dispatch regions and materializes the [C, N] mass block through HBM
+    (measured +4 ms/solve at 10k×1k — ~30× the swap math itself).
+
+    ``make_body(do_swap: bool)`` returns a scan body over ``(key, temp)``;
+    ``flags`` is the static numpy bool array from :func:`swap_flags`.
+    Key/temp streams are sliced per segment, so decisions are identical
+    to a single scan. Returns ``(carry, stacked_outputs)``."""
+    flags = np.asarray(flags)
+    bodies = {}
+    outs = []
+    i = 0
+    while i < len(flags):
+        j = i
+        while j < len(flags) and flags[j] == flags[i]:
+            j += 1
+        flag = bool(flags[i])
+        if flag not in bodies:
+            bodies[flag] = make_body(flag)
+        carry, out = jax.lax.scan(
+            bodies[flag], carry, (keys[i:j], temps[i:j])
+        )
+        outs.append(out)
+        i = j
+    if len(outs) == 1:
+        return carry, outs[0]
+    return carry, jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs), *outs
+    )
+
+
+def swap_desire(m_best, m_cur, pen_home):
+    """Optimistic per-service exchange desire: best kept mass anywhere
+    (``m_best`` — the row max of M, pmax'd over shards when node columns
+    are sharded) minus kept mass at the current node, minus the move-cost
+    bill if the service still sits on its anchor. Load terms are
+    deliberately OMITTED — a capacity-deadlocked service's best target
+    projects over-budget under the single-move projection (that veto is
+    exactly why it needs the swap phase); the pair-exact gain matrix
+    re-prices candidates with departure-corrected loads."""
+    return m_best - m_cur - pen_home
+
+
+def swap_subset(desire, eligible, M, Wc, k):
+    """Top-``k`` candidate selection + exact one-hot row contraction of
+    ``M``/``Wc`` — ONE definition for the single-chip and sharded paths
+    (only the desire reduction differs between them; a forked copy of
+    the selection rule could silently diverge their decisions). Returns
+    ``(sel, M_k, Wc_k, sub)`` where ``sub`` gathers any [C] vector to
+    the subset."""
+    C = desire.shape[0]
+    HI = jax.lax.Precision.HIGHEST
+    _, sel = jax.lax.top_k(jnp.where(eligible, desire, -jnp.inf), k)
+    E = (sel[:, None] == jnp.arange(C, dtype=jnp.int32)[None, :]).astype(
+        M.dtype
+    )
+    # one-hot row selection (HIGHEST → bit-exact), never a [k, N] gather
+    M_k = jnp.dot(E, M, preferred_element_type=jnp.float32, precision=HI)
+    Wc_k = jnp.dot(
+        jnp.dot(E, Wc, preferred_element_type=jnp.float32, precision=HI),
+        E.T, precision=HI,
+    )
+    return sel, M_k, Wc_k, (lambda v: v[sel])
+
+
+def chunk_swap(
+    M, Wc, cur, eligible, c_cpu, c_mem, cpu_load, mem_load, cap, mem_cap_s,
+    lam, ow, pen, home, k, *, enforce_capacity,
+):
+    """The full single-chip swap phase for one chunk: desire-ranked
+    top-``k`` candidate subset → exact pair decisions → full-width
+    results. Subsetting is what keeps the phase off the flagship round's
+    critical path: the [C, C] gain/interaction soup at C=1024 costs
+    ~0.45 ms of VPU time per chunk, while the same math at k=256 is
+    ~30 µs — and a chunk rarely holds more than a handful of genuinely
+    deadlocked services. With ``k >= C`` (every small instance) the
+    subset is the identity and behavior is unchanged.
+
+    Returns ``(new_node[C], swapped[C], n_swaps)``; the caller commits
+    loads/assignment exactly as for single moves."""
+    C = cur.shape[0]
+    m_cur = jnp.take_along_axis(M, cur[:, None], axis=1)[:, 0]
+    pen_home = (
+        pen * (cur == home).astype(jnp.float32) if pen is not None else 0.0
+    )
+    if k < C:
+        desire = swap_desire(jnp.max(M, axis=1), m_cur, pen_home)
+        sel, M_k, Wc_k, sub = swap_subset(desire, eligible, M, Wc, k)
+    else:
+        sel = jnp.arange(C, dtype=jnp.int32)
+        M_k, Wc_k = M, Wc
+        sub = lambda v: v
+    cur_k = sub(cur)
+    new_k, swapped_k, n_sw = swap_decisions(
+        cols_at(M_k, cur_k),
+        sub(m_cur),
+        Wc_k, cur_k, sub(eligible), sub(c_cpu), sub(c_mem),
+        cpu_load[cur_k], mem_load[cur_k], cap[cur_k], mem_cap_s[cur_k],
+        lam, ow,
+        pen=sub(pen) if pen is not None else None,
+        home=sub(home) if home is not None else None,
+        enforce_capacity=enforce_capacity,
+    )
+    new_node = cur.at[sel].set(new_k)
+    swapped = jnp.zeros((C,), bool).at[sel].set(swapped_k)
+    return new_node, swapped, n_sw
+
+
 def cols_at(M, cur, col0=0):
     """``M_cur[i, j] = M[i, cur_j]`` as a one-hot contraction (NOT a
     [C, C] gather — XLA's TPU gather runs element-at-a-time and a 1M-

@@ -1,0 +1,121 @@
+"""Swap-phase cost/quality on real hardware at the flagship scale.
+
+Measures, for several (swap_every, sweeps) configs at 10k x 1k (dense and
+sparse): the device slope per round (K=2 vs K=8 chained solves, prepared
+weights on the dense path) and the final communication cost — the
+"objective at equal device budget" evidence for the pairwise-swap phase.
+
+Run ON the TPU: python scripts/swap_perf.py [dense|sparse|50k]
+"""
+
+import json
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+
+def slope(chained, state, graph, wp, k1=2, k2=8):
+    def timed(k):
+        _, objs = chained(state, graph, wp, jax.random.PRNGKey(7), k)
+        float(objs[-1])
+        best = float("inf")
+        for rep in range(3):
+            t = time.perf_counter()
+            _, objs = chained(state, graph, wp, jax.random.PRNGKey(8 + rep), k)
+            float(objs[-1])
+            best = min(best, time.perf_counter() - t)
+        return best
+
+    return (timed(k2) - timed(k1)) / (k2 - k1) * 1e3
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "dense"
+    from kubernetes_rescheduling_tpu.objectives import communication_cost
+    from kubernetes_rescheduling_tpu.solver import (
+        GlobalSolverConfig,
+        global_assign,
+        global_assign_sparse,
+        sparse_pod_comm_cost,
+    )
+
+    if mode == "50k":
+        import runpy
+
+        bench = runpy.run_path(
+            str(Path(__file__).resolve().parent.parent / "bench.py")
+        )
+        state, graph = bench["_sparse50k_problem"]()
+        solve, cost_of, sparse = global_assign_sparse, sparse_pod_comm_cost, True
+    else:
+        from kubernetes_rescheduling_tpu.bench.harness import make_backend
+
+        backend = make_backend("large", seed=0)
+        state = backend.monitor()
+        graph = backend.comm_graph()
+        sparse = mode == "sparse"
+        if sparse:
+            from kubernetes_rescheduling_tpu.core import sparsegraph
+
+            graph = sparsegraph.from_comm_graph(graph)
+            solve, cost_of = global_assign_sparse, sparse_pod_comm_cost
+        else:
+            solve, cost_of = global_assign, communication_cost
+
+    configs = [
+        ("sw0_s9", 0, 9),
+        ("sw3_s9", 3, 9),
+        ("sw0_s10", 0, 10),
+        ("sw1_s9", 1, 9),
+        ("sw0_s12", 0, 12),
+        ("sw3_s12", 3, 12),
+    ]
+    for tag, se, sweeps in configs:
+        cfg = GlobalSolverConfig(sweeps=sweeps, swap_every=se)
+        wp = None
+        if not sparse:
+            from kubernetes_rescheduling_tpu.solver.global_solver import (
+                prepare_weights,
+            )
+
+            wp = prepare_weights(state, graph, cfg)
+
+        @partial(jax.jit, static_argnames=("k",))
+        def chained(st0, g, w, key0, k, cfg=cfg):
+            def body(st_c, i):
+                kk = jax.random.fold_in(key0, i)
+                if sparse:
+                    st_n, inf = solve(st_c, g, kk, cfg)
+                else:
+                    st_n, inf = solve(st_c, g, kk, cfg, w_mm=w)
+                return st_n, inf["objective_after"]
+
+            return jax.lax.scan(body, st0, jnp.arange(k))
+
+        ms = slope(chained, state, graph, wp)
+        st1, info = (
+            solve(state, graph, jax.random.PRNGKey(0), cfg)
+            if sparse
+            else solve(state, graph, jax.random.PRNGKey(0), cfg, w_mm=wp)
+        )
+        comm = float(cost_of(st1, graph))
+        sw = [int(x) for x in info.get("swaps_per_sweep", [])]
+        print(
+            json.dumps(
+                {
+                    "mode": mode, "config": tag, "device_ms": round(ms, 2),
+                    "comm_after": round(comm, 1), "swaps_per_sweep": sw,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

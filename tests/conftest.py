@@ -35,3 +35,19 @@ from hypothesis import settings  # noqa: E402
 settings.register_profile("ci", derandomize=True, deadline=None)
 settings.register_profile("explore", deadline=None)
 settings.load_profile(os.environ.get("HYPOTHESIS_PROFILE", "ci"))
+
+
+# XLA CPU accumulates compiled-executable state across the ~400-test
+# suite; past ~340 compilations in one process the compiler segfaults
+# deterministically (observed at an innocuous jnp.sum compile — a
+# compiler-state issue, not a semantics one; every file passes in
+# isolation). Clearing JAX's caches at module boundaries bounds the
+# accumulation; cross-module cache reuse was negligible anyway (each
+# file compiles its own shapes).
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    yield
+    jax.clear_caches()

@@ -319,3 +319,76 @@ def test_estimated_weights_beat_declared_on_measured_latency():
     lat_declared = gen.measure(st_declared, jax.random.PRNGKey(2)).latency_avg_ms
     lat_estimated = gen.measure(st_estimated, jax.random.PRNGKey(2)).latency_avg_ms
     assert lat_estimated < lat_declared
+
+
+def test_constant_extremes_preserve_policy_ordering():
+    """The latency claims rest on ORDERINGS (optimized < pile-up and
+    optimized < random), not on the loadgen's absolute milliseconds. Pin
+    the ordering at the constant grid's extreme corners — the full 54-
+    corner sweep (scripts/loadgen_sensitivity.py, 0 violations measured)
+    is the slow version of this test. Placements are monitored through
+    the sim backend so utilization couples to placement, exactly like the
+    harness."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubernetes_rescheduling_tpu.bench.harness import make_backend
+    from kubernetes_rescheduling_tpu.bench.loadgen import (
+        LoadGenConfig,
+        LoadGenerator,
+    )
+    from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+    from kubernetes_rescheduling_tpu.solver import (
+        GlobalSolverConfig,
+        global_assign,
+    )
+
+    def monitored(kind):
+        backend = make_backend("mubench", seed=0)
+        backend.inject_imbalance(backend.node_names[0])
+        st = backend.monitor()
+        if kind == "global":
+            after, _ = global_assign(
+                st, backend.comm_graph(), jax.random.PRNGKey(0),
+                GlobalSolverConfig(
+                    sweeps=9, balance_weight=0.5, enforce_capacity=True,
+                    capacity_frac=0.5,
+                ),
+            )
+            backend.restore_placement(after)
+            st = backend.monitor()
+        elif kind == "random":
+            rng = np.random.default_rng(1)
+            rand = st.replace(
+                pod_node=jnp.asarray(
+                    np.where(
+                        np.asarray(st.pod_valid),
+                        rng.integers(0, st.num_nodes, st.num_pods),
+                        np.asarray(st.pod_node),
+                    ),
+                    jnp.int32,
+                )
+            )
+            backend.restore_placement(rand)
+            st = backend.monitor()
+        return st
+
+    states = {k: monitored(k) for k in ("pileup", "global", "random")}
+    wm = mubench_workmodel_c()
+    corners = [
+        dict(proc_ms=0.5, hop_remote_ms=1.0, jitter_sigma=0.05, drop_rho=0.7),
+        dict(proc_ms=0.5, hop_remote_ms=10.0, jitter_sigma=0.5, drop_rho=1.0),
+        dict(proc_ms=5.0, hop_remote_ms=1.0, jitter_sigma=0.5, drop_rho=0.7),
+        dict(proc_ms=5.0, hop_remote_ms=10.0, jitter_sigma=0.05, drop_rho=1.0),
+    ]
+    for corner in corners:
+        gen = LoadGenerator(
+            wm, LoadGenConfig(requests_per_phase=4000, **corner)
+        )
+        lat = {
+            k: gen.measure(st, jax.random.PRNGKey(2)).latency_avg_ms
+            for k, st in states.items()
+        }
+        assert lat["global"] < lat["pileup"], (corner, lat)
+        assert lat["global"] < lat["random"], (corner, lat)

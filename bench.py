@@ -121,10 +121,12 @@ def bench_trace(
     mults_by_k = {}
 
     def timed(k):
-        nonlocal ii, jj, loc
+        nonlocal ii, jj, loc, sgraph
         if k not in mults_by_k:
             if solver_kind == "sparse":
-                loc, mults_by_k[k] = drift_multipliers_sparse(sgraph, k, seed=3)
+                sgraph, loc, mults_by_k[k] = drift_multipliers_sparse(
+                    sgraph, k, seed=3
+                )
             else:
                 ii, jj, mults_by_k[k] = drift_multipliers(graph, k, seed=3)
         m = mults_by_k[k]

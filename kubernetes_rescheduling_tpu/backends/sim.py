@@ -235,29 +235,28 @@ class SimBackend:
         charge one reconcile delay per replica — a clock model no real
         cluster has (kubelets reconcile in parallel). Returns the number
         of pods moved."""
+        node_idx = {n: i for i, n in enumerate(self.node_names)}
         target_of: dict[str, int] = {}
         for mv in moves:
-            if mv.target_node not in self.node_names:
-                continue
-            t = self.node_names.index(mv.target_node)
-            if self._node_alive[t] and mv.pod is not None:
+            t = node_idx.get(mv.target_node)
+            if t is not None and self._node_alive[t] and mv.pod is not None:
                 target_of[mv.pod] = t
-        moved = 0
+        landed: list[str] = []
         for pod in self._pods:
             t = target_of.get(pod[2])
             if t is not None:
                 pod[1] = t
-                moved += 1
+                landed.append(pod[2])
         self.clock_s += self.reconcile_delay_s
         self.events.append(
             {
                 "t": self.clock_s,
                 "event": "pod_moves",
-                "pods": moved,
+                "pods": len(landed),
                 "requested": len(moves),
             }
         )
-        return moved
+        return landed
 
     def restore_placement(self, state: ClusterState) -> int:
         """Pin pods back to the placement recorded in a checkpoint snapshot

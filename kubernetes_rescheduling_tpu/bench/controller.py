@@ -420,7 +420,6 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
     valid = np.asarray(state.pod_valid)
     svc_arr = np.asarray(state.pod_service)
     moves: list[MoveRequest] = []
-    moved_services: set[str] = set()
     for i in np.flatnonzero(valid & (old_nodes != new_nodes)):
         moves.append(
             MoveRequest(
@@ -430,20 +429,23 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
                 mechanism=PlacementMechanism["global"],
             )
         )
-        moved_services.add(graph.names[int(svc_arr[i])])
     # batch path: one reconcile wave for the whole round's replica moves
     # (per-call apply_move would scan the pod table and advance the sim
     # clock once PER REPLICA); backends without it get individual calls
     batch = getattr(backend, "apply_pod_moves", None)
+    moved_services: set[str] = set()
     if batch is not None:
-        moved_any = bool(moves) and batch(moves) > 0
+        landed = set(batch(moves)) if moves else set()
+        moved_services = {mv.service for mv in moves if mv.pod in landed}
     else:
-        moved_any = False
         for mv in moves:
-            moved_any = (backend.apply_move(mv) is not None) or moved_any
-    # services_moved carries SERVICE names: its consumers — the harness's
-    # teardown-outage injection and restart accounting — are service-
-    # granular, and a pod name there would silently no-op the outage
+            if backend.apply_move(mv) is not None:
+                moved_services.add(mv.service)
+    moved_any = bool(moved_services)
+    # services_moved carries the SERVICE names of moves that LANDED: its
+    # consumers — the harness's teardown-outage injection and restart
+    # accounting — are service-granular, and a pod name (or a move a dead
+    # node rejected) would charge disruption that never happened
     return RoundRecord(
         round=rnd,
         moved=moved_any,

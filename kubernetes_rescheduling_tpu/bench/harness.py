@@ -124,6 +124,57 @@ class ExperimentConfig:
             )
 
 
+def mubench_reference_placements():
+    """Three placements of the µBench scenario, MONITORED THROUGH the sim
+    backend so the load model couples placement to node utilization (the
+    queueing/overload regime the latency claims rest on — raw
+    request-based states would read a few % everywhere and make total
+    colocation trivially "win"): the cordon pile-up, the global solve
+    under a 50% packing budget, and a seeded random spread. ONE
+    definition shared by the loadgen sensitivity sweep
+    (scripts/loadgen_sensitivity.py) and its extreme-corner regression
+    test (tests/test_loadgen.py), so the two measure the SAME
+    placements."""
+    import jax.numpy as jnp
+
+    from kubernetes_rescheduling_tpu.solver import (
+        GlobalSolverConfig,
+        global_assign,
+    )
+
+    def monitored(kind):
+        backend = make_backend("mubench", seed=0)
+        backend.inject_imbalance(backend.node_names[0])
+        st = backend.monitor()
+        if kind == "global":
+            after, _ = global_assign(
+                st, backend.comm_graph(), jax.random.PRNGKey(0),
+                GlobalSolverConfig(
+                    sweeps=9, balance_weight=0.5, enforce_capacity=True,
+                    capacity_frac=0.5,
+                ),
+            )
+            backend.restore_placement(after)
+            st = backend.monitor()
+        elif kind == "random":
+            rng = np.random.default_rng(1)
+            rand = st.replace(
+                pod_node=jnp.asarray(
+                    np.where(
+                        np.asarray(st.pod_valid),
+                        rng.integers(0, st.num_nodes, st.num_pods),
+                        np.asarray(st.pod_node),
+                    ),
+                    jnp.int32,
+                )
+            )
+            backend.restore_placement(rand)
+            st = backend.monitor()
+        return st
+
+    return {k: monitored(k) for k in ("pileup", "global", "random")}
+
+
 def make_backend(
     scenario: str, seed: int, workmodel_path: str | None = None
 ) -> SimBackend:
@@ -432,7 +483,9 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 during.restarts = sum(
                     int(e.get("pods", 0))
                     for e in events[events_mark:]
-                    if e.get("event") == "move"
+                    # "move" = whole-Deployment re-creates; "pod_moves" =
+                    # a pod-mode round's batched per-replica wave
+                    if e.get("event") in ("move", "pod_moves")
                 )
                 restart_source = "event_log"
             else:

@@ -251,3 +251,59 @@ def plot_scale_curve(points: list[dict], out_dir: str | Path) -> Path:
     fig.savefig(path, dpi=120)
     plt.close(fig)
     return path
+
+
+# validated categorical slots (dataviz reference palette, fixed order —
+# color follows the CONFIG identity, never its rank in a given chart)
+_CAT = ("#2a78d6", "#eb6834", "#1baf7a", "#eda100")
+
+
+def plot_optimality_gap(rows, out_dir) -> "Path":
+    """Round-5 solver-quality chart: % above the MILP optimum/incumbent
+    per capacity-binding instance, grouped by solver configuration.
+
+    ``rows``: [{"instance": "40x5", "configs": {label: gap_pct, ...}}, ...]
+    with every row carrying the SAME config labels (fixed series order).
+    A dashed line marks the 10% target; negative bars mean the solver
+    beat the MILP's own incumbent."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from pathlib import Path
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    labels = list(rows[0]["configs"].keys())
+    n_cfg = len(labels)
+    xs = np.arange(len(rows))
+    width = 0.8 / n_cfg
+    fig, ax = plt.subplots(figsize=(7.2, 3.6))
+    for ci, lab in enumerate(labels):
+        vals = [r["configs"][lab] for r in rows]
+        pos = xs + (ci - (n_cfg - 1) / 2) * width
+        ax.bar(pos, vals, width=width * 0.92, color=_CAT[ci], zorder=2,
+               label=lab)
+        for x, v in zip(pos, vals):
+            ax.text(x, v + (0.3 if v >= 0 else -1.2), f"{v:.1f}",
+                    ha="center", va="bottom", fontsize=7.5, color=_INK)
+    ax.axhline(10.0, color="#9aa5b1", linewidth=1.0, linestyle="--", zorder=1)
+    ax.text(len(rows) - 0.5, 10.3, "10% target", fontsize=8, color="#6b7280",
+            ha="right")
+    ax.axhline(0.0, color=_INK, linewidth=0.8, zorder=1)
+    ax.set_xticks(xs, [r["instance"] for r in rows], fontsize=9)
+    ax.set_ylabel("% above MILP optimum / incumbent", fontsize=9, color=_INK)
+    ax.set_title(
+        "optimality gap, capacity-binding instances (round 5)",
+        fontsize=11, color=_INK, loc="left",
+    )
+    ax.grid(axis="y", color="#e3e6ea", linewidth=0.8, zorder=0)
+    ax.spines[["top", "right"]].set_visible(False)
+    ax.tick_params(colors=_INK)
+    ax.legend(fontsize=8, frameon=False, ncols=2)
+    ax.margins(y=0.18)
+    fig.tight_layout()
+    path = out_dir / "optimality_gap.png"
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

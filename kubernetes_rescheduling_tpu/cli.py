@@ -264,6 +264,13 @@ def cmd_reschedule(args) -> dict:
 def cmd_bench(args) -> dict:
     from kubernetes_rescheduling_tpu.bench.harness import ExperimentConfig, run_experiment
 
+    if args.backend == "k8s" and args.placement_unit == "pod":
+        # ExperimentConfig would raise the same rule at construction;
+        # surface it as the CLI's clean exit instead of a traceback
+        raise SystemExit(
+            "--placement-unit pod requires the sim backend: the k8s "
+            "Deployment mechanism cannot pin a single replica"
+        )
     cfg = ExperimentConfig(
         algorithms=tuple(_norm_algo(a) for a in args.algorithms.split(",") if a),
         repeats=args.repeats,

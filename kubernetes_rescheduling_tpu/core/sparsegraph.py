@@ -309,6 +309,13 @@ class TraceLocator:
     w_rows: jax.Array   # i32[2E] w_local row per slot
     w_cols: jax.Array   # i32[2E] w_local column per slot
     base_w: jax.Array   # f32[E] build-time weight per undirected edge
+    # True when the graph's COO list has been reordered into the
+    # locator's canonical [forward..., reverse...] order
+    # (:func:`reorder_for_trace`): the per-step edges_w update is then a
+    # plain concat instead of a 2E-element scatter — TPU scatters run
+    # element-at-a-time (~12 ns/el), so at 10k services the scatter was
+    # most of the streaming premium
+    canonical: bool = struct.field(pytree_node=False, default=False)
 
     @property
     def num_edges(self) -> int:
@@ -357,13 +364,35 @@ def trace_locator(sgraph: SparseCommGraph) -> TraceLocator:
     )
 
 
+def reorder_for_trace(
+    sgraph: SparseCommGraph,
+) -> tuple[SparseCommGraph, TraceLocator]:
+    """Prepare a graph for streaming: permute its COO list into the
+    locator's canonical [forward..., reverse...] order (every consumer of
+    the edge list — exact objectives, shard args — is order-independent,
+    so this is free) and return the matching canonical locator. The
+    per-step ``edges_w`` update then needs NO scatter at all."""
+    loc = trace_locator(sgraph)
+    coo = np.asarray(loc.coo)
+    sg2 = sgraph.replace(
+        edges_src=sgraph.edges_src[coo],
+        edges_dst=sgraph.edges_dst[coo],
+        edges_w=sgraph.edges_w[coo],
+    )
+    E2 = coo.shape[0]
+    return sg2, loc.replace(
+        coo=jnp.arange(E2, dtype=jnp.int32), canonical=True
+    )
+
+
 def with_edge_weights(
     sgraph: SparseCommGraph, loc: TraceLocator, new_w: jax.Array
 ) -> SparseCommGraph:
     """New graph with per-undirected-edge weights ``new_w`` (f32[E], in
     the locator's canonical edge order) — a 2E-element scatter into the
-    COO list and the block-local strips; jit-safe (static structure,
-    dynamic weights)."""
+    block-local strips, and either a plain concat (canonical locator,
+    :func:`reorder_for_trace`) or a 2E scatter into the COO list;
+    jit-safe (static structure, dynamic weights)."""
     if sgraph.dense_adj is not None:
         # single-block graphs carry a dense twin for the solver's
         # delegation path; updating only the sparse storage would leave
@@ -377,7 +406,7 @@ def with_edge_weights(
     w2 = jnp.concatenate([new_w, new_w])
     return sgraph.replace(
         w_local=sgraph.w_local.at[loc.w_rows, loc.w_cols].set(w2),
-        edges_w=sgraph.edges_w.at[loc.coo].set(w2),
+        edges_w=w2 if loc.canonical else sgraph.edges_w.at[loc.coo].set(w2),
     )
 
 

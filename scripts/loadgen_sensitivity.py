@@ -26,63 +26,16 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
-from kubernetes_rescheduling_tpu.bench.harness import make_backend
+from kubernetes_rescheduling_tpu.bench.harness import (
+    mubench_reference_placements,
+)
 from kubernetes_rescheduling_tpu.bench.loadgen import LoadGenConfig, LoadGenerator
 from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
-from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig, global_assign
-
-
-def placements():
-    """Three placements of the µBench scenario, fixed across the sweep —
-    MONITORED THROUGH THE SIM BACKEND, exactly like the harness: the
-    backend's load model couples placement to node utilization (the
-    pile-up drives its node to ~85% CPU), which is where the queueing and
-    overload terms the latency claims rest on come from. Raw
-    request-based states would read a few % utilization everywhere and
-    make total colocation trivially 'win'."""
-    import jax.numpy as jnp
-
-    def monitored(pod_node_by_name=None, solve=False):
-        backend = make_backend("mubench", seed=0)
-        backend.inject_imbalance(backend.node_names[0])
-        st = backend.monitor()
-        if solve:
-            after, _ = global_assign(
-                st, backend.comm_graph(), jax.random.PRNGKey(0),
-                GlobalSolverConfig(
-                    sweeps=9, balance_weight=0.5, enforce_capacity=True,
-                    capacity_frac=0.5,
-                ),
-            )
-            backend.restore_placement(after)
-            st = backend.monitor()
-        elif pod_node_by_name is not None:
-            st = backend.monitor()
-            rng = np.random.default_rng(1)
-            rand = st.replace(
-                pod_node=jnp.asarray(
-                    np.where(
-                        np.asarray(st.pod_valid),
-                        rng.integers(0, st.num_nodes, st.num_pods),
-                        np.asarray(st.pod_node),
-                    ),
-                    jnp.int32,
-                )
-            )
-            backend.restore_placement(rand)
-            st = backend.monitor()
-        return st
-
-    return {
-        "pileup": monitored(),
-        "global": monitored(solve=True),
-        "random": monitored(pod_node_by_name="random"),
-    }
 
 
 def main():
     wm = mubench_workmodel_c()
-    states = placements()
+    states = mubench_reference_placements()
     grid = {
         "proc_ms": [0.5, 1.5, 5.0],
         "hop_remote_ms": [1.0, 3.0, 10.0],

@@ -1,0 +1,29 @@
+"""Regenerate the round-5 optimality-gap chart.
+
+Data: the MEASURED 2026-07-31 gap table (scripts/gap_table.py + the
+best-of-4 probe; provenance in RESULTS.md "Optimality gap, round 5").
+Negative = the solver beat the MILP's 180 s incumbent.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kubernetes_rescheduling_tpu.bench.plots import plot_optimality_gap
+
+ROWS = [
+    {"instance": "40×5", "configs": {
+        "9 sweeps": 21.4, "27 sweeps": 10.7,
+        "27 sweeps + swaps": 7.1, "9 sweeps, best-of-4": 10.7}},
+    {"instance": "60×6", "configs": {
+        "9 sweeps": 19.1, "27 sweeps": 8.5,
+        "27 sweeps + swaps": 8.5, "9 sweeps, best-of-4": 2.1}},
+    {"instance": "100×6", "configs": {
+        "9 sweeps": 10.5, "27 sweeps": 5.3,
+        "27 sweeps + swaps": 2.6, "9 sweeps, best-of-4": 6.6}},
+]
+
+if __name__ == "__main__":
+    out = Path(__file__).resolve().parent.parent / "result" / "charts"
+    print(plot_optimality_gap(ROWS, out))

@@ -330,51 +330,18 @@ def test_constant_extremes_preserve_policy_ordering():
     the sim backend so utilization couples to placement, exactly like the
     harness."""
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
-    from kubernetes_rescheduling_tpu.bench.harness import make_backend
+    from kubernetes_rescheduling_tpu.bench.harness import (
+        mubench_reference_placements,
+    )
     from kubernetes_rescheduling_tpu.bench.loadgen import (
         LoadGenConfig,
         LoadGenerator,
     )
     from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
-    from kubernetes_rescheduling_tpu.solver import (
-        GlobalSolverConfig,
-        global_assign,
-    )
 
-    def monitored(kind):
-        backend = make_backend("mubench", seed=0)
-        backend.inject_imbalance(backend.node_names[0])
-        st = backend.monitor()
-        if kind == "global":
-            after, _ = global_assign(
-                st, backend.comm_graph(), jax.random.PRNGKey(0),
-                GlobalSolverConfig(
-                    sweeps=9, balance_weight=0.5, enforce_capacity=True,
-                    capacity_frac=0.5,
-                ),
-            )
-            backend.restore_placement(after)
-            st = backend.monitor()
-        elif kind == "random":
-            rng = np.random.default_rng(1)
-            rand = st.replace(
-                pod_node=jnp.asarray(
-                    np.where(
-                        np.asarray(st.pod_valid),
-                        rng.integers(0, st.num_nodes, st.num_pods),
-                        np.asarray(st.pod_node),
-                    ),
-                    jnp.int32,
-                )
-            )
-            backend.restore_placement(rand)
-            st = backend.monitor()
-        return st
+    states = mubench_reference_placements()
 
-    states = {k: monitored(k) for k in ("pileup", "global", "random")}
     wm = mubench_workmodel_c()
     corners = [
         dict(proc_ms=0.5, hop_remote_ms=1.0, jitter_sigma=0.05, drop_rho=0.7),

@@ -173,7 +173,7 @@ def test_replay_on_device_sparse_tracks_drift():
 
     scn = synthetic_scenario(n_pods=600, n_nodes=8, powerlaw=True, seed=3)
     sg = sparsegraph.from_comm_graph(scn.graph)
-    loc, mults = drift_multipliers_sparse(sg, steps=4, seed=1)
+    sg, loc, mults = drift_multipliers_sparse(sg, steps=4, seed=1)
     final, objs, befores = replay_on_device_sparse(
         scn.state, sg, loc, mults,
         jax.random.PRNGKey(0), GlobalSolverConfig(sweeps=3),

@@ -43,6 +43,81 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = float("-inf")
 
 
+def score_core(
+    m, cur, home, pen, c_cpu, c_mem, valid,
+    cpu_load, mem_load, cap, mem_cap, node_valid,
+    lam, ow, temp, seed,
+    *,
+    enforce_capacity: bool,
+    use_noise: bool,
+    use_move_pen: bool,
+):
+    """The chunk score → first-max proposal → per-row reductions as pure
+    array math on VMEM-resident values — the SINGLE definition shared by
+    the standalone score kernel and the sparse fused mass+score kernel
+    (``ops.sparse_mass.sparse_mass_score``). Bit-parity between the two
+    lowerings is structural: both call exactly this.
+
+    Shapes: ``m`` (BC, N); ``cur/home/pen/c_cpu/c_mem/valid`` (BC, 1);
+    ``cpu_load/mem_load/cap/mem_cap/node_valid`` (1, N); scalars traced.
+    Returns ``(prop, gain, wants_i32, slack_cpu, slack_mem)``, all (BC, 1).
+    """
+    bc, n = m.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (bc, n), 1)
+    is_cur = col == cur                                   # (BC, N)
+
+    proj_cpu = cpu_load + jnp.where(is_cur, 0.0, c_cpu)
+    proj_pct = proj_cpu / cap * 100.0
+    score = m - lam * proj_pct - ow * jnp.maximum(proj_pct - 100.0, 0.0)
+    if use_move_pen:
+        # disruption cost: residency anywhere but the round-start node
+        # costs pen (staying moved keeps paying; moving back recovers it),
+        # so a relocation must beat home by more than its restart cost.
+        # Static flag (like use_noise): zero-cost callers keep the exact
+        # pre-pricing kernel.
+        score = score - jnp.where(col == home, 0.0, pen)
+    if use_noise:
+        pltpu.prng_seed(seed)
+        bits = pltpu.prng_random_bits((bc, n))
+        # uniform in (0, 1): keep 23 low bits — sign-safe whatever the
+        # carrier dtype (a plain uint32→f32 convert can go through a signed
+        # path and yield negatives, turning the log-log below into NaNs)
+        mant = (bits & 0x7FFFFF).astype(jnp.float32)
+        u = (mant + 0.5) * (1.0 / 8388608.0)
+        score = score + temp * (-jnp.log(-jnp.log(u)))
+
+    if enforce_capacity:
+        proj_mem = mem_load + jnp.where(is_cur, 0.0, c_mem)
+        fits = (proj_cpu <= cap) & (proj_mem <= mem_cap)
+        feasible = (fits | is_cur) & (node_valid != 0)
+    else:
+        feasible = jnp.broadcast_to(node_valid != 0, (bc, n))
+
+    masked = jnp.where(feasible, score, _NEG_INF)
+    prop_score = jnp.max(masked, axis=1, keepdims=True)   # (BC, 1)
+    # first-max parity with jnp.argmax: lowest column index among maxima
+    at_max = masked == prop_score
+    big = jnp.int32(n)
+    prop = jnp.min(jnp.where(at_max, col, big), axis=1, keepdims=True)
+    prop = jnp.minimum(prop, big - 1)
+    cur_score = jnp.sum(jnp.where(is_cur, score, 0.0), axis=1, keepdims=True)
+    gain = prop_score - cur_score
+    wants = (valid != 0) & (gain > 0) & (prop != cur)
+
+    is_prop = col == prop
+    load_p = jnp.sum(jnp.where(is_prop, cpu_load, 0.0), axis=1, keepdims=True)
+    cap_p = jnp.sum(jnp.where(is_prop, cap, 0.0), axis=1, keepdims=True)
+    mload_p = jnp.sum(jnp.where(is_prop, mem_load, 0.0), axis=1, keepdims=True)
+    mcap_p = jnp.sum(jnp.where(is_prop, mem_cap, 0.0), axis=1, keepdims=True)
+    return (
+        prop,
+        gain,
+        wants.astype(jnp.int32),
+        cap_p - load_p - c_cpu,
+        mcap_p - mload_p - c_mem,
+    )
+
+
 def _score_kernel(
     lam_ref,        # SMEM (1, 1) f32
     ow_ref,         # SMEM (1, 1) f32 — over-budget repulsion weight
@@ -71,67 +146,22 @@ def _score_kernel(
     use_noise: bool,
     use_move_pen: bool,
 ):
-    bc, n = m_ref.shape
-    lam = lam_ref[0, 0]
-    cur = cur_ref[:]                                      # (BC, 1)
-    c_cpu = c_cpu_ref[:]
-    c_mem = c_mem_ref[:]
-    col = jax.lax.broadcasted_iota(jnp.int32, (bc, n), 1)
-    is_cur = col == cur                                   # (BC, N)
-
-    proj_cpu = cpu_load_ref[:] + jnp.where(is_cur, 0.0, c_cpu)
-    proj_pct = proj_cpu / cap_ref[:] * 100.0
-    score = (
-        m_ref[:]
-        - lam * proj_pct
-        - ow_ref[0, 0] * jnp.maximum(proj_pct - 100.0, 0.0)
+    prop, gain, wants, slack_cpu, slack_mem = score_core(
+        m_ref[:], cur_ref[:], home_ref[:], pen_ref[:],
+        c_cpu_ref[:], c_mem_ref[:], valid_ref[:],
+        cpu_load_ref[:], mem_load_ref[:], cap_ref[:], mem_cap_ref[:],
+        node_valid_ref[:],
+        lam_ref[0, 0], ow_ref[0, 0], temp_ref[0, 0],
+        seed_ref[0, 0] + pl.program_id(0),
+        enforce_capacity=enforce_capacity,
+        use_noise=use_noise,
+        use_move_pen=use_move_pen,
     )
-    if use_move_pen:
-        # disruption cost: residency anywhere but the round-start node
-        # costs pen (staying moved keeps paying; moving back recovers it),
-        # so a relocation must beat home by more than its restart cost.
-        # Static flag (like use_noise): zero-cost callers keep the exact
-        # pre-pricing kernel.
-        score = score - jnp.where(col == home_ref[:], 0.0, pen_ref[:])
-    if use_noise:
-        pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
-        bits = pltpu.prng_random_bits((bc, n))
-        # uniform in (0, 1): keep 23 low bits — sign-safe whatever the
-        # carrier dtype (a plain uint32→f32 convert can go through a signed
-        # path and yield negatives, turning the log-log below into NaNs)
-        mant = (bits & 0x7FFFFF).astype(jnp.float32)
-        u = (mant + 0.5) * (1.0 / 8388608.0)
-        score = score + temp_ref[0, 0] * (-jnp.log(-jnp.log(u)))
-
-    if enforce_capacity:
-        proj_mem = mem_load_ref[:] + jnp.where(is_cur, 0.0, c_mem)
-        fits = (proj_cpu <= cap_ref[:]) & (proj_mem <= mem_cap_ref[:])
-        feasible = (fits | is_cur) & (node_valid_ref[:] != 0)
-    else:
-        feasible = jnp.broadcast_to(node_valid_ref[:] != 0, (bc, n))
-
-    masked = jnp.where(feasible, score, _NEG_INF)
-    prop_score = jnp.max(masked, axis=1, keepdims=True)   # (BC, 1)
-    # first-max parity with jnp.argmax: lowest column index among maxima
-    at_max = masked == prop_score
-    big = jnp.int32(n)
-    prop = jnp.min(jnp.where(at_max, col, big), axis=1, keepdims=True)
-    prop = jnp.minimum(prop, big - 1)
-    cur_score = jnp.sum(jnp.where(is_cur, score, 0.0), axis=1, keepdims=True)
-    gain = prop_score - cur_score
-    wants = (valid_ref[:] != 0) & (gain > 0) & (prop != cur)
-
-    is_prop = col == prop
-    load_p = jnp.sum(jnp.where(is_prop, cpu_load_ref[:], 0.0), axis=1, keepdims=True)
-    cap_p = jnp.sum(jnp.where(is_prop, cap_ref[:], 0.0), axis=1, keepdims=True)
-    mload_p = jnp.sum(jnp.where(is_prop, mem_load_ref[:], 0.0), axis=1, keepdims=True)
-    mcap_p = jnp.sum(jnp.where(is_prop, mem_cap_ref[:], 0.0), axis=1, keepdims=True)
-
     prop_ref[:] = prop
     gain_ref[:] = gain
-    wants_ref[:] = wants.astype(jnp.int32)
-    slack_cpu_ref[:] = cap_p - load_p - c_cpu
-    slack_mem_ref[:] = mcap_p - mload_p - c_mem
+    wants_ref[:] = wants
+    slack_cpu_ref[:] = slack_cpu
+    slack_mem_ref[:] = slack_mem
 
 
 def _admission_kernel(
@@ -327,10 +357,45 @@ def fused_score_admission(
         row_i32(node_valid),
     )
 
-    # admission tiled over C rows: the (BC, C) priority block stays small
-    # while the full priority matrix would not fit VMEM at C ≥ ~1000.
-    # The (1, N) load-delta outputs map every tile to the same block and
-    # accumulate across the sequential grid.
+    return admission_stage(
+        prop, gain, wants, slack_cpu, slack_mem, cur, valid_c, c_cpu, c_mem,
+        num_nodes=N,
+        enforce_capacity=enforce_capacity,
+        interpret=interpret,
+        block_c=bc,
+        x_dtype=x_dtype,
+        emit_x_rows=emit_x_rows,
+    )
+
+
+def admission_stage(
+    prop, gain, wants, slack_cpu, slack_mem,  # [C, 1] score-stage outputs
+    cur, valid_c, c_cpu, c_mem,               # [C]-shaped chunk vectors
+    *,
+    num_nodes: int,
+    enforce_capacity: bool,
+    interpret: bool = False,
+    block_c: int = 256,
+    x_dtype=jnp.bfloat16,
+    emit_x_rows: bool = False,
+):
+    """The admission-race half of :func:`fused_score_admission`, callable
+    on any score stage's outputs (the standalone score kernel or the
+    sparse fused mass+score kernel). Admission tiled over C rows: the
+    (BC, C) priority block stays small while the full priority matrix
+    would not fit VMEM at C ≥ ~1000. The (1, N) load-delta outputs map
+    every tile to the same block and accumulate across the sequential
+    grid."""
+    C = prop.shape[0]
+    N = int(num_nodes)
+    bc = min(block_c, C)
+    grid = (pl.cdiv(C, bc),)
+
+    col_i32 = lambda x: x.reshape(C, 1).astype(jnp.int32)
+    col_f32 = lambda x: x.reshape(C, 1).astype(jnp.float32)
+    cvec = pl.BlockSpec((bc, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    out_ci = jax.ShapeDtypeStruct((C, 1), jnp.int32)
+
     crow = pl.BlockSpec((1, C), lambda i: (0, 0), memory_space=pltpu.VMEM)
     cfull = pl.BlockSpec((C, 1), lambda i: (0, 0), memory_space=pltpu.VMEM)
     nacc = pl.BlockSpec((1, N), lambda i: (0, 0), memory_space=pltpu.VMEM)

@@ -51,6 +51,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R
+from kubernetes_rescheduling_tpu.ops.fused_admission import score_core
 
 
 def _mass_body(w_ref, tgt_ref, rvu_ref, m_ref, *, first):
@@ -185,6 +186,194 @@ def hub_neighbor_mass(
         w_mm,
         tgt_l.reshape(1, -1).astype(jnp.int32),
         rvu_l.reshape(1, -1).astype(jnp.float32),
+    )
+
+
+def _chunk_mass_score_kernel(
+    blocks_ref,     # scalar prefetch i32[KB]
+    toff_ref,       # scalar prefetch i32[NBX]
+    lam_ref,        # SMEM (1, 1) f32
+    ow_ref,         # SMEM (1, 1) f32
+    temp_ref,       # SMEM (1, 1) f32
+    seed_ref,       # SMEM (1, 1) i32
+    w_ref,          # VMEM (256, bu) W tile (gathered via index_map)
+    tgt_ref,        # VMEM (1, bu) chunk-local assign slab tile
+    rvu_ref,        # VMEM (1, bu) chunk-local neighbor-replica tile
+    rvrow_ref,      # VMEM (BLOCK_R, 1) f32 row replica factor, block i
+    cur_ref,        # VMEM (BLOCK_R, 1) i32
+    home_ref,       # VMEM (BLOCK_R, 1) i32
+    pen_ref,        # VMEM (BLOCK_R, 1) f32
+    c_cpu_ref,      # VMEM (BLOCK_R, 1) f32
+    c_mem_ref,      # VMEM (BLOCK_R, 1) f32
+    valid_ref,      # VMEM (BLOCK_R, 1) i32
+    cpu_load_ref,   # VMEM (1, N) f32
+    mem_load_ref,   # VMEM (1, N) f32
+    cap_ref,        # VMEM (1, N) f32
+    mem_cap_ref,    # VMEM (1, N) f32
+    node_valid_ref, # VMEM (1, N) i32
+    prop_ref,       # out VMEM (BLOCK_R, 1) i32
+    gain_ref,       # out VMEM (BLOCK_R, 1) f32
+    wants_ref,      # out VMEM (BLOCK_R, 1) i32
+    slack_cpu_ref,  # out VMEM (BLOCK_R, 1) f32
+    slack_mem_ref,  # out VMEM (BLOCK_R, 1) f32
+    m_scr,          # scratch VMEM (BLOCK_R, N) f32 — the mass accumulator
+    *,
+    reg_tiles: int,
+    enforce_capacity: bool,
+    use_noise: bool,
+    use_move_pen: bool,
+):
+    del blocks_ref, toff_ref  # consumed by the index_map
+    # hoisted out of the pl.when bodies: program_id inside a when-region
+    # does not survive the cond sub-jaxpr on the interpret lowering
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    # the same accumulate step as the two-kernel path — bit-parity with
+    # sparse_neighbor_mass is structural, not a copy
+    _mass_body(w_ref, tgt_ref, rvu_ref, m_scr, first=j == 0)
+
+    @pl.when(j == reg_tiles - 1)
+    def _():
+        # the block's mass is complete — run the score reductions while
+        # M is still in VMEM (it never exists in HBM on this path). Same
+        # f32 ops in the same order as the two-kernel path: bit-identical
+        # decisions (with noise the seed offset is the block index, same
+        # stream law as the standalone score kernel's program_id).
+        m = m_scr[:] * rvrow_ref[:]
+        prop, gain, wants, slack_cpu, slack_mem = score_core(
+            m, cur_ref[:], home_ref[:], pen_ref[:],
+            c_cpu_ref[:], c_mem_ref[:], valid_ref[:],
+            cpu_load_ref[:], mem_load_ref[:], cap_ref[:], mem_cap_ref[:],
+            node_valid_ref[:],
+            lam_ref[0, 0], ow_ref[0, 0], temp_ref[0, 0],
+            seed_ref[0, 0] + i,
+            enforce_capacity=enforce_capacity,
+            use_noise=use_noise,
+            use_move_pen=use_move_pen,
+        )
+        prop_ref[:] = prop
+        gain_ref[:] = gain
+        wants_ref[:] = wants
+        slack_cpu_ref[:] = slack_cpu
+        slack_mem_ref[:] = slack_mem
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_nodes", "bu", "reg_tiles", "enforce_capacity", "use_noise",
+        "interpret",
+    ),
+)
+def sparse_mass_score(
+    w_mm,     # [256, TU] block-local weights in matmul dtype
+    tgt_c,    # i32[KB·u_reg] chunk-local assign slab, block-major
+    rvu_c,    # f32[KB·u_reg] chunk-local neighbor replicas
+    blocks,   # i32[KB] chunk's block ids
+    toff,     # i32[NBX] per-block first W column tile
+    rv_row,   # f32[C] row replica factor (C = KB·256)
+    cur,      # i32[C]
+    home,     # i32[C] move-cost anchor (pass cur when pricing is off)
+    move_pen, # f32[C] | None — None keeps the exact pre-pricing kernel
+    c_cpu,    # f32[C]
+    c_mem,    # f32[C]
+    valid_c,  # bool[C]
+    cpu_load, mem_load, cap, mem_cap, node_valid,   # [N] tables
+    lam, temp, seed,                                # scalars
+    overload_weight=0.0,
+    *,
+    num_nodes: int,
+    bu: int,
+    reg_tiles: int,
+    enforce_capacity: bool,
+    use_noise: bool,
+    interpret: bool = False,
+):
+    """Fused mass+score for one regular chunk: accumulates each block's
+    neighbor mass in a VMEM scratch and reduces it to the score stage's
+    ``(prop, gain, wants, slack_cpu, slack_mem)`` in the SAME kernel —
+    one launch per chunk instead of two, and the [C, N] mass block never
+    round-trips HBM. Decisions are bit-identical to
+    ``sparse_neighbor_mass`` → ``fused_score_admission``'s score stage
+    (shared ``score_core``); feed the outputs to ``admission_stage``."""
+    KB = blocks.shape[0]
+    C = KB * BLOCK_R
+    N = int(num_nodes)
+    use_move_pen = move_pen is not None
+    if move_pen is None:
+        move_pen = jnp.zeros((C,), jnp.float32)
+
+    col_i32 = lambda x: x.reshape(C, 1).astype(jnp.int32)
+    col_f32 = lambda x: x.reshape(C, 1).astype(jnp.float32)
+    row_f32 = lambda x: x.reshape(1, N).astype(jnp.float32)
+    row_i32 = lambda x: x.reshape(1, N).astype(jnp.int32)
+
+    smem = pl.BlockSpec(
+        (1, 1), lambda i, j, blocks, toff: (0, 0), memory_space=pltpu.SMEM
+    )
+    cvec = pl.BlockSpec(
+        (BLOCK_R, 1), lambda i, j, blocks, toff: (i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    nvec = pl.BlockSpec(
+        (1, N), lambda i, j, blocks, toff: (0, 0), memory_space=pltpu.VMEM
+    )
+    out_c = jax.ShapeDtypeStruct((C, 1), jnp.float32)
+    out_ci = jax.ShapeDtypeStruct((C, 1), jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(KB, reg_tiles),
+        in_specs=[
+            smem, smem, smem, smem,
+            pl.BlockSpec(
+                (BLOCK_R, bu),
+                lambda i, j, blocks, toff: (0, toff[blocks[i]] + j),
+            ),
+            pl.BlockSpec(
+                (1, bu), lambda i, j, blocks, toff: (0, i * reg_tiles + j)
+            ),
+            pl.BlockSpec(
+                (1, bu), lambda i, j, blocks, toff: (0, i * reg_tiles + j)
+            ),
+            cvec, cvec, cvec, cvec, cvec, cvec, cvec,
+            nvec, nvec, nvec, nvec, nvec,
+        ],
+        out_specs=[cvec, cvec, cvec, cvec, cvec],
+        scratch_shapes=[pltpu.VMEM((BLOCK_R, N), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _chunk_mass_score_kernel,
+            reg_tiles=reg_tiles,
+            enforce_capacity=enforce_capacity,
+            use_noise=use_noise,
+            use_move_pen=use_move_pen,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[out_ci, out_c, out_ci, out_c, out_c],
+        interpret=interpret,
+    )(
+        blocks.astype(jnp.int32),
+        toff.astype(jnp.int32),
+        jnp.asarray(lam, jnp.float32).reshape(1, 1),
+        jnp.asarray(overload_weight, jnp.float32).reshape(1, 1),
+        jnp.asarray(temp, jnp.float32).reshape(1, 1),
+        jnp.asarray(seed, jnp.int32).reshape(1, 1),
+        w_mm,
+        tgt_c.reshape(1, -1).astype(jnp.int32),
+        rvu_c.reshape(1, -1).astype(jnp.float32),
+        col_f32(rv_row),
+        col_i32(cur),
+        col_i32(home),
+        col_f32(move_pen),
+        col_f32(c_cpu),
+        col_f32(c_mem),
+        col_i32(valid_c),
+        row_f32(cpu_load),
+        row_f32(mem_load),
+        row_f32(cap),
+        row_f32(mem_cap),
+        row_i32(node_valid),
     )
 
 

@@ -54,6 +54,7 @@ from kubernetes_rescheduling_tpu.core.sparsegraph import (
 from kubernetes_rescheduling_tpu.core.state import ClusterState
 from kubernetes_rescheduling_tpu.objectives.metrics import load_std
 from kubernetes_rescheduling_tpu.ops.fused_admission import (
+    admission_stage,
     fused_score_admission,
     reference_score_admission,
 )
@@ -63,6 +64,7 @@ from kubernetes_rescheduling_tpu.ops.sparse_mass import (
     hub_tile_arrays,
     reference_hub_mass,
     reference_sparse_mass,
+    sparse_mass_score,
     sparse_neighbor_mass,
 )
 from kubernetes_rescheduling_tpu.solver.global_solver import (
@@ -92,7 +94,16 @@ def sparse_pod_comm_cost(
     small, so f32 error stays per-edge-tiny — never the global ΣW
     subtraction whose ulp error could flip the adopt gate). Halved because
     the COO list carries each undirected edge twice. Scans the edge list
-    in chunks to bound the gather footprint at scale."""
+    in chunks to bound the gather footprint at scale.
+
+    The general scan is only NEEDED when some service's replicas are
+    split across nodes: its per-edge-chunk row gathers of the count
+    matrix cost ~37 ms at 50k×2k (hundreds of thousands of 8 KB row
+    DMAs), while every solver OUTPUT colocates each service's replicas —
+    so chained production solves always present a collapsed placement.
+    Three pod scatters detect that case and a ``lax.cond`` routes it to
+    the O(E) COO cut (exactly the same quantity there, ~2.6 ms at 50k);
+    genuinely split inputs still pay for the exact general accounting."""
     SP = sgraph.sp
     N = state.num_nodes
     pod_slot = sgraph.inv[
@@ -100,28 +111,54 @@ def sparse_pod_comm_cost(
     ]
     slot = jnp.where(state.pod_valid, pod_slot, SP)
     node = jnp.clip(jnp.where(state.pod_valid, state.pod_node, N), -1, N)
-    cnt = (
-        jnp.zeros((SP + 1, N + 1), jnp.float32)
-        .at[slot, node]
-        .add(1.0)[:SP, :N]
+    # pods counted by the general form: valid AND placed on a real node
+    # (node −1 / N fall into sliced-off scatter columns below)
+    placed = state.pod_valid & (node >= 0) & (node < N)
+    slot_p = jnp.where(placed, slot, SP)
+    node_p = jnp.where(placed, node, N).astype(jnp.int32)
+    nmin = jnp.full((SP + 1,), N, jnp.int32).at[slot_p].min(node_p)[:SP]
+    nmax = (
+        jnp.full((SP + 1,), -1, jnp.int32)
+        .at[slot_p]
+        .max(jnp.where(placed, node_p, -1))[:SP]
     )
-    rv = jnp.sum(cnt, axis=1)
+    rv_eff = (
+        jnp.zeros((SP + 1,), jnp.float32)
+        .at[slot_p]
+        .add(jnp.where(placed, 1.0, 0.0))[:SP]
+    )
+    collapsed = jnp.all((rv_eff == 0) | (nmin == nmax))
 
-    E2 = sgraph.edges_src.shape[0]
-    EC = min(edge_chunk, max(E2, 1))
-    n_ec = -(-E2 // EC)
-    src = _pad_to(sgraph.edges_src, n_ec * EC, 0).reshape(n_ec, EC)
-    dst = _pad_to(sgraph.edges_dst, n_ec * EC, 0).reshape(n_ec, EC)
-    w = _pad_to(sgraph.edges_w, n_ec * EC, 0.0).reshape(n_ec, EC)
+    def fast(_):
+        # every counted service sits on one node: the pod cost IS the
+        # service-level cut of (first-node, effective replicas)
+        return sparse_pair_comm_cost(sgraph, nmin, rv_eff)
 
-    def step(acc, xs):
-        s, t, we = xs
-        kept = jnp.sum(cnt[s] * cnt[t], axis=1)
-        cross = jnp.maximum(rv[s] * rv[t] - kept, 0.0)
-        return acc + jnp.sum(we * cross), None
+    def slow(_):
+        cnt = (
+            jnp.zeros((SP + 1, N + 1), jnp.float32)
+            .at[slot, node]
+            .add(1.0)[:SP, :N]
+        )
+        rv = jnp.sum(cnt, axis=1)
 
-    total, _ = lax.scan(step, jnp.float32(0.0), (src, dst, w))
-    return 0.5 * total
+        E2 = sgraph.edges_src.shape[0]
+        EC = min(edge_chunk, max(E2, 1))
+        n_ec = -(-E2 // EC)
+        src = _pad_to(sgraph.edges_src, n_ec * EC, 0).reshape(n_ec, EC)
+        dst = _pad_to(sgraph.edges_dst, n_ec * EC, 0).reshape(n_ec, EC)
+        w = _pad_to(sgraph.edges_w, n_ec * EC, 0.0).reshape(n_ec, EC)
+
+        def step(acc, xs):
+            s, t, we = xs
+            kept = jnp.sum(cnt[s] * cnt[t], axis=1)
+            cross = jnp.maximum(rv[s] * rv[t] - kept, 0.0)
+            return acc + jnp.sum(we * cross), None
+
+        total, _ = lax.scan(step, jnp.float32(0.0), (src, dst, w))
+        return 0.5 * total
+
+    return lax.cond(collapsed, fast, slow, None)
 
 
 def global_assign_sparse(
@@ -297,18 +334,19 @@ def _global_assign_sparse(
             cpu_load, cap, state.node_valid, config.balance_weight, ow
         )
 
-    def objective_raw(assign, cpu_load):
-        """EXACT comm+balance objective — the sparse cut-sum is O(E),
+    def objective_terms(assign, cpu_load):
+        """(exact comm, ranking objective) — the sparse cut-sum is O(E),
         cheap enough to be both the per-sweep best-seen ranking AND the
-        adopt gate (no bf16 fast-form needed, unlike the dense path)."""
+        adopt gate (no bf16 fast-form needed, unlike the dense path). The
+        comm term rides the sweep carry so the epilogue's reported cost
+        reuses it via the collapse identity (every adopted placement
+        colocates each service's replicas) instead of paying a second
+        pod-level accounting pass."""
         comm = sparse_pair_comm_cost(sgraph, assign[:SP], rv_s[:SP])
-        return comm + _balance_terms(cpu_load)
-
-    def objective(assign, cpu_load):
-        obj = objective_raw(assign, cpu_load)
+        obj = comm + _balance_terms(cpu_load)
         # penalized ranking under disruption pricing: a sweep that wins on
         # comm but spends more restarts than the win is worth loses
-        return obj + move_penalty(assign) if mc_on else obj
+        return comm, (obj + move_penalty(assign) if mc_on else obj)
 
     # ---- lowering selection (mirrors the dense solver) ----
     fused_interpret = config.fused_epilogue == "interpret"
@@ -389,9 +427,14 @@ def _global_assign_sparse(
             )
         return raw * rv_s[ids_g][:, None]
 
-    def place(inner, ids, M, chunk_key, temp):
+    def place(inner, ids, M, chunk_key, temp, seed):
         """Score → argmax → admission → commit for one id set (shared by
-        the hub pass and the randomized chunks)."""
+        the hub pass and the randomized chunks). ``seed`` feeds the fused
+        kernel's core PRNG — drawn once per sweep for ALL chunks (one
+        threefry instead of ~50: the per-chunk ``randint`` chatter
+        measured 0.34 ms/sweep at 50k×2k); ``chunk_key`` still drives the
+        XLA path's gumbel (annealing noise carries no cross-lowering
+        parity requirement — ops/fused_admission.py docstring)."""
         assign, cpu_load, mem_load = inner
         valid_c = svc_valid[ids]
         c_cpu = svc_cpu_s[ids]
@@ -400,7 +443,6 @@ def _global_assign_sparse(
         home = assign0[ids] if mc_on else None
         pen = pen_vec[ids] if mc_on else None
         if use_fused:
-            seed = jax.random.randint(chunk_key, (), 0, 2**31 - 1)
             new_node, admitted, d_cpu, d_mem = fused_score_admission(
                 M, cur, c_cpu, c_mem, valid_c,
                 cpu_load, mem_load, cap, mem_cap, state.node_valid,
@@ -482,8 +524,14 @@ def _global_assign_sparse(
 
     def sweep(carry, xs, do_swap: bool = False):
         sweep_key, temp = xs
-        assign, cpu_load, mem_load, best_assign, best_obj = carry
+        assign, cpu_load, mem_load, best_assign, best_obj, best_comm = carry
         perm_key, noise_key = jax.random.split(sweep_key)
+        # one threefry draw covers every chunk's and hub group's fused-
+        # kernel seed (DCE'd entirely on the XLA lowering)
+        seeds = jax.random.randint(
+            jax.random.fold_in(noise_key, 7),
+            (n_chunks + len(hub_groups),), 0, 2**31 - 1,
+        )
         # key-split structure matches the dense inline path when NHB == 0
         # (the parity test relies on identical chunk_keys)
         hub_moves = jnp.int32(0)
@@ -497,7 +545,8 @@ def _global_assign_sparse(
                 assign = inner[0]
                 M = hub_mass(assign, group)
                 inner, g_adm = place(
-                    inner, group[1], M, keys[n_chunks + g], temp
+                    inner, group[1], M, keys[n_chunks + g], temp,
+                    seeds[n_chunks + g],
                 )
                 hub_moves = hub_moves + jnp.sum(g_adm)
             assign, cpu_load, mem_load = inner
@@ -511,13 +560,49 @@ def _global_assign_sparse(
         ).reshape(n_chunks, KB * BLOCK_R)
 
         def chunk_step(inner, xs_c):
-            blocks, ids, chunk_key = xs_c
+            blocks, ids, chunk_key, seed = xs_c
             assign = inner[0]
             u_c, rvu_c = chunk_slabs(blocks)
-            M = chunk_mass(
-                assign[jnp.clip(u_c, 0, SPX - 1)], rvu_c, blocks, ids, N
-            )
-            inner, admitted = place(inner, ids, M, chunk_key, temp)
+            tgt_c = assign[jnp.clip(u_c, 0, SPX - 1)]
+            if use_fused and use_kernels and not (use_swaps and do_swap):
+                # fused mass+score (round 5): one kernel launch per chunk
+                # and the [C, N] mass block never round-trips HBM — shared
+                # score_core keeps decisions bit-identical to the
+                # two-kernel path (which swap sweeps still use: the swap
+                # phase consumes M). ~0.35 → ~0.25 ms/chunk at 50k×2k.
+                assign, cpu_load, mem_load = inner
+                valid_c = svc_valid[ids]
+                c_cpu = svc_cpu_s[ids]
+                c_mem = svc_mem_s[ids]
+                cur = assign[ids]
+                prop, gain, wants, s_cpu, s_mem = sparse_mass_score(
+                    w_mm, tgt_c, rvu_c, blocks, toff_ext, rv_s[ids],
+                    cur,
+                    assign0[ids] if mc_on else cur,
+                    pen_vec[ids] if mc_on else None,
+                    c_cpu, c_mem, valid_c,
+                    cpu_load, mem_load, cap, mem_cap, state.node_valid,
+                    config.balance_weight, temp, seed, ow,
+                    num_nodes=N, bu=sgraph.bu, reg_tiles=sgraph.reg_tiles,
+                    enforce_capacity=config.enforce_capacity,
+                    use_noise=config.noise_temp > 0 and not fused_interpret,
+                    interpret=fused_interpret or not on_tpu,
+                )
+                new_node, admitted, d_cpu, d_mem = admission_stage(
+                    prop, gain, wants, s_cpu, s_mem,
+                    cur, valid_c, c_cpu, c_mem,
+                    num_nodes=N,
+                    enforce_capacity=config.enforce_capacity,
+                    interpret=fused_interpret or not on_tpu,
+                )
+                inner = (
+                    assign.at[ids].set(new_node),
+                    cpu_load + d_cpu,
+                    mem_load + d_mem,
+                )
+                return inner, (jnp.sum(admitted), jnp.int32(0))
+            M = chunk_mass(tgt_c, rvu_c, blocks, ids, N)
+            inner, admitted = place(inner, ids, M, chunk_key, temp, seed)
             n_moves = jnp.sum(admitted)
             if not (use_swaps and do_swap):  # STATIC branch (scan_sweeps)
                 return inner, (n_moves, jnp.int32(0))
@@ -542,18 +627,19 @@ def _global_assign_sparse(
 
         (assign, _, _), (moves, sws) = lax.scan(
             chunk_step, (assign, cpu_load, mem_load),
-            (chunk_blocks, chunk_ids, chunk_keys),
+            (chunk_blocks, chunk_ids, chunk_keys, seeds[:n_chunks]),
             unroll=2,
         )
         # refresh carried loads each sweep boundary — bounds incremental
         # f32 drift to one sweep, matching the dense paths
         cpu_fresh, mem_fresh = loads(assign)
-        obj = objective(assign, cpu_fresh)
+        comm, obj = objective_terms(assign, cpu_fresh)
         better = obj < best_obj
         best_assign = jnp.where(better, assign, best_assign)
         best_obj = jnp.where(better, obj, best_obj)
+        best_comm = jnp.where(better, comm, best_comm)
         return (
-            (assign, cpu_fresh, mem_fresh, best_assign, best_obj),
+            (assign, cpu_fresh, mem_fresh, best_assign, best_obj, best_comm),
             (jnp.sum(moves) + hub_moves, jnp.sum(sws)),
         )
 
@@ -563,31 +649,35 @@ def _global_assign_sparse(
     pct_true0 = jnp.where(
         state.node_valid, state.node_cpu_used() / cap * 100.0, 0.0
     )
+    comm_true0 = sparse_pod_comm_cost(state, sgraph)
     obj_true0 = (
-        sparse_pod_comm_cost(state, sgraph)
+        comm_true0
         + config.balance_weight * (load_std(state) / config.capacity_frac)
         + ow * jnp.sum(jnp.maximum(pct_true0 - 100.0, 0.0))
     )
     cpu0, mem0 = loads(assign0)
-    obj0 = objective(assign0, cpu0)
+    comm0_c, obj0 = objective_terms(assign0, cpu0)
     keys = jax.random.split(key, config.sweeps)
     temps = config.noise_temp * (
         1.0
         - jnp.arange(config.sweeps, dtype=jnp.float32)
         / max(config.sweeps - 1, 1)
     )
-    (_, _, _, best_assign, best_obj), (moves_per_sweep, swaps_per_sweep) = (
-        scan_sweeps(
-            make_sweep, (assign0, cpu0, mem0, assign0, obj0),
-            keys, temps, sw_flags,
-        )
+    (
+        (_, _, _, best_assign, best_obj, best_comm),
+        (moves_per_sweep, swaps_per_sweep),
+    ) = scan_sweeps(
+        make_sweep, (assign0, cpu0, mem0, assign0, obj0, comm0_c),
+        keys, temps, sw_flags,
     )
 
     # under disruption pricing the adopt gate re-prices with the EXACT
     # pod-level restart bill (the scan ranked with the cheap service-level
     # form); the reported objective stays raw
     raw_after = (
-        objective_raw(best_assign, loads(best_assign)[0]) if mc_on else best_obj
+        best_comm + _balance_terms(loads(best_assign)[0])
+        if mc_on
+        else best_obj
     )
     best_pen = _pod_bill(best_assign) if mc_on else jnp.float32(0.0)
     improved = raw_after + best_pen < obj_true0
@@ -605,7 +695,11 @@ def _global_assign_sparse(
         "moves_per_sweep": moves_per_sweep,
         "swaps_per_sweep": swaps_per_sweep,
         "move_penalty": jnp.where(improved, best_pen, 0.0),
-        "communication_cost": sparse_pod_comm_cost(new_state, sgraph),
+        # collapse identity: an adopted placement colocates every
+        # service's replicas, so its pod-level cost IS the tracked
+        # service-level cut of best_assign; unadopted keeps the input's
+        # (already computed) true cost — no second pod-level pass
+        "communication_cost": jnp.where(improved, best_comm, comm_true0),
         "load_std": load_std(new_state),
         "hub_pass": jnp.asarray(NHB > 0),
     }

@@ -1,0 +1,75 @@
+"""Probe the 50k solve's FIXED-cost suspects: the pod-level comm cost
+scan, the sorted-space prologue, and per-sweep threefry chatter."""
+import runpy, sys, time
+from functools import partial
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import jax, jax.numpy as jnp, numpy as np
+
+bench = runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"))
+state, sg = bench["_sparse50k_problem"]()
+from kubernetes_rescheduling_tpu.solver.sparse_solver import (
+    sparse_pod_comm_cost, sorted_problem_arrays,
+)
+SP = sg.sp
+N = int(state.num_nodes)
+E2 = sg.edges_src.shape[0]
+print(f"E2={E2} P={state.num_pods}", flush=True)
+rng = np.random.default_rng(0)
+assign0 = jnp.asarray(rng.integers(0, N, size=SP), jnp.int32)
+
+def timeit(name, step, k1=20, k2=120):
+    @partial(jax.jit, static_argnames=("kk",))
+    def run(a0, st, g, kk):
+        def body(a, i):
+            return step(a, i, st, g), 0
+        a, _ = jax.lax.scan(body, a0, jnp.arange(kk))
+        return a
+    def best_of(kk, reps=3):
+        out = run(assign0, state, sg, kk); jnp.sum(out).item()
+        best = float("inf")
+        for _ in range(reps):
+            t = time.perf_counter()
+            out = run(assign0, state, sg, kk); jnp.sum(out).item()
+            best = min(best, time.perf_counter() - t)
+        return best
+    ms = (best_of(k2) - best_of(k1)) / (k2 - k1) * 1e3
+    print(f"{name:34s} {ms:8.4f} ms/iter", flush=True)
+
+# 1. pod-level comm cost (the obj_true0 / info twin)
+def pod_cost_step(a, i, st, g):
+    st2 = st.replace(pod_node=jnp.where(st.pod_valid, a[:st.num_pods] % N, st.pod_node))
+    return a.at[0].set(sparse_pod_comm_cost(st2, g).astype(jnp.int32) % N)
+timeit("pod-level comm cost", pod_cost_step)
+
+# 2. sorted-space prologue (aggregates + gathers + rvu)
+def prologue_step(a, i, st, g):
+    st2 = st.replace(pod_node=jnp.where(st.pod_valid, a[:st.num_pods] % N, st.pod_node))
+    sv, sc, sm, cu, rv_s, rvu = sorted_problem_arrays(st2, g, SP)
+    return a.at[0].set((jnp.sum(rv_s) + jnp.sum(rvu)).astype(jnp.int32) % N)
+timeit("sorted prologue (aggr+rvu)", prologue_step)
+
+# 3. W cast to bf16
+def cast_step(a, i, st, g):
+    w = (g.w_local * (1.0 + 0.0 * a[0])).astype(jnp.bfloat16)
+    return a.at[0].set(jnp.sum(w[:, :8]).astype(jnp.int32) % N)
+timeit("W cast f32->bf16", cast_step)
+
+# 4. per-sweep threefry chatter: split(50) + 50 randints + permutation
+def rng_step(a, i, st, g):
+    key = jax.random.fold_in(jax.random.PRNGKey(0), a[0])
+    pk, nk = jax.random.split(key)
+    keys = jax.random.split(nk, 50)
+    tot = jnp.int32(0)
+    for c in range(50):
+        tot = tot + jax.random.randint(keys[c], (), 0, 2**31 - 1)
+    bp = jax.random.permutation(pk, 160)
+    return a.at[0].set((tot + jnp.sum(bp)) % N)
+timeit("sweep PRNG (split+50 randint)", rng_step)
+
+# 5. ONE randint
+def rng1_step(a, i, st, g):
+    key = jax.random.fold_in(jax.random.PRNGKey(0), a[0])
+    return a.at[0].set(jax.random.randint(key, (), 0, 2**31 - 1) % N)
+timeit("one fold_in+randint", rng1_step)
+print("OK", flush=True)

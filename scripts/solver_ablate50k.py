@@ -1,0 +1,55 @@
+"""Ablate the REAL sparse solver at 50k via monkeypatches, slope method:
+(a) baseline, (b) per-sweep COO objective zeroed, (c) hub pass removed
+(timing-only: hub rows simply never move), (d) both. Run ON the TPU."""
+import runpy, sys, time
+from functools import partial
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import jax, jax.numpy as jnp
+
+bench = runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"))
+state, sg = bench["_sparse50k_problem"]()
+import kubernetes_rescheduling_tpu.solver.sparse_solver as ss
+from kubernetes_rescheduling_tpu.solver import GlobalSolverConfig
+
+real_cut = ss.sparse_pair_comm_cost
+
+def solve_ms(sgraph, sweeps, k1=2, k2=8):
+    cfg = GlobalSolverConfig(sweeps=sweeps, swap_every=0)
+
+    @partial(jax.jit, static_argnames=("k",))
+    def chained(st0, g, key0, k):
+        def body(st, i):
+            st_n, inf = ss.global_assign_sparse(
+                st, g, jax.random.fold_in(key0, i), cfg
+            )
+            return st_n, inf["objective_after"]
+        return jax.lax.scan(body, st0, jnp.arange(k))
+
+    def timed(k):
+        _, objs = chained(state, sgraph, jax.random.PRNGKey(7), k)
+        float(objs[-1])
+        best = float("inf")
+        for rep in range(3):
+            t = time.perf_counter()
+            _, objs = chained(state, sgraph, jax.random.PRNGKey(8 + rep), k)
+            float(objs[-1])
+            best = min(best, time.perf_counter() - t)
+        return best
+
+    t1 = timed(k1); t2 = timed(k2)
+    return (t2 - t1) / (k2 - k1) * 1e3
+
+def run(tag, sgraph):
+    s3 = solve_ms(sgraph, 3); s9 = solve_ms(sgraph, 9)
+    per = (s9 - s3) / 6
+    print(f"{tag:24s} s3={s3:7.1f} s9={s9:7.1f}  per-sweep={per:6.2f} fixed={s3-3*per:6.1f}", flush=True)
+
+run("baseline", sg)
+ss.sparse_pair_comm_cost = lambda g, a, rv: jnp.float32(0.0)
+run("objective zeroed", sg)
+ss.sparse_pair_comm_cost = real_cut
+sg_nohub = sg.replace(hub_blocks=())
+run("no hub pass", sg_nohub)
+ss.sparse_pair_comm_cost = lambda g, a, rv: jnp.float32(0.0)
+run("no hubs + obj zeroed", sg_nohub)

@@ -1,0 +1,261 @@
+"""Attribute sparse-solver device time to components at 50k x 2k.
+
+Same scan-chained slope discipline as scripts/sparse_ablate.py, at the
+flagship sparse scale, to locate the per-chunk fixed cost the round-4/5
+measurements diagnosed (59 chunk steps/sweep x ~0.35 ms). Run ON the TPU.
+"""
+
+import runpy
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R
+from kubernetes_rescheduling_tpu.core.sparsegraph import sparse_pair_comm_cost
+from kubernetes_rescheduling_tpu.ops.fused_admission import fused_score_admission
+from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+    chunk_local_slabs,
+    hub_neighbor_mass,
+    hub_tile_arrays,
+    sparse_neighbor_mass,
+)
+
+bench = runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"))
+state, sg = bench["_sparse50k_problem"]()
+SP = sg.sp
+N = int(state.num_nodes)
+NHB = len(sg.hub_blocks)
+NBR = len(sg.regular_blocks)
+print(
+    f"S={sg.num_services} SP={SP} N={N} blocks={sg.num_blocks} hub={NHB} "
+    f"regular={NBR} TU={sg.w_local.shape[1]} u_reg={sg.u_reg} "
+    f"reg_tiles={sg.reg_tiles} chunks/sweep={-(-NBR // 4)}"
+)
+
+rng = np.random.default_rng(0)
+assign0 = jnp.asarray(rng.integers(0, N, size=SP), jnp.int32)
+rv = jnp.asarray((rng.random(SP) > 0.02).astype(np.float32))
+rvu = jnp.where(sg.u_ids < SP, rv[jnp.clip(sg.u_ids, 0, SP - 1)], 0.0)
+w_mm = sg.w_local.astype(jnp.bfloat16)
+toff = jnp.asarray(sg.block_toff, jnp.int32)
+blocks = jnp.asarray(sg.regular_blocks[:4], jnp.int32)
+ids = (np.asarray(blocks)[:, None] * BLOCK_R + np.arange(BLOCK_R)).reshape(-1)
+ids_j = jnp.asarray(ids)
+
+cpu_load = jnp.asarray(rng.random(N) * 1000, jnp.float32)
+mem_load = jnp.zeros(N)
+cap = jnp.full(N, 2000.0)
+mem_cap = jnp.full(N, jnp.inf)
+node_valid = jnp.ones(N, bool)
+c_cpu = jnp.asarray(rng.random(1024) * 100, jnp.float32)
+c_mem = jnp.zeros(1024)
+valid_c = jnp.ones(1024, bool)
+
+
+def timeit(name, step, k1=100, k2=900):
+    """Slope between two chain lengths — the tunnel RTT and dispatch are
+    the same constant at both, so the slope is pure per-iteration device
+    time (the plain total/k form reads RTT/k ~ 0.6 ms of phantom cost)."""
+
+    @partial(jax.jit, static_argnames=("kk",))
+    def run(a0, kk):
+        def body(a, i):
+            return step(a, i), 0
+        a, _ = jax.lax.scan(body, a0, jnp.arange(kk))
+        return a
+
+    def best_of(kk, reps=3):
+        out = run(assign0, kk)
+        jnp.sum(out).item()  # warm + fence
+        best = float("inf")
+        for _ in range(reps):
+            t = time.perf_counter()
+            out = run(assign0, kk)
+            jnp.sum(out).item()
+            best = min(best, time.perf_counter() - t)
+        return best
+
+    ms = (best_of(k2) - best_of(k1)) / (k2 - k1) * 1e3
+    print(f"{name:34s} {ms:8.4f} ms/iter")
+
+
+# 0. chunk-local slab slice alone
+def slab_step(a, i):
+    starts = toff[blocks] * sg.bu
+    u_c, rvu_c = chunk_local_slabs(sg.u_ids, rvu, starts, sg.u_reg)
+    return a.at[0].set((jnp.sum(u_c) + jnp.sum(rvu_c).astype(jnp.int32)) % N)
+
+timeit("chunk slabs (slices only)", slab_step)
+
+
+# 1. chunk-local tgt gather (KB*u_reg elements from SP table)
+def gather_step(a, i):
+    starts = toff[blocks] * sg.bu
+    u_c, rvu_c = chunk_local_slabs(sg.u_ids, rvu, starts, sg.u_reg)
+    tgt = a[jnp.clip(u_c, 0, SP - 1)]
+    return a.at[0].set(jnp.sum(tgt) % N)
+
+timeit("slabs + tgt gather", gather_step)
+
+
+# 2. regular-chunk mass kernel
+def mass_step(a, i):
+    starts = toff[blocks] * sg.bu
+    u_c, rvu_c = chunk_local_slabs(sg.u_ids, rvu, starts, sg.u_reg)
+    tgt_c = a[jnp.clip(u_c, 0, SP - 1)]
+    M = sparse_neighbor_mass(
+        w_mm, tgt_c, rvu_c, blocks, toff,
+        num_nodes=N, bu=sg.bu, reg_tiles=sg.reg_tiles,
+    )
+    return a.at[0].set(jnp.sum(M).astype(jnp.int32) % N)
+
+timeit("slabs + gather + mass kernel", mass_step)
+
+
+# 3. score+admission epilogue (C=1024, N=2048)
+def place_step(a, i):
+    M = (a[ids_j][:, None] * jnp.ones((1, N))).astype(jnp.float32)
+    new_node, admitted, d_cpu, d_mem = fused_score_admission(
+        M, a[ids_j], c_cpu, c_mem, valid_c,
+        cpu_load, mem_load, cap, mem_cap, node_valid,
+        0.0, 0.5, i.astype(jnp.int32),
+        enforce_capacity=True, use_noise=True, emit_x_rows=False,
+    )
+    return a.at[ids_j].set(new_node)
+
+timeit("score+admission (C=1024)", place_step)
+
+
+# 3b. score kernel alone (drop the admission call: emit prop via a
+# degenerate race) — approximated by enforce_capacity=False which skips
+# the priority matmul path
+def place_nocap_step(a, i):
+    M = (a[ids_j][:, None] * jnp.ones((1, N))).astype(jnp.float32)
+    new_node, admitted, d_cpu, d_mem = fused_score_admission(
+        M, a[ids_j], c_cpu, c_mem, valid_c,
+        cpu_load, mem_load, cap, mem_cap, node_valid,
+        0.0, 0.5, i.astype(jnp.int32),
+        enforce_capacity=False, use_noise=True, emit_x_rows=False,
+    )
+    return a.at[ids_j].set(new_node)
+
+timeit("score+admission (no race)", place_nocap_step)
+
+
+# 4. full chunk step (mass -> place -> commit scatters)
+def full_step(a, i):
+    starts = toff[blocks] * sg.bu
+    u_c, rvu_c = chunk_local_slabs(sg.u_ids, rvu, starts, sg.u_reg)
+    tgt_c = a[jnp.clip(u_c, 0, SP - 1)]
+    M = sparse_neighbor_mass(
+        w_mm, tgt_c, rvu_c, blocks, toff,
+        num_nodes=N, bu=sg.bu, reg_tiles=sg.reg_tiles,
+    )
+    new_node, admitted, d_cpu, d_mem = fused_score_admission(
+        M, a[ids_j], c_cpu, c_mem, valid_c,
+        cpu_load, mem_load, cap, mem_cap, node_valid,
+        0.0, 0.5, i.astype(jnp.int32),
+        enforce_capacity=True, use_noise=True, emit_x_rows=False,
+    )
+    return a.at[ids_j].set(new_node)
+
+timeit("FULL chunk step", full_step)
+
+
+# 5. per-sweep exact objective (COO, E2 edges)
+def obj_step(a, i):
+    c = sparse_pair_comm_cost(sg, a[:SP], rv[:SP])
+    return a.at[0].set(c.astype(jnp.int32) % N)
+
+timeit("objective COO (per sweep)", obj_step)
+
+
+# 6. loads refresh (per sweep)
+svc_cpu = jnp.asarray(rng.random(SP) * 100, jnp.float32)
+def loads_step(a, i):
+    l = jnp.zeros((N + 1,), jnp.float32).at[jnp.where(rv > 0, a, N)].add(svc_cpu)[:N]
+    return a.at[0].set(jnp.sum(l).astype(jnp.int32) % N)
+
+timeit("loads scatter-add (per sweep)", loads_step)
+
+
+# 7. hub mass (one group of <=4 hub blocks as the solver batches them)
+if NHB:
+    hb = sg.hub_blocks[:4]
+    h_col, h_lcol, h_out, h_first = hub_tile_arrays(sg, hb)
+    u_g = jnp.concatenate(
+        [
+            sg.u_ids[
+                sg.block_toff[b] * sg.bu :
+                (sg.block_toff[b] + sg.block_ntiles[b]) * sg.bu
+            ]
+            for b in hb
+        ]
+    )
+    rvu_g = jnp.where(u_g < SP, rv[jnp.clip(u_g, 0, SP - 1)], 0.0)
+
+    def hub_step(a, i):
+        tgt_l = a[jnp.clip(u_g, 0, SP - 1)]
+        M = hub_neighbor_mass(
+            w_mm, tgt_l, rvu_g, h_col, h_lcol, h_out, h_first,
+            num_nodes=N, num_hub_blocks=len(hb), bu=sg.bu,
+        )
+        return a.at[0].set(jnp.sum(M).astype(jnp.int32) % N)
+
+    timeit(f"hub mass group ({len(hb)} blocks)", hub_step)
+
+print("OK")
+
+
+# 8. ALL hub groups (as the solver batches them: KB=4 per group), mass
+# + place, chained — the full per-sweep hub pass
+KB = 4
+hub_groups = []
+for g in range(0, NHB, KB):
+    hb = sg.hub_blocks[g : g + KB]
+    hc = hub_tile_arrays(sg, hb)
+    u_gg = jnp.concatenate(
+        [
+            sg.u_ids[
+                sg.block_toff[b] * sg.bu :
+                (sg.block_toff[b] + sg.block_ntiles[b]) * sg.bu
+            ]
+            for b in hb
+        ]
+    )
+    rvu_gg = jnp.where(u_gg < SP, rv[jnp.clip(u_gg, 0, SP - 1)], 0.0)
+    ids_g = jnp.asarray(
+        np.concatenate(
+            [np.arange(BLOCK_R, dtype=np.int32) + b * BLOCK_R for b in hb]
+        )
+    )
+    hub_groups.append((hb, ids_g, u_gg, rvu_gg, hc))
+    print(f"  hub group {g//KB}: blocks={list(hb)} width={u_gg.shape[0]}")
+
+
+def hub_pass_step(a, i):
+    for hb, ids_g, u_gg, rvu_gg, (hcol, hlcol, hout, hfirst) in hub_groups:
+        tgt_l = a[jnp.clip(u_gg, 0, SP - 1)]
+        M = hub_neighbor_mass(
+            w_mm, tgt_l, rvu_gg, hcol, hlcol, hout, hfirst,
+            num_nodes=N, num_hub_blocks=len(hb), bu=sg.bu,
+        )
+        CG = len(hb) * BLOCK_R
+        new_node, admitted, d_cpu, d_mem = fused_score_admission(
+            M, a[ids_g], c_cpu[:CG], c_mem[:CG], valid_c[:CG],
+            cpu_load, mem_load, cap, mem_cap, node_valid,
+            0.0, 0.5, i.astype(jnp.int32),
+            enforce_capacity=True, use_noise=True, emit_x_rows=False,
+        )
+        a = a.at[ids_g].set(new_node)
+    return a
+
+timeit("FULL hub pass (all groups)", hub_pass_step, k1=50, k2=300)
+print("OK2")

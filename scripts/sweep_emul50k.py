@@ -1,0 +1,113 @@
+"""Emulate ONE full sparse sweep at 50k from measured components, vs the
+real solver's 15.2 ms/sweep slope — to locate overhead beyond the parts."""
+import runpy, sys, time
+from functools import partial
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+
+bench = runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"))
+state, sg = bench["_sparse50k_problem"]()
+from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R, sparse_pair_comm_cost
+from kubernetes_rescheduling_tpu.ops.fused_admission import fused_score_admission
+from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+    chunk_local_slabs, hub_neighbor_mass, hub_tile_arrays, sparse_neighbor_mass,
+)
+SP, N = sg.sp, int(state.num_nodes)
+NBR = len(sg.regular_blocks); NHB = len(sg.hub_blocks)
+KB = 4
+n_chunks = -(-NBR // KB)
+ndummy = n_chunks * KB - NBR
+SPX = SP + ndummy * BLOCK_R
+rng = np.random.default_rng(0)
+rv = jnp.asarray((rng.random(SPX) > 0.02).astype(np.float32))
+rvu = jnp.where(sg.u_ids < SP, rv[jnp.clip(sg.u_ids, 0, SPX - 1)], 0.0)
+toff_ext = jnp.asarray(np.asarray(list(sg.block_toff) + [sg.zero_toff] * ndummy, np.int32))
+reg_ext = jnp.asarray(np.asarray(list(sg.regular_blocks) + [sg.num_blocks + d for d in range(ndummy)], np.int32))
+cpu_load0 = jnp.asarray(rng.random(N) * 1000, jnp.float32)
+mem_load0 = jnp.zeros(N)
+cap = jnp.full(N, 2000.0); mem_cap = jnp.full(N, jnp.inf)
+node_valid = jnp.ones(N, bool)
+svc_cpu = jnp.asarray(rng.random(SPX) * 2, jnp.float32)
+svc_mem = jnp.zeros(SPX)
+svc_valid = jnp.ones(SPX, bool)
+assign0 = jnp.asarray(rng.integers(0, N, size=SPX), jnp.int32)
+
+hub_groups = []
+for g in range(0, NHB, KB):
+    hb = sg.hub_blocks[g:g+KB]
+    ids_g = jnp.asarray(np.concatenate([np.arange(BLOCK_R, dtype=np.int32) + b*BLOCK_R for b in hb]))
+    u_g = jnp.concatenate([sg.u_ids[sg.block_toff[b]*sg.bu:(sg.block_toff[b]+sg.block_ntiles[b])*sg.bu] for b in hb])
+    rvu_g = jnp.where(u_g < SP, rv[jnp.clip(u_g, 0, SPX-1)], 0.0)
+    hub_groups.append((hb, ids_g, u_g, rvu_g, hub_tile_arrays(sg, hb)))
+
+def one_sweep(carry, sweep_key, w_mm):
+    assign, cpu_load, mem_load, best_assign, best_obj = carry
+    perm_key, noise_key = jax.random.split(sweep_key)
+    keys = jax.random.split(noise_key, n_chunks + len(hub_groups))
+    chunk_keys = keys[:n_chunks]
+    def place(inner, ids, M, chunk_key):
+        assign, cpu_load, mem_load = inner
+        seed = jax.random.randint(chunk_key, (), 0, 2**31 - 1)
+        new_node, admitted, d_cpu, d_mem = fused_score_admission(
+            M, assign[ids], svc_cpu[ids], svc_mem[ids], svc_valid[ids],
+            cpu_load, mem_load, cap, mem_cap, node_valid,
+            0.0, 0.5, seed, enforce_capacity=True, use_noise=True,
+            emit_x_rows=False)
+        return (assign.at[ids].set(new_node), cpu_load + d_cpu, mem_load + d_mem), admitted
+    inner = (assign, cpu_load, mem_load)
+    for g, (hb, ids_g, u_g, rvu_g, (hc, hl, ho, hf)) in enumerate(hub_groups):
+        assign = inner[0]
+        tgt_l = assign[jnp.clip(u_g, 0, SPX-1)]
+        M = hub_neighbor_mass(w_mm, tgt_l, rvu_g, hc, hl, ho, hf,
+                              num_nodes=N, num_hub_blocks=len(hb), bu=sg.bu)
+        M = M * rv[ids_g][:, None]
+        inner, _ = place(inner, ids_g, M, keys[n_chunks + g])
+    assign, cpu_load, mem_load = inner
+    bp = jax.random.permutation(perm_key, n_chunks * KB)
+    chunk_blocks = reg_ext[bp].reshape(n_chunks, KB)
+    chunk_ids = (chunk_blocks[:, :, None] * BLOCK_R + jnp.arange(BLOCK_R, dtype=jnp.int32)[None, None, :]).reshape(n_chunks, KB * BLOCK_R)
+    def chunk_step(inner, xs):
+        blocks, ids, ck = xs
+        assign = inner[0]
+        starts = toff_ext[blocks] * sg.bu
+        u_c, rvu_c = chunk_local_slabs(sg.u_ids, rvu, starts, sg.u_reg)
+        tgt_c = assign[jnp.clip(u_c, 0, SPX-1)]
+        M = sparse_neighbor_mass(w_mm, tgt_c, rvu_c, blocks, toff_ext,
+                                 num_nodes=N, bu=sg.bu, reg_tiles=sg.reg_tiles)
+        M = M * rv[ids][:, None]
+        inner, admitted = place(inner, ids, M, ck)
+        return inner, jnp.sum(admitted)
+    (assign, _, _), moves = lax.scan(chunk_step, (assign, cpu_load, mem_load),
+                                     (chunk_blocks, chunk_ids, chunk_keys), unroll=2)
+    a = jnp.where(svc_valid, assign, N)
+    cpu_fresh = jnp.zeros((N+1,), jnp.float32).at[a].add(svc_cpu)[:N]
+    mem_fresh = jnp.zeros((N+1,), jnp.float32).at[a].add(svc_mem)[:N]
+    obj = sparse_pair_comm_cost(sg, assign[:SP], rv[:SP])
+    better = obj < best_obj
+    best_assign = jnp.where(better, assign, best_assign)
+    best_obj = jnp.where(better, obj, best_obj)
+    return (assign, cpu_fresh, mem_fresh, best_assign, best_obj), jnp.sum(moves)
+
+def timeit(name, k1=20, k2=80):
+    @partial(jax.jit, static_argnames=("kk",))
+    def run(a0, g, kk):
+        w_mm = g.w_local.astype(jnp.bfloat16)
+        carry = (a0, cpu_load0, mem_load0, a0, jnp.float32(1e30))
+        def body(c, i):
+            return one_sweep(c, jax.random.fold_in(jax.random.PRNGKey(0), i), w_mm)
+        c, _ = lax.scan(body, carry, jnp.arange(kk))
+        return c[0]
+    def best_of(kk, reps=3):
+        out = run(assign0, sg, kk); jnp.sum(out).item()
+        best = float("inf")
+        for _ in range(reps):
+            t = time.perf_counter()
+            out = run(assign0, sg, kk); jnp.sum(out).item()
+            best = min(best, time.perf_counter() - t)
+        return best
+    ms = (best_of(k2) - best_of(k1)) / (k2 - k1) * 1e3
+    print(f"{name:30s} {ms:8.3f} ms/sweep", flush=True)
+
+timeit("EMULATED full sweep")

@@ -1,0 +1,59 @@
+"""Split the 50k x 2k sparse solve into per-sweep cost and per-solve
+fixed cost: chained-solve slope at two sweep counts. Run ON the TPU.
+
+Per-solve device ms at sweeps=s is  fixed + s * per_sweep;  measuring the
+chained-K slope at s1 and s2 gives both terms.
+"""
+
+import runpy
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+bench = runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"))
+state, sg = bench["_sparse50k_problem"]()
+
+from kubernetes_rescheduling_tpu.solver import (  # noqa: E402
+    GlobalSolverConfig,
+    global_assign_sparse,
+)
+
+
+def solve_ms(sweeps: int, swap_every: int = 0, k1: int = 2, k2: int = 8):
+    cfg = GlobalSolverConfig(sweeps=sweeps, swap_every=swap_every)
+
+    @partial(jax.jit, static_argnames=("k",))
+    def chained(st0, g, key0, k):
+        def body(st, i):
+            st_n, inf = global_assign_sparse(
+                st, g, jax.random.fold_in(key0, i), cfg
+            )
+            return st_n, inf["objective_after"]
+
+        return jax.lax.scan(body, st0, jnp.arange(k))
+
+    def timed(k):
+        _, objs = chained(state, sg, jax.random.PRNGKey(7), k)
+        float(objs[-1])
+        best = float("inf")
+        for rep in range(3):
+            t = time.perf_counter()
+            _, objs = chained(state, sg, jax.random.PRNGKey(8 + rep), k)
+            float(objs[-1])
+            best = min(best, time.perf_counter() - t)
+        return best, float(objs[-1])
+
+    t2, _ = timed(k1)
+    t8, obj = timed(k2)
+    return (t8 - t2) / (k2 - k1) * 1e3, obj
+
+
+for sweeps in (3, 9, 15):
+    ms, obj = solve_ms(sweeps)
+    print(f"sweeps={sweeps:2d}  {ms:7.1f} ms/solve  obj={obj:.0f}", flush=True)

@@ -224,3 +224,87 @@ def test_fused_noise_is_deterministic_per_seed():
     assert not np.array_equal(np.asarray(a1[0]), np.asarray(b[0])) or not (
         np.array_equal(np.asarray(a1[1]), np.asarray(b[1]))
     )
+
+
+def test_sparse_mass_score_matches_two_kernel_path():
+    """The round-5 fused mass+score kernel (one launch, M in VMEM
+    scratch) must reproduce the two-kernel path bit for bit: same mass
+    accumulation order, same shared score_core, fed through the same
+    admission stage."""
+    from kubernetes_rescheduling_tpu.core import sparsegraph
+    from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R
+    from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
+    from kubernetes_rescheduling_tpu.ops.fused_admission import admission_stage
+    from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+        chunk_local_slabs,
+        sparse_mass_score,
+        sparse_neighbor_mass,
+    )
+
+    scn = synthetic_scenario(n_pods=1024, n_nodes=128, powerlaw=True, seed=5)
+    adj = np.asarray(scn.graph.adj)
+    iu, ju = np.nonzero(np.triu(adj, k=1))
+    sg = sparsegraph.from_edges(
+        iu, ju, adj[iu, ju], adj.shape[0], names=scn.graph.names,
+        bu=128, reg_tiles=4,
+    )
+    rng = np.random.default_rng(0)
+    SP, N = sg.sp, 128
+    KB = 2
+    blocks = jnp.asarray(sg.regular_blocks[:KB], jnp.int32)
+    ids = (np.asarray(blocks)[:, None] * BLOCK_R + np.arange(BLOCK_R)).reshape(-1)
+    C = KB * BLOCK_R
+    assign = jnp.asarray(rng.integers(0, N, size=SP), jnp.int32)
+    rv = jnp.asarray(rng.integers(1, 3, size=SP).astype(np.float32))
+    rvu = jnp.where(sg.u_ids < SP, rv[jnp.clip(sg.u_ids, 0, SP - 1)], 0.0)
+    w_mm = sg.w_local.astype(jnp.float32)
+    toff = jnp.asarray(sg.block_toff, jnp.int32)
+    starts = toff[blocks] * sg.bu
+    u_c, rvu_c = chunk_local_slabs(sg.u_ids, rvu, starts, sg.u_reg)
+    tgt_c = assign[jnp.clip(u_c, 0, SP - 1)]
+
+    cur = assign[jnp.asarray(ids)]
+    c_cpu = jnp.asarray(rng.integers(1, 5, size=C) * 10.0, jnp.float32)
+    c_mem = jnp.zeros((C,), jnp.float32)
+    valid_c = jnp.asarray(rng.random(C) < 0.9)
+    cap = jnp.full((N,), 900.0, jnp.float32)
+    cpu_load = jnp.asarray(rng.uniform(0, 800.0, N), jnp.float32)
+    mem_cap = jnp.full((N,), 1e9, jnp.float32)
+    mem_load = jnp.zeros((N,), jnp.float32)
+    node_valid = jnp.asarray(rng.random(N) < 0.95)
+    lam = 0.5
+
+    for mc_pen in (None, jnp.asarray(rng.random(C), jnp.float32)):
+        home = cur if mc_pen is None else jnp.asarray(
+            rng.integers(0, N, size=C), jnp.int32
+        )
+        # two-kernel path: mass kernel -> HBM -> score+admission
+        M = sparse_neighbor_mass(
+            w_mm, tgt_c, rvu_c, blocks, toff,
+            num_nodes=N, bu=sg.bu, reg_tiles=sg.reg_tiles, interpret=True,
+        ) * rv[jnp.asarray(ids)][:, None]
+        exp_node, exp_adm, exp_dc, exp_dm = fused_score_admission(
+            M, cur, c_cpu, c_mem, valid_c,
+            cpu_load, mem_load, cap, mem_cap, node_valid,
+            lam, 0.0, 0,
+            overload_weight=10.0, home=home, move_pen=mc_pen,
+            enforce_capacity=True, use_noise=False, interpret=True,
+            emit_x_rows=False,
+        )
+        # fused path: mass accumulated in VMEM scratch, scored in-kernel
+        prop, gain, wants, s_cpu, s_mem = sparse_mass_score(
+            w_mm, tgt_c, rvu_c, blocks, toff, rv[jnp.asarray(ids)],
+            cur, home, mc_pen, c_cpu, c_mem, valid_c,
+            cpu_load, mem_load, cap, mem_cap, node_valid,
+            lam, 0.0, 0, 10.0,
+            num_nodes=N, bu=sg.bu, reg_tiles=sg.reg_tiles,
+            enforce_capacity=True, use_noise=False, interpret=True,
+        )
+        got_node, got_adm, got_dc, got_dm = admission_stage(
+            prop, gain, wants, s_cpu, s_mem, cur, valid_c, c_cpu, c_mem,
+            num_nodes=N, enforce_capacity=True, interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got_node), np.asarray(exp_node))
+        np.testing.assert_array_equal(np.asarray(got_adm), np.asarray(exp_adm))
+        np.testing.assert_array_equal(np.asarray(got_dc), np.asarray(exp_dc))
+        np.testing.assert_array_equal(np.asarray(got_dm), np.asarray(exp_dm))

@@ -21,6 +21,7 @@ Environment knobs:
   BENCH_SCENARIO  large (default) | powerlaw | dense | mubench |
                   sparse50k (50k services × 2k nodes, sparse solver —
                   a scale the dense form cannot allocate) |
+                  sparse100k (100k × 4k — dense would need ~56 GB) |
                   trace (streaming weight drift at 10k×1k, all steps
                   inside one compiled scan — BASELINE config 5 on chip;
                   honors BENCH_SOLVER) |
@@ -172,9 +173,10 @@ def bench_trace(
     }
 
 
-def _sparse50k_problem():
-    """50k services × 2k nodes: over the dense form's sizing wall — only
-    expressible with the block-local sparse storage."""
+def _sparse_problem(n_services: int, n_nodes: int):
+    """Power-law mesh past the dense form's sizing wall — only
+    expressible with the block-local sparse storage (50k×2k ≈ 0.4 GB
+    sparse vs ≈ 14 GB dense; 100k×4k would need ~56 GB dense)."""
     import numpy as np
 
     from kubernetes_rescheduling_tpu.core import sparsegraph
@@ -184,15 +186,19 @@ def _sparse50k_problem():
     )
 
     rng = np.random.default_rng(0)
-    wm = _random_workmodel(50_000, rng, powerlaw=True, mean_degree=4.0)
+    wm = _random_workmodel(n_services, rng, powerlaw=True, mean_degree=4.0)
     graph = sparsegraph.from_workmodel(wm)
     state = state_from_workmodel(
         wm,
-        node_names=[f"w{i:05d}" for i in range(2_000)],
+        node_names=[f"w{i:05d}" for i in range(n_nodes)],
         node_cpu_cap_m=5_000.0,
         seed=0,
     )
     return state, graph
+
+
+def _sparse50k_problem():
+    return _sparse_problem(50_000, 2_000)
 
 
 def main() -> int:
@@ -221,6 +227,9 @@ def main() -> int:
     if scenario == "sparse50k":
         solver_kind = "sparse"
         state, graph = _sparse50k_problem()
+    elif scenario == "sparse100k":
+        solver_kind = "sparse"
+        state, graph = _sparse_problem(100_000, 4_000)
     else:
         from kubernetes_rescheduling_tpu.bench.harness import make_backend
 

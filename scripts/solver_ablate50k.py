@@ -46,10 +46,18 @@ def run(tag, sgraph):
     print(f"{tag:24s} s3={s3:7.1f} s9={s9:7.1f}  per-sweep={per:6.2f} fixed={s3-3*per:6.1f}", flush=True)
 
 run("baseline", sg)
+# jax.clear_caches between variants: the inner @jax.jit _global_assign_sparse
+# caches its jaxpr on first trace, so a later monkeypatch of the module
+# global is silently ignored on cache hits — without the clear, the
+# "objective zeroed" rows re-measure the UNABLATED baseline (found by
+# review; the first recorded run had exactly that flaw)
 ss.sparse_pair_comm_cost = lambda g, a, rv: jnp.float32(0.0)
+jax.clear_caches()
 run("objective zeroed", sg)
 ss.sparse_pair_comm_cost = real_cut
+jax.clear_caches()
 sg_nohub = sg.replace(hub_blocks=())
 run("no hub pass", sg_nohub)
 ss.sparse_pair_comm_cost = lambda g, a, rv: jnp.float32(0.0)
+jax.clear_caches()
 run("no hubs + obj zeroed", sg_nohub)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R
+from kubernetes_rescheduling_tpu.solver.sparse_solver import hub_slab
 from kubernetes_rescheduling_tpu.core.sparsegraph import sparse_pair_comm_cost
 from kubernetes_rescheduling_tpu.ops.fused_admission import fused_score_admission
 from kubernetes_rescheduling_tpu.ops.sparse_mass import (
@@ -190,16 +191,7 @@ timeit("loads scatter-add (per sweep)", loads_step)
 if NHB:
     hb = sg.hub_blocks[:4]
     h_col, h_lcol, h_out, h_first = hub_tile_arrays(sg, hb)
-    u_g = jnp.concatenate(
-        [
-            sg.u_ids[
-                sg.block_toff[b] * sg.bu :
-                (sg.block_toff[b] + sg.block_ntiles[b]) * sg.bu
-            ]
-            for b in hb
-        ]
-    )
-    rvu_g = jnp.where(u_g < SP, rv[jnp.clip(u_g, 0, SP - 1)], 0.0)
+    u_g, rvu_g = hub_slab(sg, hb, rv, SP)
 
     def hub_step(a, i):
         tgt_l = a[jnp.clip(u_g, 0, SP - 1)]
@@ -221,16 +213,7 @@ hub_groups = []
 for g in range(0, NHB, KB):
     hb = sg.hub_blocks[g : g + KB]
     hc = hub_tile_arrays(sg, hb)
-    u_gg = jnp.concatenate(
-        [
-            sg.u_ids[
-                sg.block_toff[b] * sg.bu :
-                (sg.block_toff[b] + sg.block_ntiles[b]) * sg.bu
-            ]
-            for b in hb
-        ]
-    )
-    rvu_gg = jnp.where(u_gg < SP, rv[jnp.clip(u_gg, 0, SP - 1)], 0.0)
+    u_gg, rvu_gg = hub_slab(sg, hb, rv, SP)
     ids_g = jnp.asarray(
         np.concatenate(
             [np.arange(BLOCK_R, dtype=np.int32) + b * BLOCK_R for b in hb]

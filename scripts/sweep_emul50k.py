@@ -10,6 +10,7 @@ from jax import lax
 bench = runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"))
 state, sg = bench["_sparse50k_problem"]()
 from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R, sparse_pair_comm_cost
+from kubernetes_rescheduling_tpu.solver.sparse_solver import hub_slab
 from kubernetes_rescheduling_tpu.ops.fused_admission import fused_score_admission
 from kubernetes_rescheduling_tpu.ops.sparse_mass import (
     chunk_local_slabs, hub_neighbor_mass, hub_tile_arrays, sparse_neighbor_mass,
@@ -38,8 +39,7 @@ hub_groups = []
 for g in range(0, NHB, KB):
     hb = sg.hub_blocks[g:g+KB]
     ids_g = jnp.asarray(np.concatenate([np.arange(BLOCK_R, dtype=np.int32) + b*BLOCK_R for b in hb]))
-    u_g = jnp.concatenate([sg.u_ids[sg.block_toff[b]*sg.bu:(sg.block_toff[b]+sg.block_ntiles[b])*sg.bu] for b in hb])
-    rvu_g = jnp.where(u_g < SP, rv[jnp.clip(u_g, 0, SPX-1)], 0.0)
+    u_g, rvu_g = hub_slab(sg, hb, rv, SPX)
     hub_groups.append((hb, ids_g, u_g, rvu_g, hub_tile_arrays(sg, hb)))
 
 def one_sweep(carry, sweep_key, w_mm):

@@ -308,3 +308,36 @@ def test_sparse_solver_respects_capacity():
         scn.state, sg, jax.random.PRNGKey(1), GlobalSolverConfig(sweeps=4)
     )
     assert float(capacity_violation(new_state)) <= v_before + 1e-3
+
+
+def test_sparse_pod_comm_cost_fast_and_slow_branches_agree():
+    """The round-5 lax.cond fast path (collapsed placements take the O(E)
+    COO cut) must agree with the general pod-level scan on BOTH branch
+    predicates: a split placement (slow branch) and its per-service
+    collapse (fast branch), each checked against the dense metric."""
+    scn = synthetic_scenario(
+        n_pods=240, n_nodes=8, powerlaw=True, seed=11, replicas=3
+    )
+    sg = sparsegraph.from_comm_graph(scn.graph)
+    rng = np.random.default_rng(1)
+    split = scn.state.replace(
+        pod_node=jnp.asarray(
+            rng.integers(0, 8, size=scn.state.num_pods), jnp.int32
+        )
+    )
+    assert float(communication_cost(split, scn.graph)) == pytest.approx(
+        float(sparse_pod_comm_cost(split, sg)), rel=1e-6
+    )
+    # collapse: every pod moves to its service's first pod's node
+    svc_first = np.full(scn.graph.num_services, -1, np.int64)
+    pn = np.asarray(split.pod_node)
+    ps = np.asarray(split.pod_service)
+    for p in range(scn.state.num_pods):
+        if svc_first[ps[p]] < 0:
+            svc_first[ps[p]] = pn[p]
+    collapsed = split.replace(
+        pod_node=jnp.asarray(svc_first[ps], jnp.int32)
+    )
+    assert float(communication_cost(collapsed, scn.graph)) == pytest.approx(
+        float(sparse_pod_comm_cost(collapsed, sg)), rel=1e-6
+    )

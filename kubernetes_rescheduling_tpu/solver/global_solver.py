@@ -302,6 +302,66 @@ def exact_comm_cost(adj, rv, assign):
     )
 
 
+def collapsed_placement(idx, node, counted, size: int, n):
+    """Collapse detection over ``size`` groups of pods: returns
+    ``(nmin, rv_eff, collapsed)`` where ``nmin`` is each group's lowest
+    counted node (``n`` when empty), ``rv_eff`` its counted-pod count,
+    and ``collapsed`` whether every nonempty group sits on ONE node.
+    ONE definition shared by the dense (:func:`input_comm_cost`) and
+    sparse (``sparse_solver.sparse_pod_comm_cost``) fast-path
+    predicates — their cond routing must stay semantically identical
+    to each twin's slow branch, so the masking lives here, never in
+    one caller alone. ``counted`` must already exclude pods outside
+    ``[0, n)`` and ``idx`` must be in ``[0, size)`` wherever counted."""
+    idx_c = jnp.where(counted, idx, size)
+    node_c = jnp.where(counted, node, n).astype(jnp.int32)
+    nmin = jnp.full((size + 1,), n, jnp.int32).at[idx_c].min(node_c)[:size]
+    nmax = (
+        jnp.full((size + 1,), -1, jnp.int32)
+        .at[idx_c]
+        .max(jnp.where(counted, node_c, -1))[:size]
+    )
+    rv_eff = (
+        jnp.zeros((size + 1,), jnp.float32)
+        .at[idx_c]
+        .add(jnp.where(counted, 1.0, 0.0))[:size]
+    )
+    return nmin, rv_eff, jnp.all((rv_eff == 0) | (nmin == nmax))
+
+
+def input_comm_cost(state, graph):
+    """``objectives.metrics.communication_cost`` with a collapsed fast
+    path (round 5): the occ@occᵀ quadratic form costs ~4 ms at 10k×1k
+    (a 200-GFLOP f32 matmul), but it is only NEEDED when some service's
+    replicas are split across nodes — every solver output colocates
+    them, so chained production solves always present a collapsed
+    placement. Three pod scatters detect that case (mirroring
+    ``service_node_counts``' pod masking exactly) and ``lax.cond``
+    routes it to the direct cut-sum; split inputs keep the general
+    quadratic form. The two branches compute the same mathematical
+    quantity (cross pairs = rv_s·rv_t·[a_s≠a_t] when collapsed); f32
+    summation order differs, so agreement is to ulps, not bitwise —
+    same contract as the sparse twin's fast path."""
+    num_s = graph.num_services
+    n = state.num_nodes
+    svc = jnp.where(state.pod_valid, state.pod_service, num_s)
+    node = jnp.clip(jnp.where(state.pod_valid, state.pod_node, n), -1, n)
+    counted = state.pod_valid & (node >= 0) & (node < n)
+    nmin, rv_eff, collapsed = collapsed_placement(svc, node, counted, num_s, n)
+
+    def fast(_):
+        # valid-service masking via the rv factors (communication_cost
+        # masks adj on both axes; a zero rv on either side is equivalent)
+        return exact_comm_cost(
+            graph.adj, rv_eff * graph.service_valid, nmin
+        )
+
+    def slow(_):
+        return communication_cost(state, graph)
+
+    return lax.cond(collapsed, fast, slow, None)
+
+
 def restart_bill_from_arrays(pod_mask, pod_node, tgt, move_cost):
     """Array-level core of :func:`pod_restart_bill` — also used inside
     shard_map bodies, where only the raw pod arrays are in scope."""
@@ -797,7 +857,7 @@ def global_assign(
     pct_true0 = jnp.where(
         state.node_valid, state.node_cpu_used() / cap * 100.0, 0.0
     )
-    comm_true0 = communication_cost(state, graph)
+    comm_true0 = input_comm_cost(state, graph)
     obj_true0 = (
         comm_true0
         + config.balance_weight * (load_std(state) / config.capacity_frac)

@@ -72,6 +72,7 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     _pad_to,
     _service_aggregates,
     auto_chunk,
+    collapsed_placement,
     pct_balance_terms,
     pod_restart_bill,
 )
@@ -112,22 +113,11 @@ def sparse_pod_comm_cost(
     slot = jnp.where(state.pod_valid, pod_slot, SP)
     node = jnp.clip(jnp.where(state.pod_valid, state.pod_node, N), -1, N)
     # pods counted by the general form: valid AND placed on a real node
-    # (node −1 / N fall into sliced-off scatter columns below)
+    # (node −1 / N fall into sliced-off scatter columns below); the
+    # detection itself is the shared `collapsed_placement` — the dense
+    # twin's predicate cannot drift from this one
     placed = state.pod_valid & (node >= 0) & (node < N)
-    slot_p = jnp.where(placed, slot, SP)
-    node_p = jnp.where(placed, node, N).astype(jnp.int32)
-    nmin = jnp.full((SP + 1,), N, jnp.int32).at[slot_p].min(node_p)[:SP]
-    nmax = (
-        jnp.full((SP + 1,), -1, jnp.int32)
-        .at[slot_p]
-        .max(jnp.where(placed, node_p, -1))[:SP]
-    )
-    rv_eff = (
-        jnp.zeros((SP + 1,), jnp.float32)
-        .at[slot_p]
-        .add(jnp.where(placed, 1.0, 0.0))[:SP]
-    )
-    collapsed = jnp.all((rv_eff == 0) | (nmin == nmax))
+    nmin, rv_eff, collapsed = collapsed_placement(slot, node, placed, SP, N)
 
     def fast(_):
         # every counted service sits on one node: the pod cost IS the

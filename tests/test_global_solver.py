@@ -326,3 +326,36 @@ def test_prepared_weights_identical_solve():
         np.asarray(st_a.pod_node), np.asarray(st_b.pod_node)
     )
     assert float(info_a["objective_after"]) == float(info_b["objective_after"])
+
+
+def test_input_comm_cost_fast_and_slow_branches_agree():
+    """The dense collapsed fast path (round 5) must agree with the
+    occ@occᵀ quadratic form on both branch predicates: a split placement
+    (slow) and its per-service collapse (fast)."""
+    from kubernetes_rescheduling_tpu.objectives import communication_cost
+    from kubernetes_rescheduling_tpu.solver.global_solver import (
+        input_comm_cost,
+    )
+
+    scn = synthetic_scenario(
+        n_pods=240, n_nodes=8, powerlaw=True, seed=12, replicas=3
+    )
+    rng = np.random.default_rng(2)
+    split = scn.state.replace(
+        pod_node=jnp.asarray(
+            rng.integers(0, 8, size=scn.state.num_pods), jnp.int32
+        )
+    )
+    assert float(input_comm_cost(split, scn.graph)) == pytest.approx(
+        float(communication_cost(split, scn.graph)), rel=1e-6
+    )
+    svc_first = np.full(scn.graph.num_services, -1, np.int64)
+    pn = np.asarray(split.pod_node)
+    ps = np.asarray(split.pod_service)
+    for p in range(scn.state.num_pods):
+        if svc_first[ps[p]] < 0:
+            svc_first[ps[p]] = pn[p]
+    collapsed = split.replace(pod_node=jnp.asarray(svc_first[ps], jnp.int32))
+    assert float(input_comm_cost(collapsed, scn.graph)) == pytest.approx(
+        float(communication_cost(collapsed, scn.graph)), rel=1e-6
+    )

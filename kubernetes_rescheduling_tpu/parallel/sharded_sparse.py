@@ -148,11 +148,20 @@ def _solve_factory(
             over = lax.psum(jnp.sum(jnp.maximum(pct - 100.0, 0.0)), "tp")
             return config.balance_weight * jnp.sqrt(var) + ow * over
 
+        # per-edge rv-weighted weight, PRECOMPUTED once per solve: rv is
+        # fixed across sweeps, so the per-sweep objective gathers only the
+        # two assign columns instead of four (measured ~half the 2.6
+        # ms/sweep objective cost at 50k). Product grouping matches the
+        # old inline form ((w·rv_s)·rv_t) term for term — the per-sweep
+        # value is BIT-IDENTICAL, and identical to the single-chip sparse
+        # solver's (the tp bit-parity contract).
+        e_rvw = e_w * rv_s[e_src] * rv_s[e_dst]
+
         def objective(assign, cpu_l):
             """EXACT sparse cut-sum (replicated — every shard computes the
             same value from the replicated edge list) + psum'd balance."""
             cut = (assign[e_src] != assign[e_dst]).astype(jnp.float32)
-            comm = 0.5 * jnp.sum(e_w * rv_s[e_src] * rv_s[e_dst] * cut)
+            comm = 0.5 * jnp.sum(e_rvw * cut)
             return comm + _balance_terms(cpu_l)
 
         # disruption pricing: penalized per-sweep ranking, raw exact return

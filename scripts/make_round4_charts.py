@@ -41,11 +41,11 @@ SCALE = [
     {"scale": "2k×200", "services": 2_000, "solver": "dense", "ms": 4.2},
     {"scale": "10k×1k", "services": 10_000, "solver": "dense", "ms": 31.3},
     {"scale": "20k×2k", "services": 20_000, "solver": "dense", "ms": 159.0},
-    {"scale": "10k×1k", "services": 10_000, "solver": "sparse", "ms": 30.5},
-    {"scale": "20k×2k", "services": 20_000, "solver": "sparse", "ms": 67.9},
-    {"scale": "50k×2k", "services": 50_000, "solver": "sparse", "ms": 175.4},
+    {"scale": "10k×1k", "services": 10_000, "solver": "sparse", "ms": 29.7},
+    {"scale": "20k×2k", "services": 20_000, "solver": "sparse", "ms": 58.3},
+    {"scale": "50k×2k", "services": 50_000, "solver": "sparse", "ms": 148.8},
     {"scale": "50k×2k", "services": 50_000, "solver": "dense", "ms": None},
-    {"scale": "100k×4k", "services": 100_000, "solver": "sparse", "ms": 394.1},
+    {"scale": "100k×4k", "services": 100_000, "solver": "sparse", "ms": 358.6},
     {"scale": "100k×4k", "services": 100_000, "solver": "dense", "ms": None},
 ]
 

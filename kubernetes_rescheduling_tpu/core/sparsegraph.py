@@ -410,12 +410,38 @@ def with_edge_weights(
     )
 
 
+def rv_weighted_edge_w(
+    sgraph: SparseCommGraph, rv_sorted: jax.Array
+) -> jax.Array:
+    """Per-edge rv-weighted weight ``(w·rv_s)·rv_t`` — THE canonical
+    product grouping of the exact cut-sum, shared by
+    :func:`sparse_pair_comm_cost` and both sparse solvers' per-sweep
+    objectives (which precompute it once per solve: rv is fixed across
+    sweeps, so each sweep gathers only the two assign columns). One
+    definition keeps the single-chip ↔ node-sharded objective
+    bit-identical by construction, not by copy."""
+    s, t = sgraph.edges_src, sgraph.edges_dst
+    return sgraph.edges_w * rv_sorted[s] * rv_sorted[t]
+
+
+def edge_cut_sum(
+    sgraph: SparseCommGraph, e_rvw: jax.Array, assign_sorted: jax.Array
+) -> jax.Array:
+    """``0.5·Σ_e e_rvw·[a_s≠a_t]`` over the symmetric COO list (each
+    undirected edge appears twice, hence the 0.5) — the per-sweep half
+    of the exact cut-sum; ``e_rvw`` from :func:`rv_weighted_edge_w`."""
+    cut = (
+        assign_sorted[sgraph.edges_src] != assign_sorted[sgraph.edges_dst]
+    ).astype(jnp.float32)
+    return 0.5 * jnp.sum(e_rvw * cut)
+
+
 def sparse_pair_comm_cost(
     sgraph: SparseCommGraph, assign_sorted: jax.Array, rv_sorted: jax.Array
 ) -> jax.Array:
     """Exact pair-weighted cut ``0.5·Σ_e w_e·rv_s·rv_t·[a_s≠a_t]`` — the
     sparse twin of the dense solver's ``exact_comm_cost`` (a direct sum, so
     error scales with the cut, not with ulp(ΣW))."""
-    s, t = sgraph.edges_src, sgraph.edges_dst
-    cut = (assign_sorted[s] != assign_sorted[t]).astype(jnp.float32)
-    return 0.5 * jnp.sum(sgraph.edges_w * rv_sorted[s] * rv_sorted[t] * cut)
+    return edge_cut_sum(
+        sgraph, rv_weighted_edge_w(sgraph, rv_sorted), assign_sorted
+    )

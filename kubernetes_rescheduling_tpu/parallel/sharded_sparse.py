@@ -150,11 +150,13 @@ def _solve_factory(
 
         # per-edge rv-weighted weight, PRECOMPUTED once per solve: rv is
         # fixed across sweeps, so the per-sweep objective gathers only the
-        # two assign columns instead of four (measured ~half the 2.6
-        # ms/sweep objective cost at 50k). Product grouping matches the
-        # old inline form ((w·rv_s)·rv_t) term for term — the per-sweep
-        # value is BIT-IDENTICAL, and identical to the single-chip sparse
-        # solver's (the tp bit-parity contract).
+        # two assign columns instead of four (measured ~2.4 of the 2.6
+        # ms/sweep objective cost at 50k). The expression mirrors
+        # core.sparsegraph.rv_weighted_edge_w/edge_cut_sum — the canonical
+        # grouping the single-chip solver uses via those helpers (only
+        # raw arrays are in scope inside shard_map); the per-sweep value
+        # is BIT-IDENTICAL across the two paths (the tp parity contract).
+        # Keep all three in lockstep when changing any.
         e_rvw = e_w * rv_s[e_src] * rv_s[e_dst]
 
         def objective(assign, cpu_l):

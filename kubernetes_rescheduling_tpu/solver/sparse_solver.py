@@ -49,6 +49,8 @@ from jax import lax
 from kubernetes_rescheduling_tpu.core.sparsegraph import (
     BLOCK_R,
     SparseCommGraph,
+    edge_cut_sum,
+    rv_weighted_edge_w,
     sparse_pair_comm_cost,
 )
 from kubernetes_rescheduling_tpu.core.state import ClusterState
@@ -326,13 +328,11 @@ def _global_assign_sparse(
 
     # per-edge rv-weighted weight, PRECOMPUTED once per solve: rv is fixed
     # across sweeps, so the per-sweep cut-sum gathers only the two assign
-    # columns instead of four (~half the 2.6 ms/sweep objective cost at
-    # 50k). Product grouping matches sparse_pair_comm_cost term for term
-    # ((w·rv_s)·rv_t), so the value is BIT-IDENTICAL to it — and to the
-    # node-sharded solver's twin, which precomputes the same way (the tp
-    # bit-parity contract).
-    e_src, e_dst = sgraph.edges_src, sgraph.edges_dst
-    e_rvw = sgraph.edges_w * rv_s[e_src] * rv_s[e_dst]
+    # columns instead of four (~2.4 of the 2.6 ms/sweep objective cost at
+    # 50k). The canonical grouping lives in core.sparsegraph — the value
+    # is BIT-IDENTICAL to sparse_pair_comm_cost and to the node-sharded
+    # twin's (the tp bit-parity contract) by shared definition.
+    e_rvw = rv_weighted_edge_w(sgraph, rv_s)
 
     def objective_terms(assign, cpu_load):
         """(exact comm, ranking objective) — the sparse cut-sum is O(E),
@@ -342,8 +342,7 @@ def _global_assign_sparse(
         reuses it via the collapse identity (every adopted placement
         colocates each service's replicas) instead of paying a second
         pod-level accounting pass."""
-        cut = (assign[e_src] != assign[e_dst]).astype(jnp.float32)
-        comm = 0.5 * jnp.sum(e_rvw * cut)
+        comm = edge_cut_sum(sgraph, e_rvw, assign)
         obj = comm + _balance_terms(cpu_load)
         # penalized ranking under disruption pricing: a sweep that wins on
         # comm but spends more restarts than the win is worth loses

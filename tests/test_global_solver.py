@@ -341,11 +341,10 @@ def test_input_comm_cost_fast_and_slow_branches_agree():
         n_pods=240, n_nodes=8, powerlaw=True, seed=12, replicas=3
     )
     rng = np.random.default_rng(2)
-    split = scn.state.replace(
-        pod_node=jnp.asarray(
-            rng.integers(0, 8, size=scn.state.num_pods), jnp.int32
-        )
-    )
+    nodes = rng.integers(0, 8, size=scn.state.num_pods)
+    nodes[rng.random(scn.state.num_pods) < 0.1] = -1  # unplaced pods:
+    # excluded from the accounting by BOTH branches (and by the metric)
+    split = scn.state.replace(pod_node=jnp.asarray(nodes, jnp.int32))
     assert float(input_comm_cost(split, scn.graph)) == pytest.approx(
         float(communication_cost(split, scn.graph)), rel=1e-6
     )

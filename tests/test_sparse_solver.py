@@ -320,11 +320,10 @@ def test_sparse_pod_comm_cost_fast_and_slow_branches_agree():
     )
     sg = sparsegraph.from_comm_graph(scn.graph)
     rng = np.random.default_rng(1)
-    split = scn.state.replace(
-        pod_node=jnp.asarray(
-            rng.integers(0, 8, size=scn.state.num_pods), jnp.int32
-        )
-    )
+    nodes = rng.integers(0, 8, size=scn.state.num_pods)
+    nodes[rng.random(scn.state.num_pods) < 0.1] = -1  # unplaced pods:
+    # excluded from the accounting by BOTH branches (and by the metric)
+    split = scn.state.replace(pod_node=jnp.asarray(nodes, jnp.int32))
     assert float(communication_cost(split, scn.graph)) == pytest.approx(
         float(sparse_pod_comm_cost(split, sg)), rel=1e-6
     )

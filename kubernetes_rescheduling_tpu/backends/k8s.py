@@ -37,6 +37,13 @@ from kubernetes_rescheduling_tpu.core.quantities import cpu_to_millicores, mem_t
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph, UNASSIGNED
 from kubernetes_rescheduling_tpu.core.workmodel import Workmodel
 
+# telemetry.accounting is jax-free by design — safe here despite the
+# adapter's never-imports-jax contract
+from kubernetes_rescheduling_tpu.telemetry.accounting import (
+    count_reconcile,
+    timed_call,
+)
+
 logger = logging.getLogger(__name__)
 
 # policy name -> how the reference pins the re-created Deployment
@@ -270,6 +277,10 @@ class K8sBackend:
 
     def monitor(self) -> ClusterState:
         """Build the padded snapshot (reference podmonitor.py:7-125)."""
+        with timed_call("k8s", "monitor"):
+            return self._monitor()
+
+    def _monitor(self) -> ClusterState:
         nodes = self.core_api.list_node(watch=False)
         node_names = self._worker_names(nodes)
         cap_cpu: dict[str, float] = {}
@@ -531,6 +542,10 @@ class K8sBackend:
         delete_replaced_pod.py:144-185 + rescheduling.py:57-73). Returns the
         landing node on success (the advisory target for ``affinityOnly`` —
         the live scheduler's pick is only observable at the next monitor)."""
+        with timed_call("k8s", "apply_move"):
+            return self._apply_move(move)
+
+    def _apply_move(self, move: MoveRequest) -> str | None:
         if move.pod is not None:
             # deleting one pod of a Deployment only makes its ReplicaSet
             # re-create it wherever the scheduler likes — there is no
@@ -597,6 +612,8 @@ class K8sBackend:
         # the floor keeps a fake-client test run from zeroing the accounting
         self._wait_ready(name)
         self.reconcile_delay_s = max(time.monotonic() - t0, 1e-3)
+        # a whole-Deployment move restarts every replica
+        count_reconcile("k8s", int(body["spec"].get("replicas") or 1))
         return move.target_node
 
     def advance(self, seconds: float) -> None:

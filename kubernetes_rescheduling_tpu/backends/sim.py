@@ -28,6 +28,10 @@ import numpy as np
 from kubernetes_rescheduling_tpu.backends.base import MoveRequest
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph, UNASSIGNED
 from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, kahn_traversal
+from kubernetes_rescheduling_tpu.telemetry.accounting import (
+    count_reconcile,
+    timed_call,
+)
 
 
 @dataclass
@@ -109,6 +113,10 @@ class SimBackend:
 
     def monitor(self) -> ClusterState:
         """Snapshot with load-model CPU usage (reference podmonitor.monitor)."""
+        with timed_call("sim", "monitor"):
+            return self._monitor()
+
+    def _monitor(self) -> ClusterState:
         rps = self.load.service_rps(self.workmodel)
         replicas = {s.name: max(1, s.replicas) for s in self.workmodel.services}
         services, nodes, cpus, mems, names = [], [], [], [], []
@@ -157,6 +165,10 @@ class SimBackend:
         model the kubescheduling policy kernel implements. The requested
         target is advisory for that mechanism, exactly as on a real cluster.
         """
+        with timed_call("sim", "apply_move"):
+            return self._apply_move(move)
+
+    def _apply_move(self, move: MoveRequest) -> str | None:
         if move.service not in self._svc_index:
             return None
         if move.mechanism == "affinityOnly":
@@ -178,6 +190,8 @@ class SimBackend:
                 if move.pod is not None:
                     break  # a pod name matches at most one entry
         self.clock_s += self.reconcile_delay_s
+        if moved:
+            count_reconcile("sim", moved)
         landed = self.node_names[target]
         self.events.append(
             {
@@ -248,6 +262,8 @@ class SimBackend:
                 pod[1] = t
                 landed.append(pod[2])
         self.clock_s += self.reconcile_delay_s
+        if landed:
+            count_reconcile("sim", len(landed))
         self.events.append(
             {
                 "t": self.clock_s,

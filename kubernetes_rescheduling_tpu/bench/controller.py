@@ -25,6 +25,12 @@ from kubernetes_rescheduling_tpu.backends.k8s import PlacementMechanism
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.telemetry import (
+    get_registry,
+    instrument_jit,
+    pull,
+    span,
+)
 from kubernetes_rescheduling_tpu.utils.checkpoint import CheckpointManager
 from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
 from kubernetes_rescheduling_tpu.utils.profiling import LatencyHistogram
@@ -47,6 +53,11 @@ class RoundRecord:
     load_std: float
     services_moved: tuple[str, ...] = ()  # every Deployment recreated this round
     decision_latencies_s: tuple[float, ...] = ()  # one sample per decide/solve
+    # global rounds: the solver's own before/after accounting (its info
+    # dict), surfaced instead of dropped — None on greedy rounds
+    objective_before: float | None = None
+    objective_after: float | None = None
+    solver_improved: bool | None = None
 
     @property
     def decision_latency_s(self) -> float:
@@ -92,8 +103,57 @@ class ControllerResult:
 
 
 # the same decision kernel the scanned loop uses (solver.round_loop.decide),
-# jitted for one-round-at-a-time use against a live backend
-_decide = jax.jit(decide)
+# jitted for one-round-at-a-time use against a live backend. Instrumented:
+# jax_traces_total{fn="controller_decide"} must stay at 1 across a
+# steady-state run — a second trace means some argument went
+# shape-polymorphic and every round is paying a recompile.
+_decide = instrument_jit(decide, name="controller_decide")
+
+
+def _emit_round_metrics(registry, algorithm: str, record: "RoundRecord") -> None:
+    """One metric sample set per completed round — the registry twin of
+    the logger's per-round event (one definition; the counts the
+    one-event-per-round test pins come from here)."""
+    lab = {"algorithm": algorithm}
+    registry.counter(
+        "rounds_total", "rescheduling rounds executed", labelnames=("algorithm",)
+    ).labels(**lab).inc()
+    registry.counter(
+        "services_moved_total",
+        "deployments recreated by rescheduling moves",
+        labelnames=("algorithm",),
+    ).labels(**lab).inc(len(record.services_moved))
+    hist = registry.histogram(
+        "decision_seconds",
+        "device-side decision latency per decide/solve",
+        labelnames=("algorithm",),
+    ).labels(**lab)
+    for s in record.decision_latencies_s:
+        hist.observe(s)
+    registry.gauge(
+        "communication_cost",
+        "communication cost after the most recent round",
+        labelnames=("algorithm",),
+    ).labels(**lab).set(record.communication_cost)
+    registry.gauge(
+        "load_std",
+        "node CPU-% standard deviation after the most recent round",
+        labelnames=("algorithm",),
+    ).labels(**lab).set(record.load_std)
+    # some restart paths report only one of the two objectives — gate each
+    # gauge on its own field so the other still surfaces
+    if record.objective_before is not None:
+        registry.gauge(
+            "solver_objective_before",
+            "solver objective of the incoming placement (global rounds)",
+            labelnames=("algorithm",),
+        ).labels(**lab).set(record.objective_before)
+    if record.objective_after is not None:
+        registry.gauge(
+            "solver_objective_after",
+            "solver objective of the adopted placement (global rounds)",
+            labelnames=("algorithm",),
+        ).labels(**lab).set(record.objective_after)
 
 
 def run_controller(
@@ -105,6 +165,7 @@ def run_controller(
     checkpoint_dir: str | None = None,
     logger: StructuredLogger | None = None,
     graph=None,
+    registry=None,
 ) -> ControllerResult:
     """Run ``config.max_rounds`` rounds against a backend.
 
@@ -129,8 +190,14 @@ def run_controller(
     decisions the uninterrupted run would have.
 
     ``logger`` records one structured event per round (SURVEY §5.5 gap).
+
+    ``registry`` (default: the process registry) receives one metric
+    sample set per round — counters ``rounds_total``/
+    ``services_moved_total``, the ``decision_seconds`` histogram, and
+    cost/objective gauges — alongside the spans the loop emits.
     """
     config = config.validate()
+    registry = registry if registry is not None else get_registry()
     key = key if key is not None else jax.random.PRNGKey(config.seed)
     # decisions may run on an estimated graph; TELEMETRY always reports on
     # the backend's declared graph so round costs stay comparable across
@@ -166,15 +233,18 @@ def run_controller(
         sub = jax.random.fold_in(key, rnd)
         graph = graph_src()  # fresh estimate per round when streaming
 
-        if config.algorithm == "global" or config.moves_per_round == "all":
-            record = _global_round(backend, state, graph, config, sub, rnd)
-        else:
-            record = _greedy_round(backend, state, graph, config, sub, rnd)
-        backend.advance(config.sleep_after_action_s)
-        state = backend.monitor()
+        with span("controller/round", round=rnd, algorithm=config.algorithm):
+            if config.algorithm == "global" or config.moves_per_round == "all":
+                record = _global_round(backend, state, graph, config, sub, rnd)
+            else:
+                record = _greedy_round(backend, state, graph, config, sub, rnd)
+            backend.advance(config.sleep_after_action_s)
+            with span("backend/monitor"):
+                state = backend.monitor()
         record.communication_cost = float(communication_cost(state, metric_graph))
         record.load_std = float(load_std(state))
         result.rounds.append(record)
+        _emit_round_metrics(registry, config.algorithm, record)
         if logger is not None:
             logger.info(
                 "round",
@@ -185,6 +255,8 @@ def run_controller(
                 communication_cost=record.communication_cost,
                 load_std=record.load_std,
                 decision_latency_s=record.decision_latency_s,
+                objective_before=record.objective_before,
+                objective_after=record.objective_after,
             )
         if on_round is not None:
             on_round(record, state)
@@ -212,9 +284,13 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
     for i in range(k_moves):
         key, sub = jax.random.split(key)
         t0 = time.perf_counter()
-        most, hazard_mask, victim, svc, target = jax.block_until_ready(
-            _decide(state, graph, pid, jnp.asarray(config.hazard_threshold_pct), sub)
-        )
+        with span("controller/decide", round=rnd):
+            most, hazard_mask, victim, svc, target = jax.block_until_ready(
+                _decide(
+                    state, graph, pid,
+                    jnp.asarray(config.hazard_threshold_pct), sub,
+                )
+            )
         latencies.append(time.perf_counter() - t0)
 
         most_i, victim_i, target_i = int(most), int(victim), int(target)
@@ -385,6 +461,29 @@ def _top_gain_moves(
     return [changed[i] for i in sorted(picked)]
 
 
+def _pull_solver_objectives(info):
+    """Host-pull the solver's before/after accounting from its info dict,
+    as ONE counted transfer (the values arrive together). Some restart
+    paths omit ``objective_before``/``improved`` — absent keys come back
+    as None rather than forcing every solver to grow them."""
+    keys = [
+        k for k in ("objective_before", "objective_after", "improved")
+        if k in info
+    ]
+    if not keys:
+        return None, None, None
+    pulled = pull(
+        jnp.stack([jnp.asarray(info[k], jnp.float32) for k in keys]),
+        site="solver_objectives",
+    )
+    d = dict(zip(keys, pulled))
+    return (
+        float(d["objective_before"]) if "objective_before" in d else None,
+        float(d["objective_after"]) if "objective_after" in d else None,
+        bool(d["improved"]) if "improved" in d else None,
+    )
+
+
 def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
     """Per-replica global round: solve on the expanded pod graph, apply
     per-pod moves (MoveRequest.pod). The pod graph is cached per
@@ -405,15 +504,17 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
         cache = (graph, sig, pod_level_graph(state, graph))
         backend._pod_graph_cache = cache
     pod_graph = cache[2]
-    new_state, info = jax.block_until_ready(
-        global_assign_pods(
-            state, graph, key, cfg,
-            pod_graph=pod_graph,
-            n_restarts=config.solver_restarts,
-            tp=config.solver_tp,
+    with span("controller/pod_solve", round=rnd):
+        new_state, info = jax.block_until_ready(
+            global_assign_pods(
+                state, graph, key, cfg,
+                pod_graph=pod_graph,
+                n_restarts=config.solver_restarts,
+                tp=config.solver_tp,
+            )
         )
-    )
     latency = time.perf_counter() - t0
+    obj_before, obj_after, improved = _pull_solver_objectives(info)
 
     old_nodes = np.asarray(state.pod_node)
     new_nodes = np.asarray(new_state.pod_node)
@@ -456,6 +557,9 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
         load_std=0.0,
         services_moved=tuple(sorted(moved_services)) if moved_any else (),
         decision_latencies_s=(latency,),
+        objective_before=obj_before,
+        objective_after=obj_after,
+        solver_improved=improved,
     )
 
 
@@ -483,18 +587,20 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
             cache = (graph, sparsegraph.from_comm_graph(graph))
             backend._sparse_graph_cache = cache
         sparse_graph = cache[1]
-    new_state, info = jax.block_until_ready(
-        solve_with_restarts(
-            state,
-            graph,
-            key,
-            n_restarts=config.solver_restarts,
-            config=cfg,
-            tp=config.solver_tp,
-            sparse_graph=sparse_graph,
+    with span("controller/global_solve", round=rnd):
+        new_state, info = jax.block_until_ready(
+            solve_with_restarts(
+                state,
+                graph,
+                key,
+                n_restarts=config.solver_restarts,
+                config=cfg,
+                tp=config.solver_tp,
+                sparse_graph=sparse_graph,
+            )
         )
-    )
     latency = time.perf_counter() - t0
+    obj_before, obj_after, improved = _pull_solver_objectives(info)
 
     old_nodes = np.asarray(state.pod_node)
     new_nodes = np.asarray(new_state.pod_node)
@@ -543,4 +649,7 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         load_std=0.0,
         services_moved=tuple(moved_names),
         decision_latencies_s=(latency,),
+        objective_before=obj_before,
+        objective_after=obj_after,
+        solver_improved=improved,
     )

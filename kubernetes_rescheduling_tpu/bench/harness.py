@@ -47,6 +47,7 @@ from kubernetes_rescheduling_tpu.config import RescheduleConfig
 from kubernetes_rescheduling_tpu.core.topology import _random_workmodel
 from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, mubench_workmodel_c
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+from kubernetes_rescheduling_tpu.telemetry import get_registry, span, write_manifest
 from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
 
 
@@ -301,6 +302,15 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
         else:
             fp_file.write_text(json.dumps(fingerprint, default=float))
 
+    # provenance next (after the fingerprint gate): even a session that
+    # crashes mid-matrix leaves a record of what ran, on which devices,
+    # from which commit — but a resume must NOT clobber the manifest of
+    # the run that produced the existing cells
+    manifest_file = session / "manifest.json"
+    if manifest_file.is_file():
+        manifest_file = session / "manifest.resume.json"
+    write_manifest(manifest_file, json.loads(json.dumps(cfg_dict, default=float)))
+
     for algo in cfg.algorithms:
         for run_i in range(1, cfg.repeats + 1):
             run_dir = session / algo / f"run_{run_i}"
@@ -464,15 +474,16 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
             crash_probe = getattr(backend, "pod_restart_counts", None)
             crashes_at_start = crash_probe() if crash_probe else None
             t0 = time.perf_counter()
-            result = run_controller(
-                backend,
-                rcfg,
-                key=jax.random.PRNGKey(seed),
-                on_round=on_round,
-                checkpoint_dir=str(run_dir / "checkpoints") if cfg.session_name else None,
-                logger=logger,
-                graph=solve_graph if cfg.observe_weights else None,
-            )
+            with span("bench/run", algorithm=algo, run=run_i):
+                result = run_controller(
+                    backend,
+                    rcfg,
+                    key=jax.random.PRNGKey(seed),
+                    on_round=on_round,
+                    checkpoint_dir=str(run_dir / "checkpoints") if cfg.session_name else None,
+                    logger=logger,
+                    graph=solve_graph if cfg.observe_weights else None,
+                )
             wall_s = time.perf_counter() - t0
             # `restarts` = pods recreated by Deployment moves (the
             # disruption the RESCHEDULER causes) — identical semantics on
@@ -543,6 +554,10 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
             }
             run_marker.write_text(json.dumps(run_record, default=float))
             logger.info("run_complete", moves=result.moves)
+            # cumulative registry snapshot per cell (values are monotone;
+            # the telemetry report reads the LAST sample per series), so a
+            # crash keeps the counters up to the finished cells
+            get_registry().dump_jsonl(run_dir / "metrics.jsonl")
             summary["runs"].append(run_record)
 
     # per-algorithm aggregates (mean over runs). Final-placement metrics

@@ -25,6 +25,8 @@ from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 from kubernetes_rescheduling_tpu.core.workmodel import ServiceSpec, Workmodel
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
 from kubernetes_rescheduling_tpu.solver.global_solver import GlobalSolverConfig
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
+from kubernetes_rescheduling_tpu.telemetry.spans import span
 
 
 @dataclass(frozen=True)
@@ -186,9 +188,10 @@ def replay(
         # solve_with_restarts degrades to the plain single solve at
         # n_restarts<=1 — one dispatch path, same key derivation as the
         # controller's global rounds
-        new_state, _ = solve_with_restarts(
-            state, graph, sub, n_restarts=restarts, config=config
-        )
+        with span("trace/step", t=step.t):
+            new_state, _ = solve_with_restarts(
+                state, graph, sub, n_restarts=restarts, config=config
+            )
         after = float(communication_cost(new_state, graph))
         moves = int(
             np.sum(
@@ -251,8 +254,13 @@ def _replay_run(st0, graph, ii, jj, mults, key0, config):
 
 # module-level jit: repeated calls with the same shapes hit the cache —
 # a per-call closure would retrace the whole k-step scan every call, and
-# the benchmark's timed reps would silently include full recompiles
-_replay_run_jit = jax.jit(_replay_run, static_argnames=("config",))
+# the benchmark's timed reps would silently include full recompiles.
+# instrument_jit makes that guarantee OBSERVABLE: a second
+# jax_traces_total{fn="replay_run"} increment in a steady-shape run means
+# the timings silently include a recompile
+_replay_run_jit = instrument_jit(
+    _replay_run, name="replay_run", static_argnames=("config",)
+)
 
 
 def drift_multipliers_sparse(
@@ -300,7 +308,9 @@ def _replay_sparse_run(st0, sgraph, loc, mults, key0, config):
     return st_f, objs, befores
 
 
-_replay_sparse_jit = jax.jit(_replay_sparse_run, static_argnames=("config",))
+_replay_sparse_jit = instrument_jit(
+    _replay_sparse_run, name="replay_sparse_run", static_argnames=("config",)
+)
 
 
 def replay_on_device_sparse(

@@ -11,6 +11,11 @@ Subcommands:
   solve       one-shot global solve on a scenario, printing objectives
   trace       streaming trace replay (external workmodel/trace streams
               or the builtin Bookinfo canary; BASELINE config 5)
+  telemetry   summarize a run's telemetry artifacts (metrics JSONL,
+              event logs, manifests, Chrome traces) as a report
+
+``reschedule``/``bench``/``trace`` take ``--metrics-out``/``--trace-out``:
+see OBSERVABILITY.md for the artifact set each flag produces.
 """
 
 from __future__ import annotations
@@ -19,6 +24,7 @@ import argparse
 import json
 import os
 import sys
+from pathlib import Path
 
 ALGO_ALIASES = {"car": "communication"}
 
@@ -40,6 +46,21 @@ def _moves_per_round(value: str) -> int | str:
     if n < 1:
         raise argparse.ArgumentTypeError("must be >= 1 (or 'all')")
     return n
+
+
+def _add_telemetry_flags(parser: argparse.ArgumentParser) -> None:
+    """The unified observability outputs, shared by every run command."""
+    parser.add_argument(
+        "--metrics-out", default=None, metavar="PATH",
+        help="write the metrics registry as JSONL here, plus a Prometheus "
+             "text exposition at <PATH stem>.prom and a run manifest at "
+             "<PATH stem>.manifest.json",
+    )
+    parser.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="write host-side spans as Chrome trace-event JSON here "
+             "(load in ui.perfetto.dev); also triggers the run manifest",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -94,6 +115,7 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["service", "pod"],
                    help="pod = every replica places independently (global "
                         "algorithm, sim backend)")
+    _add_telemetry_flags(r)
 
     b = sub.add_parser("bench", help="run the experiment matrix")
     b.add_argument("--backend", default="sim", choices=["sim", "k8s"],
@@ -141,6 +163,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pod = every replica places independently (global "
                         "algorithm, sim backend)")
     b.add_argument("--seed", type=int, default=0)
+    _add_telemetry_flags(b)
 
     t = sub.add_parser(
         "trace",
@@ -168,6 +191,7 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--restarts", type=int, default=1,
                    help="best-of-N solves per trace step over the mesh")
     t.add_argument("--seed", type=int, default=0)
+    _add_telemetry_flags(t)
 
     s = sub.add_parser("solve", help="one-shot global solve")
     s.add_argument("--scenario", default="mubench",
@@ -199,7 +223,51 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--latency-budget", type=float, default=None,
                    help="auto-tune the sweep count to fill this many ms of "
                         "device time per round (overrides --sweeps)")
+
+    m = sub.add_parser(
+        "telemetry",
+        help="summarize telemetry artifacts (metrics JSONL, structured "
+             "event logs, manifests, Chrome traces) as a readable report",
+    )
+    m.add_argument("paths", nargs="+",
+                   help="artifact files; the kind of each is detected from "
+                        "its record shape")
     return p
+
+
+def _write_telemetry_artifacts(args) -> dict | None:
+    """Flush the process registry/tracer to the paths the run asked for.
+    Returns the manifest (also written next to the metrics dump) so the
+    command's JSON output can reference what was recorded."""
+    metrics_out = getattr(args, "metrics_out", None)
+    trace_out = getattr(args, "trace_out", None)
+    if not metrics_out and not trace_out:
+        return None
+    from kubernetes_rescheduling_tpu.telemetry import (
+        get_registry,
+        get_tracer,
+        write_manifest,
+    )
+
+    if metrics_out:
+        registry = get_registry()
+        registry.dump_jsonl(metrics_out)
+        registry.write_exposition(Path(metrics_out).with_suffix(".prom"))
+    if trace_out:
+        get_tracer().export_chrome(trace_out)
+    anchor = Path(metrics_out if metrics_out else trace_out)
+    config = {
+        k: v for k, v in vars(args).items()
+        if k != "command" and not callable(v)
+    }
+    config["command"] = args.command
+    return write_manifest(anchor.with_suffix(".manifest.json"), config)
+
+
+def cmd_telemetry(args) -> str:
+    from kubernetes_rescheduling_tpu.telemetry.report import report
+
+    return report(args.paths)
 
 
 def cmd_reschedule(args) -> dict:
@@ -491,8 +559,13 @@ def main(argv: list[str] | None = None) -> int:
         "bench": cmd_bench,
         "solve": cmd_solve,
         "trace": cmd_trace,
+        "telemetry": cmd_telemetry,
     }[args.command]
     out = handler(args)
+    _write_telemetry_artifacts(args)
+    if isinstance(out, str):  # the telemetry report is already human text
+        print(out)
+        return 0
     json.dump(out, sys.stdout, indent=2, default=float)
     print()
     return 0

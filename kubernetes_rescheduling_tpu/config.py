@@ -10,7 +10,11 @@ capacity enforcement, solver iterations). Loadable from TOML.
 from __future__ import annotations
 
 import dataclasses
-import tomllib
+
+try:
+    import tomllib  # Python >= 3.11
+except ModuleNotFoundError:  # pragma: no cover - depends on interpreter
+    import tomli as tomllib  # type: ignore[no-redef]
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Literal

@@ -377,7 +377,7 @@ def admission_stage(
     interpret: bool = False,
     block_c: int = 256,
     x_dtype=jnp.bfloat16,
-    emit_x_rows: bool = False,
+    emit_x_rows: bool,
 ):
     """The admission-race half of :func:`fused_score_admission`, callable
     on any score stage's outputs (the standalone score kernel or the
@@ -385,7 +385,12 @@ def admission_stage(
     (BC, C) priority block stays small while the full priority matrix
     would not fit VMEM at C ≥ ~1000. The (1, N) load-delta outputs map
     every tile to the same block and accumulate across the sequential
-    grid."""
+    grid.
+
+    ``emit_x_rows`` is keyword-REQUIRED and has no default: it changes the
+    return ARITY (5-tuple with occupancy rows vs 4-tuple without), and
+    :func:`fused_score_admission` defaults the flag the other way — every
+    caller must state which contract it is unpacking."""
     C = prop.shape[0]
     N = int(num_nodes)
     bc = min(block_c, C)

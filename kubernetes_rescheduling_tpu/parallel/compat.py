@@ -1,0 +1,26 @@
+"""JAX version compatibility shims for the parallel layer.
+
+``jax.shard_map`` (with its ``check_vma`` flag) is the stable API on
+recent jax; older releases only ship ``jax.experimental.shard_map`` whose
+equivalent flag is ``check_rep``. One import site so every sharded solver
+works on both — the call sites keep the modern spelling.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:
+    from jax import shard_map  # jax >= 0.6: stable API
+except ImportError:  # pragma: no cover - depends on jax version
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f=None, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return functools.partial(shard_map, **kwargs)
+        return _shard_map_exp(f, **kwargs)
+
+
+__all__ = ["shard_map"]

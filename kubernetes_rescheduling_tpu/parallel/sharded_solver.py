@@ -31,7 +31,9 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from kubernetes_rescheduling_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph

@@ -34,12 +34,15 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from kubernetes_rescheduling_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubernetes_rescheduling_tpu.core.sparsegraph import (
     BLOCK_R,
     SparseCommGraph,
+    rv_weighted_edge_w,
 )
 from kubernetes_rescheduling_tpu.core.state import ClusterState
 from kubernetes_rescheduling_tpu.objectives.metrics import load_std
@@ -126,7 +129,7 @@ def _solve_factory(
     def solve_one(
         assign_init, w_mm, u_ids, rvu, rv_s, svc_valid, svc_cpu, svc_mem,
         toff_ext, reg_ext, hub_ids_all, u_hub_all, rvu_hub_all,
-        e_src, e_dst, e_w,
+        e_src, e_dst, e_rvw,
         cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r,
     ):
         shard = lax.axis_index("tp")
@@ -148,16 +151,14 @@ def _solve_factory(
             over = lax.psum(jnp.sum(jnp.maximum(pct - 100.0, 0.0)), "tp")
             return config.balance_weight * jnp.sqrt(var) + ow * over
 
-        # per-edge rv-weighted weight, PRECOMPUTED once per solve: rv is
-        # fixed across sweeps, so the per-sweep objective gathers only the
-        # two assign columns instead of four (measured ~2.4 of the 2.6
-        # ms/sweep objective cost at 50k). The expression mirrors
-        # core.sparsegraph.rv_weighted_edge_w/edge_cut_sum — the canonical
-        # grouping the single-chip solver uses via those helpers (only
-        # raw arrays are in scope inside shard_map); the per-sweep value
-        # is BIT-IDENTICAL across the two paths (the tp parity contract).
-        # Keep all three in lockstep when changing any.
-        e_rvw = e_w * rv_s[e_src] * rv_s[e_dst]
+        # ``e_rvw`` arrives PRECOMPUTED (``_prep`` calls the canonical
+        # core.sparsegraph.rv_weighted_edge_w outside the shard_map body,
+        # replicated like the rest of the edge list): rv is fixed across
+        # sweeps, so the per-sweep objective gathers only the two assign
+        # columns instead of four (measured ~2.4 of the 2.6 ms/sweep
+        # objective cost at 50k) — and the single-chip and sharded solvers
+        # now share ONE product grouping by construction, so the tp
+        # bit-parity contract cannot drift through a hand-copied formula.
 
         def objective(assign, cpu_l):
             """EXACT sparse cut-sum (replicated — every shard computes the
@@ -388,7 +389,7 @@ def _build_solve_restarts(mesh, config, sgraph_meta, S, N, r_local):
     def solve_r(
         assign_init, w_mm, u_ids, rvu, rv_s, svc_valid, svc_cpu, svc_mem,
         toff_ext, reg_ext, hub_ids_all, u_hub_all, rvu_hub_all,
-        e_src, e_dst, e_w,
+        e_src, e_dst, e_rvw,
         cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l,
         pod_slot, pod_node0, pod_mask, obj_true0, keys_block,
     ):
@@ -396,7 +397,7 @@ def _build_solve_restarts(mesh, config, sgraph_meta, S, N, r_local):
             ba, bo = solve_one(
                 assign_init, w_mm, u_ids, rvu, rv_s, svc_valid, svc_cpu,
                 svc_mem, toff_ext, reg_ext, hub_ids_all, u_hub_all,
-                rvu_hub_all, e_src, e_dst, e_w,
+                rvu_hub_all, e_src, e_dst, e_rvw,
                 cap_l, mem_cap_l, base_cpu_l, base_mem_l, valid_l, keys_r,
             )
             return carry, (ba, bo)
@@ -495,10 +496,15 @@ def _prep(state, sgraph, config, N):
     )
     cap = jnp.where(cpu_cap > 0, cpu_cap, 1.0) * config.capacity_frac
 
+    # per-edge rv-weighted weight through the ONE canonical helper, built
+    # here (outside the shard_map body) and replicated like the rest of
+    # the edge list — the solver bodies consume it directly instead of
+    # re-deriving the product by hand (the three-site bit-parity hazard)
+    e_rvw = rv_weighted_edge_w(sgraph, rv_s)
     args = (
         assign0, w_mm, sgraph.u_ids, rvu, rv_s, svc_valid, svc_cpu_s,
         svc_mem_s, toff_ext, reg_ext, hub_ids_all, u_hub_all, rvu_hub_all,
-        sgraph.edges_src, sgraph.edges_dst, sgraph.edges_w,
+        sgraph.edges_src, sgraph.edges_dst, e_rvw,
         cap, mem_cap, state.node_base_cpu, state.node_base_mem,
         state.node_valid,
     )

@@ -329,25 +329,41 @@ def collapsed_placement(idx, node, counted, size: int, n):
     return nmin, rv_eff, jnp.all((rv_eff == 0) | (nmin == nmax))
 
 
+def comm_cost_collapse(state, graph):
+    """The ``(nmin, rv_eff, collapsed)`` routing inputs of
+    :func:`input_comm_cost`, exposed so the predicate itself is testable
+    (the regression the ADVICE-round-5 fix pins: a split INVALID service
+    must not defeat the collapsed fast path).
+
+    Per-pod SERVICE validity joins the counted predicate: an invalid
+    service contributes zero to BOTH branches (adj is masked on both
+    axes / its rv factor is zeroed), so its pods must not be able to
+    flip ``collapsed`` — one split invalid service would otherwise route
+    every chained solve to the ~4 ms quadratic form."""
+    num_s = graph.num_services
+    n = state.num_nodes
+    svc = jnp.where(state.pod_valid, state.pod_service, num_s)
+    node = jnp.clip(jnp.where(state.pod_valid, state.pod_node, n), -1, n)
+    svc_ok = (svc < num_s) & graph.service_valid[jnp.clip(svc, 0, num_s - 1)]
+    counted = state.pod_valid & (node >= 0) & (node < n) & svc_ok
+    return collapsed_placement(svc, node, counted, num_s, n)
+
+
 def input_comm_cost(state, graph):
     """``objectives.metrics.communication_cost`` with a collapsed fast
     path (round 5): the occ@occᵀ quadratic form costs ~4 ms at 10k×1k
     (a 200-GFLOP f32 matmul), but it is only NEEDED when some service's
     replicas are split across nodes — every solver output colocates
     them, so chained production solves always present a collapsed
-    placement. Three pod scatters detect that case (mirroring
-    ``service_node_counts``' pod masking exactly) and ``lax.cond``
+    placement. Three pod scatters detect that case
+    (:func:`comm_cost_collapse` — ``service_node_counts``' pod masking
+    plus per-pod service validity) and ``lax.cond``
     routes it to the direct cut-sum; split inputs keep the general
     quadratic form. The two branches compute the same mathematical
     quantity (cross pairs = rv_s·rv_t·[a_s≠a_t] when collapsed); f32
     summation order differs, so agreement is to ulps, not bitwise —
     same contract as the sparse twin's fast path."""
-    num_s = graph.num_services
-    n = state.num_nodes
-    svc = jnp.where(state.pod_valid, state.pod_service, num_s)
-    node = jnp.clip(jnp.where(state.pod_valid, state.pod_node, n), -1, n)
-    counted = state.pod_valid & (node >= 0) & (node < n)
-    nmin, rv_eff, collapsed = collapsed_placement(svc, node, counted, num_s, n)
+    nmin, rv_eff, collapsed = comm_cost_collapse(state, graph)
 
     def fast(_):
         # valid-service masking via the rv factors (communication_cost

@@ -35,6 +35,7 @@ from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, l
 from kubernetes_rescheduling_tpu.policies.hazard import detect_hazard
 from kubernetes_rescheduling_tpu.policies.scoring import choose_node
 from kubernetes_rescheduling_tpu.policies.victim import deployment_group, pick_victim
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
 
 
 @struct.dataclass
@@ -104,7 +105,12 @@ def round_step(
     return new_state, telemetry
 
 
-@partial(jax.jit, static_argnames=("rounds",))
+# instrument_jit instead of bare jax.jit: the whole point of the one-scan
+# loop is compiling ONCE per (shape, rounds) signature — the registry's
+# jax_traces_total{fn="run_rounds"} makes a silent retrace (the mystery
+# slowdown class the module-level-jit comments in bench/trace.py guard
+# against by hand) a visible metric and a test assertion
+@partial(instrument_jit, name="run_rounds", static_argnames=("rounds",))
 def run_rounds(
     state: ClusterState,
     graph: CommGraph,

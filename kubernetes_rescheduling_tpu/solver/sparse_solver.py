@@ -106,7 +106,13 @@ def sparse_pod_comm_cost(
     so chained production solves always present a collapsed placement.
     Three pod scatters detect that case and a ``lax.cond`` routes it to
     the O(E) COO cut (exactly the same quantity there, ~2.6 ms at 50k);
-    genuinely split inputs still pay for the exact general accounting."""
+    genuinely split inputs still pay for the exact general accounting.
+
+    Unlike the dense twin (``global_solver.comm_cost_collapse``), the
+    collapse predicate here needs no per-pod service-validity term: a
+    sparse graph's invalid services are its sorted-space PADDING slots,
+    which ``inv`` never maps a pod onto — the dense failure mode (a split
+    invalid-service defeating the fast path) is unrepresentable."""
     SP = sgraph.sp
     N = state.num_nodes
     pod_slot = sgraph.inv[
@@ -594,6 +600,7 @@ def _global_assign_sparse(
                     num_nodes=N,
                     enforce_capacity=config.enforce_capacity,
                     interpret=fused_interpret or not on_tpu,
+                    emit_x_rows=False,  # inline-mass path: 4-tuple return
                 )
                 inner = (
                     assign.at[ids].set(new_node),

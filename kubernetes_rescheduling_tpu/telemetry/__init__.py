@@ -1,0 +1,73 @@
+"""Unified telemetry: metrics registry, span tracing, JAX-aware accounting.
+
+The control loop's value is operational — decisions/sec, per-round latency,
+cost before/after — yet the repo historically observed itself through
+ad-hoc JSONL and hand-rolled timers. This package is the one place all of
+that lives now:
+
+- :mod:`registry` — labeled ``Counter``/``Gauge``/``Histogram`` series
+  with Prometheus text exposition and a JSONL sink. Histograms are
+  fixed-bucket streaming (bounded memory), replacing the unbounded
+  sample-list ``LatencyHistogram``.
+- :mod:`spans` — nested host-side spans (``with span("solve/compile")``)
+  exported as Chrome trace-event JSON (load it in Perfetto), with the
+  ``jax.profiler`` integration folded in (``span(..., profile_dir=...)``).
+- :mod:`accounting` — ``instrument_jit`` counts traces/compiles and
+  lowering time per compiled function; ``pull`` counts device→host
+  transfers. A silent retrace in a hot loop becomes a visible metric.
+- :mod:`manifest` — per-run provenance (config, devices, jax version,
+  git rev).
+- :mod:`report` — summarize a run's JSONL into a human-readable report
+  (the ``telemetry`` CLI subcommand).
+
+Everything routes through one default :class:`MetricsRegistry`
+(:func:`get_registry`) unless a caller injects its own; the registry is
+pure Python (no jax import), so the never-traced k8s adapter can use it
+too.
+"""
+
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.telemetry.spans import (
+    Tracer,
+    get_tracer,
+    set_tracer,
+    span,
+)
+from kubernetes_rescheduling_tpu.telemetry.accounting import (
+    count_reconcile,
+    instrument_jit,
+    pull,
+    publish_round_telemetry,
+    timed_call,
+)
+from kubernetes_rescheduling_tpu.telemetry.manifest import (
+    run_manifest,
+    write_manifest,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "set_registry",
+    "Tracer",
+    "get_tracer",
+    "set_tracer",
+    "span",
+    "count_reconcile",
+    "instrument_jit",
+    "pull",
+    "publish_round_telemetry",
+    "timed_call",
+    "run_manifest",
+    "write_manifest",
+]

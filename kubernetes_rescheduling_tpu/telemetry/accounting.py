@@ -1,0 +1,216 @@
+"""JAX-aware accounting: retrace/compile counters and transfer counts.
+
+The scan'd round loop and the controller's jitted decision kernel are only
+fast while they compile ONCE; a silently shape-polymorphic argument turns
+every call into a full retrace + recompile, and nothing in the program
+output says so (the exact failure mode the module-level-jit comments in
+``bench/trace.py`` guard against by hand). :func:`instrument_jit` makes it
+a metric:
+
+- ``jax_traces_total{fn=...}`` — +1 every time the Python body is traced
+  (i.e. every compilation of a new input signature);
+- ``jax_trace_seconds{fn=...}`` — wall time spent inside the traced body
+  (tracing/lowering, not XLA backend compilation);
+- ``jax_compile_seconds{fn=...}`` — wall time of calls during which a
+  trace occurred (tracing + lowering + XLA compile + the first run);
+- ``jax_calls_total{fn=...}`` — total dispatches.
+
+A steady-state loop therefore shows ``jax_calls_total = N`` and
+``jax_traces_total = 1`` — and a test can assert exactly that.
+
+:func:`pull` counts device→host transfers (the tunnel round trips that
+dominate small-problem latency) as ``device_transfers_total{site=...}``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+
+def instrument_jit(
+    fn: Callable | None = None,
+    *,
+    name: str | None = None,
+    registry: MetricsRegistry | None = None,
+    **jit_kwargs: Any,
+):
+    """``jax.jit`` with trace/compile accounting; usable as a decorator
+    (``@instrument_jit``) or a wrapper (``instrument_jit(f, name=...)``).
+
+    ``registry=None`` resolves the process-default registry AT CALL TIME,
+    so a module-level instrumented jit (e.g. the controller's decision
+    kernel) reports into whatever registry is current when it runs —
+    tests that swap in a fresh registry see the counts.
+    """
+    if fn is None:
+        return functools.partial(
+            instrument_jit, name=name, registry=registry, **jit_kwargs
+        )
+
+    import jax
+
+    fn_label = name or getattr(fn, "__name__", "jit_fn")
+    state = {"traces": 0}
+
+    def _reg() -> MetricsRegistry:
+        return registry if registry is not None else get_registry()
+
+    @functools.wraps(fn)
+    def traced_body(*args, **kwargs):
+        # executes ONLY while jax traces a new input signature
+        reg = _reg()
+        state["traces"] += 1
+        reg.counter(
+            "jax_traces_total",
+            "times a jitted function was traced (= compilations)",
+            labelnames=("fn",),
+        ).labels(fn=fn_label).inc()
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        reg.counter(
+            "jax_trace_seconds",
+            "wall time spent tracing/lowering jitted functions",
+            labelnames=("fn",),
+        ).labels(fn=fn_label).inc(time.perf_counter() - t0)
+        return out
+
+    jitted = jax.jit(traced_body, **jit_kwargs)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        reg = _reg()
+        before = state["traces"]
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        reg.counter(
+            "jax_calls_total", "jitted function dispatches", labelnames=("fn",)
+        ).labels(fn=fn_label).inc()
+        if state["traces"] > before:
+            reg.histogram(
+                "jax_compile_seconds",
+                "wall time of calls that triggered a trace+compile",
+                labelnames=("fn",),
+            ).labels(fn=fn_label).observe(dt)
+        return out
+
+    wrapper.traces = lambda: state["traces"]
+    wrapper.fn_label = fn_label
+    wrapper._jitted = jitted
+    return wrapper
+
+
+def pull(
+    x,
+    site: str = "unnamed",
+    registry: MetricsRegistry | None = None,
+) -> np.ndarray:
+    """Materialize a device value on the host (``np.asarray``) and count
+    the transfer as ``device_transfers_total{site=...}`` — the per-round
+    tunnel round trips become a visible budget instead of ambient cost."""
+    reg = registry if registry is not None else get_registry()
+    reg.counter(
+        "device_transfers_total",
+        "device->host pulls through telemetry.pull",
+        labelnames=("site",),
+    ).labels(site=site).inc()
+    return np.asarray(x)
+
+
+@contextlib.contextmanager
+def timed_call(
+    backend: str,
+    call: str,
+    registry: MetricsRegistry | None = None,
+):
+    """Count one backend API call and observe its latency — the shared
+    instrumentation convention for ``backends/sim.py`` and
+    ``backends/k8s.py`` (``backend_calls_total`` /
+    ``backend_call_seconds``, labeled by backend and call). jax-free, so
+    the never-traced k8s adapter can use it."""
+    reg = registry if registry is not None else get_registry()
+    reg.counter(
+        "backend_calls_total",
+        "backend API calls",
+        labelnames=("backend", "call"),
+    ).labels(backend=backend, call=call).inc()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        reg.histogram(
+            "backend_call_seconds",
+            "backend API call latency",
+            labelnames=("backend", "call"),
+        ).labels(backend=backend, call=call).observe(time.perf_counter() - t0)
+
+
+def count_reconcile(
+    backend: str,
+    pods: int,
+    registry: MetricsRegistry | None = None,
+) -> None:
+    """One reconcile wave (a Deployment re-create or a batched pod-move
+    wave) that restarted ``pods`` pods."""
+    reg = registry if registry is not None else get_registry()
+    reg.counter(
+        "backend_reconciles_total",
+        "reconcile waves applied by a backend",
+        labelnames=("backend",),
+    ).labels(backend=backend).inc()
+    reg.counter(
+        "backend_pods_restarted_total",
+        "pods restarted by reconcile waves",
+        labelnames=("backend",),
+    ).labels(backend=backend).inc(max(int(pods), 0))
+
+
+def publish_round_telemetry(
+    tel,
+    *,
+    algorithm: str = "unknown",
+    registry: MetricsRegistry | None = None,
+) -> dict[str, float]:
+    """Surface a ``solver.round_loop.RoundTelemetry`` (single round or the
+    scan's stacked rounds) through the registry. One host pull for the
+    whole pytree; returns the summary it published."""
+    reg = registry if registry is not None else get_registry()
+    moved = pull(tel.moved, site="round_telemetry", registry=reg)
+    cost = np.asarray(tel.communication_cost, dtype=np.float64)
+    lstd = np.asarray(tel.load_std, dtype=np.float64)
+    rounds = int(moved.size)
+    moves = int(np.sum(moved))
+    reg.counter(
+        "rounds_total", "rescheduling rounds executed", labelnames=("algorithm",)
+    ).labels(algorithm=algorithm).inc(rounds)
+    reg.counter(
+        "moves_total", "rounds that moved a deployment", labelnames=("algorithm",)
+    ).labels(algorithm=algorithm).inc(moves)
+    g_cost = reg.gauge(
+        "communication_cost",
+        "communication cost after the most recent round",
+        labelnames=("algorithm",),
+    ).labels(algorithm=algorithm)
+    g_std = reg.gauge(
+        "load_std",
+        "node CPU-% standard deviation after the most recent round",
+        labelnames=("algorithm",),
+    ).labels(algorithm=algorithm)
+    g_cost.set(float(cost.reshape(-1)[-1]))
+    g_std.set(float(lstd.reshape(-1)[-1]))
+    return {
+        "rounds": rounds,
+        "moves": moves,
+        "communication_cost": float(cost.reshape(-1)[-1]),
+        "load_std": float(lstd.reshape(-1)[-1]),
+    }

@@ -1,0 +1,84 @@
+"""Per-run provenance manifest: config, devices, versions, git rev.
+
+A metrics dump without the run that produced it is noise; the manifest
+makes every ``--metrics-out``/``--trace-out`` artifact self-describing —
+what command ran, on which devices, with which jax, from which commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any
+
+
+def _git_rev(cwd: str | None = None) -> dict[str, Any] | None:
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5, cwd=cwd,
+        )
+        if rev.returncode != 0:
+            return None
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"],
+            capture_output=True, text=True, timeout=5, cwd=cwd,
+        )
+        return {
+            "rev": rev.stdout.strip(),
+            "dirty": bool(dirty.stdout.strip()) if dirty.returncode == 0 else None,
+        }
+    except Exception:
+        return None
+
+
+def _jax_info() -> dict[str, Any]:
+    """Device inventory WITHOUT importing jax on a process that has not
+    already paid for it — importing jax here would initialize a backend
+    as a side effect of writing a manifest."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {"imported": False}
+    try:
+        devices = jax.devices()
+        return {
+            "imported": True,
+            "version": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_count": len(devices),
+            "devices": [str(d) for d in devices],
+        }
+    except Exception as e:  # backend init can fail on misconfigured hosts
+        return {"imported": True, "version": jax.__version__, "error": str(e)}
+
+
+def run_manifest(config: dict[str, Any] | None = None) -> dict[str, Any]:
+    import numpy as np
+
+    return {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "argv": list(sys.argv),
+        "config": config,
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "hostname": platform.node(),
+        "pid": os.getpid(),
+        "numpy": np.__version__,
+        "jax": _jax_info(),
+        "git": _git_rev(cwd=str(Path(__file__).resolve().parent)),
+    }
+
+
+def write_manifest(
+    path: str | Path, config: dict[str, Any] | None = None
+) -> dict[str, Any]:
+    m = run_manifest(config)
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(m, indent=2, default=str))
+    return m

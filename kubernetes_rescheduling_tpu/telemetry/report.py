@@ -1,0 +1,146 @@
+"""Human-readable summaries of a run's telemetry artifacts.
+
+Backs the ``telemetry`` CLI subcommand: point it at the JSONL files a run
+produced (``--metrics-out`` dumps, ``StructuredLogger`` event logs, a
+manifest) and it prints what an operator wants to know — rounds, moves,
+cost trajectory, latency percentiles, retrace counts — without jq
+archaeology. Input kind is detected per file from the record shape.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+
+def _read_jsonl(path: Path) -> list[dict[str, Any]]:
+    records = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line:
+            records.append(json.loads(line))
+    return records
+
+
+def _fmt_hist(rec: dict[str, Any]) -> str:
+    count = rec.get("count", 0)
+    if not count:
+        return "count=0"
+    mean = rec["sum"] / count
+    return (
+        f"count={count} mean={mean * 1e3:.3f}ms "
+        f"min={rec['min'] * 1e3:.3f}ms max={rec['max'] * 1e3:.3f}ms"
+    )
+
+
+def _labels_str(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+def summarize_metrics(records: list[dict[str, Any]]) -> list[str]:
+    """Registry-dump JSONL (``MetricsRegistry.dump_jsonl``) → text lines.
+    When a run appended several snapshots, the LAST sample per series
+    wins (values are cumulative)."""
+    latest: dict[tuple, dict[str, Any]] = {}
+    for rec in records:
+        key = (rec["metric"], tuple(sorted((rec.get("labels") or {}).items())))
+        latest[key] = rec
+    lines = []
+    for (metric, _), rec in sorted(latest.items()):
+        labels = _labels_str(rec.get("labels") or {})
+        if rec.get("type") == "histogram":
+            lines.append(f"  {metric}{labels}  {_fmt_hist(rec)}")
+        else:
+            lines.append(f"  {metric}{labels} = {rec.get('value')}")
+    return lines
+
+
+def summarize_events(records: list[dict[str, Any]]) -> list[str]:
+    """StructuredLogger JSONL → text lines; per-round ``round`` events get
+    the full trajectory treatment, everything else a count by event."""
+    rounds = [r for r in records if r.get("event") == "round"]
+    by_event: dict[str, int] = {}
+    for r in records:
+        by_event[r.get("event", "?")] = by_event.get(r.get("event", "?"), 0) + 1
+    lines = [
+        f"  events: "
+        + ", ".join(f"{k}×{v}" for k, v in sorted(by_event.items()))
+    ]
+    if rounds:
+        moved = sum(1 for r in rounds if r.get("moved"))
+        costs = [
+            r["communication_cost"]
+            for r in rounds
+            if r.get("communication_cost") is not None
+        ]
+        lats = sorted(
+            r["decision_latency_s"]
+            for r in rounds
+            if r.get("decision_latency_s") is not None
+        )
+        lines.append(f"  rounds: {len(rounds)}  moved: {moved}")
+        if costs:
+            lines.append(
+                f"  communication_cost: {costs[0]:.2f} -> {costs[-1]:.2f}"
+            )
+        if lats:
+            def pct(q):
+                return lats[min(int(q / 100 * len(lats)), len(lats) - 1)]
+
+            lines.append(
+                f"  decision latency: p50={pct(50) * 1e3:.2f}ms "
+                f"p90={pct(90) * 1e3:.2f}ms max={lats[-1] * 1e3:.2f}ms"
+            )
+    return lines
+
+
+def summarize_manifest(m: dict[str, Any]) -> list[str]:
+    jx = m.get("jax") or {}
+    git = m.get("git") or {}
+    lines = [
+        f"  run: {m.get('timestamp')}  host: {m.get('hostname')}",
+        f"  argv: {' '.join(m.get('argv') or [])}",
+        f"  python {m.get('python')}  jax {jx.get('version', '?')} "
+        f"({jx.get('backend', '?')} ×{jx.get('device_count', '?')})",
+    ]
+    if git:
+        rev = git.get("rev", "?")[:12]
+        lines.append(f"  git: {rev}{' (dirty)' if git.get('dirty') else ''}")
+    return lines
+
+
+def summarize_file(path: str | Path) -> str:
+    """Detect the artifact kind from its record shape and summarize."""
+    p = Path(path)
+    if not p.is_file():
+        return f"{p}: not a file"
+    header = [f"== {p} =="]
+    text = p.read_text().strip()
+    if not text:
+        return "\n".join(header + ["  (empty)"])
+    if text.startswith("{") and "\n" not in text.split("}")[0] or p.suffix == ".json":
+        # whole-file JSON: a manifest or a Chrome trace
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            obj = None
+        if isinstance(obj, dict):
+            if "traceEvents" in obj:
+                return "\n".join(
+                    header + [f"  chrome trace: {len(obj['traceEvents'])} spans"]
+                )
+            if "argv" in obj or "jax" in obj:
+                return "\n".join(header + summarize_manifest(obj))
+    records = _read_jsonl(p)
+    if records and "metric" in records[0]:
+        return "\n".join(header + summarize_metrics(records))
+    if records and "event" in records[0]:
+        return "\n".join(header + summarize_events(records))
+    return "\n".join(header + [f"  {len(records)} records (unknown schema)"])
+
+
+def report(paths: list[str]) -> str:
+    return "\n".join(summarize_file(p) for p in paths)

@@ -1,0 +1,180 @@
+"""Nested host-side spans, exported as Chrome trace-event JSON.
+
+``with span("solve/compile"):`` wraps any host-side region; spans nest
+naturally (the exporter emits complete events — ``ph: "X"`` — whose
+nesting Perfetto reconstructs from timestamps per thread). The resulting
+file loads directly in https://ui.perfetto.dev or ``chrome://tracing``.
+
+``span(..., profile_dir=...)`` folds the ``jax.profiler`` integration
+(``utils.profiling.trace_to``) under the same API: the host span is
+recorded AND the region runs under a device trace for TensorBoard — one
+call site instead of two nested context managers.
+
+Span durations also feed the metrics registry (histogram
+``span_seconds{span=...}``), so the exposition dump carries per-region
+latency distributions without a second instrumentation pass.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterator
+
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+
+@dataclass(frozen=True)
+class SpanEvent:
+    """One completed span (Chrome trace-event ``ph: "X"`` semantics)."""
+
+    name: str
+    ts_us: float      # wall-clock start, microseconds since the epoch
+    dur_us: float
+    tid: int
+    depth: int
+    args: dict[str, Any] = field(default_factory=dict)
+
+
+class Tracer:
+    """Collects spans; bounded to ``max_events`` (ring semantics — the
+    newest spans win, matching the logger's ring buffer contract)."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        max_events: int = 100_000,
+    ) -> None:
+        self._events: collections.deque[SpanEvent] = collections.deque(
+            maxlen=max_events
+        )
+        self._dropped = 0
+        self._max_events = max_events
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._registry = registry
+        # perf_counter gives monotonic durations; the wall anchor places
+        # them on the epoch axis so traces from separate processes align
+        self._wall_anchor = time.time()
+        self._perf_anchor = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (
+            self._wall_anchor + (time.perf_counter() - self._perf_anchor)
+        ) * 1e6
+
+    def _depth_stack(self) -> list[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        profile_dir: str | None = None,
+        **args: Any,
+    ) -> Iterator[None]:
+        stack = self._depth_stack()
+        depth = len(stack)
+        stack.append(name)
+        t0_us = self._now_us()
+        t0 = time.perf_counter()
+        try:
+            if profile_dir is not None:
+                from kubernetes_rescheduling_tpu.utils.profiling import trace_to
+
+                with trace_to(profile_dir):
+                    yield
+            else:
+                yield
+        finally:
+            dur_s = time.perf_counter() - t0
+            stack.pop()
+            ev = SpanEvent(
+                name=name,
+                ts_us=t0_us,
+                dur_us=dur_s * 1e6,
+                tid=threading.get_ident(),
+                depth=depth,
+                args=args,
+            )
+            with self._lock:
+                if len(self._events) == self._max_events:
+                    self._dropped += 1  # deque evicts the oldest span
+                self._events.append(ev)
+            reg = self._registry if self._registry is not None else get_registry()
+            reg.histogram(
+                "span_seconds",
+                "wall time of named host-side spans",
+                labelnames=("span",),
+            ).labels(span=name).observe(dur_s)
+
+    @property
+    def events(self) -> list[SpanEvent]:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    def to_chrome(self) -> dict[str, Any]:
+        """Chrome trace-event JSON object (Perfetto-loadable)."""
+        pid = os.getpid()
+        events = [
+            {
+                "name": ev.name,
+                "ph": "X",
+                "ts": ev.ts_us,
+                "dur": ev.dur_us,
+                "pid": pid,
+                "tid": ev.tid,
+                "args": {**ev.args, "depth": ev.depth},
+            }
+            for ev in self.events
+        ]
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path: str | Path) -> None:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(self.to_chrome(), default=float))
+
+
+_default_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default_tracer
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process-default tracer; returns the previous one."""
+    global _default_tracer
+    prev = _default_tracer
+    _default_tracer = tracer
+    return prev
+
+
+@contextlib.contextmanager
+def span(name: str, profile_dir: str | None = None, **args: Any):
+    """``with span("solve/compile"):`` on the process-default tracer."""
+    with _default_tracer.span(name, profile_dir=profile_dir, **args):
+        yield

@@ -3,6 +3,7 @@ e.g. main.py:54-115, rescheduling.py:65-68)."""
 
 from __future__ import annotations
 
+import collections
 import json
 import sys
 import time
@@ -15,15 +16,25 @@ _LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
 
 @dataclass
 class StructuredLogger:
-    """JSONL event logger with optional human-readable echo."""
+    """JSONL event logger with optional human-readable echo.
+
+    In-memory retention is a RING buffer of the newest ``max_records``
+    events (a long-running controller logs one event per round forever;
+    an unbounded list was a slow leak). The file/stream sinks still see
+    every event — only the in-process ``records`` view is capped.
+    """
 
     name: str = "krt"
     path: str | Path | None = None
     stream: IO | None = None
     level: str = "info"
     echo: bool = False
+    max_records: int = 4096
 
-    _records: list[dict] = field(default_factory=list, repr=False)
+    _records: collections.deque = field(default=None, repr=False)  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        self._records = collections.deque(maxlen=self.max_records)
 
     def log(self, level: str, event: str, **fields: Any) -> None:
         if _LEVELS.get(level, 20) < _LEVELS.get(self.level, 20):

@@ -11,9 +11,9 @@ from __future__ import annotations
 
 import contextlib
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
-import numpy as np
+from kubernetes_rescheduling_tpu.telemetry.registry import Histogram
 
 
 @dataclass
@@ -30,28 +30,20 @@ class Timer:
         self.elapsed_s = time.perf_counter() - self._t0
 
 
-@dataclass
-class LatencyHistogram:
-    """Streaming latency stats for decision rounds."""
+class LatencyHistogram(Histogram):
+    """Streaming latency stats for decision rounds.
 
-    samples_s: list[float] = field(default_factory=list)
+    Now a fixed-bucket streaming histogram (``telemetry.registry.
+    Histogram``) instead of an unbounded sample list: memory is
+    O(buckets) however long the run, count/mean/max stay exact, and the
+    percentiles are bucket-interpolated estimates (error bounded by the
+    bucket width). ``add``/``summary`` keep the historical API."""
+
+    def __init__(self) -> None:
+        super().__init__("latency_seconds")
 
     def add(self, seconds: float) -> None:
-        self.samples_s.append(seconds)
-
-    def summary(self) -> dict[str, float]:
-        if not self.samples_s:
-            return {"count": 0}
-        a = np.asarray(self.samples_s)
-        return {
-            "count": int(a.size),
-            "mean_ms": float(a.mean() * 1e3),
-            "p50_ms": float(np.percentile(a, 50) * 1e3),
-            "p90_ms": float(np.percentile(a, 90) * 1e3),
-            "p99_ms": float(np.percentile(a, 99) * 1e3),
-            "max_ms": float(a.max() * 1e3),
-            "decisions_per_sec": float(1.0 / a.mean()),
-        }
+        self.observe(seconds)
 
 
 @contextlib.contextmanager

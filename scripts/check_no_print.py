@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Static check: no bare ``print()`` inside the library.
+
+Everything under ``kubernetes_rescheduling_tpu/`` reports through the
+structured logger or the telemetry registry; stdout belongs to the CLI
+(``cli.py``), whose JSON output a pipeline consumes — one stray debug
+print inside the package corrupts it. AST-based (not grep) so comments,
+strings, and methods NAMED print don't false-positive.
+
+Run directly (exit 1 on violation) or through its test twin
+(tests/test_no_print.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parent.parent / "kubernetes_rescheduling_tpu"
+# stdout is the CLI's output channel — the one module allowed to print
+ALLOWED = {PACKAGE / "cli.py"}
+
+
+def find_bare_prints(path: Path) -> list[int]:
+    """Line numbers of ``print(...)`` calls on the builtin name."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    return [
+        node.lineno
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "print"
+    ]
+
+
+def violations() -> list[str]:
+    out = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if path in ALLOWED:
+            continue
+        for lineno in find_bare_prints(path):
+            out.append(f"{path.relative_to(PACKAGE.parent)}:{lineno}")
+    return out
+
+
+def main() -> int:
+    bad = violations()
+    if bad:
+        sys.stderr.write(
+            "bare print() outside the CLI — route through the structured "
+            "logger or the telemetry registry:\n"
+            + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -358,3 +358,47 @@ def test_input_comm_cost_fast_and_slow_branches_agree():
     assert float(input_comm_cost(collapsed, scn.graph)) == pytest.approx(
         float(communication_cost(collapsed, scn.graph)), rel=1e-6
     )
+
+
+def test_split_invalid_service_cannot_defeat_collapsed_fast_path():
+    """Regression (ADVICE round 5): an INVALID service contributes zero
+    to both branches of `input_comm_cost`, so its pods being split
+    across nodes must not flip the collapse predicate — that would
+    silently route every chained production solve to the ~4 ms
+    quadratic form."""
+    from kubernetes_rescheduling_tpu.solver.global_solver import (
+        comm_cost_collapse,
+        input_comm_cost,
+    )
+
+    scn = synthetic_scenario(
+        n_pods=240, n_nodes=8, powerlaw=True, seed=12, replicas=3
+    )
+    ps = np.asarray(scn.state.pod_service)
+    # collapse every service onto one node...
+    svc_first = np.arange(scn.graph.num_services) % 8
+    nodes = svc_first[ps].astype(np.int64)
+    # ...then invalidate one replicated service and split its pods
+    victim = int(ps[0])
+    graph = scn.graph.replace(
+        service_valid=scn.graph.service_valid.at[victim].set(False)
+    )
+    victim_pods = np.flatnonzero(ps == victim)
+    assert victim_pods.size >= 2, "need a replicated service to split"
+    nodes[victim_pods] = np.arange(victim_pods.size) % 8
+    state = scn.state.replace(pod_node=jnp.asarray(nodes, jnp.int32))
+
+    _, _, collapsed = comm_cost_collapse(state, graph)
+    assert bool(collapsed), (
+        "split pods of an invalid service defeated the collapsed fast path"
+    )
+    # and a split VALID service still routes to the general form
+    _, _, collapsed_valid = comm_cost_collapse(state, scn.graph)
+    assert not bool(collapsed_valid)
+    # value parity holds on both graphs regardless of routing
+    assert float(input_comm_cost(state, graph)) == pytest.approx(
+        float(communication_cost(state, graph)), rel=1e-6
+    )
+    assert float(input_comm_cost(state, scn.graph)) == pytest.approx(
+        float(communication_cost(state, scn.graph)), rel=1e-6
+    )

@@ -1,0 +1,30 @@
+"""CI twin of ``scripts/check_no_print.py``: the library never prints.
+
+All output from ``kubernetes_rescheduling_tpu/`` goes through the
+structured logger or the telemetry registry; stdout belongs to the CLI
+whose JSON a pipeline consumes."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+
+def _load_checker():
+    path = Path(__file__).resolve().parent.parent / "scripts" / "check_no_print.py"
+    spec = importlib.util.spec_from_file_location("check_no_print", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_no_print", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_no_bare_print_outside_cli():
+    checker = _load_checker()
+    assert checker.violations() == []
+
+
+def test_checker_catches_a_print(tmp_path):
+    checker = _load_checker()
+    f = tmp_path / "mod.py"
+    f.write_text("def g():\n    print('dbg')  # noqa\n")
+    assert checker.find_bare_prints(f) == [2]

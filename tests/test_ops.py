@@ -303,6 +303,7 @@ def test_sparse_mass_score_matches_two_kernel_path():
         got_node, got_adm, got_dc, got_dm = admission_stage(
             prop, gain, wants, s_cpu, s_mem, cur, valid_c, c_cpu, c_mem,
             num_nodes=N, enforce_capacity=True, interpret=True,
+            emit_x_rows=False,
         )
         np.testing.assert_array_equal(np.asarray(got_node), np.asarray(exp_node))
         np.testing.assert_array_equal(np.asarray(got_adm), np.asarray(exp_adm))

@@ -7,7 +7,11 @@ import jax
 import jax.numpy as jnp
 import pytest
 import numpy as np
-from hypothesis import given, settings, strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this environment"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from kubernetes_rescheduling_tpu.core.quantities import (
     cpu_to_millicores,

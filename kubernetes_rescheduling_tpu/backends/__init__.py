@@ -7,11 +7,23 @@
   semantics (foreground delete + wait-404, anti-affinity patch, pinned
   re-create). Never traced; works against any object implementing the small
   client protocol (the real ``kubernetes`` package or a fake).
+- ``ChaosBackend`` — fault-injecting wrapper over any backend (seeded
+  monitor failures, stale/partial snapshots, move timeouts/mis-lands,
+  node flap), the chaos-engineering surface the resilience layer is
+  tested against.
 """
 
 from kubernetes_rescheduling_tpu.backends.base import Backend, MoveRequest
 from kubernetes_rescheduling_tpu.backends.sim import LoadModel, SimBackend
 from kubernetes_rescheduling_tpu.backends.k8s import K8sBackend, PlacementMechanism
+from kubernetes_rescheduling_tpu.backends.chaos import (
+    ChaosBackend,
+    ChaosError,
+    ChaosProfile,
+    ChaosTimeoutError,
+    PROFILES as CHAOS_PROFILES,
+    with_chaos,
+)
 
 __all__ = [
     "Backend",
@@ -20,4 +32,10 @@ __all__ = [
     "SimBackend",
     "K8sBackend",
     "PlacementMechanism",
+    "ChaosBackend",
+    "ChaosError",
+    "ChaosProfile",
+    "ChaosTimeoutError",
+    "CHAOS_PROFILES",
+    "with_chaos",
 ]

@@ -1,0 +1,284 @@
+"""Fault-injecting wrapper around any ``Backend`` — the chaos-engineering
+treatment (Chaos Monkey / chaos-mesh style) for the control loop.
+
+``ChaosBackend`` wraps a real backend and injects seeded, configurable
+faults at the exact surface the controller consumes:
+
+- ``monitor()`` exceptions (:class:`ChaosError`), stale snapshots (the
+  previous round's state served again), partial snapshots (a random
+  subset of pods dropped from validity — a watch cache that lags), and
+  transient ``None`` returns;
+- ``apply_move`` exceptions, timeouts (:class:`ChaosTimeoutError`, after
+  the move's wall budget has visibly been consumed on the inner clock),
+  transient ``None`` returns (the protocol's "move failed" signal), and
+  moves that land on the WRONG node (a scheduler override / race);
+- node crash/flap sequences: every ``node_flap_period`` monitors a worker
+  is killed and revived ``node_flap_down_calls`` monitors later (needs an
+  inner backend exposing ``kill_node``/``revive_node`` — the simulator).
+
+Every injected fault is counted twice: in the process telemetry registry
+as ``chaos_faults_total{kind=...}`` and in the wrapper's own
+``fault_counts`` dict — the chaos soak test asserts the two agree, which
+pins the telemetry wiring end to end.
+
+Faults draw from one seeded ``random.Random``, so a chaos run is exactly
+reproducible; everything the profile does not inject passes straight
+through (``__getattr__`` forwards ``node_names``, ``inject_imbalance``,
+``restore_placement``, ``events``, …).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from kubernetes_rescheduling_tpu.backends.base import Backend, MoveRequest
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.telemetry.registry import get_registry
+
+
+class ChaosError(ConnectionError):
+    """Injected boundary failure (transient by construction)."""
+
+
+class ChaosTimeoutError(TimeoutError):
+    """Injected boundary timeout; the inner clock has already advanced."""
+
+
+@dataclass(frozen=True)
+class ChaosProfile:
+    """Per-call fault probabilities plus the node-flap schedule."""
+
+    name: str = "custom"
+    monitor_error_rate: float = 0.0    # monitor() raises ChaosError
+    monitor_stale_rate: float = 0.0    # previous snapshot served again
+    monitor_partial_rate: float = 0.0  # a random pod subset goes invalid
+    monitor_none_rate: float = 0.0     # transient None return
+    move_error_rate: float = 0.0       # apply_move raises ChaosError
+    move_timeout_rate: float = 0.0     # apply_move raises ChaosTimeoutError
+    move_none_rate: float = 0.0        # transient None return (move "failed")
+    move_wrong_node_rate: float = 0.0  # lands on a different node
+    move_timeout_s: float = 30.0       # clock consumed by an injected timeout
+    partial_drop_frac: float = 0.2     # pod fraction dropped by a partial snapshot
+    node_flap_period: int = 0          # kill a worker every N monitor calls (0 = off)
+    node_flap_down_calls: int = 2      # monitors the worker stays dead
+
+    def validate(self) -> "ChaosProfile":
+        for f in dataclasses.fields(self):
+            if f.name.endswith("_rate") or f.name.endswith("_frac"):
+                v = getattr(self, f.name)
+                if not (0.0 <= v <= 1.0):
+                    raise ValueError(f"{f.name} must be in [0, 1], got {v}")
+        if self.node_flap_period < 0 or self.node_flap_down_calls < 1:
+            raise ValueError("node flap schedule must be non-negative / >= 1")
+        return self
+
+
+# Named profiles the CLI exposes (``--chaos-profile``). "soak" is the one
+# the acceptance soak test runs: monitor failures + move timeouts + node
+# flap, hot enough that a 30-round run exercises every degraded path.
+PROFILES: dict[str, ChaosProfile] = {
+    "none": ChaosProfile(name="none"),
+    "flaky-monitor": ChaosProfile(
+        name="flaky-monitor",
+        monitor_error_rate=0.2,
+        monitor_stale_rate=0.1,
+        monitor_none_rate=0.05,
+    ),
+    "flaky-moves": ChaosProfile(
+        name="flaky-moves",
+        move_error_rate=0.15,
+        move_timeout_rate=0.1,
+        move_none_rate=0.1,
+        move_wrong_node_rate=0.1,
+    ),
+    "node-flap": ChaosProfile(
+        name="node-flap", node_flap_period=5, node_flap_down_calls=2
+    ),
+    "soak": ChaosProfile(
+        name="soak",
+        monitor_error_rate=0.25,
+        monitor_stale_rate=0.10,
+        monitor_partial_rate=0.05,
+        monitor_none_rate=0.05,
+        move_error_rate=0.15,
+        move_timeout_rate=0.15,
+        move_none_rate=0.10,
+        move_wrong_node_rate=0.10,
+        node_flap_period=7,
+        node_flap_down_calls=2,
+    ),
+}
+
+
+class ChaosBackend:
+    """Wrap ``inner`` with the faults of ``profile`` (seeded)."""
+
+    def __init__(
+        self,
+        inner: Backend,
+        profile: ChaosProfile,
+        seed: int = 0,
+        registry=None,
+    ):
+        self.inner = inner
+        self.profile = profile.validate()
+        self.seed = seed
+        self.registry = registry  # None = the process default, per call
+        self._rng = random.Random(seed)
+        self._last_state: ClusterState | None = None
+        self._monitor_calls = 0
+        self._flapped_node: str | None = None
+        self._flap_revive_at = 0
+        self.fault_counts: dict[str, int] = {}
+
+    # ---- fault bookkeeping ----
+
+    def _count(self, kind: str) -> None:
+        self.fault_counts[kind] = self.fault_counts.get(kind, 0) + 1
+        reg = self.registry if self.registry is not None else get_registry()
+        reg.counter(
+            "chaos_faults_total",
+            "faults injected by the chaos backend",
+            labelnames=("kind",),
+        ).labels(kind=kind).inc()
+
+    def _hit(self, rate: float) -> bool:
+        return rate > 0 and self._rng.random() < rate
+
+    # ---- Backend protocol ----
+
+    def comm_graph(self) -> CommGraph:
+        return self.inner.comm_graph()
+
+    def _flap(self) -> None:
+        """Kill/revive sequencing, driven by the monitor-call counter."""
+        p = self.profile
+        if p.node_flap_period <= 0:
+            return
+        kill = getattr(self.inner, "kill_node", None)
+        revive = getattr(self.inner, "revive_node", None)
+        if kill is None or revive is None:
+            return  # inner backend cannot express node death
+        if (
+            self._flapped_node is not None
+            and self._monitor_calls >= self._flap_revive_at
+        ):
+            revive(self._flapped_node)
+            self._count("node_revive")
+            self._flapped_node = None
+        if (
+            self._flapped_node is None
+            and self._monitor_calls % p.node_flap_period == 0
+            and self._monitor_calls > 0
+        ):
+            names = list(self.inner.node_names)
+            if names:
+                self._flapped_node = names[self._rng.randrange(len(names))]
+                self._flap_revive_at = (
+                    self._monitor_calls + p.node_flap_down_calls
+                )
+                kill(self._flapped_node)
+                self._count("node_kill")
+
+    def monitor(self) -> ClusterState | None:
+        p = self.profile
+        self._monitor_calls += 1
+        self._flap()
+        if self._hit(p.monitor_error_rate):
+            self._count("monitor_error")
+            raise ChaosError("chaos: injected monitor failure")
+        if self._hit(p.monitor_none_rate):
+            self._count("monitor_none")
+            return None
+        if self._hit(p.monitor_stale_rate) and self._last_state is not None:
+            self._count("monitor_stale")
+            return self._last_state
+        state = self.inner.monitor()
+        if self._hit(p.monitor_partial_rate):
+            self._count("monitor_partial")
+            state = self._partial(state)
+            return state  # deliberately NOT cached as last good
+        self._last_state = state
+        return state
+
+    def _partial(self, state: ClusterState) -> ClusterState:
+        """Drop a random ``partial_drop_frac`` of valid pods — the lagging
+        watch-cache snapshot. Shapes are untouched (only validity flips),
+        so the decision kernels never retrace."""
+        valid = np.asarray(state.pod_valid).copy()
+        idx = np.flatnonzero(valid)
+        n_drop = int(len(idx) * self.profile.partial_drop_frac)
+        if n_drop > 0:
+            drop = self._rng.sample(list(idx), n_drop)
+            valid[np.asarray(drop, dtype=np.int64)] = False
+        import jax.numpy as jnp
+
+        return state.replace(pod_valid=jnp.asarray(valid))
+
+    def apply_move(self, move: MoveRequest) -> str | None:
+        p = self.profile
+        if self._hit(p.move_error_rate):
+            self._count("move_error")
+            raise ChaosError(f"chaos: injected apply_move failure ({move.service})")
+        if self._hit(p.move_timeout_rate):
+            self._count("move_timeout")
+            # the budget was really consumed: the inner clock moves first
+            self.inner.advance(p.move_timeout_s)
+            raise ChaosTimeoutError(
+                f"chaos: apply_move({move.service}) exceeded "
+                f"{p.move_timeout_s}s"
+            )
+        if self._hit(p.move_none_rate):
+            self._count("move_none")
+            return None
+        if self._hit(p.move_wrong_node_rate):
+            names = [
+                n
+                for n in getattr(self.inner, "node_names", [])
+                if n != move.target_node
+            ]
+            if names:
+                self._count("move_wrong_node")
+                wrong = names[self._rng.randrange(len(names))]
+                return self.inner.apply_move(
+                    dataclasses.replace(move, target_node=wrong)
+                )
+        return self.inner.apply_move(move)
+
+    def advance(self, seconds: float) -> None:
+        self.inner.advance(seconds)
+
+    def __getattr__(self, name: str) -> Any:
+        # everything un-injected (node_names, inject_imbalance,
+        # restore_placement, events, reconcile_delay_s, …) passes through
+        return getattr(self.inner, name)
+
+
+def with_chaos(
+    backend: Backend,
+    profile: str | ChaosProfile,
+    seed: int = 0,
+    registry=None,
+):
+    """Wrap ``backend`` unless the profile is "none" (then return it as-is).
+    ``profile`` is a name from :data:`PROFILES` or an explicit
+    :class:`ChaosProfile`; ``registry`` receives the fault counters
+    (default: the process registry, resolved per call)."""
+    if isinstance(profile, str):
+        if profile not in PROFILES:
+            raise ValueError(
+                f"unknown chaos profile {profile!r}; expected one of "
+                f"{sorted(PROFILES)}"
+            )
+        profile = PROFILES[profile]
+    if (
+        profile.name == "none"
+        or profile == ChaosProfile(name=profile.name)
+    ):
+        return backend
+    return ChaosBackend(backend, profile, seed=seed, registry=registry)

@@ -43,8 +43,40 @@ from kubernetes_rescheduling_tpu.telemetry.accounting import (
     count_reconcile,
     timed_call,
 )
+from kubernetes_rescheduling_tpu.telemetry.registry import get_registry
+# utils.logging / utils.retry likewise use no jax themselves (the utils
+# package resolves its jax-importing members lazily)
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger, get_logger
+from kubernetes_rescheduling_tpu.utils.retry import (
+    RetryPolicy,
+    call_with_retry,
+    is_transient,
+)
 
 logger = logging.getLogger(__name__)
+
+
+def _is_api_error(e: BaseException) -> bool:
+    """What the adapter may swallow: transport-level failures plus anything
+    carrying an HTTP ``status`` (the real client's ``ApiException`` and the
+    test fakes' stand-in). ``RuntimeError`` is included because the
+    kubernetes client surfaces some config/transport failures as plain
+    ``RuntimeError`` — but its interpreter-level subclasses
+    (``RecursionError``/``NotImplementedError``) are coding bugs, not API
+    weather, and stay fatal, as do ``TypeError``/``KeyError``/… — the bare
+    ``except Exception`` blocks this replaces hid all of those."""
+    if isinstance(e, (RecursionError, NotImplementedError)):
+        return False
+    return isinstance(
+        e, (ConnectionError, TimeoutError, OSError, RuntimeError)
+    ) or hasattr(e, "status")
+
+
+# worth another attempt = the SHARED transient predicate (utils.retry):
+# transport errors and throttling/server-side statuses; a definitive API
+# answer (404, 403, 422, …) never is. One definition with the controller
+# boundary, so the two layers can't disagree on what retries.
+_retryable = is_transient
 
 # policy name -> how the reference pins the re-created Deployment
 PlacementMechanism: dict[str, str] = {
@@ -234,6 +266,39 @@ class K8sBackend:
     # windows track what the cluster actually does rather than zero.
     reconcile_delay_s: float = 10.0
     sleeper: Callable[[float], None] = field(default=time.sleep)
+    # every API call below routes through this policy (transport errors and
+    # 429/5xx retried with backoff + jitter; definitive statuses never).
+    # Deliberately SHORT: run_controller's BoundaryClient retries the whole
+    # boundary call one layer up, so the layers multiply — this inner
+    # policy handles single-request blips (one quick re-send), the outer
+    # one call-level failures, and a dead cluster still reaches the
+    # circuit breaker in seconds, not minutes.
+    retry: RetryPolicy = field(
+        default_factory=lambda: RetryPolicy(
+            max_attempts=2, base_delay_s=0.5, max_delay_s=2.0, deadline_s=10.0
+        )
+    )
+    slog: StructuredLogger = field(default_factory=lambda: get_logger("k8s"))
+
+    def _api(self, label: str, fn: Callable[[], Any]) -> Any:
+        """One cluster API call under the shared retry policy."""
+        return call_with_retry(
+            fn,
+            policy=self.retry,
+            label=f"k8s.{label}",
+            retryable=_retryable,
+            sleeper=self.sleeper,
+        )
+
+    def _swallow(self, call: str, exc: BaseException) -> None:
+        """An API error this adapter deliberately absorbs: logged through
+        the structured logger and counted — never silent."""
+        self.slog.warn("swallowed_error", call=call, error=repr(exc))
+        get_registry().counter(
+            "backend_swallowed_errors_total",
+            "API errors a backend absorbed instead of raising",
+            labelnames=("backend", "call"),
+        ).labels(backend="k8s", call=call).inc()
 
     def __post_init__(self) -> None:
         if self.core_api is None or self.apps_api is None or self.custom_api is None:
@@ -263,8 +328,11 @@ class K8sBackend:
             if kind == "Deployment":
                 return _get(o, "name")
             if kind == "ReplicaSet":
-                rs = self.apps_api.read_namespaced_replica_set(
-                    _get(o, "name"), self.namespace
+                rs = self._api(
+                    "read_replica_set",
+                    lambda: self.apps_api.read_namespaced_replica_set(
+                        _get(o, "name"), self.namespace
+                    ),
                 )
                 for ro in (
                     _get(rs, "metadata", "owner_references")
@@ -281,7 +349,7 @@ class K8sBackend:
             return self._monitor()
 
     def _monitor(self) -> ClusterState:
-        nodes = self.core_api.list_node(watch=False)
+        nodes = self._api("list_node", lambda: self.core_api.list_node(watch=False))
         node_names = self._worker_names(nodes)
         cap_cpu: dict[str, float] = {}
         cap_mem: dict[str, float] = {}
@@ -295,21 +363,30 @@ class K8sBackend:
         node_used: dict[str, float] = {}
         node_used_mem: dict[str, float] = {}
         try:
-            res = self.custom_api.list_cluster_custom_object(
-                "metrics.k8s.io", "v1beta1", "nodes"
+            res = self._api(
+                "node_metrics",
+                lambda: self.custom_api.list_cluster_custom_object(
+                    "metrics.k8s.io", "v1beta1", "nodes"
+                ),
             )
             for item in res.get("items", []):
                 name = item["metadata"]["name"]
                 node_used[name] = float(cpu_to_millicores(item["usage"]["cpu"]))
                 node_used_mem[name] = float(mem_to_bytes(item["usage"]["memory"]))
-        except Exception:
-            pass  # metrics-server absent → usage stays 0 (reference podmonitor.py:86-87)
+        except Exception as e:
+            if not _is_api_error(e):
+                raise
+            # metrics-server absent → usage stays 0 (reference podmonitor.py:86-87)
+            self._swallow("monitor.node_metrics", e)
 
         # pod usage, containers summed (reference get_resource_usage.py:48-68)
         pod_usage: dict[str, tuple[float, float]] = {}
         try:
-            res = self.custom_api.list_namespaced_custom_object(
-                "metrics.k8s.io", "v1beta1", self.namespace, "pods"
+            res = self._api(
+                "pod_metrics",
+                lambda: self.custom_api.list_namespaced_custom_object(
+                    "metrics.k8s.io", "v1beta1", self.namespace, "pods"
+                ),
             )
             for item in res.get("items", []):
                 cpu = sum(
@@ -321,8 +398,10 @@ class K8sBackend:
                     for c in item.get("containers", [])
                 )
                 pod_usage[item["metadata"]["name"]] = (float(cpu), float(mem))
-        except Exception:
-            pass
+        except Exception as e:
+            if not _is_api_error(e):
+                raise
+            self._swallow("monitor.pod_metrics", e)
 
         services, pod_nodes, pod_cpu, pod_mem, pod_names = [], [], [], [], []
         tracked_cpu = {n: 0.0 for n in node_names}
@@ -376,7 +455,9 @@ class K8sBackend:
     @property
     def node_names(self) -> list[str]:
         """Worker node names (control plane excluded), freshly listed."""
-        return self._worker_names(self.core_api.list_node(watch=False))
+        return self._worker_names(
+            self._api("list_node", lambda: self.core_api.list_node(watch=False))
+        )
 
     def cordon(self, node: str) -> bool:
         """``kubectl cordon``: mark the node unschedulable (reference
@@ -430,9 +511,14 @@ class K8sBackend:
         caller (snapshot and restart probe alike)."""
         lister = getattr(self.core_api, "list_namespaced_pod", None)
         if lister is not None:
-            pods = lister(self.namespace, watch=False)
+            pods = self._api(
+                "list_pods", lambda: lister(self.namespace, watch=False)
+            )
             return _get(pods, "items", default=[]) or []
-        pods = self.core_api.list_pod_for_all_namespaces(watch=False)
+        pods = self._api(
+            "list_pods",
+            lambda: self.core_api.list_pod_for_all_namespaces(watch=False),
+        )
         return [
             p
             for p in (_get(pods, "items", default=[]) or [])
@@ -450,7 +536,10 @@ class K8sBackend:
         crashes). ``None`` when the listing fails."""
         try:
             items = self._list_namespace_pods()
-        except Exception:
+        except Exception as e:
+            if not _is_api_error(e):
+                raise
+            self._swallow("pod_restart_counts", e)
             return None
         out: dict[str, int] = {}
         for p in items:
@@ -559,10 +648,16 @@ class K8sBackend:
             )
         name = move.service
         try:
-            dep = self.apps_api.read_namespaced_deployment(
-                name=name, namespace=self.namespace
+            dep = self._api(
+                "read_deployment",
+                lambda: self.apps_api.read_namespaced_deployment(
+                    name=name, namespace=self.namespace
+                ),
             )
-        except Exception:
+        except Exception as e:
+            if not _is_api_error(e):
+                raise
+            self._swallow("apply_move.read_deployment", e)
             return None
         if not isinstance(dep, dict):
             # real client model → plain dict
@@ -591,22 +686,42 @@ class K8sBackend:
 
         t0 = time.monotonic()
         try:
-            self.apps_api.delete_namespaced_deployment(
-                name=name,
-                namespace=self.namespace,
-                body={"propagationPolicy": "Foreground"},
+            self._api(
+                "delete_deployment",
+                lambda: self.apps_api.delete_namespaced_deployment(
+                    name=name,
+                    namespace=self.namespace,
+                    body={"propagationPolicy": "Foreground"},
+                ),
             )
         except Exception as e:
+            if not _is_api_error(e):
+                raise
             if getattr(e, "status", None) != 404:  # already gone = fine
-                return None  # transient failure: skip the round, keep the loop alive
+                # transient failure: skip the round, keep the loop alive
+                self._swallow("apply_move.delete_deployment", e)
+                return None
         if not self._wait_deleted(name):
             return None  # timeout → skip round (reference delete_replaced_pod.py:178-180)
         try:
-            self.apps_api.create_namespaced_deployment(
-                namespace=self.namespace, body=body
+            self._api(
+                "create_deployment",
+                lambda: self.apps_api.create_namespaced_deployment(
+                    namespace=self.namespace, body=body
+                ),
             )
-        except Exception:
-            return None
+        except Exception as e:
+            if not _is_api_error(e):
+                raise
+            if getattr(e, "status", None) != 409:
+                self._swallow("apply_move.create_deployment", e)
+                return None
+            # 409 AlreadyExists after our own delete→404 wait: the first
+            # create attempt landed but its response was lost and the
+            # retry collided with it — the move SUCCEEDED (mirror of the
+            # "404 on delete = already gone" rule above); reporting None
+            # here would undercount services_moved and feed the breaker
+            # for a move the cluster actually applied
         # outage window = delete → 404 → re-create → pods READY (a ready
         # timeout still stamps the elapsed budget — conservative, not zero);
         # the floor keeps a fake-client test run from zeroing the accounting

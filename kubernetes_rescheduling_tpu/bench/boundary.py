@@ -1,0 +1,268 @@
+"""The controller's resilient boundary: retry wrapper + circuit breaker.
+
+``run_controller`` never touches ``backend.monitor()`` /
+``backend.apply_move()`` directly (statically enforced by
+``scripts/check_boundary_retry.py``); every boundary call routes through a
+:class:`BoundaryClient`, which
+
+- retries transient failures under a :class:`~utils.retry.RetryPolicy`
+  (backoff sleeps go through the BACKEND's own ``advance`` by default, so
+  a simulated cluster waits on the simulated clock and a live one really
+  sleeps);
+- converts exhausted calls into the protocol's failure signals
+  (``monitor() -> None`` / ``apply_move() -> None``) instead of crashing
+  the loop;
+- feeds every outcome to a :class:`CircuitBreaker`, the controller's
+  degradation state machine.
+
+Transient means transient: connection/timeout/OS errors (which include
+the chaos backend's injected :class:`ChaosError` /
+:class:`ChaosTimeoutError`) and API exceptions carrying a throttling or
+server-side ``status`` (429/5xx — the kubernetes client's
+``ApiException`` shape) are absorbed. A ``TypeError`` — or any other
+programming error — still crashes, as it must.
+
+Breaker states (the classic three):
+
+- **closed** — healthy; every success resets the consecutive-failure count.
+- **open** — ``max_consecutive_failures`` boundary failures in a row; the
+  controller freezes moves and reuses its last good snapshot for
+  ``cooldown_rounds`` rounds (the skipped rounds are counted, never
+  silently lost).
+- **half_open** — cooldown elapsed; ONE probe ``monitor()`` is allowed.
+  Success closes the breaker, failure re-opens it (fresh cooldown).
+
+Transitions are triple-recorded: a structured ``breaker`` event, a
+``circuit_breaker_transitions_total{to=...}`` counter, and the
+``circuit_breaker_state`` gauge (0=closed, 1=half_open, 2=open).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from kubernetes_rescheduling_tpu.backends.base import Backend, MoveRequest
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+from kubernetes_rescheduling_tpu.utils.retry import (
+    RetryPolicy,
+    call_with_retry,
+    is_transient,
+)
+
+# What the boundary absorbs is utils.retry.is_transient — one shared
+# predicate with the k8s adapter. ChaosError subclasses ConnectionError
+# and ChaosTimeoutError subclasses TimeoutError, so injected faults need
+# no special-casing; everything non-transient propagates.
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+_STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+@dataclass
+class CircuitBreaker:
+    """Consecutive-failure breaker with a cooldown-then-probe reopen path.
+
+    ``max_consecutive_failures=0`` disables the machine entirely (the
+    breaker never leaves ``closed``) — the loop keeps the reference's
+    skip-the-round behavior with retries only.
+    """
+
+    max_consecutive_failures: int = 5
+    cooldown_rounds: int = 2
+    logger: StructuredLogger | None = None
+    registry: MetricsRegistry | None = None
+
+    state: str = CLOSED
+    consecutive_failures: int = 0
+    opened_at_round: int = 0
+    round: int = 0
+    transitions: list[dict] = field(default_factory=list)
+
+    def _reg(self) -> MetricsRegistry:
+        return self.registry if self.registry is not None else get_registry()
+
+    def _transition(self, to: str, **fields: Any) -> None:
+        if to == self.state:
+            return
+        rec = {"round": self.round, "from": self.state, "to": to, **fields}
+        self.transitions.append(rec)
+        self.state = to
+        reg = self._reg()
+        reg.counter(
+            "circuit_breaker_transitions_total",
+            "circuit breaker state transitions",
+            labelnames=("to",),
+        ).labels(to=to).inc()
+        reg.gauge(
+            "circuit_breaker_state",
+            "breaker state (0=closed, 1=half_open, 2=open)",
+        ).set(_STATE_CODE[to])
+        if self.logger is not None:
+            self.logger.info("breaker", **rec)
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_consecutive_failures > 0
+
+    def on_round_start(self, rnd: int) -> str:
+        """Advance the per-round clock; OPEN moves to HALF_OPEN once the
+        cooldown has elapsed. Returns the state the round runs under."""
+        self.round = rnd
+        if (
+            self.state == OPEN
+            and rnd - self.opened_at_round >= self.cooldown_rounds
+        ):
+            self._transition(HALF_OPEN)
+        return self.state
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state in (HALF_OPEN, OPEN):
+            # OPEN normally sees no calls (the controller skips the round),
+            # but the startup probe loop can succeed while OPEN — a real
+            # success is stronger evidence than a half-open probe, so the
+            # breaker must not stay open over a healthy backend
+            self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state == HALF_OPEN or (
+            self.enabled
+            and self.state == CLOSED
+            and self.consecutive_failures >= self.max_consecutive_failures
+        ):
+            self.opened_at_round = self.round
+            self._transition(
+                OPEN, consecutive_failures=self.consecutive_failures
+            )
+
+
+class BoundaryClient:
+    """The controller's only path to the cluster.
+
+    ``monitor()`` returns ``None`` instead of raising once retries are
+    exhausted; ``apply_move()`` likewise (the protocol's existing skip
+    signal). A ``None`` return counts as a failure BY DESIGN even though
+    the protocol cannot distinguish a transient loss from a deterministic
+    rejection: a backend that persistently rejects every move is sick from
+    the controller's perspective, and the breaker's cooldown + half-open
+    probe (a monitor, which succeeds on such a backend) recovers cheaply
+    from the false-positive case. A per-round failure budget freezes
+    further MOVES for the round once crossed — monitors stay allowed
+    (they are how the breaker's probe and the loop's snapshot recovery
+    work).
+    """
+
+    def __init__(
+        self,
+        backend: Backend,
+        *,
+        policy: RetryPolicy | None = None,
+        breaker: CircuitBreaker | None = None,
+        failure_budget_per_round: int = 0,
+        logger: StructuredLogger | None = None,
+        registry: MetricsRegistry | None = None,
+        sleeper: Callable[[float], None] | None = None,
+    ):
+        self.backend = backend
+        self.policy = (policy or RetryPolicy()).validate()
+        # every boundary call treats a None return as transient (the
+        # protocol's "failed, skip" signal) — precomputed once
+        self._policy_retry_none = dataclasses.replace(
+            self.policy, retry_none=True
+        )
+        self.breaker = breaker or CircuitBreaker(registry=registry, logger=logger)
+        self.failure_budget_per_round = failure_budget_per_round
+        self.logger = logger
+        self.registry = registry
+        # backoff waits on the backend's own clock: simulated time for the
+        # simulator, ``time.sleep`` (via K8sBackend.sleeper) for a cluster
+        self.sleeper = sleeper if sleeper is not None else backend.advance
+        self.round_failures = 0
+        self.total_failures = 0
+
+    # ---- per-round bookkeeping ----
+
+    def begin_round(self, rnd: int) -> str:
+        self.round_failures = 0
+        return self.breaker.on_round_start(rnd)
+
+    @property
+    def moves_frozen(self) -> bool:
+        """Moves stop for the round when the breaker is open or the round
+        has spent its failure budget."""
+        return self.breaker.state == OPEN or (
+            self.failure_budget_per_round > 0
+            and self.round_failures >= self.failure_budget_per_round
+        )
+
+    def _failed(self, call: str, exc: BaseException | None) -> None:
+        self.round_failures += 1
+        self.total_failures += 1
+        self.breaker.record_failure()
+        if self.logger is not None:
+            self.logger.warn(
+                "boundary_failure",
+                call=call,
+                error=repr(exc) if exc is not None else "returned None",
+                breaker=self.breaker.state,
+                consecutive=self.breaker.consecutive_failures,
+            )
+
+    def _call(self, call: str, fn: Callable[[], Any]):
+        try:
+            out = call_with_retry(
+                fn,
+                policy=self._policy_retry_none,
+                label=call,
+                retryable=is_transient,
+                sleeper=self.sleeper,
+                registry=self.registry,
+            )
+        except Exception as e:  # noqa: BLE001 — non-transient re-raises
+            if not is_transient(e):
+                raise
+            self._failed(call, e)
+            return None
+        if out is None:
+            self._failed(call, None)
+            return None
+        self.breaker.record_success()
+        return out
+
+    # ---- boundary surface ----
+
+    def monitor(self):
+        return self._call("monitor", self.backend.monitor)
+
+    def apply_move(self, move: MoveRequest) -> str | None:
+        if self.moves_frozen:
+            return None  # safe mode: the round's remaining moves are dropped
+        return self._call("apply_move", lambda: self.backend.apply_move(move))
+
+    def comm_graph(self):
+        return self.backend.comm_graph()
+
+    @property
+    def raw_backend(self):
+        """The innermost backend (unwrapping chaos layers): the host for
+        per-backend caches that must outlive this run's wrappers."""
+        b = self.backend
+        while hasattr(b, "inner"):
+            b = b.inner
+        return b
+
+    def advance(self, seconds: float) -> None:
+        self.backend.advance(seconds)
+
+    def __getattr__(self, name: str) -> Any:
+        # sim-only extensions (apply_pod_moves, restore_placement, events,
+        # …) pass through un-wrapped; per-round caches the round functions
+        # hang on the boundary live on the wrapper itself (plain setattr)
+        return getattr(self.backend, name)

@@ -21,7 +21,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_rescheduling_tpu.backends.base import Backend, MoveRequest
+from kubernetes_rescheduling_tpu.backends.chaos import with_chaos
 from kubernetes_rescheduling_tpu.backends.k8s import PlacementMechanism
+from kubernetes_rescheduling_tpu.bench.boundary import (
+    HALF_OPEN,
+    OPEN,
+    BoundaryClient,
+    CircuitBreaker,
+)
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS
@@ -58,6 +65,12 @@ class RoundRecord:
     objective_before: float | None = None
     objective_after: float | None = None
     solver_improved: bool | None = None
+    # resilience: the breaker state the round ran under, whether the round
+    # finished on a stale snapshot (post-move monitor failed), and how many
+    # boundary failures it burned
+    breaker_state: str = "closed"
+    degraded: bool = False
+    boundary_failures: int = 0
 
     @property
     def decision_latency_s(self) -> float:
@@ -80,6 +93,16 @@ class RoundRecord:
 class ControllerResult:
     rounds: list[RoundRecord] = field(default_factory=list)
     resumed_from_round: int = 0  # >0 when a checkpoint resume skipped rounds
+    # resilience accounting: rounds the open breaker froze (counted, never
+    # silently lost — max_rounds == len(rounds) + skipped_rounds), the
+    # breaker's transition log, and total boundary failures absorbed
+    skipped_rounds: int = 0
+    breaker_transitions: list[dict] = field(default_factory=list)
+    boundary_failures: int = 0
+
+    @property
+    def degraded_rounds(self) -> int:
+        return sum(1 for r in self.rounds if r.degraded)
 
     @property
     def decisions_per_sec(self) -> float:
@@ -195,14 +218,41 @@ def run_controller(
     sample set per round — counters ``rounds_total``/
     ``services_moved_total``, the ``decision_seconds`` histogram, and
     cost/objective gauges — alongside the spans the loop emits.
+
+    Resilience: ``config.chaos`` optionally wraps the backend in the
+    fault-injecting ``ChaosBackend``; either way every boundary call goes
+    through a ``BoundaryClient`` (retry + circuit breaker — see
+    ``bench/boundary.py``). When the breaker opens, the loop enters safe
+    mode: moves freeze, the last good snapshot is reused, and each frozen
+    round is a COUNTED skip (``result.skipped_rounds``; never a silent
+    hole — ``max_rounds == len(result.rounds) + result.skipped_rounds``).
     """
     config = config.validate()
     registry = registry if registry is not None else get_registry()
     key = key if key is not None else jax.random.PRNGKey(config.seed)
+    if config.chaos.profile != "none":
+        backend = with_chaos(
+            backend, config.chaos.profile, seed=config.chaos.seed,
+            registry=registry,
+        )
+    breaker = CircuitBreaker(
+        max_consecutive_failures=config.max_consecutive_failures,
+        cooldown_rounds=config.breaker_cooldown_rounds,
+        logger=logger,
+        registry=registry,
+    )
+    boundary = BoundaryClient(
+        backend,
+        policy=config.retry,
+        breaker=breaker,
+        failure_budget_per_round=config.failure_budget_per_round,
+        logger=logger,
+        registry=registry,
+    )
     # decisions may run on an estimated graph; TELEMETRY always reports on
     # the backend's declared graph so round costs stay comparable across
     # configurations (and with the harness's before/after metrics)
-    metric_graph = backend.comm_graph()
+    metric_graph = boundary.comm_graph()
     if graph is None:
         graph_src = lambda: metric_graph  # noqa: E731
     elif callable(graph):
@@ -225,26 +275,87 @@ def run_controller(
             if logger is not None:
                 logger.info("resume", round=start_round, checkpoint=done_round)
 
+    def skip_round(rnd: int, state) -> None:
+        """Safe mode: the open breaker froze this round — count it, pace,
+        checkpoint the carried-over snapshot so resume semantics hold."""
+        result.skipped_rounds += 1
+        registry.counter(
+            "rounds_skipped_total",
+            "rounds frozen by the open circuit breaker",
+            labelnames=("algorithm",),
+        ).labels(algorithm=config.algorithm).inc()
+        if logger is not None:
+            logger.info(
+                "round_skipped",
+                round=rnd,
+                breaker=breaker.state,
+                consecutive_failures=breaker.consecutive_failures,
+            )
+        boundary.advance(config.sleep_after_action_s)
+        if mgr is not None:
+            mgr.save(
+                rnd, state, extra={"algorithm": config.algorithm, "skipped": True}
+            )
+
     # one snapshot per round: the post-move snapshot provides this round's
     # metrics AND the next round's state (a live monitor() is 4 cluster-wide
-    # API calls — doubling it per round doubles API-server load)
-    state = backend.monitor()
+    # API calls — doubling it per round doubles API-server load).
+    # Startup has no last-good snapshot to degrade to, so the initial
+    # monitor gets its own bounded probe loop on top of the per-call
+    # retries; only a backend that stays dark through all of it raises.
+    state = None
+    for _ in range(max(3, config.max_consecutive_failures + 1)):
+        state = boundary.monitor()
+        if state is not None:
+            break
+    if state is None:
+        raise ConnectionError(
+            "backend unavailable: initial monitor() failed after retries "
+            "(no last good snapshot to degrade to)"
+        )
     for rnd in range(start_round, config.max_rounds + 1):
+        mode = boundary.begin_round(rnd)
+        if mode == OPEN:
+            skip_round(rnd, state)
+            continue
+        if mode == HALF_OPEN:
+            # one probe before trusting the backend with a full round; a
+            # success closes the breaker AND refreshes the stale snapshot
+            probe = boundary.monitor()
+            if probe is None:
+                skip_round(rnd, state)
+                continue
+            state = probe
         sub = jax.random.fold_in(key, rnd)
         graph = graph_src()  # fresh estimate per round when streaming
 
         with span("controller/round", round=rnd, algorithm=config.algorithm):
             if config.algorithm == "global" or config.moves_per_round == "all":
-                record = _global_round(backend, state, graph, config, sub, rnd)
+                record = _global_round(boundary, state, graph, config, sub, rnd)
             else:
-                record = _greedy_round(backend, state, graph, config, sub, rnd)
-            backend.advance(config.sleep_after_action_s)
+                record = _greedy_round(boundary, state, graph, config, sub, rnd)
+            boundary.advance(config.sleep_after_action_s)
             with span("backend/monitor"):
-                state = backend.monitor()
+                new_state = boundary.monitor()
+        if new_state is None:
+            # post-move snapshot failed: finish the round DEGRADED on the
+            # last good snapshot instead of crashing (metrics below are
+            # stale but labeled as such via record.degraded)
+            record.degraded = True
+        else:
+            state = new_state
+        record.breaker_state = breaker.state
+        record.boundary_failures = boundary.round_failures
         record.communication_cost = float(communication_cost(state, metric_graph))
         record.load_std = float(load_std(state))
         result.rounds.append(record)
         _emit_round_metrics(registry, config.algorithm, record)
+        if record.degraded:
+            registry.counter(
+                "degraded_rounds_total",
+                "rounds completed on a stale snapshot after boundary failure",
+                labelnames=("algorithm",),
+            ).labels(algorithm=config.algorithm).inc()
         if logger is not None:
             logger.info(
                 "round",
@@ -257,6 +368,9 @@ def run_controller(
                 decision_latency_s=record.decision_latency_s,
                 objective_before=record.objective_before,
                 objective_after=record.objective_after,
+                breaker=record.breaker_state,
+                degraded=record.degraded,
+                boundary_failures=record.boundary_failures,
             )
         if on_round is not None:
             on_round(record, state)
@@ -265,10 +379,12 @@ def run_controller(
         # outputs; replaying a move is idempotent (same pin, same target)
         if mgr is not None:
             mgr.save(rnd, state, extra={"algorithm": config.algorithm})
+    result.breaker_transitions = list(breaker.transitions)
+    result.boundary_failures = boundary.total_failures
     return result
 
 
-def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
+def _greedy_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
     """Up to ``config.moves_per_round`` greedy moves: after each move the
     working snapshot is edited in place (the moved service's pods re-homed —
     reference main.py:73's ``edit_cluster`` intent, done correctly), so the
@@ -310,7 +426,7 @@ def _greedy_round(backend, state, graph, config, key, rnd) -> RoundRecord:
             for j in range(state.num_nodes)
             if bool(hazard_mask[j])
         )
-        landed = backend.apply_move(
+        landed = boundary.apply_move(
             MoveRequest(
                 service=service_name,
                 target_node=target_name,
@@ -484,7 +600,7 @@ def _pull_solver_objectives(info):
     )
 
 
-def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
+def _pod_round(boundary, state, graph, config, cfg, key, rnd) -> RoundRecord:
     """Per-replica global round: solve on the expanded pod graph, apply
     per-pod moves (MoveRequest.pod). The pod graph is cached per
     (declared graph, pod set) — pod churn or a re-estimated graph
@@ -499,10 +615,13 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
         np.asarray(state.pod_service).tobytes(),
         np.asarray(state.pod_valid).tobytes(),
     )
-    cache = getattr(backend, "_pod_graph_cache", None)
+    # cache on the RAW backend (not this run's wrappers), so repeated runs
+    # against the same backend keep the reuse
+    cache_host = getattr(boundary, "raw_backend", boundary)
+    cache = getattr(cache_host, "_pod_graph_cache", None)
     if cache is None or cache[0] is not graph or cache[1] != sig:
         cache = (graph, sig, pod_level_graph(state, graph))
-        backend._pod_graph_cache = cache
+        cache_host._pod_graph_cache = cache
     pod_graph = cache[2]
     with span("controller/pod_solve", round=rnd):
         new_state, info = jax.block_until_ready(
@@ -532,15 +651,17 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
         )
     # batch path: one reconcile wave for the whole round's replica moves
     # (per-call apply_move would scan the pod table and advance the sim
-    # clock once PER REPLICA); backends without it get individual calls
-    batch = getattr(backend, "apply_pod_moves", None)
+    # clock once PER REPLICA); backends without it get individual calls.
+    # The batch call passes through the boundary un-retried (sim-only —
+    # the simulator's batch wave cannot transiently fail).
+    batch = getattr(boundary, "apply_pod_moves", None)
     moved_services: set[str] = set()
     if batch is not None:
         landed = set(batch(moves)) if moves else set()
         moved_services = {mv.service for mv in moves if mv.pod in landed}
     else:
         for mv in moves:
-            if backend.apply_move(mv) is not None:
+            if boundary.apply_move(mv) is not None:
                 moved_services.add(mv.service)
     moved_any = bool(moved_services)
     # services_moved carries the SERVICE names of moves that LANDED: its
@@ -563,7 +684,7 @@ def _pod_round(backend, state, graph, config, cfg, key, rnd) -> RoundRecord:
     )
 
 
-def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
+def _global_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
     cfg = GlobalSolverConfig(
         sweeps=config.global_solver_iters,
         balance_weight=config.balance_weight,
@@ -572,7 +693,7 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         move_cost=config.move_cost,
     )
     if config.placement_unit == "pod":
-        return _pod_round(backend, state, graph, config, cfg, key, rnd)
+        return _pod_round(boundary, state, graph, config, cfg, key, rnd)
     t0 = time.perf_counter()
     sparse_graph = None
     if config.solver_backend == "sparse":
@@ -582,10 +703,11 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
         # adjacency; streaming re-estimated graphs rebuild each round.
         from kubernetes_rescheduling_tpu.core import sparsegraph
 
-        cache = getattr(backend, "_sparse_graph_cache", None)
+        cache_host = getattr(boundary, "raw_backend", boundary)
+        cache = getattr(cache_host, "_sparse_graph_cache", None)
         if cache is None or cache[0] is not graph:
             cache = (graph, sparsegraph.from_comm_graph(graph))
-            backend._sparse_graph_cache = cache
+            cache_host._sparse_graph_cache = cache
         sparse_graph = cache[1]
     with span("controller/global_solve", round=rnd):
         new_state, info = jax.block_until_ready(
@@ -629,7 +751,7 @@ def _global_round(backend, state, graph, config, key, rnd) -> RoundRecord:
     moved_any = False
     moved_names: list[str] = []
     for s, target in changed:
-        landed = backend.apply_move(
+        landed = boundary.apply_move(
             MoveRequest(
                 service=graph.names[s],
                 target_node=new_state.node_names[target],

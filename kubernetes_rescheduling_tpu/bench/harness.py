@@ -43,7 +43,7 @@ from kubernetes_rescheduling_tpu.bench.sinks import (
     communication_cost_sink,
     node_std_sink,
 )
-from kubernetes_rescheduling_tpu.config import RescheduleConfig
+from kubernetes_rescheduling_tpu.config import ChaosConfig, RescheduleConfig
 from kubernetes_rescheduling_tpu.core.topology import _random_workmodel
 from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, mubench_workmodel_c
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
@@ -102,6 +102,13 @@ class ExperimentConfig:
     # declared workmodel topology (reference README.md:47 — the objective
     # is defined on actual deployed traffic).
     observe_weights: bool = False
+    # Chaos soak cells: a named backends.chaos profile ("none" = off)
+    # wraps each cell's LOOP backend (measurement phases stay on the raw
+    # backend); the breaker threshold feeds the controller's degraded-mode
+    # state machine.
+    chaos_profile: str = "none"
+    chaos_seed: int = 0
+    max_consecutive_failures: int = 5
 
     def __post_init__(self):
         # fail invalid solver combinations in milliseconds at construction,
@@ -420,6 +427,13 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 enforce_capacity=cfg.enforce_capacity,
                 capacity_frac=cfg.capacity_frac,
                 seed=seed,
+                # run_controller wraps ITS view of the backend in the chaos
+                # profile; the harness's own phase r1/r3 measurements stay
+                # on the raw backend (faults hit the loop, not the ruler)
+                chaos=ChaosConfig(
+                    profile=cfg.chaos_profile, seed=cfg.chaos_seed + run_i
+                ),
+                max_consecutive_failures=cfg.max_consecutive_failures,
             )
             # solve_graph (above) closes over this accumulator; bound here,
             # before the controller ever calls the estimator
@@ -523,7 +537,18 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 )
             load_during = during.stats()
 
-            # phase r3: load against the final placement
+            # phase r3: load against the final placement. A chaos cell's
+            # node flap may end the loop with a worker still killed — heal
+            # the raw backend first so the "after" ruler measures the
+            # recovered cluster, not the last injected fault.
+            if cfg.chaos_profile != "none":
+                revive = getattr(backend, "revive_node", None)
+                if revive is not None:
+                    for node in backend.node_names:
+                        revive(node)
+                pending = getattr(backend, "schedule_pending", None)
+                if pending is not None:
+                    pending()
             after = backend.monitor()
             load_after = loadgen.measure(after, k_after)
             after_metrics = {
@@ -549,6 +574,10 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 "decisions_per_sec": result.decisions_per_sec,
                 "decision_latency": result.latency_summary(),
                 "resumed_from_round": result.resumed_from_round,
+                "skipped_rounds": result.skipped_rounds,
+                "degraded_rounds": result.degraded_rounds,
+                "boundary_failures": result.boundary_failures,
+                "breaker_transitions": result.breaker_transitions,
                 "wall_s": wall_s,
                 "sim_clock_s": getattr(backend, "clock_s", None),
             }
@@ -591,3 +620,70 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
     session.mkdir(parents=True, exist_ok=True)
     (session / "summary.json").write_text(json.dumps(summary, indent=2, default=float))
     return summary
+
+
+def run_chaos_soak(
+    profile: str = "soak",
+    rounds: int = 30,
+    *,
+    scenario: str = "mubench",
+    algorithm: str = "communication",
+    seed: int = 0,
+    chaos_seed: int = 0,
+    max_consecutive_failures: int = 3,
+    breaker_cooldown_rounds: int = 2,
+    failure_budget_per_round: int = 2,
+    retry=None,
+    logger: StructuredLogger | None = None,
+    registry=None,
+) -> dict:
+    """The chaos soak cell: one seeded fault profile against one scenario,
+    the controller's degraded-mode machinery fully enabled.
+
+    The chaos wrapper is built HERE (not via ``config.chaos``) so the
+    report can cross-check the wrapper's own ``fault_counts`` against the
+    telemetry registry's ``chaos_faults_total`` counters — the invariant
+    the acceptance soak test pins: every injected fault is counted, every
+    round is accounted (``rounds == records + skips``), and the loop
+    finishes without raising.
+    """
+    from kubernetes_rescheduling_tpu.backends.chaos import with_chaos
+    from kubernetes_rescheduling_tpu.utils.retry import RetryPolicy
+
+    backend = make_backend(scenario, seed)
+    backend.inject_imbalance(backend.node_names[0])
+    chaos = with_chaos(backend, profile, seed=chaos_seed, registry=registry)
+    rcfg = RescheduleConfig(
+        algorithm=algorithm,
+        max_rounds=rounds,
+        sleep_after_action_s=0.0,
+        seed=seed,
+        retry=retry if retry is not None else RetryPolicy(max_attempts=2, base_delay_s=0.05),
+        max_consecutive_failures=max_consecutive_failures,
+        breaker_cooldown_rounds=breaker_cooldown_rounds,
+        failure_budget_per_round=failure_budget_per_round,
+    )
+    with span("bench/chaos_soak", profile=profile):
+        result = run_controller(
+            chaos, rcfg, key=jax.random.PRNGKey(seed), logger=logger,
+            registry=registry,
+        )
+    fault_counts = dict(getattr(chaos, "fault_counts", {}))
+    return {
+        "profile": profile,
+        "rounds": rounds,
+        "records": len(result.rounds),
+        "skipped_rounds": result.skipped_rounds,
+        "degraded_rounds": result.degraded_rounds,
+        "boundary_failures": result.boundary_failures,
+        "moves": result.moves,
+        "breaker_transitions": result.breaker_transitions,
+        "breaker_opens": sum(
+            1 for t in result.breaker_transitions if t["to"] == "open"
+        ),
+        "breaker_closes": sum(
+            1 for t in result.breaker_transitions if t["to"] == "closed"
+        ),
+        "fault_counts": fault_counts,
+        "faults_injected": sum(fault_counts.values()),
+    }

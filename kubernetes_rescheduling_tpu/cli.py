@@ -48,6 +48,27 @@ def _moves_per_round(value: str) -> int | str:
     return n
 
 
+def _add_resilience_flags(parser: argparse.ArgumentParser) -> None:
+    """Fault-injection + degraded-mode knobs, shared by reschedule/bench."""
+    parser.add_argument(
+        "--chaos-profile", default="none", metavar="NAME",
+        help="wrap the loop's backend in the fault-injecting ChaosBackend "
+             "under this named profile (none|flaky-monitor|flaky-moves|"
+             "node-flap|soak); faults are seeded and counted as "
+             "chaos_faults_total{kind}",
+    )
+    parser.add_argument(
+        "--chaos-seed", type=int, default=0,
+        help="seed for the injected fault stream (reproducible chaos)",
+    )
+    parser.add_argument(
+        "--max-consecutive-failures", type=int, default=5,
+        help="circuit breaker threshold: consecutive boundary failures "
+             "before the controller opens into safe mode (0 disables the "
+             "breaker; retries still apply)",
+    )
+
+
 def _add_telemetry_flags(parser: argparse.ArgumentParser) -> None:
     """The unified observability outputs, shared by every run command."""
     parser.add_argument(
@@ -115,6 +136,7 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["service", "pod"],
                    help="pod = every replica places independently (global "
                         "algorithm, sim backend)")
+    _add_resilience_flags(r)
     _add_telemetry_flags(r)
 
     b = sub.add_parser("bench", help="run the experiment matrix")
@@ -163,6 +185,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pod = every replica places independently (global "
                         "algorithm, sim backend)")
     b.add_argument("--seed", type=int, default=0)
+    _add_resilience_flags(b)
     _add_telemetry_flags(b)
 
     t = sub.add_parser(
@@ -275,7 +298,7 @@ def cmd_reschedule(args) -> dict:
 
     from kubernetes_rescheduling_tpu.bench.controller import run_controller
     from kubernetes_rescheduling_tpu.bench.harness import make_backend
-    from kubernetes_rescheduling_tpu.config import RescheduleConfig
+    from kubernetes_rescheduling_tpu.config import ChaosConfig, RescheduleConfig
 
     algo = _norm_algo(args.algorithm)
     if args.backend == "k8s" and args.placement_unit == "pod":
@@ -319,6 +342,8 @@ def cmd_reschedule(args) -> dict:
         solver_restarts=args.restarts,
         solver_tp=args.tp,
         seed=args.seed,
+        chaos=ChaosConfig(profile=args.chaos_profile, seed=args.chaos_seed),
+        max_consecutive_failures=args.max_consecutive_failures,
     )
     result = run_controller(backend, cfg, key=jax.random.PRNGKey(args.seed))
     return {
@@ -326,6 +351,10 @@ def cmd_reschedule(args) -> dict:
         "rounds": [rec.as_dict() for rec in result.rounds],
         "moves": result.moves,
         "decisions_per_sec": result.decisions_per_sec,
+        "skipped_rounds": result.skipped_rounds,
+        "degraded_rounds": result.degraded_rounds,
+        "boundary_failures": result.boundary_failures,
+        "breaker_transitions": result.breaker_transitions,
     }
 
 
@@ -360,6 +389,9 @@ def cmd_bench(args) -> dict:
         enforce_capacity=args.capacity_frac is not None,
         capacity_frac=args.capacity_frac if args.capacity_frac is not None else 1.0,
         seed=args.seed,
+        chaos_profile=args.chaos_profile,
+        chaos_seed=args.chaos_seed,
+        max_consecutive_failures=args.max_consecutive_failures,
     )
     return run_experiment(cfg)
 

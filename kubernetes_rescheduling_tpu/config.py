@@ -15,9 +15,11 @@ try:
     import tomllib  # Python >= 3.11
 except ModuleNotFoundError:  # pragma: no cover - depends on interpreter
     import tomli as tomllib  # type: ignore[no-redef]
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Literal
+
+from kubernetes_rescheduling_tpu.utils.retry import RetryPolicy
 
 PolicyName = Literal[
     "spread", "binpack", "random", "kubescheduling", "communication", "global"
@@ -30,6 +32,17 @@ POLICIES: tuple[str, ...] = (
     "kubescheduling",
     "communication",
 )
+
+
+@dataclass(frozen=True)
+class ChaosConfig:
+    """Fault-injection block: which named ``backends.chaos`` profile wraps
+    the loop's backend (``"none"`` = no wrapper), under which fault seed.
+    Profile names are validated by ``backends.chaos.with_chaos`` at wrap
+    time — this block stays jax-free so config import stays light."""
+
+    profile: str = "none"
+    seed: int = 0
 
 
 @dataclass(frozen=True)
@@ -92,6 +105,19 @@ class RescheduleConfig:
     delete_timeout_s: float = 180.0        # reference delete_replaced_pod.py:8
     delete_poll_interval_s: float = 1.5    # reference delete_replaced_pod.py:8
 
+    # Resilience: every controller→backend call goes through the retry
+    # boundary (utils.retry + bench.boundary); the breaker opens into safe
+    # mode after this many CONSECUTIVE boundary failures (0 disables the
+    # state machine — retries only), stays open `breaker_cooldown_rounds`
+    # rounds (each a counted skip), then half-open probes its way closed.
+    # `failure_budget_per_round` freezes a round's remaining MOVES once it
+    # has burned that many failures (0 = unlimited).
+    retry: RetryPolicy = field(default_factory=RetryPolicy)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    max_consecutive_failures: int = 5
+    breaker_cooldown_rounds: int = 2
+    failure_budget_per_round: int = 0
+
     def validate(self) -> "RescheduleConfig":
         valid = set(POLICIES) | {"global"}
         if self.algorithm not in valid:
@@ -132,6 +158,13 @@ class RescheduleConfig:
                     "(use move_cost — disruption pricing measures strictly "
                     "better than wave capping, RESULTS.md round 4)"
                 )
+        self.retry.validate()
+        if self.max_consecutive_failures < 0:
+            raise ValueError("max_consecutive_failures must be >= 0")
+        if self.breaker_cooldown_rounds < 1:
+            raise ValueError("breaker_cooldown_rounds must be >= 1")
+        if self.failure_budget_per_round < 0:
+            raise ValueError("failure_budget_per_round must be >= 0")
         return self
 
     @classmethod
@@ -141,4 +174,9 @@ class RescheduleConfig:
         unknown = set(data) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        # nested blocks arrive as TOML tables — rehydrate the dataclasses
+        if isinstance(data.get("retry"), dict):
+            data["retry"] = RetryPolicy(**data["retry"])
+        if isinstance(data.get("chaos"), dict):
+            data["chaos"] = ChaosConfig(**data["chaos"])
         return cls(**data).validate()

@@ -94,6 +94,24 @@ def summarize_events(records: list[dict[str, Any]]) -> list[str]:
                 f"  decision latency: p50={pct(50) * 1e3:.2f}ms "
                 f"p90={pct(90) * 1e3:.2f}ms max={lats[-1] * 1e3:.2f}ms"
             )
+    # resilience: breaker transitions, skipped/degraded rounds, boundary
+    # failures — the degraded-mode trajectory an operator reads first when
+    # a run looks wrong
+    transitions = [r for r in records if r.get("event") == "breaker"]
+    if transitions:
+        arrows = ", ".join(
+            f"{t.get('from', '?')}->{t.get('to', '?')}@r{t.get('round', '?')}"
+            for t in transitions
+        )
+        lines.append(f"  breaker: {arrows}")
+    skipped = by_event.get("round_skipped", 0)
+    degraded = sum(1 for r in rounds if r.get("degraded"))
+    failures = sum(1 for r in records if r.get("event") == "boundary_failure")
+    if skipped or degraded or failures:
+        lines.append(
+            f"  resilience: skipped={skipped} degraded={degraded} "
+            f"boundary_failures={failures}"
+        )
     return lines
 
 

@@ -1,26 +1,54 @@
-"""Cross-cutting utilities: structured logging, profiling, checkpointing.
+"""Cross-cutting utilities: structured logging, retry, profiling, checkpointing.
 
 The reference logs with bare ``print`` (SURVEY.md §5.5), has no profiler, and
 persists nothing but append-only CSVs (§5.4) — a crashed experiment restarts
-from round 1. Here: JSONL structured logs, per-round decision-latency
-histograms + a ``jax.profiler`` wrapper, and array-native checkpoint/resume.
+from round 1. Here: JSONL structured logs, a shared boundary retry policy,
+per-round decision-latency histograms + a ``jax.profiler`` wrapper, and
+array-native checkpoint/resume.
+
+``checkpoint`` imports ``jax.numpy`` at module load, so its names are
+resolved lazily (PEP 562): ``utils`` itself adds no jax dependency for
+consumers that only want ``logging``/``retry`` (``backends/k8s.py``,
+``config.py``). Note this is module-level hygiene only — the top-level
+package ``__init__`` currently imports jax anyway.
 """
 
 from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger, get_logger
+from kubernetes_rescheduling_tpu.utils.retry import (
+    RetryPolicy,
+    call_with_retry,
+    is_transient,
+)
 from kubernetes_rescheduling_tpu.utils.profiling import (
     LatencyHistogram,
     Timer,
     trace_to,
 )
-from kubernetes_rescheduling_tpu.utils.checkpoint import (
-    load_state,
-    save_state,
-    CheckpointManager,
-)
+
+_LAZY = {
+    "load_state": "checkpoint",
+    "save_state": "checkpoint",
+    "CheckpointManager": "checkpoint",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(
+            f"kubernetes_rescheduling_tpu.utils.{_LAZY[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "StructuredLogger",
     "get_logger",
+    "RetryPolicy",
+    "call_with_retry",
+    "is_transient",
     "LatencyHistogram",
     "Timer",
     "trace_to",

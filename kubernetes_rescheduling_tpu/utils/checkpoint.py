@@ -10,6 +10,7 @@ latest one.
 from __future__ import annotations
 
 import json
+import os
 from dataclasses import dataclass
 from pathlib import Path
 
@@ -41,23 +42,33 @@ def save_state(state: ClusterState, path: str | Path, extra: dict | None = None)
     """
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
-    # write-then-rename, .json before .npz: latest() discovers checkpoints
-    # by .npz, so a kill at any point leaves either no round_k entry or a
-    # complete one — never a truncated file that poisons every later resume
+    # write-to-temp then os.replace, .json before .npz: latest() discovers
+    # checkpoints by .npz, so a kill at any point leaves either no round_k
+    # entry or a complete one — never a truncated file that poisons every
+    # later resume. os.replace (not rename) is atomic AND overwrites, so a
+    # round replayed after a crash-resume cleanly supersedes its torn
+    # predecessor on every platform; each temp is fsynced before the
+    # replace so the swap never publishes data the kernel hasn't flushed.
     tmp_npz = Path(f"{p}.tmp.npz")  # numpy insists on the .npz extension
-    np.savez_compressed(
-        tmp_npz,
-        **{f: np.asarray(getattr(state, f)) for f in _ARRAY_FIELDS},
-    )
+    with open(tmp_npz, "wb") as f:
+        np.savez_compressed(
+            f,
+            **{a: np.asarray(getattr(state, a)) for a in _ARRAY_FIELDS},
+        )
+        f.flush()
+        os.fsync(f.fileno())
     meta = {
         "node_names": list(state.node_names),
         "pod_names": list(state.pod_names),
         "extra": extra or {},
     }
     tmp_json = Path(f"{p}.json.tmp")
-    tmp_json.write_text(json.dumps(meta, default=float))
-    tmp_json.rename(f"{p}.json")
-    tmp_npz.rename(f"{p}.npz")
+    with open(tmp_json, "w") as f:
+        f.write(json.dumps(meta, default=float))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp_json, f"{p}.json")
+    os.replace(tmp_npz, f"{p}.npz")
 
 
 def load_state(path: str | Path) -> tuple[ClusterState, dict]:
@@ -81,6 +92,9 @@ class CheckpointManager:
     keep: int = 5
 
     def save(self, round_num: int, state: ClusterState, extra: dict | None = None) -> Path:
+        """Crash-safe: temp-file + fsync + atomic ``os.replace`` (see
+        :func:`save_state`) — a kill mid-save can never leave a torn
+        latest checkpoint for resume to load."""
         d = Path(self.directory)
         d.mkdir(parents=True, exist_ok=True)
         path = d / f"round_{round_num:06d}"

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Static check: the controller never calls the backend boundary raw.
+
+Every ``monitor()`` / ``apply_move()`` the control loop issues must route
+through the retry-and-circuit-breaker boundary (``bench/boundary.py``) —
+one raw ``backend.monitor()`` re-introduces the reference's
+crash-on-flaky-cluster behavior the resilience layer exists to remove.
+
+AST-based, like its sibling ``check_no_print.py``: inside
+``bench/controller.py``, a ``.monitor(...)`` or ``.apply_move(...)`` call
+is only legal on a receiver NAMED ``boundary`` (the BoundaryClient the
+loop builds). The boundary module itself is the one place allowed to
+touch ``self.backend.<call>``.
+
+Run directly (exit 1 on violation) or through its test twin
+(tests/test_boundary_retry.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parent.parent / "kubernetes_rescheduling_tpu"
+# the control loop: the one consumer of the Backend protocol that must be
+# resilient. (harness/CLI measurement phases deliberately stay raw — a
+# broken ruler should fail loudly, not retry.)
+CHECKED = PACKAGE / "bench" / "controller.py"
+BOUNDARY_CALLS = {"monitor", "apply_move"}
+ALLOWED_RECEIVERS = {"boundary"}
+
+
+def find_raw_boundary_calls(path: Path) -> list[tuple[int, str]]:
+    """(line, source-ish) pairs for boundary calls on a raw receiver."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in BOUNDARY_CALLS
+        ):
+            continue
+        recv = node.func.value
+        if isinstance(recv, ast.Name) and recv.id in ALLOWED_RECEIVERS:
+            continue
+        recv_txt = ast.unparse(recv) if hasattr(ast, "unparse") else "<recv>"
+        out.append((node.lineno, f"{recv_txt}.{node.func.attr}(...)"))
+    return out
+
+
+def violations() -> list[str]:
+    return [
+        f"{CHECKED.relative_to(PACKAGE.parent)}:{line}: {what}"
+        for line, what in find_raw_boundary_calls(CHECKED)
+    ]
+
+
+def main() -> int:
+    bad = violations()
+    if bad:
+        sys.stderr.write(
+            "raw boundary call in the controller — route monitor()/"
+            "apply_move() through the BoundaryClient (bench/boundary.py):\n"
+            + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,39 @@
+"""CI twin of ``scripts/check_boundary_retry.py``: the controller's
+``monitor()``/``apply_move()`` calls all route through the retry +
+circuit-breaker boundary, never the raw backend."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+
+def _load_checker():
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_boundary_retry.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_boundary_retry", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_boundary_retry", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_controller_has_no_raw_boundary_calls():
+    checker = _load_checker()
+    assert checker.violations() == []
+
+
+def test_checker_catches_a_raw_call(tmp_path):
+    checker = _load_checker()
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "def run(backend, boundary):\n"
+        "    state = backend.monitor()\n"       # raw: flagged
+        "    ok = boundary.monitor()\n"          # routed: allowed
+        "    backend.apply_move(None)\n"         # raw: flagged
+        "    backend.comm_graph()\n"             # not a boundary call
+    )
+    lines = [line for line, _ in checker.find_raw_boundary_calls(f)]
+    assert lines == [2, 4]

@@ -1,0 +1,884 @@
+"""Resilience layer: retry policy, chaos backend, circuit breaker,
+degraded-mode controller, crash-safe checkpoints — ISSUE 2's surface.
+
+The acceptance soak test at the bottom runs ≥30 rounds under the seeded
+"soak" fault profile (monitor failures + move timeouts + node flap) and
+pins the invariants: the controller never raises, the breaker opens and
+re-closes, no round is silently lost, and every injected fault shows up
+in the telemetry registry.
+"""
+
+import dataclasses
+import json
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends.chaos import (
+    PROFILES,
+    ChaosBackend,
+    ChaosError,
+    ChaosProfile,
+    with_chaos,
+)
+from kubernetes_rescheduling_tpu.bench.boundary import (
+    BoundaryClient,
+    CircuitBreaker,
+)
+from kubernetes_rescheduling_tpu.bench.controller import run_controller
+from kubernetes_rescheduling_tpu.bench.harness import make_backend, run_chaos_soak
+from kubernetes_rescheduling_tpu.config import ChaosConfig, RescheduleConfig
+from kubernetes_rescheduling_tpu.telemetry import (
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+from kubernetes_rescheduling_tpu.utils.retry import RetryPolicy, call_with_retry
+
+
+@pytest.fixture()
+def registry():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+# ---- utils.retry ----
+
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self, registry):
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        out = call_with_retry(
+            flaky,
+            policy=RetryPolicy(max_attempts=3, base_delay_s=1.0, jitter_frac=0.0),
+            label="t",
+            sleeper=sleeps.append,
+        )
+        assert out == "ok"
+        assert sleeps == [1.0, 2.0]  # exponential backoff
+        fam = registry.counter("boundary_retries_total", labelnames=("call",))
+        assert fam.labels(call="t").value == 2
+
+    def test_exhaustion_reraises_last_and_counts(self, registry):
+        def dead():
+            raise TimeoutError("still down")
+
+        with pytest.raises(TimeoutError, match="still down"):
+            call_with_retry(
+                dead,
+                policy=RetryPolicy(max_attempts=2, base_delay_s=0.0),
+                label="t",
+                sleeper=lambda s: None,
+            )
+        fam = registry.counter("boundary_failures_total", labelnames=("call",))
+        assert fam.labels(call="t").value == 1
+
+    def test_non_retryable_raises_immediately(self, registry):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise TypeError("programming error")
+
+        with pytest.raises(TypeError):
+            call_with_retry(
+                broken,
+                policy=RetryPolicy(max_attempts=5, base_delay_s=0.0),
+                retryable=lambda e: isinstance(e, ConnectionError),
+                sleeper=lambda s: None,
+            )
+        assert calls["n"] == 1  # no second attempt
+
+    def test_deadline_stops_retrying(self, registry):
+        sleeps = []
+
+        def dead():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            call_with_retry(
+                dead,
+                policy=RetryPolicy(
+                    max_attempts=10, base_delay_s=5.0, jitter_frac=0.0,
+                    deadline_s=1.0,
+                ),
+                sleeper=sleeps.append,
+            )
+        assert sleeps == []  # the first backoff would already overrun
+
+    def test_retry_none(self, registry):
+        outs = iter([None, None, "late"])
+        out = call_with_retry(
+            lambda: next(outs),
+            policy=RetryPolicy(
+                max_attempts=3, base_delay_s=0.0, retry_none=True
+            ),
+            sleeper=lambda s: None,
+        )
+        assert out == "late"
+        # all-None exhausts to None (not an exception)
+        out = call_with_retry(
+            lambda: None,
+            policy=RetryPolicy(
+                max_attempts=2, base_delay_s=0.0, retry_none=True
+            ),
+            sleeper=lambda s: None,
+        )
+        assert out is None
+
+    def test_jitter_is_seeded_deterministic(self):
+        p = RetryPolicy(base_delay_s=1.0, jitter_frac=0.5)
+        a = p.backoff_s(2, random.Random(7))
+        b = p.backoff_s(2, random.Random(7))
+        assert a == b
+        assert 1.0 <= a <= 3.0  # 2.0 ± 50%
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0).validate()
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter_frac=1.5).validate()
+
+    def test_is_transient_shared_predicate(self):
+        from kubernetes_rescheduling_tpu.utils.retry import is_transient
+
+        assert is_transient(ConnectionError("reset"))
+        assert is_transient(TimeoutError("slow"))
+        throttled = Exception("throttled")
+        throttled.status = 503
+        assert is_transient(throttled)
+        definitive = Exception("gone")
+        definitive.status = 404
+        assert not is_transient(definitive)
+        # definitive local answers fail fast, never burn the retry budget
+        assert not is_transient(FileNotFoundError("no kubeconfig"))
+        assert not is_transient(PermissionError("unreadable CA bundle"))
+        assert not is_transient(TypeError("bug"))
+
+
+# ---- circuit breaker ----
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        kw.setdefault("max_consecutive_failures", 3)
+        kw.setdefault("cooldown_rounds", 2)
+        return CircuitBreaker(**kw)
+
+    def test_opens_after_consecutive_failures(self, registry):
+        br = self.make()
+        br.on_round_start(1)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+        br.record_failure()
+        assert br.state == "open"
+        assert br.transitions[-1]["to"] == "open"
+        fam = registry.counter(
+            "circuit_breaker_transitions_total", labelnames=("to",)
+        )
+        assert fam.labels(to="open").value == 1
+
+    def test_success_resets_count(self, registry):
+        br = self.make()
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+
+    def test_half_open_probe_then_close_or_reopen(self, registry):
+        br = self.make()
+        br.on_round_start(1)
+        for _ in range(3):
+            br.record_failure()
+        assert br.state == "open"
+        assert br.on_round_start(2) == "open"  # cooldown not elapsed
+        assert br.on_round_start(3) == "half_open"
+        br.record_failure()  # failed probe → straight back to open
+        assert br.state == "open"
+        assert br.on_round_start(5) == "half_open"
+        br.record_success()  # good probe → closed
+        assert br.state == "closed"
+        tos = [t["to"] for t in br.transitions]
+        assert tos == ["open", "half_open", "open", "half_open", "closed"]
+
+    def test_disabled_never_opens(self, registry):
+        br = self.make(max_consecutive_failures=0)
+        for _ in range(50):
+            br.record_failure()
+        assert br.state == "closed"
+
+
+# ---- chaos backend ----
+
+
+def _sim():
+    b = make_backend("mubench", seed=1)
+    b.inject_imbalance("worker1")
+    return b
+
+
+class TestChaosBackend:
+    def test_profiles_validate(self):
+        for name, prof in PROFILES.items():
+            assert prof.validate().name == name
+        with pytest.raises(ValueError):
+            ChaosProfile(monitor_error_rate=1.5).validate()
+        with pytest.raises(ValueError):
+            with_chaos(_sim(), "no-such-profile")
+
+    def test_none_profile_is_passthrough(self):
+        b = _sim()
+        assert with_chaos(b, "none") is b
+
+    def test_seeded_fault_stream_is_deterministic(self, registry):
+        def run(seed):
+            chaos = ChaosBackend(_sim(), PROFILES["soak"], seed=seed)
+            for _ in range(40):
+                try:
+                    chaos.monitor()
+                except ChaosError:
+                    pass
+            return dict(chaos.fault_counts)
+
+        assert run(3) == run(3)
+        assert run(3) != run(4)  # different seed, different stream
+
+    def test_injected_registry_receives_fault_counters(self):
+        """An explicitly injected registry gets the chaos counters — the
+        fault_counts==registry invariant must not depend on the process
+        default."""
+        own = MetricsRegistry()
+        chaos = ChaosBackend(
+            _sim(), ChaosProfile(monitor_error_rate=1.0), seed=0, registry=own
+        )
+        with pytest.raises(ChaosError):
+            chaos.monitor()
+        fam = own.counter("chaos_faults_total", labelnames=("kind",))
+        assert fam.labels(kind="monitor_error").value == 1
+
+    def test_fault_counts_match_registry(self, registry):
+        chaos = ChaosBackend(_sim(), PROFILES["soak"], seed=0)
+        for _ in range(30):
+            try:
+                chaos.monitor()
+            except ChaosError:
+                pass
+        assert chaos.fault_counts  # something was injected at these rates
+        fam = registry.counter("chaos_faults_total", labelnames=("kind",))
+        for kind, n in chaos.fault_counts.items():
+            assert fam.labels(kind=kind).value == n
+
+    def test_stale_snapshot_is_previous_state(self, registry):
+        prof = ChaosProfile(monitor_stale_rate=1.0)
+        chaos = ChaosBackend(_sim(), prof, seed=0)
+        first = chaos.monitor()  # nothing cached yet → real snapshot
+        assert first is not None
+        # mutate the cluster; a stale monitor must NOT see it
+        chaos.inner.kill_node("worker1")
+        again = chaos.monitor()
+        assert again is first
+        assert chaos.fault_counts["monitor_stale"] == 1
+
+    def test_partial_snapshot_drops_pods_not_shapes(self, registry):
+        prof = ChaosProfile(monitor_partial_rate=1.0, partial_drop_frac=0.3)
+        chaos = ChaosBackend(_sim(), prof, seed=0)
+        full = chaos.inner.monitor()
+        part = chaos.monitor()
+        assert part.pod_valid.shape == full.pod_valid.shape
+        n_full = int(np.asarray(full.pod_valid).sum())
+        n_part = int(np.asarray(part.pod_valid).sum())
+        assert n_part == n_full - int(n_full * 0.3)
+
+    def test_wrong_node_move_lands_elsewhere(self, registry):
+        from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+
+        prof = ChaosProfile(move_wrong_node_rate=1.0)
+        chaos = ChaosBackend(_sim(), prof, seed=0)
+        landed = chaos.apply_move(
+            MoveRequest(service="s0", target_node="worker2")
+        )
+        assert landed is not None and landed != "worker2"
+        assert chaos.fault_counts["move_wrong_node"] == 1
+
+    def test_move_timeout_consumes_inner_clock(self, registry):
+        from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+
+        prof = ChaosProfile(move_timeout_rate=1.0, move_timeout_s=30.0)
+        sim = _sim()
+        chaos = ChaosBackend(sim, prof, seed=0)
+        with pytest.raises(TimeoutError):
+            chaos.apply_move(MoveRequest(service="s0", target_node="worker2"))
+        assert sim.clock_s == 30.0
+
+    def test_node_flap_kills_and_revives(self, registry):
+        prof = ChaosProfile(node_flap_period=3, node_flap_down_calls=2)
+        sim = _sim()
+        chaos = ChaosBackend(sim, prof, seed=0)
+        saw_dead = False
+        for _ in range(10):
+            state = chaos.monitor()
+            if not bool(np.asarray(state.node_valid).all()):
+                saw_dead = True
+        assert saw_dead
+        assert chaos.fault_counts["node_kill"] >= 1
+        assert chaos.fault_counts["node_revive"] >= 1
+        # the last revive schedule eventually restores every node
+        assert chaos.fault_counts["node_kill"] - chaos.fault_counts[
+            "node_revive"
+        ] in (0, 1)
+
+
+# ---- boundary client ----
+
+
+class _FlakyBackend:
+    """Backend stub: scripted monitor/apply_move outcomes."""
+
+    def __init__(self, monitor_script=(), move_script=()):
+        self.monitor_script = list(monitor_script)
+        self.move_script = list(move_script)
+        self.advanced = []
+
+    def _pop(self, script, default):
+        item = script.pop(0) if script else default
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def monitor(self):
+        return self._pop(self.monitor_script, "state")
+
+    def apply_move(self, move):
+        return self._pop(self.move_script, "worker1")
+
+    def comm_graph(self):
+        return "graph"
+
+    def advance(self, seconds):
+        self.advanced.append(seconds)
+
+
+class TestBoundaryClient:
+    def make(self, backend, **kw):
+        kw.setdefault("policy", RetryPolicy(max_attempts=2, base_delay_s=0.0))
+        kw.setdefault(
+            "breaker",
+            CircuitBreaker(max_consecutive_failures=2, cooldown_rounds=1),
+        )
+        return BoundaryClient(backend, **kw)
+
+    def test_retries_then_succeeds(self, registry):
+        b = _FlakyBackend(monitor_script=[ConnectionError("x"), "fresh"])
+        bd = self.make(b)
+        assert bd.monitor() == "fresh"
+        assert bd.breaker.consecutive_failures == 0
+        assert b.advanced  # the backoff waited on the backend clock
+
+    def test_exhausted_monitor_returns_none_and_counts(self, registry):
+        b = _FlakyBackend(
+            monitor_script=[ConnectionError("x"), ConnectionError("x")]
+        )
+        bd = self.make(b)
+        bd.begin_round(1)
+        assert bd.monitor() is None
+        assert bd.round_failures == 1
+        assert bd.breaker.consecutive_failures == 1
+
+    def test_absorbs_status_bearing_api_errors(self, registry):
+        """A kubernetes-client-shaped ApiException (has .status) with a
+        throttling/server-side status is transient to the boundary; a
+        definitive status (404) is not."""
+
+        class ApiExc(Exception):
+            def __init__(self, status):
+                self.status = status
+
+        b = _FlakyBackend(monitor_script=[ApiExc(503), ApiExc(503)])
+        bd = self.make(b)
+        bd.begin_round(1)
+        assert bd.monitor() is None  # absorbed after retries, not raised
+        assert bd.breaker.consecutive_failures == 1
+
+        b2 = _FlakyBackend(monitor_script=[ApiExc(404)])
+        with pytest.raises(ApiExc):
+            self.make(b2).monitor()
+
+    def test_startup_success_while_open_recloses_breaker(self, registry):
+        """The startup probe loop can succeed while the breaker is OPEN
+        (opened by the failed probes themselves); the success must close
+        it — a healthy just-probed backend must not cost skipped rounds."""
+        b = _FlakyBackend(
+            monitor_script=[
+                ConnectionError("x"), ConnectionError("x"),
+                ConnectionError("x"), "fresh",
+            ]
+        )
+        bd = BoundaryClient(
+            b,
+            policy=RetryPolicy(max_attempts=1),
+            breaker=CircuitBreaker(
+                max_consecutive_failures=3, cooldown_rounds=2
+            ),
+        )
+        for _ in range(3):
+            assert bd.monitor() is None
+        assert bd.breaker.state == "open"
+        assert bd.monitor() == "fresh"
+        assert bd.breaker.state == "closed"
+
+    def test_programming_errors_propagate(self, registry):
+        b = _FlakyBackend(monitor_script=[TypeError("bug")])
+        bd = self.make(b)
+        with pytest.raises(TypeError):
+            bd.monitor()
+        # and a plain RuntimeError (e.g. a monkeypatched crash in a test)
+        b2 = _FlakyBackend(monitor_script=[RuntimeError("crash")])
+        with pytest.raises(RuntimeError):
+            self.make(b2).monitor()
+
+    def test_open_breaker_freezes_moves(self, registry):
+        b = _FlakyBackend()
+        bd = self.make(b)
+        bd.breaker.record_failure()
+        bd.breaker.record_failure()  # opens at 2
+        assert bd.breaker.state == "open"
+        assert bd.apply_move(object()) is None
+        assert b.move_script == []  # inner backend never touched
+
+    def test_failure_budget_freezes_round(self, registry):
+        b = _FlakyBackend(
+            move_script=[ConnectionError("x"), ConnectionError("x"), "w"]
+        )
+        bd = self.make(
+            b,
+            policy=RetryPolicy(max_attempts=1),
+            breaker=CircuitBreaker(max_consecutive_failures=0),
+            failure_budget_per_round=1,
+        )
+        bd.begin_round(1)
+        assert bd.apply_move(object()) is None  # burned the budget
+        assert bd.moves_frozen
+        assert bd.apply_move(object()) is None  # frozen, inner untouched
+        assert len(b.move_script) == 2
+        bd.begin_round(2)  # budget resets per round
+        assert not bd.moves_frozen
+
+
+# ---- controller degraded mode (integration) ----
+
+
+def test_controller_clean_run_unchanged(registry):
+    """With no chaos and no failures the resilience layer is invisible:
+    every round records, nothing skips, the breaker never moves."""
+    backend = _sim()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=4, sleep_after_action_s=0.0,
+        seed=1,
+    )
+    result = run_controller(backend, cfg)
+    assert len(result.rounds) == 4
+    assert result.skipped_rounds == 0
+    assert result.breaker_transitions == []
+    assert result.boundary_failures == 0
+    assert all(r.breaker_state == "closed" for r in result.rounds)
+    assert all(not r.degraded for r in result.rounds)
+
+
+def test_controller_config_chaos_wraps_backend(registry):
+    """config.chaos wires the wrapper inside run_controller: the loop
+    completes under injected faults and the registry shows them."""
+    backend = _sim()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=10, sleep_after_action_s=0.0,
+        seed=1,
+        chaos=ChaosConfig(profile="flaky-monitor", seed=1),
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.0),
+        max_consecutive_failures=3,
+    )
+    result = run_controller(backend, cfg)
+    assert len(result.rounds) + result.skipped_rounds == 10
+    recs = registry.snapshot()
+    kinds = {
+        r["labels"].get("kind")
+        for r in recs
+        if r["metric"] == "chaos_faults_total"
+    }
+    assert kinds  # faults were injected and counted
+
+
+def test_round_events_carry_resilience_fields(registry):
+    logger = StructuredLogger(name="t")
+    backend = _sim()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=2, sleep_after_action_s=0.0,
+        seed=1,
+    )
+    run_controller(backend, cfg, logger=logger)
+    rounds = [r for r in logger.records if r["event"] == "round"]
+    assert len(rounds) == 2
+    for r in rounds:
+        assert r["breaker"] == "closed"
+        assert r["degraded"] is False
+        assert r["boundary_failures"] == 0
+
+
+# ---- acceptance: the chaos soak ----
+
+
+def test_chaos_soak_acceptance(registry):
+    """ISSUE 2 acceptance: ≥30 rounds under the seeded soak profile
+    (monitor failures + move timeouts + node flap). The controller never
+    raises (reaching the asserts proves it), the breaker opens and
+    re-closes at least once, no round is silently lost, and the injected
+    fault counts equal the registry's fault counters."""
+    logger = StructuredLogger(name="soak")
+    report = run_chaos_soak(
+        profile="soak",
+        rounds=35,
+        seed=1,
+        chaos_seed=0,
+        retry=RetryPolicy(max_attempts=1),
+        max_consecutive_failures=3,
+        breaker_cooldown_rounds=2,
+        failure_budget_per_round=2,
+        logger=logger,
+    )
+    # ≥ 30 simulated rounds, each one accounted: a record or a counted skip
+    assert report["rounds"] == 35
+    assert report["records"] + report["skipped_rounds"] == 35
+    assert report["skipped_rounds"] >= 1
+    # the breaker opened into safe mode and recovered
+    assert report["breaker_opens"] >= 1
+    assert report["breaker_closes"] >= 1
+    # injected-fault counts == the registry's fault counters, per kind
+    assert report["faults_injected"] > 0
+    fam = registry.counter("chaos_faults_total", labelnames=("kind",))
+    for kind, n in report["fault_counts"].items():
+        assert fam.labels(kind=kind).value == n
+    # skip accounting agrees between result, registry, and event log
+    fam = registry.counter("rounds_skipped_total", labelnames=("algorithm",))
+    assert fam.labels(algorithm="communication").value == report["skipped_rounds"]
+    events = [r["event"] for r in logger.records]
+    assert events.count("round_skipped") == report["skipped_rounds"]
+    assert events.count("round") == report["records"]
+    assert "breaker" in events
+
+
+def test_harness_chaos_cell_completes_and_reports(tmp_path, registry):
+    """A chaos soak cell in the experiment matrix: faults hit the LOOP
+    (run_controller's wrapped view) while the harness's before/after
+    measurements stay on the raw backend, and the run record carries the
+    resilience accounting."""
+    from kubernetes_rescheduling_tpu.bench.harness import (
+        ExperimentConfig,
+        run_experiment,
+    )
+    from kubernetes_rescheduling_tpu.bench.loadgen import LoadGenConfig
+
+    cfg = ExperimentConfig(
+        algorithms=("communication",),
+        repeats=1,
+        rounds=5,
+        scenario="mubench",
+        out_dir=str(tmp_path),
+        seed=3,
+        chaos_profile="flaky-moves",
+        chaos_seed=0,
+        max_consecutive_failures=3,
+        load=LoadGenConfig(requests_per_phase=256, chunk=256),
+    )
+    summary = run_experiment(cfg)
+    run = summary["runs"][0]
+    assert "skipped_rounds" in run and "boundary_failures" in run
+    # every round accounted for
+    rounds_jsonl = list(tmp_path.glob("session_*/communication/run_1/rounds.jsonl"))
+    assert len(rounds_jsonl) == 1
+    recorded = len(rounds_jsonl[0].read_text().splitlines())
+    assert recorded + run["skipped_rounds"] == 5
+
+
+# ---- config plumbing ----
+
+
+def test_config_toml_nested_resilience_blocks(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        "algorithm = 'communication'\n"
+        "max_consecutive_failures = 7\n"
+        "[retry]\n"
+        "max_attempts = 4\n"
+        "base_delay_s = 0.25\n"
+        "[chaos]\n"
+        "profile = 'flaky-moves'\n"
+        "seed = 9\n"
+    )
+    cfg = RescheduleConfig.from_toml(p)
+    assert cfg.retry == RetryPolicy(max_attempts=4, base_delay_s=0.25)
+    assert cfg.chaos == ChaosConfig(profile="flaky-moves", seed=9)
+    assert cfg.max_consecutive_failures == 7
+
+
+def test_config_resilience_validation():
+    with pytest.raises(ValueError):
+        RescheduleConfig(max_consecutive_failures=-1).validate()
+    with pytest.raises(ValueError):
+        RescheduleConfig(breaker_cooldown_rounds=0).validate()
+    with pytest.raises(ValueError):
+        RescheduleConfig(retry=RetryPolicy(max_attempts=0)).validate()
+
+
+# ---- satellite: k8s narrow exceptions + swallowed-error counter ----
+
+
+class _ApiError(Exception):
+    def __init__(self, status):
+        self.status = status
+
+
+class _MiniCore:
+    def list_node(self, watch=False):
+        return {
+            "items": [
+                {
+                    "metadata": {"name": n},
+                    "status": {"capacity": {"cpu": "8", "memory": "16Gi"}},
+                }
+                for n in ("master", "worker1")
+            ]
+        }
+
+    def list_namespaced_pod(self, namespace, watch=False):
+        return {"items": []}
+
+
+class _RaisingCustom:
+    def __init__(self, exc):
+        self.exc = exc
+
+    def list_cluster_custom_object(self, *a, **kw):
+        raise self.exc
+
+    def list_namespaced_custom_object(self, *a, **kw):
+        raise self.exc
+
+
+def _k8s_backend(custom_exc):
+    from kubernetes_rescheduling_tpu.backends.k8s import K8sBackend
+    from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+
+    return K8sBackend(
+        workmodel=mubench_workmodel_c(),
+        core_api=_MiniCore(),
+        apps_api=object(),
+        custom_api=_RaisingCustom(custom_exc),
+        sleeper=lambda s: None,
+    )
+
+
+def test_k8s_swallows_api_errors_with_log_and_counter(registry):
+    backend = _k8s_backend(_ApiError(503))
+    state = backend.monitor()  # metrics-server down: usage stays 0
+    assert state.num_nodes == 1  # master excluded
+    fam = registry.counter(
+        "backend_swallowed_errors_total", labelnames=("backend", "call")
+    )
+    assert fam.labels(backend="k8s", call="monitor.node_metrics").value == 1
+    assert fam.labels(backend="k8s", call="monitor.pod_metrics").value == 1
+    # the structured log saw both swallows too
+    swallowed = [
+        r for r in backend.slog.records if r["event"] == "swallowed_error"
+    ]
+    assert len(swallowed) >= 2
+
+
+def test_k8s_programming_errors_are_not_swallowed(registry):
+    backend = _k8s_backend(TypeError("bug in the adapter"))
+    with pytest.raises(TypeError, match="bug in the adapter"):
+        backend.monitor()
+    # interpreter-level RuntimeError subclasses are coding bugs, not API
+    # weather — they must stay fatal too
+    backend = _k8s_backend(RecursionError("runaway parse"))
+    with pytest.raises(RecursionError):
+        backend.monitor()
+
+
+def test_k8s_create_conflict_after_delete_counts_as_success(registry):
+    """409 AlreadyExists on the create-after-delete path = the first
+    (response-lost) create attempt landed; the move must report success,
+    mirroring the 404-on-delete rule."""
+    from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+    from kubernetes_rescheduling_tpu.backends.k8s import K8sBackend
+    from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+
+    body = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "s0", "namespace": "default"},
+        "spec": {
+            "replicas": 1,
+            "template": {"metadata": {}, "spec": {"containers": []}},
+        },
+    }
+
+    class ConflictApps:
+        def __init__(self):
+            self.deleted = False
+
+        def read_namespaced_deployment(self, name, namespace):
+            if self.deleted:
+                raise _ApiError(404)
+            return body
+
+        def delete_namespaced_deployment(self, name, namespace, body=None):
+            self.deleted = True
+
+        def create_namespaced_deployment(self, namespace, body):
+            raise _ApiError(409)  # our retried create collided with itself
+
+    backend = K8sBackend(
+        workmodel=mubench_workmodel_c(),
+        core_api=_MiniCore(),
+        apps_api=ConflictApps(),
+        custom_api=_RaisingCustom(_ApiError(404)),
+        sleeper=lambda s: None,
+        delete_timeout_s=0.01,
+        delete_poll_interval_s=0.001,
+    )
+    landed = backend.apply_move(
+        MoveRequest(service="s0", target_node="worker1", mechanism="nodeName")
+    )
+    assert landed == "worker1"
+    # and it was NOT counted as a swallowed error
+    fam = registry.counter(
+        "backend_swallowed_errors_total", labelnames=("backend", "call")
+    )
+    assert fam.labels(
+        backend="k8s", call="apply_move.create_deployment"
+    ).value == 0
+
+
+def test_k8s_retries_throttled_status(registry):
+    """429/5xx retry under the adapter's policy; a definitive 404 does not."""
+    calls = {"n": 0}
+
+    class FlakyCustom(_RaisingCustom):
+        def list_cluster_custom_object(self, *a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise _ApiError(503)
+            return {"items": []}
+
+        def list_namespaced_custom_object(self, *a, **kw):
+            return {"items": []}
+
+    backend = _k8s_backend(_ApiError(404))
+    backend.custom_api = FlakyCustom(None)
+    backend.monitor()
+    assert calls["n"] == 2  # the 503 was retried, then succeeded
+    fam = registry.counter("boundary_retries_total", labelnames=("call",))
+    assert fam.labels(call="k8s.node_metrics").value == 1
+
+
+# ---- satellite: crash-safe checkpoints + mid-round crash resume ----
+
+
+def test_checkpoint_save_atomically_replaces_torn_predecessor(tmp_path):
+    from kubernetes_rescheduling_tpu.core.topology import mubench_scenario
+    from kubernetes_rescheduling_tpu.utils.checkpoint import CheckpointManager
+
+    scn = mubench_scenario()
+    # a previous crash left a torn (garbage) checkpoint for round 5
+    (tmp_path / "round_000005.npz").write_bytes(b"not a zip")
+    (tmp_path / "round_000005.json").write_text("{broken")
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(5, scn.state, extra={"cost": 1.0})  # os.replace overwrites both
+    r, state, extra = mgr.latest()
+    assert r == 5 and extra["cost"] == 1.0
+    np.testing.assert_array_equal(
+        np.asarray(state.pod_node), np.asarray(scn.state.pod_node)
+    )
+
+
+def test_resume_replays_crashed_round_with_identical_decisions(tmp_path):
+    """Kill the loop inside on_round (a raising sink) mid-round 3, resume
+    from checkpoint_dir on a fresh backend: the crashed round replays and
+    every fold_in-derived decision matches the uninterrupted run."""
+    import jax
+
+    rounds = 6
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=rounds,
+        sleep_after_action_s=0.0, seed=5,
+    )
+
+    def fields(rec):
+        return (rec.round, rec.moved, rec.services_moved, rec.target,
+                rec.most_hazard)
+
+    clean = run_controller(
+        _sim(), cfg, key=jax.random.PRNGKey(5),
+        checkpoint_dir=str(tmp_path / "clean"),
+    )
+
+    class Crash(RuntimeError):
+        pass
+
+    def crashing_sink(rec, state):
+        if rec.round == 3:
+            raise Crash("sink died")
+
+    ckpt = str(tmp_path / "crashy")
+    with pytest.raises(Crash):
+        run_controller(
+            _sim(), cfg, key=jax.random.PRNGKey(5),
+            checkpoint_dir=ckpt, on_round=crashing_sink,
+        )
+
+    resumed = run_controller(
+        _sim(), cfg, key=jax.random.PRNGKey(5), checkpoint_dir=ckpt
+    )
+    # checkpoints exist for rounds 1-2 only → round 3 is REPLAYED
+    assert resumed.resumed_from_round == 3
+    assert [r.round for r in resumed.rounds] == list(range(3, rounds + 1))
+    expected = [fields(r) for r in clean.rounds[2:]]
+    assert [fields(r) for r in resumed.rounds] == expected
+
+
+# ---- report surfacing ----
+
+
+def test_report_summarizes_resilience_events():
+    from kubernetes_rescheduling_tpu.telemetry.report import summarize_events
+
+    records = [
+        {"event": "round", "round": 1, "moved": True, "degraded": False,
+         "communication_cost": 5.0, "decision_latency_s": 0.01},
+        {"event": "boundary_failure", "call": "monitor", "error": "x"},
+        {"event": "breaker", "round": 2, "from": "closed", "to": "open"},
+        {"event": "round_skipped", "round": 2, "breaker": "open"},
+        {"event": "breaker", "round": 4, "from": "open", "to": "half_open"},
+        {"event": "breaker", "round": 4, "from": "half_open", "to": "closed"},
+        {"event": "round", "round": 4, "moved": False, "degraded": True,
+         "communication_cost": 4.0, "decision_latency_s": 0.01},
+    ]
+    text = "\n".join(summarize_events(records))
+    assert "breaker: closed->open@r2" in text
+    assert "skipped=1" in text
+    assert "degraded=1" in text
+    assert "boundary_failures=1" in text

@@ -77,6 +77,10 @@ class CircuitBreaker:
     cooldown_rounds: int = 2
     logger: StructuredLogger | None = None
     registry: MetricsRegistry | None = None
+    # observer hook (the live ops plane): called AFTER a transition is
+    # recorded/counted/logged, with the transition record — the flight
+    # recorder dumps its bundle from here on close→open
+    on_transition: Callable[[dict], None] | None = None
 
     state: str = CLOSED
     consecutive_failures: int = 0
@@ -105,6 +109,8 @@ class CircuitBreaker:
         ).set(_STATE_CODE[to])
         if self.logger is not None:
             self.logger.info("breaker", **rec)
+        if self.on_transition is not None:
+            self.on_transition(rec)
 
     @property
     def enabled(self) -> bool:

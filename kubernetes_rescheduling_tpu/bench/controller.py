@@ -38,6 +38,10 @@ from kubernetes_rescheduling_tpu.telemetry import (
     pull,
     span,
 )
+from kubernetes_rescheduling_tpu.telemetry.explain import (
+    greedy_explanation,
+    solver_explanation,
+)
 from kubernetes_rescheduling_tpu.utils.checkpoint import CheckpointManager
 from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
 from kubernetes_rescheduling_tpu.utils.profiling import LatencyHistogram
@@ -46,7 +50,7 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     GlobalSolverConfig,
     pct_balance_terms,
 )
-from kubernetes_rescheduling_tpu.solver.round_loop import decide
+from kubernetes_rescheduling_tpu.solver.round_loop import decide, decide_explain
 
 
 @dataclass
@@ -71,6 +75,9 @@ class RoundRecord:
     breaker_state: str = "closed"
     degraded: bool = False
     boundary_failures: int = 0
+    # decision explainability: one DecisionExplanation dict per decide/
+    # solve this round (telemetry.explain) — empty when explain is off
+    explanations: tuple[dict, ...] = ()
 
     @property
     def decision_latency_s(self) -> float:
@@ -132,6 +139,15 @@ class ControllerResult:
 # shape-polymorphic and every round is paying a recompile.
 _decide = instrument_jit(decide, name="controller_decide")
 
+# the explain twin: the same decision (shared policy_scores + lex argmax —
+# bit-identical by construction) plus the compact explanation bundle the
+# host pulls in ONE transfer. Separate fn label, same steady-state
+# invariant: 1 trace per (shape, top_k) signature.
+_decide_explain = instrument_jit(
+    decide_explain, name="controller_decide_explain",
+    static_argnames=("top_k",),
+)
+
 
 def _emit_round_metrics(registry, algorithm: str, record: "RoundRecord") -> None:
     """One metric sample set per completed round — the registry twin of
@@ -189,6 +205,7 @@ def run_controller(
     logger: StructuredLogger | None = None,
     graph=None,
     registry=None,
+    ops=None,
 ) -> ControllerResult:
     """Run ``config.max_rounds`` rounds against a backend.
 
@@ -226,6 +243,14 @@ def run_controller(
     mode: moves freeze, the last good snapshot is reused, and each frozen
     round is a COUNTED skip (``result.skipped_rounds``; never a silent
     hole — ``max_rounds == len(result.rounds) + result.skipped_rounds``).
+
+    ``ops`` (a ``telemetry.server.OpsPlane``) attaches the live ops
+    plane: /healthz reads the breaker and SLO watchdog in real time, the
+    flight recorder rings the last N rounds and dumps a bundle on
+    breaker-open / crash / SIGUSR1, and each round feeds the watchdog.
+    Decision explainability is on whenever ``config.obs.explain`` and a
+    logger or ops plane is attached: rounds carry ``DecisionExplanation``
+    dicts (``record.explanations``) and emit ``decision`` events.
     """
     config = config.validate()
     registry = registry if registry is not None else get_registry()
@@ -248,6 +273,17 @@ def run_controller(
         failure_budget_per_round=config.failure_budget_per_round,
         logger=logger,
         registry=registry,
+    )
+    if ops is not None:
+        ops.bind(breaker=breaker, logger=logger, algorithm=config.algorithm)
+        breaker.on_transition = ops.on_breaker_transition
+    # decision explainability: on when configured AND someone is listening
+    # (a structured logger or the ops plane) — the bare loop stays exactly
+    # the historical decision kernel
+    explain_k = (
+        config.obs.explain_top_k
+        if config.obs.explain and (ops is not None or logger is not None)
+        else 0
     )
     # decisions may run on an estimated graph; TELEMETRY always reports on
     # the backend's declared graph so round costs stay comparable across
@@ -291,6 +327,8 @@ def run_controller(
                 breaker=breaker.state,
                 consecutive_failures=breaker.consecutive_failures,
             )
+        if ops is not None:
+            ops.observe_skip(rnd, breaker_state=breaker.state)
         boundary.advance(config.sleep_after_action_s)
         if mgr is not None:
             mgr.save(
@@ -313,52 +351,57 @@ def run_controller(
             "backend unavailable: initial monitor() failed after retries "
             "(no last good snapshot to degrade to)"
         )
-    for rnd in range(start_round, config.max_rounds + 1):
-        mode = boundary.begin_round(rnd)
-        if mode == OPEN:
-            skip_round(rnd, state)
-            continue
-        if mode == HALF_OPEN:
-            # one probe before trusting the backend with a full round; a
-            # success closes the breaker AND refreshes the stale snapshot
-            probe = boundary.monitor()
-            if probe is None:
+    try:
+        for rnd in range(start_round, config.max_rounds + 1):
+            mode = boundary.begin_round(rnd)
+            if mode == OPEN:
                 skip_round(rnd, state)
                 continue
-            state = probe
-        sub = jax.random.fold_in(key, rnd)
-        graph = graph_src()  # fresh estimate per round when streaming
+            if mode == HALF_OPEN:
+                # one probe before trusting the backend with a full round; a
+                # success closes the breaker AND refreshes the stale snapshot
+                probe = boundary.monitor()
+                if probe is None:
+                    skip_round(rnd, state)
+                    continue
+                state = probe
+            sub = jax.random.fold_in(key, rnd)
+            graph = graph_src()  # fresh estimate per round when streaming
 
-        with span("controller/round", round=rnd, algorithm=config.algorithm):
-            if config.algorithm == "global" or config.moves_per_round == "all":
-                record = _global_round(boundary, state, graph, config, sub, rnd)
+            with span("controller/round", round=rnd, algorithm=config.algorithm):
+                if config.algorithm == "global" or config.moves_per_round == "all":
+                    record = _global_round(
+                        boundary, state, graph, config, sub, rnd,
+                        logger=logger, explain=explain_k > 0,
+                    )
+                else:
+                    record = _greedy_round(
+                        boundary, state, graph, config, sub, rnd,
+                        logger=logger, explain_k=explain_k,
+                    )
+                boundary.advance(config.sleep_after_action_s)
+                with span("backend/monitor"):
+                    new_state = boundary.monitor()
+            if new_state is None:
+                # post-move snapshot failed: finish the round DEGRADED on the
+                # last good snapshot instead of crashing (metrics below are
+                # stale but labeled as such via record.degraded)
+                record.degraded = True
             else:
-                record = _greedy_round(boundary, state, graph, config, sub, rnd)
-            boundary.advance(config.sleep_after_action_s)
-            with span("backend/monitor"):
-                new_state = boundary.monitor()
-        if new_state is None:
-            # post-move snapshot failed: finish the round DEGRADED on the
-            # last good snapshot instead of crashing (metrics below are
-            # stale but labeled as such via record.degraded)
-            record.degraded = True
-        else:
-            state = new_state
-        record.breaker_state = breaker.state
-        record.boundary_failures = boundary.round_failures
-        record.communication_cost = float(communication_cost(state, metric_graph))
-        record.load_std = float(load_std(state))
-        result.rounds.append(record)
-        _emit_round_metrics(registry, config.algorithm, record)
-        if record.degraded:
-            registry.counter(
-                "degraded_rounds_total",
-                "rounds completed on a stale snapshot after boundary failure",
-                labelnames=("algorithm",),
-            ).labels(algorithm=config.algorithm).inc()
-        if logger is not None:
-            logger.info(
-                "round",
+                state = new_state
+            record.breaker_state = breaker.state
+            record.boundary_failures = boundary.round_failures
+            record.communication_cost = float(communication_cost(state, metric_graph))
+            record.load_std = float(load_std(state))
+            result.rounds.append(record)
+            _emit_round_metrics(registry, config.algorithm, record)
+            if record.degraded:
+                registry.counter(
+                    "degraded_rounds_total",
+                    "rounds completed on a stale snapshot after boundary failure",
+                    labelnames=("algorithm",),
+                ).labels(algorithm=config.algorithm).inc()
+            round_event = dict(
                 round=rnd,
                 moved=record.moved,
                 services=list(record.services_moved),
@@ -372,55 +415,116 @@ def run_controller(
                 degraded=record.degraded,
                 boundary_failures=record.boundary_failures,
             )
-        if on_round is not None:
-            on_round(record, state)
-        # checkpoint LAST: a crash inside on_round (sinks, load segment)
-        # replays this round on resume instead of leaving a hole in its
-        # outputs; replaying a move is idempotent (same pin, same target)
-        if mgr is not None:
-            mgr.save(rnd, state, extra={"algorithm": config.algorithm})
+            if logger is not None:
+                logger.info("round", **round_event)
+            if ops is not None:
+                ops.observe_round(
+                    record,
+                    state,
+                    events=[
+                        {"event": "decision", **e} for e in record.explanations
+                    ] + [{"event": "round", **round_event}],
+                )
+            if on_round is not None:
+                on_round(record, state)
+            # checkpoint LAST: a crash inside on_round (sinks, load segment)
+            # replays this round on resume instead of leaving a hole in its
+            # outputs; replaying a move is idempotent (same pin, same target)
+            if mgr is not None:
+                mgr.save(rnd, state, extra={"algorithm": config.algorithm})
+    except BaseException as e:
+        # the always-on crash-dump path: whatever escapes the loop leaves
+        # a flight-recorder bundle behind before propagating
+        if ops is not None:
+            ops.on_crash(e)
+        raise
     result.breaker_transitions = list(breaker.transitions)
     result.boundary_failures = boundary.total_failures
     return result
 
 
-def _greedy_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
+def _greedy_round(
+    boundary, state, graph, config, key, rnd, *, logger=None, explain_k=0,
+) -> RoundRecord:
     """Up to ``config.moves_per_round`` greedy moves: after each move the
     working snapshot is edited in place (the moved service's pods re-homed —
     reference main.py:73's ``edit_cluster`` intent, done correctly), so the
     next decision sees the drained hazard node and stops early once nothing
-    is hazardous anymore."""
+    is hazardous anymore.
+
+    With ``explain_k > 0`` each decide runs the explain twin of the
+    decision kernel (bit-identical choice) and records a
+    ``DecisionExplanation`` — top-k hazard nodes, top-k candidate targets
+    with score margins, chosen target and why — pulled device→host as ONE
+    counted transfer and emitted as a ``decision`` event."""
     pid = jnp.asarray(POLICY_IDS[config.algorithm])
     k_moves = config.moves_per_round
     first_hazard: str | None = None
     moved_names: list[str] = []
     first_target: str | None = None
     latencies: list[float] = []
+    explanations: list[dict] = []
+
+    def emit(expl, stop=None):
+        if expl is None:
+            return
+        if stop is not None:
+            expl["stop"] = stop
+            expl["why"] += f" ({stop})"
+        explanations.append(expl)
+        if logger is not None:
+            logger.info("decision", **expl)
 
     for i in range(k_moves):
         key, sub = jax.random.split(key)
         t0 = time.perf_counter()
         with span("controller/decide", round=rnd):
-            most, hazard_mask, victim, svc, target = jax.block_until_ready(
-                _decide(
-                    state, graph, pid,
-                    jnp.asarray(config.hazard_threshold_pct), sub,
+            if explain_k > 0:
+                most, hazard_mask, victim, svc, target, bundle = (
+                    jax.block_until_ready(
+                        _decide_explain(
+                            state, graph, pid,
+                            jnp.asarray(config.hazard_threshold_pct), sub,
+                            top_k=explain_k,
+                        )
+                    )
                 )
-            )
+            else:
+                bundle = None
+                most, hazard_mask, victim, svc, target = jax.block_until_ready(
+                    _decide(
+                        state, graph, pid,
+                        jnp.asarray(config.hazard_threshold_pct), sub,
+                    )
+                )
         latencies.append(time.perf_counter() - t0)
 
         most_i, victim_i, target_i = int(most), int(victim), int(target)
+        service_name = graph.names[int(svc)] if victim_i >= 0 else None
+        target_name = state.node_names[target_i] if target_i >= 0 else None
+        expl = None
+        if bundle is not None:
+            expl = greedy_explanation(
+                pull(bundle, site="decision_explain"),
+                state.node_names,
+                round=rnd,
+                seq=i,
+                policy=config.algorithm,
+                service=service_name,
+                hazard_node=state.node_names[most_i] if most_i >= 0 else None,
+                chosen=target_name if victim_i >= 0 else None,
+            )
         if first_hazard is None and most_i >= 0:
             first_hazard = state.node_names[most_i]
         if most_i < 0 or victim_i < 0 or target_i < 0:
+            emit(expl)
             break  # no hazard left (or nowhere to go): the round is done
-        service_name = graph.names[int(svc)]
         if service_name in moved_names:
             # the drain has started ping-ponging (the move made the target
             # the new hazard node and elected the same service back) —
             # further moves this round are churn, not progress
+            emit(expl, stop="ping-pong stop: service already moved this round")
             break
-        target_name = state.node_names[target_i]
         hazard_names = tuple(
             state.node_names[j]
             for j in range(state.num_nodes)
@@ -434,6 +538,10 @@ def _greedy_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
                 mechanism=PlacementMechanism[config.algorithm],
             )
         )
+        if expl is not None:
+            expl["landed"] = landed
+            expl["applied"] = landed is not None
+        emit(expl, stop=None if landed is not None else "boundary move failed")
         if landed is None:
             break
         moved_names.append(service_name)
@@ -463,35 +571,16 @@ def _greedy_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
         load_std=0.0,
         services_moved=tuple(moved_names),
         decision_latencies_s=tuple(latencies),
+        explanations=tuple(explanations),
     )
 
 
-def _top_gain_moves(
-    changed: list[tuple[int, int]], state, graph, solver_cfg, k: int
-) -> list[tuple[int, int]]:
-    """≤``k`` strictly-improving moves selected GREEDILY AND SEQUENTIALLY,
-    using the SOLVER's own accounting (``solver_cfg`` is the round's
-    GlobalSolverConfig): comm + λ·std of CPU-% **of the packing budget**
-    (``capacity_frac``-scaled, exactly as the solver's objective measures
-    load) + the over-budget repulsion term when capacity is enforced.
+def _move_scoring_env(state, graph, solver_cfg):
+    """Host-side scoring context over one snapshot — the shared setup for
+    the wave-cap selection (``_top_gain_moves``) and the per-move gain
+    scores the ``global`` DecisionExplanation records."""
+    import types
 
-    Each accepted move updates the working placement and node loads, and
-    every remaining candidate is re-scored against that updated state —
-    so the wave is jointly consistent: two moves cannot cumulatively
-    over-budget one node (while capacity is enforced, a candidate whose
-    target would newly exceed the CPU or memory budget is skipped — the
-    solver's own feasibility rule), and a move the solver admitted only
-    because an earlier move vacates its target is scored with that
-    vacancy visible.
-
-    Comm gain of relocating service ``s`` to ``t`` with every *unmoved*
-    service fixed: ``Σ_j W[s,j]·([node_j ≠ cur_s] − [node_j ≠ t])`` on the
-    replica-weighted pair matrix (row-wise host-side — only the changed
-    services' adjacency rows are touched). Candidates whose gain at their
-    evaluation state is ≤ 0 are never selected — they only pay off in
-    combination with moves this wave did not take, and applying them alone
-    is churn (the convergence criterion: a capped loop stops when no
-    single next move helps)."""
     S = graph.num_services
     svc_arr = np.asarray(state.pod_service)
     valid = np.asarray(state.pod_valid)
@@ -533,33 +622,102 @@ def _top_gain_moves(
             )
         )
 
-    work_node = svc_node.copy()
-    loads = used.copy()
-    mem_loads = mem_used.copy()
+    return types.SimpleNamespace(
+        svc_node=svc_node, svc_cpu=svc_cpu, svc_mem=svc_mem,
+        replicas=replicas, adj=adj, placed=placed,
+        cap=cap, mem_cap=mem_cap, used=used, mem_used=mem_used,
+        enforce_capacity=solver_cfg.enforce_capacity,
+        balance_terms=balance_terms,
+    )
+
+
+def _move_gain(env, work_node, loads, mem_loads, bal_now, s, t):
+    """(gain, feasible) of relocating service ``s`` to ``t`` at the given
+    working state — the solver's own accounting (comm cut + balance terms,
+    capacity feasibility when enforced)."""
+    w = env.adj[s] * env.replicas[s] * env.replicas
+    cut_before = float(np.sum(w[env.placed & (work_node != work_node[s])]))
+    cut_after = float(np.sum(w[env.placed & (work_node != t)]))
+    new_loads = loads.copy()
+    if 0 <= work_node[s] < len(new_loads):
+        new_loads[work_node[s]] -= env.svc_cpu[s]
+    new_loads[t] += env.svc_cpu[s]
+    feasible = not (
+        env.enforce_capacity
+        and t != work_node[s]
+        and (
+            new_loads[t] > env.cap[t]
+            or mem_loads[t] + env.svc_mem[s] > env.mem_cap[t]
+        )
+    )
+    gain = cut_before - cut_after + bal_now - env.balance_terms(new_loads)
+    return gain, feasible
+
+
+def _individual_move_gains(
+    changed: list[tuple[int, int]], state, graph, solver_cfg
+) -> list[tuple[int, int, float]]:
+    """Each candidate move's INDIVIDUAL gain at the round-start state
+    (every other service held in place) — what the uncapped global
+    round's explanation records as candidate scores."""
+    env = _move_scoring_env(state, graph, solver_cfg)
+    work_node = env.svc_node.copy()
+    loads = env.used.copy()
+    mem_loads = env.mem_used.copy()
+    bal_now = env.balance_terms(loads)
+    return [
+        (s, t, _move_gain(env, work_node, loads, mem_loads, bal_now, s, t)[0])
+        for s, t in changed
+    ]
+
+
+def _top_gain_moves(
+    changed: list[tuple[int, int]], state, graph, solver_cfg, k: int
+) -> list[tuple[int, int, float]]:
+    """≤``k`` strictly-improving moves selected GREEDILY AND SEQUENTIALLY,
+    using the SOLVER's own accounting (``solver_cfg`` is the round's
+    GlobalSolverConfig): comm + λ·std of CPU-% **of the packing budget**
+    (``capacity_frac``-scaled, exactly as the solver's objective measures
+    load) + the over-budget repulsion term when capacity is enforced.
+
+    Each accepted move updates the working placement and node loads, and
+    every remaining candidate is re-scored against that updated state —
+    so the wave is jointly consistent: two moves cannot cumulatively
+    over-budget one node (while capacity is enforced, a candidate whose
+    target would newly exceed the CPU or memory budget is skipped — the
+    solver's own feasibility rule), and a move the solver admitted only
+    because an earlier move vacates its target is scored with that
+    vacancy visible.
+
+    Comm gain of relocating service ``s`` to ``t`` with every *unmoved*
+    service fixed: ``Σ_j W[s,j]·([node_j ≠ cur_s] − [node_j ≠ t])`` on the
+    replica-weighted pair matrix (row-wise host-side — only the changed
+    services' adjacency rows are touched). Candidates whose gain at their
+    evaluation state is ≤ 0 are never selected — they only pay off in
+    combination with moves this wave did not take, and applying them alone
+    is churn (the convergence criterion: a capped loop stops when no
+    single next move helps).
+
+    Returns ``(service, target, gain)`` triples — the gain at each move's
+    EVALUATION state, which the ``global`` DecisionExplanation records as
+    the candidate score."""
+    env = _move_scoring_env(state, graph, solver_cfg)
+    work_node = env.svc_node.copy()
+    loads = env.used.copy()
+    mem_loads = env.mem_used.copy()
     picked: list[int] = []
+    gains: dict[int, float] = {}
     remaining = list(range(len(changed)))
     for _ in range(min(k, len(changed))):
-        bal_now = balance_terms(loads)
+        bal_now = env.balance_terms(loads)
         best_i, best_gain = None, 1e-9
         for i in remaining:
             s, t = changed[i]
-            w = adj[s] * replicas[s] * replicas
-            cut_before = float(np.sum(w[placed & (work_node != work_node[s])]))
-            cut_after = float(np.sum(w[placed & (work_node != t)]))
-            new_loads = loads.copy()
-            if 0 <= work_node[s] < len(new_loads):
-                new_loads[work_node[s]] -= svc_cpu[s]
-            new_loads[t] += svc_cpu[s]
-            if (
-                solver_cfg.enforce_capacity
-                and t != work_node[s]
-                and (
-                    new_loads[t] > cap[t]
-                    or mem_loads[t] + svc_mem[s] > mem_cap[t]
-                )
-            ):
+            gain, feasible = _move_gain(
+                env, work_node, loads, mem_loads, bal_now, s, t
+            )
+            if not feasible:
                 continue  # would newly exceed a budget at the CURRENT loads
-            gain = cut_before - cut_after + bal_now - balance_terms(new_loads)
             # strict >: ties go to the earliest candidate (lower position)
             if gain > best_gain:
                 best_i, best_gain = i, gain
@@ -567,14 +725,15 @@ def _top_gain_moves(
             break  # no remaining move helps on its own — wave converged
         s, t = changed[best_i]
         if 0 <= work_node[s] < len(loads):
-            loads[work_node[s]] -= svc_cpu[s]
-            mem_loads[work_node[s]] -= svc_mem[s]
-        loads[t] += svc_cpu[s]
-        mem_loads[t] += svc_mem[s]
+            loads[work_node[s]] -= env.svc_cpu[s]
+            mem_loads[work_node[s]] -= env.svc_mem[s]
+        loads[t] += env.svc_cpu[s]
+        mem_loads[t] += env.svc_mem[s]
         work_node[s] = t
         picked.append(best_i)
+        gains[best_i] = best_gain
         remaining.remove(best_i)
-    return [changed[i] for i in sorted(picked)]
+    return [(*changed[i], gains[i]) for i in sorted(picked)]
 
 
 def _pull_solver_objectives(info):
@@ -600,7 +759,10 @@ def _pull_solver_objectives(info):
     )
 
 
-def _pod_round(boundary, state, graph, config, cfg, key, rnd) -> RoundRecord:
+def _pod_round(
+    boundary, state, graph, config, cfg, key, rnd, *, logger=None,
+    explain=False,
+) -> RoundRecord:
     """Per-replica global round: solve on the expanded pod graph, apply
     per-pod moves (MoveRequest.pod). The pod graph is cached per
     (declared graph, pod set) — pod churn or a re-estimated graph
@@ -656,14 +818,42 @@ def _pod_round(boundary, state, graph, config, cfg, key, rnd) -> RoundRecord:
     # the simulator's batch wave cannot transiently fail).
     batch = getattr(boundary, "apply_pod_moves", None)
     moved_services: set[str] = set()
+    landed_moves: list[MoveRequest] = []
     if batch is not None:
         landed = set(batch(moves)) if moves else set()
-        moved_services = {mv.service for mv in moves if mv.pod in landed}
+        landed_moves = [mv for mv in moves if mv.pod in landed]
     else:
         for mv in moves:
             if boundary.apply_move(mv) is not None:
-                moved_services.add(mv.service)
+                landed_moves.append(mv)
+    moved_services = {mv.service for mv in landed_moves}
     moved_any = bool(moved_services)
+
+    explanations: tuple[dict, ...] = ()
+    if explain:
+        # per-service candidates scored by replicas relocated — the pod
+        # round's unit of disruption; chosen = the most-relocated service
+        per_svc: dict[str, dict] = {}
+        for mv in landed_moves:
+            d = per_svc.setdefault(
+                mv.service,
+                {"service": mv.service, "node": mv.target_node,
+                 "node_index": None, "score": 0.0, "applied": True},
+            )
+            d["score"] += 1.0
+        expl = solver_explanation(
+            kind="pod",
+            round=rnd,
+            policy=config.algorithm,
+            candidates=sorted(per_svc.values(), key=lambda d: d["service"]),
+            objective_before=obj_before,
+            objective_after=obj_after,
+            applied=len(landed_moves),
+            proposed=len(moves),
+        )
+        if logger is not None:
+            logger.info("decision", **expl)
+        explanations = (expl,)
     # services_moved carries the SERVICE names of moves that LANDED: its
     # consumers — the harness's teardown-outage injection and restart
     # accounting — are service-granular, and a pod name (or a move a dead
@@ -681,10 +871,13 @@ def _pod_round(boundary, state, graph, config, cfg, key, rnd) -> RoundRecord:
         objective_before=obj_before,
         objective_after=obj_after,
         solver_improved=improved,
+        explanations=explanations,
     )
 
 
-def _global_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
+def _global_round(
+    boundary, state, graph, config, key, rnd, *, logger=None, explain=False,
+) -> RoundRecord:
     cfg = GlobalSolverConfig(
         sweeps=config.global_solver_iters,
         balance_weight=config.balance_weight,
@@ -693,7 +886,10 @@ def _global_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
         move_cost=config.move_cost,
     )
     if config.placement_unit == "pod":
-        return _pod_round(boundary, state, graph, config, cfg, key, rnd)
+        return _pod_round(
+            boundary, state, graph, config, cfg, key, rnd,
+            logger=logger, explain=explain,
+        )
     t0 = time.perf_counter()
     sparse_graph = None
     if config.solver_backend == "sparse":
@@ -738,6 +934,8 @@ def _global_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
         changed.append((s, int(new_nodes[i])))
 
     cap = config.global_moves_cap
+    proposed = len(changed)
+    gains: dict[tuple[int, int], float] = {}
     if isinstance(cap, int):
         # wave cap: apply only the k moves whose INDIVIDUAL relocation
         # (others held at their old nodes) most improves the solver's
@@ -746,7 +944,16 @@ def _global_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
         # is still pursued k Deployments at a time, and once no single
         # move helps on its own the loop is converged instead of churning
         # (the full solution may keep shifting under annealing noise)
-        changed = _top_gain_moves(changed, state, graph, cfg, cap)
+        scored = _top_gain_moves(changed, state, graph, cfg, cap)
+        changed = [(s, t) for s, t, _ in scored]
+        gains = {(s, t): g for s, t, g in scored}
+    elif explain and changed:
+        # uncapped rounds never score moves for selection — score them
+        # once at the start state so the explanation still carries why
+        gains = {
+            (s, t): g
+            for s, t, g in _individual_move_gains(changed, state, graph, cfg)
+        }
 
     moved_any = False
     moved_names: list[str] = []
@@ -761,6 +968,32 @@ def _global_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
         moved_any = moved_any or landed is not None
         if landed is not None:
             moved_names.append(graph.names[s])
+
+    explanations: tuple[dict, ...] = ()
+    if explain:
+        candidates = [
+            {
+                "service": graph.names[s],
+                "node": new_state.node_names[t],
+                "node_index": int(t),
+                "score": float(gains.get((s, t), 0.0)),
+                "applied": graph.names[s] in moved_names,
+            }
+            for s, t in changed
+        ]
+        expl = solver_explanation(
+            kind="global",
+            round=rnd,
+            policy=config.algorithm,
+            candidates=candidates,
+            objective_before=obj_before,
+            objective_after=obj_after,
+            applied=len(moved_names),
+            proposed=proposed,
+        )
+        if logger is not None:
+            logger.info("decision", **expl)
+        explanations = (expl,)
     return RoundRecord(
         round=rnd,
         moved=moved_any,
@@ -774,4 +1007,5 @@ def _global_round(boundary, state, graph, config, key, rnd) -> RoundRecord:
         objective_before=obj_before,
         objective_after=obj_after,
         solver_improved=improved,
+        explanations=explanations,
     )

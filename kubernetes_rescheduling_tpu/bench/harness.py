@@ -109,6 +109,13 @@ class ExperimentConfig:
     chaos_profile: str = "none"
     chaos_seed: int = 0
     max_consecutive_failures: int = 5
+    # Live ops plane: serve /metrics, /healthz, /events on this port for
+    # the whole session (0 = ephemeral, None = off). One OpsPlane spans
+    # every matrix cell; per-cell loggers re-bind as cells start, so
+    # /events always follows the running cell. Flight-recorder bundles
+    # land in bundle_dir (None = <session>/flight_recorder).
+    serve_port: int | None = None
+    bundle_dir: str | None = None
 
     def __post_init__(self):
         # fail invalid solver combinations in milliseconds at construction,
@@ -286,339 +293,363 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
     and a half-finished cell restores the simulator from its latest
     per-round checkpoint and continues (SURVEY §5.4 — the reference restarts
     from round 1, losing the experiment).
+
+    With ``cfg.serve_port`` set, one live ops plane serves the whole
+    session: ``/metrics`` scrapes the process registry across cells,
+    ``/healthz`` tracks the currently-running cell's breaker/SLO state,
+    and flight-recorder bundles land under ``<session>/flight_recorder``.
     """
     stamp = cfg.session_name or time.strftime("%Y%m%d_%H%M%S")
     session = Path(cfg.out_dir) / f"session_{stamp}"
     cfg_dict = dataclasses.asdict(cfg)
     summary: dict = {"config": cfg_dict, "runs": []}
 
-    if cfg.session_name:
-        # a resumed session must be the SAME experiment: reloading another
-        # config's run.json would silently mix results
-        session.mkdir(parents=True, exist_ok=True)
-        fingerprint = {k: v for k, v in cfg_dict.items() if k != "out_dir"}
-        fp_file = session / "config.json"
-        if fp_file.is_file():
-            saved = json.loads(fp_file.read_text())
-            if saved != json.loads(json.dumps(fingerprint, default=float)):
-                raise ValueError(
-                    f"session {cfg.session_name!r} was created with a different "
-                    f"config; refusing to mix results (delete {session} or use "
-                    "a new session name)"
-                )
-        else:
-            fp_file.write_text(json.dumps(fingerprint, default=float))
+    ops = None
+    if cfg.serve_port is not None:
+        from kubernetes_rescheduling_tpu.config import ObsConfig
+        from kubernetes_rescheduling_tpu.telemetry import OpsPlane
 
-    # provenance next (after the fingerprint gate): even a session that
-    # crashes mid-matrix leaves a record of what ran, on which devices,
-    # from which commit — but a resume must NOT clobber the manifest of
-    # the run that produced the existing cells
-    manifest_file = session / "manifest.json"
-    if manifest_file.is_file():
-        manifest_file = session / "manifest.resume.json"
-    write_manifest(manifest_file, json.loads(json.dumps(cfg_dict, default=float)))
+        ops = OpsPlane.from_config(
+            ObsConfig(serve_port=cfg.serve_port),
+            bundle_dir=cfg.bundle_dir or str(session / "flight_recorder"),
+        ).start()
 
-    for algo in cfg.algorithms:
-        for run_i in range(1, cfg.repeats + 1):
-            run_dir = session / algo / f"run_{run_i}"
-            run_dir.mkdir(parents=True, exist_ok=True)
-            run_marker = run_dir / "run.json"
-            if cfg.session_name and run_marker.is_file():
-                summary["runs"].append(json.loads(run_marker.read_text()))
-                continue
-            seed = cfg.seed * 1000 + run_i
-            backend = make_experiment_backend(cfg, seed, **backend_kwargs)
-            if cfg.inject_imbalance and hasattr(backend, "inject_imbalance"):
-                backend.inject_imbalance(backend.node_names[0])
-
-            graph = backend.comm_graph()
-            load_model = getattr(backend, "load", None)
-            loadgen = LoadGenerator(
-                backend.workmodel,
-                cfg.load,
-                fanout_frac=load_model.fanout_frac if load_model else 1.0,
-            )
-            key = jax.random.PRNGKey(seed)
-            key, k_before, k_during, k_after = jax.random.split(key, 4)
-            std_sink = node_std_sink(run_dir)
-            cost_sink = communication_cost_sink(run_dir)
-            rounds_sink = JsonlSink(run_dir / "rounds.jsonl")
-            logger = StructuredLogger(name=f"{algo}/run_{run_i}", path=run_dir / "log.jsonl")
-
-            # phase r1: load against the imbalanced "Before" placement.
-            # Persisted immediately so a crash-resume doesn't re-measure
-            # "before" against a mid-rescheduling cluster.
-            phase1 = run_dir / "phase1.json"
-            if cfg.session_name and phase1.is_file():
-                saved = json.loads(phase1.read_text())
-                before_metrics = saved["before"]
-                load_before_dict = saved["load_before"]
-                edge_counts = (
-                    np.asarray(saved["edge_counts"], dtype=np.int64)
-                    if saved.get("edge_counts") is not None
-                    else None
-                )
-                obs_sent = int(saved.get("obs_sent", 0))
+    try:
+        if cfg.session_name:
+            # a resumed session must be the SAME experiment: reloading another
+            # config's run.json would silently mix results
+            session.mkdir(parents=True, exist_ok=True)
+            fingerprint = {k: v for k, v in cfg_dict.items() if k != "out_dir"}
+            fp_file = session / "config.json"
+            if fp_file.is_file():
+                saved = json.loads(fp_file.read_text())
+                if saved != json.loads(json.dumps(fingerprint, default=float)):
+                    raise ValueError(
+                        f"session {cfg.session_name!r} was created with a different "
+                        f"config; refusing to mix results (delete {session} or use "
+                        "a new session name)"
+                    )
             else:
-                before = backend.monitor()
-                samples_before = loadgen.run(before, k_before)
-                load_before = samples_before.stats()
-                load_before_dict = load_before.as_dict()
-                edge_counts = samples_before.edge_counts
-                obs_sent = samples_before.sent
-                before_metrics = {
-                    "communication_cost": float(communication_cost(before, graph)),
-                    "load_std": float(load_std(before)),
-                    "response_time_ms": load_before.latency_avg_ms,
-                }
-                std_sink.append(before_metrics["load_std"])
-                phase1.write_text(
-                    json.dumps(
-                        {
-                            "before": before_metrics,
-                            "load_before": load_before_dict,
-                            # persisted so a crash-resume can still estimate
-                            "edge_counts": (
-                                edge_counts.tolist()
-                                if edge_counts is not None
-                                else None
-                            ),
-                            "obs_sent": obs_sent,
-                        },
-                        default=float,
-                    )
+                fp_file.write_text(json.dumps(fingerprint, default=float))
+
+        # provenance next (after the fingerprint gate): even a session that
+        # crashes mid-matrix leaves a record of what ran, on which devices,
+        # from which commit — but a resume must NOT clobber the manifest of
+        # the run that produced the existing cells
+        manifest_file = session / "manifest.json"
+        if manifest_file.is_file():
+            manifest_file = session / "manifest.resume.json"
+        write_manifest(manifest_file, json.loads(json.dumps(cfg_dict, default=float)))
+
+        for algo in cfg.algorithms:
+            for run_i in range(1, cfg.repeats + 1):
+                run_dir = session / algo / f"run_{run_i}"
+                run_dir.mkdir(parents=True, exist_ok=True)
+                run_marker = run_dir / "run.json"
+                if cfg.session_name and run_marker.is_file():
+                    summary["runs"].append(json.loads(run_marker.read_text()))
+                    continue
+                seed = cfg.seed * 1000 + run_i
+                backend = make_experiment_backend(cfg, seed, **backend_kwargs)
+                if cfg.inject_imbalance and hasattr(backend, "inject_imbalance"):
+                    backend.inject_imbalance(backend.node_names[0])
+
+                graph = backend.comm_graph()
+                load_model = getattr(backend, "load", None)
+                loadgen = LoadGenerator(
+                    backend.workmodel,
+                    cfg.load,
+                    fanout_frac=load_model.fanout_frac if load_model else 1.0,
                 )
+                key = jax.random.PRNGKey(seed)
+                key, k_before, k_during, k_after = jax.random.split(key, 4)
+                std_sink = node_std_sink(run_dir)
+                cost_sink = communication_cost_sink(run_dir)
+                rounds_sink = JsonlSink(run_dir / "rounds.jsonl")
+                logger = StructuredLogger(name=f"{algo}/run_{run_i}", path=run_dir / "log.jsonl")
 
-            # traffic-estimated weights for the DECISION graph: the solver
-            # optimizes what the request stream actually traversed —
-            # seeded by phase r1 and RE-ESTIMATED each round from the
-            # sustained load's accumulating counts (`during` below), so
-            # decisions track drifting traffic. Reported
-            # communication_cost metrics stay on the declared graph for
-            # comparability across configurations.
-            def solve_graph(_counts=edge_counts, _sent=obs_sent):
-                total = _counts
-                n = _sent
-                if during.edge_counts is not None:
-                    total = (
-                        during.edge_counts
-                        if total is None
-                        else total + during.edge_counts
+                # phase r1: load against the imbalanced "Before" placement.
+                # Persisted immediately so a crash-resume doesn't re-measure
+                # "before" against a mid-rescheduling cluster.
+                phase1 = run_dir / "phase1.json"
+                if cfg.session_name and phase1.is_file():
+                    saved = json.loads(phase1.read_text())
+                    before_metrics = saved["before"]
+                    load_before_dict = saved["load_before"]
+                    edge_counts = (
+                        np.asarray(saved["edge_counts"], dtype=np.int64)
+                        if saved.get("edge_counts") is not None
+                        else None
                     )
-                    n += during.sent
-                return loadgen.observed_graph(total, n, graph)
+                    obs_sent = int(saved.get("obs_sent", 0))
+                else:
+                    before = backend.monitor()
+                    samples_before = loadgen.run(before, k_before)
+                    load_before = samples_before.stats()
+                    load_before_dict = load_before.as_dict()
+                    edge_counts = samples_before.edge_counts
+                    obs_sent = samples_before.sent
+                    before_metrics = {
+                        "communication_cost": float(communication_cost(before, graph)),
+                        "load_std": float(load_std(before)),
+                        "response_time_ms": load_before.latency_avg_ms,
+                    }
+                    std_sink.append(before_metrics["load_std"])
+                    phase1.write_text(
+                        json.dumps(
+                            {
+                                "before": before_metrics,
+                                "load_before": load_before_dict,
+                                # persisted so a crash-resume can still estimate
+                                "edge_counts": (
+                                    edge_counts.tolist()
+                                    if edge_counts is not None
+                                    else None
+                                ),
+                                "obs_sent": obs_sent,
+                            },
+                            default=float,
+                        )
+                    )
 
-            # phase r2: the control loop under sustained load — per round,
-            # simulate the segment's requests with teardown outages for every
-            # Deployment moved that round (reference release2.sh:50-59)
-            rcfg = RescheduleConfig(
-                algorithm=algo,
-                max_rounds=cfg.rounds,
-                hazard_threshold_pct=cfg.hazard_threshold_pct,
-                sleep_after_action_s=cfg.pacing_s,  # simulated clock, not wall
-                balance_weight=cfg.balance_weight,
-                move_cost=cfg.move_cost,
-                solver_backend=cfg.solver_backend,
-                placement_unit=cfg.placement_unit,
-                solver_restarts=cfg.solver_restarts,
-                solver_tp=cfg.solver_tp,
-                moves_per_round=cfg.moves_per_round,
-                global_moves_cap=cfg.global_moves_cap,
-                enforce_capacity=cfg.enforce_capacity,
-                capacity_frac=cfg.capacity_frac,
-                seed=seed,
-                # run_controller wraps ITS view of the backend in the chaos
-                # profile; the harness's own phase r1/r3 measurements stay
-                # on the raw backend (faults hit the loop, not the ruler)
-                chaos=ChaosConfig(
-                    profile=cfg.chaos_profile, seed=cfg.chaos_seed + run_i
-                ),
-                max_consecutive_failures=cfg.max_consecutive_failures,
-            )
-            # solve_graph (above) closes over this accumulator; bound here,
-            # before the controller ever calls the estimator
-            during = new_samples()
+                # traffic-estimated weights for the DECISION graph: the solver
+                # optimizes what the request stream actually traversed —
+                # seeded by phase r1 and RE-ESTIMATED each round from the
+                # sustained load's accumulating counts (`during` below), so
+                # decisions track drifting traffic. Reported
+                # communication_cost metrics stay on the declared graph for
+                # comparability across configurations.
+                def solve_graph(_counts=edge_counts, _sent=obs_sent):
+                    total = _counts
+                    n = _sent
+                    if during.edge_counts is not None:
+                        total = (
+                            during.edge_counts
+                            if total is None
+                            else total + during.edge_counts
+                        )
+                        n += during.sent
+                    return loadgen.observed_graph(total, n, graph)
 
-            def clock(_backend=backend):
-                # sim: the simulated clock; live cluster: wall time
-                c = getattr(_backend, "clock_s", None)
-                return time.monotonic() if c is None else c
-
-            seg_state = {"clock": clock(), "i": 0}
-
-            def on_round(rec, state, _ss=seg_state, _during=during):
-                # sinks written in-loop so a crash keeps completed rounds'
-                # rows (the reference CSV schemas) for the resumed session
-                std_sink.append(rec.load_std)
-                rounds_sink.append(rec.as_dict())
-                now = clock()
-                seg_dur = max(now - _ss["clock"], 1e-9)
-                _ss["clock"] = now
-                n_req = max(
-                    int(
-                        cfg.load.requests_per_phase
-                        * seg_dur
-                        / max(cfg.load.duration_s, 1e-9)
+                # phase r2: the control loop under sustained load — per round,
+                # simulate the segment's requests with teardown outages for every
+                # Deployment moved that round (reference release2.sh:50-59)
+                rcfg = RescheduleConfig(
+                    algorithm=algo,
+                    max_rounds=cfg.rounds,
+                    hazard_threshold_pct=cfg.hazard_threshold_pct,
+                    sleep_after_action_s=cfg.pacing_s,  # simulated clock, not wall
+                    balance_weight=cfg.balance_weight,
+                    move_cost=cfg.move_cost,
+                    solver_backend=cfg.solver_backend,
+                    placement_unit=cfg.placement_unit,
+                    solver_restarts=cfg.solver_restarts,
+                    solver_tp=cfg.solver_tp,
+                    moves_per_round=cfg.moves_per_round,
+                    global_moves_cap=cfg.global_moves_cap,
+                    enforce_capacity=cfg.enforce_capacity,
+                    capacity_frac=cfg.capacity_frac,
+                    seed=seed,
+                    # run_controller wraps ITS view of the backend in the chaos
+                    # profile; the harness's own phase r1/r3 measurements stay
+                    # on the raw backend (faults hit the loop, not the ruler)
+                    chaos=ChaosConfig(
+                        profile=cfg.chaos_profile, seed=cfg.chaos_seed + run_i
                     ),
-                    64,
+                    max_consecutive_failures=cfg.max_consecutive_failures,
                 )
-                # read per round, not once: K8sBackend replaces its initial
-                # estimate with the measured delete→recreate wall time after
-                # each move (sim exposes its simulated teardown latency)
-                reconcile = getattr(backend, "reconcile_delay_s", 10.0)
-                outages = [
-                    (svc, i * reconcile, (i + 1) * reconcile)
-                    for i, svc in enumerate(rec.services_moved)
-                ]
-                loadgen.run(
-                    state,
-                    jax.random.fold_in(k_during, _ss["i"]),
-                    duration_s=seg_dur,
-                    n_requests=n_req,
-                    outages=outages,
-                    samples=_during,
-                )
-                _ss["i"] += 1
+                # solve_graph (above) closes over this accumulator; bound here,
+                # before the controller ever calls the estimator
+                during = new_samples()
 
-            events = getattr(backend, "events", None)
-            events_mark = len(events) if events is not None else 0
-            # live cluster: snapshot per-pod restartCount so the loop's
-            # container crashes can be MEASURED as a delta that survives
-            # delete+recreate (fresh pods start at 0)
-            crash_probe = getattr(backend, "pod_restart_counts", None)
-            crashes_at_start = crash_probe() if crash_probe else None
-            t0 = time.perf_counter()
-            with span("bench/run", algorithm=algo, run=run_i):
-                result = run_controller(
-                    backend,
-                    rcfg,
-                    key=jax.random.PRNGKey(seed),
-                    on_round=on_round,
-                    checkpoint_dir=str(run_dir / "checkpoints") if cfg.session_name else None,
-                    logger=logger,
-                    graph=solve_graph if cfg.observe_weights else None,
-                )
-            wall_s = time.perf_counter() - t0
-            # `restarts` = pods recreated by Deployment moves (the
-            # disruption the RESCHEDULER causes) — identical semantics on
-            # both backends: sim reads its event log, live derives from
-            # moved services' replica counts (each moved Deployment's
-            # replicas are all recreated, so this is exact, not estimated)
-            if events is not None:
-                during.restarts = sum(
-                    int(e.get("pods", 0))
-                    for e in events[events_mark:]
-                    # "move" = whole-Deployment re-creates; "pod_moves" =
-                    # a pod-mode round's batched per-replica wave
-                    if e.get("event") in ("move", "pod_moves")
-                )
-                restart_source = "event_log"
-            else:
-                replicas = {
-                    s.name: max(1, s.replicas) for s in backend.workmodel.services
+                def clock(_backend=backend):
+                    # sim: the simulated clock; live cluster: wall time
+                    c = getattr(_backend, "clock_s", None)
+                    return time.monotonic() if c is None else c
+
+                seg_state = {"clock": clock(), "i": 0}
+
+                def on_round(rec, state, _ss=seg_state, _during=during):
+                    # sinks written in-loop so a crash keeps completed rounds'
+                    # rows (the reference CSV schemas) for the resumed session
+                    std_sink.append(rec.load_std)
+                    rounds_sink.append(rec.as_dict())
+                    now = clock()
+                    seg_dur = max(now - _ss["clock"], 1e-9)
+                    _ss["clock"] = now
+                    n_req = max(
+                        int(
+                            cfg.load.requests_per_phase
+                            * seg_dur
+                            / max(cfg.load.duration_s, 1e-9)
+                        ),
+                        64,
+                    )
+                    # read per round, not once: K8sBackend replaces its initial
+                    # estimate with the measured delete→recreate wall time after
+                    # each move (sim exposes its simulated teardown latency)
+                    reconcile = getattr(backend, "reconcile_delay_s", 10.0)
+                    outages = [
+                        (svc, i * reconcile, (i + 1) * reconcile)
+                        for i, svc in enumerate(rec.services_moved)
+                    ]
+                    loadgen.run(
+                        state,
+                        jax.random.fold_in(k_during, _ss["i"]),
+                        duration_s=seg_dur,
+                        n_requests=n_req,
+                        outages=outages,
+                        samples=_during,
+                    )
+                    _ss["i"] += 1
+
+                events = getattr(backend, "events", None)
+                events_mark = len(events) if events is not None else 0
+                # live cluster: snapshot per-pod restartCount so the loop's
+                # container crashes can be MEASURED as a delta that survives
+                # delete+recreate (fresh pods start at 0)
+                crash_probe = getattr(backend, "pod_restart_counts", None)
+                crashes_at_start = crash_probe() if crash_probe else None
+                t0 = time.perf_counter()
+                with span("bench/run", algorithm=algo, run=run_i):
+                    result = run_controller(
+                        backend,
+                        rcfg,
+                        key=jax.random.PRNGKey(seed),
+                        on_round=on_round,
+                        checkpoint_dir=str(run_dir / "checkpoints") if cfg.session_name else None,
+                        logger=logger,
+                        graph=solve_graph if cfg.observe_weights else None,
+                        ops=ops,
+                    )
+                wall_s = time.perf_counter() - t0
+                # `restarts` = pods recreated by Deployment moves (the
+                # disruption the RESCHEDULER causes) — identical semantics on
+                # both backends: sim reads its event log, live derives from
+                # moved services' replica counts (each moved Deployment's
+                # replicas are all recreated, so this is exact, not estimated)
+                if events is not None:
+                    during.restarts = sum(
+                        int(e.get("pods", 0))
+                        for e in events[events_mark:]
+                        # "move" = whole-Deployment re-creates; "pod_moves" =
+                        # a pod-mode round's batched per-replica wave
+                        if e.get("event") in ("move", "pod_moves")
+                    )
+                    restart_source = "event_log"
+                else:
+                    replicas = {
+                        s.name: max(1, s.replicas) for s in backend.workmodel.services
+                    }
+                    during.restarts = sum(
+                        replicas.get(svc, 1)
+                        for rec in result.rounds
+                        for svc in rec.services_moved
+                    )
+                    restart_source = "derived_from_moves"
+                # `container_crashes` = the reference's restartCount metric
+                # (release1.sh:101-102) as a measured per-pod delta: pods in
+                # both snapshots contribute max(end-start, 0); pods created
+                # during the loop contribute their full count. (Crashes a pod
+                # accrued AFTER the start snapshot but before its own
+                # teardown are unobservable — restartCount dies with the pod.)
+                crashes_at_end = crash_probe() if crash_probe else None
+                if crashes_at_start is not None and crashes_at_end is not None:
+                    during.container_crashes = sum(
+                        max(c - crashes_at_start.get(pod, 0), 0)
+                        for pod, c in crashes_at_end.items()
+                    )
+                load_during = during.stats()
+
+                # phase r3: load against the final placement. A chaos cell's
+                # node flap may end the loop with a worker still killed — heal
+                # the raw backend first so the "after" ruler measures the
+                # recovered cluster, not the last injected fault.
+                if cfg.chaos_profile != "none":
+                    revive = getattr(backend, "revive_node", None)
+                    if revive is not None:
+                        for node in backend.node_names:
+                            revive(node)
+                    pending = getattr(backend, "schedule_pending", None)
+                    if pending is not None:
+                        pending()
+                after = backend.monitor()
+                load_after = loadgen.measure(after, k_after)
+                after_metrics = {
+                    "communication_cost": float(communication_cost(after, graph)),
+                    "load_std": float(load_std(after)),
+                    "response_time_ms": load_after.latency_avg_ms,
                 }
-                during.restarts = sum(
-                    replicas.get(svc, 1)
-                    for rec in result.rounds
-                    for svc in rec.services_moved
-                )
-                restart_source = "derived_from_moves"
-            # `container_crashes` = the reference's restartCount metric
-            # (release1.sh:101-102) as a measured per-pod delta: pods in
-            # both snapshots contribute max(end-start, 0); pods created
-            # during the loop contribute their full count. (Crashes a pod
-            # accrued AFTER the start snapshot but before its own
-            # teardown are unobservable — restartCount dies with the pod.)
-            crashes_at_end = crash_probe() if crash_probe else None
-            if crashes_at_start is not None and crashes_at_end is not None:
-                during.container_crashes = sum(
-                    max(c - crashes_at_start.get(pod, 0), 0)
-                    for pod, c in crashes_at_end.items()
-                )
-            load_during = during.stats()
+                cost_sink.append(after_metrics["communication_cost"])
 
-            # phase r3: load against the final placement. A chaos cell's
-            # node flap may end the loop with a worker still killed — heal
-            # the raw backend first so the "after" ruler measures the
-            # recovered cluster, not the last injected fault.
-            if cfg.chaos_profile != "none":
-                revive = getattr(backend, "revive_node", None)
-                if revive is not None:
-                    for node in backend.node_names:
-                        revive(node)
-                pending = getattr(backend, "schedule_pending", None)
-                if pending is not None:
-                    pending()
-            after = backend.monitor()
-            load_after = loadgen.measure(after, k_after)
-            after_metrics = {
-                "communication_cost": float(communication_cost(after, graph)),
-                "load_std": float(load_std(after)),
-                "response_time_ms": load_after.latency_avg_ms,
+                run_record = {
+                    "algorithm": algo,
+                    "run": run_i,
+                    "seed": seed,
+                    "before": before_metrics,
+                    "after": after_metrics,
+                    "load": {
+                        "before": load_before_dict,
+                        "during": load_during.as_dict(),
+                        "after": load_after.as_dict(),
+                    },
+                    "moves": result.moves,
+                    "restart_source": restart_source,
+                    "decisions_per_sec": result.decisions_per_sec,
+                    "decision_latency": result.latency_summary(),
+                    "resumed_from_round": result.resumed_from_round,
+                    "skipped_rounds": result.skipped_rounds,
+                    "degraded_rounds": result.degraded_rounds,
+                    "boundary_failures": result.boundary_failures,
+                    "breaker_transitions": result.breaker_transitions,
+                    "wall_s": wall_s,
+                    "sim_clock_s": getattr(backend, "clock_s", None),
+                }
+                run_marker.write_text(json.dumps(run_record, default=float))
+                logger.info("run_complete", moves=result.moves)
+                # cumulative registry snapshot per cell (values are monotone;
+                # the telemetry report reads the LAST sample per series), so a
+                # crash keeps the counters up to the finished cells
+                get_registry().dump_jsonl(run_dir / "metrics.jsonl")
+                summary["runs"].append(run_record)
+
+        # per-algorithm aggregates (mean over runs). Final-placement metrics
+        # average over every run; loop-phase metrics (decision rate, disruption)
+        # only over runs that actually executed rounds — a crash-resumed cell
+        # whose loop had already finished contributes zeros that would skew them.
+        agg: dict[str, dict] = {}
+        for algo in cfg.algorithms:
+            runs = [r for r in summary["runs"] if r["algorithm"] == algo]
+            looped = [r for r in runs if r["decision_latency"].get("count", 0) > 0]
+
+            def loop_mean(metric_fn):
+                return float(np.mean([metric_fn(r) for r in looped])) if looped else 0.0
+
+            agg[algo] = {
+                "communication_cost": float(
+                    np.mean([r["after"]["communication_cost"] for r in runs])
+                ),
+                "load_std": float(np.mean([r["after"]["load_std"] for r in runs])),
+                "response_time_ms": float(
+                    np.mean([r["after"]["response_time_ms"] for r in runs])
+                ),
+                "error_rate_during": loop_mean(
+                    lambda r: r["load"]["during"]["error_rate"]
+                ),
+                "restarts": loop_mean(lambda r: r["load"]["during"]["restarts"]),
+                "decisions_per_sec": loop_mean(lambda r: r["decisions_per_sec"]),
             }
-            cost_sink.append(after_metrics["communication_cost"])
+        summary["aggregate"] = agg
 
-            run_record = {
-                "algorithm": algo,
-                "run": run_i,
-                "seed": seed,
-                "before": before_metrics,
-                "after": after_metrics,
-                "load": {
-                    "before": load_before_dict,
-                    "during": load_during.as_dict(),
-                    "after": load_after.as_dict(),
-                },
-                "moves": result.moves,
-                "restart_source": restart_source,
-                "decisions_per_sec": result.decisions_per_sec,
-                "decision_latency": result.latency_summary(),
-                "resumed_from_round": result.resumed_from_round,
-                "skipped_rounds": result.skipped_rounds,
-                "degraded_rounds": result.degraded_rounds,
-                "boundary_failures": result.boundary_failures,
-                "breaker_transitions": result.breaker_transitions,
-                "wall_s": wall_s,
-                "sim_clock_s": getattr(backend, "clock_s", None),
-            }
-            run_marker.write_text(json.dumps(run_record, default=float))
-            logger.info("run_complete", moves=result.moves)
-            # cumulative registry snapshot per cell (values are monotone;
-            # the telemetry report reads the LAST sample per series), so a
-            # crash keeps the counters up to the finished cells
-            get_registry().dump_jsonl(run_dir / "metrics.jsonl")
-            summary["runs"].append(run_record)
-
-    # per-algorithm aggregates (mean over runs). Final-placement metrics
-    # average over every run; loop-phase metrics (decision rate, disruption)
-    # only over runs that actually executed rounds — a crash-resumed cell
-    # whose loop had already finished contributes zeros that would skew them.
-    agg: dict[str, dict] = {}
-    for algo in cfg.algorithms:
-        runs = [r for r in summary["runs"] if r["algorithm"] == algo]
-        looped = [r for r in runs if r["decision_latency"].get("count", 0) > 0]
-
-        def loop_mean(metric_fn):
-            return float(np.mean([metric_fn(r) for r in looped])) if looped else 0.0
-
-        agg[algo] = {
-            "communication_cost": float(
-                np.mean([r["after"]["communication_cost"] for r in runs])
-            ),
-            "load_std": float(np.mean([r["after"]["load_std"] for r in runs])),
-            "response_time_ms": float(
-                np.mean([r["after"]["response_time_ms"] for r in runs])
-            ),
-            "error_rate_during": loop_mean(
-                lambda r: r["load"]["during"]["error_rate"]
-            ),
-            "restarts": loop_mean(lambda r: r["load"]["during"]["restarts"]),
-            "decisions_per_sec": loop_mean(lambda r: r["decisions_per_sec"]),
-        }
-    summary["aggregate"] = agg
-
-    session.mkdir(parents=True, exist_ok=True)
-    (session / "summary.json").write_text(json.dumps(summary, indent=2, default=float))
+        session.mkdir(parents=True, exist_ok=True)
+        (session / "summary.json").write_text(json.dumps(summary, indent=2, default=float))
+    finally:
+        # shut the live endpoint (and restore the SIGUSR1 handler)
+        # however the matrix ends — a crashing cell must not leak the
+        # server socket into the next session (run_controller already
+        # dumped a crash bundle on the way out)
+        if ops is not None:
+            ops.close()
     return summary
 
 
@@ -636,9 +667,14 @@ def run_chaos_soak(
     retry=None,
     logger: StructuredLogger | None = None,
     registry=None,
+    ops=None,
 ) -> dict:
     """The chaos soak cell: one seeded fault profile against one scenario,
-    the controller's degraded-mode machinery fully enabled.
+    the controller's degraded-mode machinery fully enabled. ``ops``
+    optionally attaches a live ops plane (``telemetry.server.OpsPlane``)
+    so the soak can be WATCHED: /healthz flips while the breaker is open,
+    and breaker-open rounds leave flight-recorder bundles behind — the
+    acceptance path the live-observability soak test drives.
 
     The chaos wrapper is built HERE (not via ``config.chaos``) so the
     report can cross-check the wrapper's own ``fault_counts`` against the
@@ -666,7 +702,7 @@ def run_chaos_soak(
     with span("bench/chaos_soak", profile=profile):
         result = run_controller(
             chaos, rcfg, key=jax.random.PRNGKey(seed), logger=logger,
-            registry=registry,
+            registry=registry, ops=ops,
         )
     fault_counts = dict(getattr(chaos, "fault_counts", {}))
     return {

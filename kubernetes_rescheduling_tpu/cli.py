@@ -12,10 +12,15 @@ Subcommands:
   trace       streaming trace replay (external workmodel/trace streams
               or the builtin Bookinfo canary; BASELINE config 5)
   telemetry   summarize a run's telemetry artifacts (metrics JSONL,
-              event logs, manifests, Chrome traces) as a report
+              event logs, manifests, Chrome traces, flight-recorder
+              bundles) as a report; ``telemetry explain`` renders
+              decision explanations, ``telemetry bundle`` summarizes a
+              flight-recorder bundle
 
 ``reschedule``/``bench``/``trace`` take ``--metrics-out``/``--trace-out``:
 see OBSERVABILITY.md for the artifact set each flag produces.
+``reschedule``/``bench`` additionally take ``--serve PORT`` — the live
+ops plane (/metrics, /healthz, /events + flight recorder + SLO watchdog).
 """
 
 from __future__ import annotations
@@ -84,6 +89,24 @@ def _add_telemetry_flags(parser: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_serve_flags(parser: argparse.ArgumentParser) -> None:
+    """The live ops plane (reschedule/bench)."""
+    parser.add_argument(
+        "--serve", type=int, default=None, metavar="PORT",
+        help="serve the live ops plane on 127.0.0.1:PORT while the run "
+             "executes: /metrics (Prometheus exposition from the live "
+             "registry), /healthz (breaker + SLO + staleness; 503 when "
+             "unhealthy), /events (recent structured events). 0 picks an "
+             "ephemeral port. Also arms the flight recorder (bundle on "
+             "breaker-open/crash/SIGUSR1) and the SLO watchdog",
+    )
+    parser.add_argument(
+        "--bundle-dir", default=None, metavar="DIR",
+        help="where flight-recorder bundles land (default: the obs "
+             "config's bundle_dir, ./flight_recorder)",
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="kubernetes_rescheduling_tpu",
@@ -138,6 +161,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "algorithm, sim backend)")
     _add_resilience_flags(r)
     _add_telemetry_flags(r)
+    _add_serve_flags(r)
 
     b = sub.add_parser("bench", help="run the experiment matrix")
     b.add_argument("--backend", default="sim", choices=["sim", "k8s"],
@@ -187,6 +211,7 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--seed", type=int, default=0)
     _add_resilience_flags(b)
     _add_telemetry_flags(b)
+    _add_serve_flags(b)
 
     t = sub.add_parser(
         "trace",
@@ -250,11 +275,17 @@ def build_parser() -> argparse.ArgumentParser:
     m = sub.add_parser(
         "telemetry",
         help="summarize telemetry artifacts (metrics JSONL, structured "
-             "event logs, manifests, Chrome traces) as a readable report",
+             "event logs, manifests, Chrome traces, flight-recorder "
+             "bundles) as a readable report; 'telemetry explain <files>' "
+             "renders decision explanations, 'telemetry bundle <file>' "
+             "summarizes a flight-recorder bundle (incl. the "
+             "explain-consistency verdict)",
     )
     m.add_argument("paths", nargs="+",
-                   help="artifact files; the kind of each is detected from "
-                        "its record shape")
+                   help="artifact files (kind detected from record shape); "
+                        "an optional leading mode word — 'report' "
+                        "(default), 'explain', or 'bundle' — selects the "
+                        "rendering")
     return p
 
 
@@ -288,9 +319,45 @@ def _write_telemetry_artifacts(args) -> dict | None:
 
 
 def cmd_telemetry(args) -> str:
-    from kubernetes_rescheduling_tpu.telemetry.report import report
+    from kubernetes_rescheduling_tpu.telemetry.report import (
+        report,
+        report_bundle,
+        report_explain,
+    )
 
-    return report(args.paths)
+    mode, paths = "report", list(args.paths)
+    if paths and paths[0] in ("report", "explain", "bundle"):
+        mode, paths = paths[0], paths[1:]
+    if not paths:
+        raise SystemExit(f"telemetry {mode}: no artifact paths given")
+    if mode == "explain":
+        return report_explain(paths)
+    if mode == "bundle":
+        return report_bundle(paths)
+    return report(paths)
+
+
+def _build_ops_plane(args, config):
+    """The live ops plane for a run command (``--serve``); None when off.
+    Returns (ops, logger): the logger feeds /events and decision events."""
+    if args.serve is None:
+        return None, None
+    import dataclasses as _dc
+
+    from kubernetes_rescheduling_tpu.telemetry import OpsPlane
+    from kubernetes_rescheduling_tpu.utils.logging import get_logger
+
+    obs = _dc.replace(config.obs, serve_port=args.serve)
+    logger = get_logger()
+    ops = OpsPlane.from_config(
+        obs, logger=logger, bundle_dir=args.bundle_dir
+    ).start()
+    port = ops.server.port if ops.server is not None else None
+    if port is not None:
+        sys.stderr.write(
+            f"ops plane: http://127.0.0.1:{port}/metrics /healthz /events\n"
+        )
+    return ops, logger
 
 
 def cmd_reschedule(args) -> dict:
@@ -345,7 +412,15 @@ def cmd_reschedule(args) -> dict:
         chaos=ChaosConfig(profile=args.chaos_profile, seed=args.chaos_seed),
         max_consecutive_failures=args.max_consecutive_failures,
     )
-    result = run_controller(backend, cfg, key=jax.random.PRNGKey(args.seed))
+    ops, logger = _build_ops_plane(args, cfg)
+    try:
+        result = run_controller(
+            backend, cfg, key=jax.random.PRNGKey(args.seed),
+            logger=logger, ops=ops,
+        )
+    finally:
+        if ops is not None:
+            ops.close()
     return {
         "algorithm": algo,
         "rounds": [rec.as_dict() for rec in result.rounds],
@@ -392,6 +467,8 @@ def cmd_bench(args) -> dict:
         chaos_profile=args.chaos_profile,
         chaos_seed=args.chaos_seed,
         max_consecutive_failures=args.max_consecutive_failures,
+        serve_port=args.serve,
+        bundle_dir=args.bundle_dir,
     )
     return run_experiment(cfg)
 

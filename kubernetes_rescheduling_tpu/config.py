@@ -46,6 +46,46 @@ class ChaosConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Live ops plane block: the in-process HTTP endpoint
+    (``telemetry.server``), decision explainability, the flight recorder,
+    and the SLO watchdog. jax-free, like :class:`ChaosConfig`, so config
+    import stays light; ``OpsPlane.from_config`` consumes it."""
+
+    serve_port: int | None = None        # None = no HTTP server; 0 = ephemeral
+    explain: bool = True                 # record DecisionExplanations when a
+                                         # logger or ops plane is attached
+    explain_top_k: int = 3               # candidates/hazard nodes per decision
+    flight_recorder_rounds: int = 16     # ring capacity (rounds)
+    bundle_dir: str = "flight_recorder"  # where trigger dumps land
+    max_round_age_s: float = 0.0         # /healthz staleness rule (0 = off)
+    slo_window: int = 20                 # rolling-window rounds
+    slo_min_samples: int = 5
+    slo_latency_p95_s: float = 0.0       # 0 disables the latency rule
+    slo_cost_regression_frac: float = 0.0  # 0 disables the cost rule
+    slo_max_retraces: int = 1            # 0 disables the retrace rule
+
+    def validate(self) -> "ObsConfig":
+        if self.serve_port is not None and not (0 <= self.serve_port <= 65535):
+            raise ValueError(f"serve_port must be in [0, 65535], got {self.serve_port}")
+        if self.explain_top_k < 1:
+            raise ValueError("explain_top_k must be >= 1")
+        if self.flight_recorder_rounds < 1:
+            raise ValueError("flight_recorder_rounds must be >= 1")
+        if self.max_round_age_s < 0:
+            raise ValueError("max_round_age_s must be >= 0")
+        if self.slo_window < 2:
+            raise ValueError("slo_window must be >= 2")
+        if self.slo_min_samples < 1:
+            raise ValueError("slo_min_samples must be >= 1")
+        if self.slo_latency_p95_s < 0 or self.slo_cost_regression_frac < 0:
+            raise ValueError("SLO thresholds must be >= 0")
+        if self.slo_max_retraces < 0:
+            raise ValueError("slo_max_retraces must be >= 0")
+        return self
+
+
+@dataclass(frozen=True)
 class RescheduleConfig:
     """One config object for a rescheduling run."""
 
@@ -118,6 +158,10 @@ class RescheduleConfig:
     breaker_cooldown_rounds: int = 2
     failure_budget_per_round: int = 0
 
+    # Observability: the live ops plane (HTTP endpoint, decision
+    # explainability, flight recorder, SLO watchdog) — see ObsConfig.
+    obs: ObsConfig = field(default_factory=ObsConfig)
+
     def validate(self) -> "RescheduleConfig":
         valid = set(POLICIES) | {"global"}
         if self.algorithm not in valid:
@@ -159,6 +203,7 @@ class RescheduleConfig:
                     "better than wave capping, RESULTS.md round 4)"
                 )
         self.retry.validate()
+        self.obs.validate()
         if self.max_consecutive_failures < 0:
             raise ValueError("max_consecutive_failures must be >= 0")
         if self.breaker_cooldown_rounds < 1:
@@ -179,4 +224,6 @@ class RescheduleConfig:
             data["retry"] = RetryPolicy(**data["retry"])
         if isinstance(data.get("chaos"), dict):
             data["chaos"] = ChaosConfig(**data["chaos"])
+        if isinstance(data.get("obs"), dict):
+            data["obs"] = ObsConfig(**data["obs"])
         return cls(**data).validate()

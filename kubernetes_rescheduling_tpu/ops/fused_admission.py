@@ -43,6 +43,29 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = float("-inf")
 
 
+def _stateless_uniform(seed, shape):
+    """Deterministic per-(seed, row, col) uniform in (0, 1) from a u32
+    finalizer-style mixer — plain vector ops, so it lowers everywhere the
+    kernels do (including interpret mode, where the TPU core PRNG has no
+    lowering). Noise quality is annealing-grade, not cryptographic; its
+    real job is making the SEED-OFFSET LAW testable off-hardware: the
+    fused mass+score kernel offsets ``seed`` by the 256-row block index,
+    the standalone score kernel by ``program_id`` over ``block_c``-row
+    tiles, and the two streams coincide exactly when ``block_c ==
+    BLOCK_R`` — the parity the noise-on tests pin."""
+    r = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    c = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    x = seed.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+    x = x ^ (r * jnp.uint32(0x85EBCA6B)) ^ (c * jnp.uint32(0xC2B2AE35))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    mant = (x & jnp.uint32(0x7FFFFF)).astype(jnp.float32)
+    return (mant + 0.5) * (1.0 / 8388608.0)
+
+
 def score_core(
     m, cur, home, pen, c_cpu, c_mem, valid,
     cpu_load, mem_load, cap, mem_cap, node_valid,
@@ -51,6 +74,7 @@ def score_core(
     enforce_capacity: bool,
     use_noise: bool,
     use_move_pen: bool,
+    noise_impl: str = "tpu",
 ):
     """The chunk score → first-max proposal → per-row reductions as pure
     array math on VMEM-resident values — the SINGLE definition shared by
@@ -77,13 +101,18 @@ def score_core(
         # pre-pricing kernel.
         score = score - jnp.where(col == home, 0.0, pen)
     if use_noise:
-        pltpu.prng_seed(seed)
-        bits = pltpu.prng_random_bits((bc, n))
-        # uniform in (0, 1): keep 23 low bits — sign-safe whatever the
-        # carrier dtype (a plain uint32→f32 convert can go through a signed
-        # path and yield negatives, turning the log-log below into NaNs)
-        mant = (bits & 0x7FFFFF).astype(jnp.float32)
-        u = (mant + 0.5) * (1.0 / 8388608.0)
+        if noise_impl == "tpu":
+            pltpu.prng_seed(seed)
+            bits = pltpu.prng_random_bits((bc, n))
+            # uniform in (0, 1): keep 23 low bits — sign-safe whatever the
+            # carrier dtype (a plain uint32→f32 convert can go through a signed
+            # path and yield negatives, turning the log-log below into NaNs)
+            mant = (bits & 0x7FFFFF).astype(jnp.float32)
+            u = (mant + 0.5) * (1.0 / 8388608.0)
+        elif noise_impl == "stateless":
+            u = _stateless_uniform(seed, (bc, n))
+        else:
+            raise ValueError(f"unknown noise_impl {noise_impl!r}")
         score = score + temp * (-jnp.log(-jnp.log(u)))
 
     if enforce_capacity:
@@ -145,6 +174,7 @@ def _score_kernel(
     enforce_capacity: bool,
     use_noise: bool,
     use_move_pen: bool,
+    noise_impl: str,
 ):
     prop, gain, wants, slack_cpu, slack_mem = score_core(
         m_ref[:], cur_ref[:], home_ref[:], pen_ref[:],
@@ -156,6 +186,7 @@ def _score_kernel(
         enforce_capacity=enforce_capacity,
         use_noise=use_noise,
         use_move_pen=use_move_pen,
+        noise_impl=noise_impl,
     )
     prop_ref[:] = prop
     gain_ref[:] = gain
@@ -267,7 +298,7 @@ def _admission_kernel(
     jax.jit,
     static_argnames=(
         "enforce_capacity", "use_noise", "interpret", "block_c", "x_dtype",
-        "emit_x_rows",
+        "emit_x_rows", "noise_impl",
     ),
 )
 def fused_score_admission(
@@ -294,6 +325,7 @@ def fused_score_admission(
     block_c: int = 256,
     x_dtype=jnp.bfloat16,
     emit_x_rows: bool = True,
+    noise_impl: str = "tpu",
 ):
     """Returns ``(new_node i32[C], admitted bool[C], x_rows x_dtype[C, N],
     d_cpu f32[N], d_mem f32[N])`` — the chunk step's decision plus its
@@ -327,6 +359,7 @@ def fused_score_admission(
         functools.partial(
             _score_kernel, enforce_capacity=enforce_capacity,
             use_noise=use_noise, use_move_pen=use_move_pen,
+            noise_impl=noise_impl,
         ),
         grid=grid,
         in_specs=[

@@ -222,6 +222,7 @@ def _chunk_mass_score_kernel(
     enforce_capacity: bool,
     use_noise: bool,
     use_move_pen: bool,
+    noise_impl: str,
 ):
     del blocks_ref, toff_ref  # consumed by the index_map
     # hoisted out of the pl.when bodies: program_id inside a when-region
@@ -250,6 +251,7 @@ def _chunk_mass_score_kernel(
             enforce_capacity=enforce_capacity,
             use_noise=use_noise,
             use_move_pen=use_move_pen,
+            noise_impl=noise_impl,
         )
         prop_ref[:] = prop
         gain_ref[:] = gain
@@ -262,7 +264,7 @@ def _chunk_mass_score_kernel(
     jax.jit,
     static_argnames=(
         "num_nodes", "bu", "reg_tiles", "enforce_capacity", "use_noise",
-        "interpret",
+        "interpret", "noise_impl",
     ),
 )
 def sparse_mass_score(
@@ -288,6 +290,7 @@ def sparse_mass_score(
     enforce_capacity: bool,
     use_noise: bool,
     interpret: bool = False,
+    noise_impl: str = "tpu",
 ):
     """Fused mass+score for one regular chunk: accumulates each block's
     neighbor mass in a VMEM scratch and reduces it to the score stage's
@@ -348,6 +351,7 @@ def sparse_mass_score(
             enforce_capacity=enforce_capacity,
             use_noise=use_noise,
             use_move_pen=use_move_pen,
+            noise_impl=noise_impl,
         ),
         grid_spec=grid_spec,
         out_shape=[out_ci, out_c, out_ci, out_c, out_c],

@@ -102,6 +102,28 @@ def policy_key_table(
     return k1, k2
 
 
+def policy_scores(
+    policy_id: jax.Array,
+    state: ClusterState,
+    graph: CommGraph,
+    service_idx: jax.Array,
+    hazard_mask: jax.Array,
+    key: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The active policy's per-node scoring rows for one placement decision:
+    ``(k1, k2, cand)`` — primary key, tie-break key (both f32[N]) and the
+    candidate mask (valid ∧ ¬hazard). :func:`choose_node` is exactly the
+    masked lexicographic argmax of these rows; the decision-explainability
+    path records the same rows (top-k) so a recorded explanation can
+    re-derive the chosen node as their argmax — one definition, two readers.
+    """
+    f = node_features(state, graph, service_idx)
+    cand = state.node_valid & ~hazard_mask
+    k1, k2 = policy_key_table(f, state, key)
+    pid = jnp.clip(policy_id, 0, len(POLICY_NAMES) - 1)
+    return k1[pid], k2[pid], cand
+
+
 def choose_node(
     policy_id: jax.Array,
     state: ClusterState,
@@ -117,8 +139,7 @@ def choose_node(
     every valid node is hazardous (the reference raises RuntimeError there,
     rescheduling.py:98-99; the caller decides whether to skip or fail).
     """
-    f = node_features(state, graph, service_idx)
-    cand = state.node_valid & ~hazard_mask
-    k1, k2 = policy_key_table(f, state, key)
-    pid = jnp.clip(policy_id, 0, len(POLICY_NAMES) - 1)
-    return lex_argmax([k1[pid], k2[pid]], cand)
+    k1, k2, cand = policy_scores(
+        policy_id, state, graph, service_idx, hazard_mask, key
+    )
+    return lex_argmax([k1, k2], cand)

@@ -31,9 +31,17 @@ from flax import struct
 from jax import lax
 
 from kubernetes_rescheduling_tpu.core.state import UNASSIGNED, ClusterState, CommGraph
-from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+from kubernetes_rescheduling_tpu.objectives.metrics import (
+    communication_cost,
+    load_std,
+    node_cpu_pct_rounded,
+)
 from kubernetes_rescheduling_tpu.policies.hazard import detect_hazard
-from kubernetes_rescheduling_tpu.policies.scoring import choose_node
+from kubernetes_rescheduling_tpu.policies.scoring import (
+    choose_node,
+    lex_argmax,
+    policy_scores,
+)
 from kubernetes_rescheduling_tpu.policies.victim import deployment_group, pick_victim
 from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
 
@@ -75,6 +83,69 @@ def decide(
     target = choose_node(policy_id, removed, graph, svc, hazard_mask, key)
     target = jnp.where(victim >= 0, target, -1)
     return most, hazard_mask, victim, svc, target
+
+
+def decide_explain(
+    state: ClusterState,
+    graph: CommGraph,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    key: jax.Array,
+    *,
+    top_k: int = 3,
+) -> tuple[jax.Array, ...]:
+    """:func:`decide` plus a compact explanation bundle, in one compiled
+    program — the device half of decision explainability.
+
+    The decision itself is bit-identical to :func:`decide` (same
+    ``policy_scores`` rows, same masked lex argmax, same key), so the
+    controller can swap kernels without changing behavior. The extra
+    output is one f32[6, k] array (k = min(top_k, num_nodes)) the host
+    pulls in a SINGLE transfer:
+
+    - rows 0-1: top-k hazard — node index, CPU percent (−inf-padded when
+      fewer valid nodes exist);
+    - rows 2-4: top-k candidate targets by primary score — node index,
+      primary score ``k1``, tie-break ``k2``;
+    - row 5: candidate validity (1.0 where the slot is a real candidate).
+
+    The CHOSEN node is guaranteed to be among the recorded candidates
+    (the last slot is overwritten when top-k by ``k1`` alone would miss a
+    tie-break winner), so re-deriving the argmax over the recorded rows
+    must reproduce the decision — the explain-consistency invariant the
+    flight-recorder bundle check pins.
+    """
+    most, hazard_mask = detect_hazard(state, threshold)
+    victim = jnp.where(most >= 0, pick_victim(state, most), -1)
+    group = deployment_group(state, victim)
+    svc = state.pod_service[jnp.clip(victim, 0, state.num_pods - 1)]
+    removed = state.replace(pod_node=jnp.where(group, UNASSIGNED, state.pod_node))
+    k1, k2, cand = policy_scores(
+        policy_id, removed, graph, svc, hazard_mask, key
+    )
+    target = lex_argmax([k1, k2], cand)
+    target = jnp.where(victim >= 0, target, -1)
+
+    k = min(int(top_k), state.num_nodes)
+    pct = node_cpu_pct_rounded(state).astype(jnp.float32)
+    hz_v, hz_i = lax.top_k(jnp.where(state.node_valid, pct, -jnp.inf), k)
+    c_v, c_i = lax.top_k(jnp.where(cand, k1, -jnp.inf), k)
+    # top-k by k1 alone can exclude the lex winner when >k nodes tie on
+    # the primary key — force the chosen node into the last slot so the
+    # recorded candidates always contain the argmax
+    missing = (target >= 0) & ~jnp.any(c_i == target)
+    c_i = c_i.at[-1].set(jnp.where(missing, target, c_i[-1]))
+    bundle = jnp.stack(
+        [
+            hz_i.astype(jnp.float32),
+            hz_v,
+            c_i.astype(jnp.float32),
+            k1[c_i],
+            k2[c_i],
+            cand[c_i].astype(jnp.float32),
+        ]
+    )
+    return most, hazard_mask, victim, svc, target, bundle
 
 
 def round_step(

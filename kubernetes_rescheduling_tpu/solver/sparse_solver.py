@@ -69,6 +69,20 @@ from kubernetes_rescheduling_tpu.ops.sparse_mass import (
     sparse_mass_score,
     sparse_neighbor_mass,
 )
+
+# The noise seed-offset law: the fused mass+score kernel
+# (sparse_mass_score) offsets its per-block PRNG seed by the BLOCK_R-row
+# block index, while the standalone score kernel inside
+# fused_score_admission offsets by program_id over block_c-row tiles. The
+# two streams — and therefore noise-on decisions across the two lowerings
+# of the same sweep — coincide only when the score kernel tiles at
+# exactly BLOCK_R rows, so the solver pins its block_c here instead of
+# trusting the kernel's default to stay aligned.
+_SCORE_BLOCK_C = 256
+assert _SCORE_BLOCK_C == BLOCK_R, (
+    "noise seed-offset law broken: fused_score_admission must tile C at "
+    "BLOCK_R rows (see ops/sparse_mass._chunk_mass_score_kernel)"
+)
 from kubernetes_rescheduling_tpu.solver.global_solver import (
     GlobalSolverConfig,
     _pad_to,
@@ -459,6 +473,10 @@ def _global_assign_sparse(
                 enforce_capacity=config.enforce_capacity,
                 use_noise=config.noise_temp > 0 and not fused_interpret,
                 interpret=fused_interpret,
+                # pinned, not defaulted: the noise seed-offset law needs
+                # the score kernel tiled at exactly BLOCK_R rows (see the
+                # module-level assert)
+                block_c=_SCORE_BLOCK_C,
                 emit_x_rows=False,
             )
             return (

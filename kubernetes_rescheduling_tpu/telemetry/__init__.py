@@ -19,6 +19,17 @@ that lives now:
   git rev).
 - :mod:`report` — summarize a run's JSONL into a human-readable report
   (the ``telemetry`` CLI subcommand).
+- :mod:`server` — the LIVE ops plane: in-process ``/metrics`` /
+  ``/healthz`` / ``/events`` HTTP endpoint plus the :class:`OpsPlane`
+  aggregate the controller consumes (``--serve PORT``).
+- :mod:`explain` — decision explainability: per-decision
+  ``DecisionExplanation`` records whose chosen move re-derives as the
+  argmax of the recorded candidate scores (consistency-checked).
+- :mod:`flight_recorder` — bounded ring of recent rounds, dumped as a
+  self-contained diagnostics bundle on breaker-open / crash / SIGUSR1.
+- :mod:`watchdog` — rolling-window SLO rules (latency p95, comm-cost
+  regression, retraces) feeding ``/healthz`` and
+  ``slo_violations_total{rule}``.
 
 Everything routes through one default :class:`MetricsRegistry`
 (:func:`get_registry`) unless a caller injects its own; the registry is
@@ -51,6 +62,16 @@ from kubernetes_rescheduling_tpu.telemetry.manifest import (
     run_manifest,
     write_manifest,
 )
+from kubernetes_rescheduling_tpu.telemetry.explain import (
+    explanation_consistent,
+)
+from kubernetes_rescheduling_tpu.telemetry.flight_recorder import FlightRecorder
+from kubernetes_rescheduling_tpu.telemetry.server import (
+    HealthState,
+    OpsPlane,
+    OpsServer,
+)
+from kubernetes_rescheduling_tpu.telemetry.watchdog import SLORules, Watchdog
 
 __all__ = [
     "Counter",
@@ -70,4 +91,11 @@ __all__ = [
     "timed_call",
     "run_manifest",
     "write_manifest",
+    "explanation_consistent",
+    "FlightRecorder",
+    "HealthState",
+    "OpsPlane",
+    "OpsServer",
+    "SLORules",
+    "Watchdog",
 ]

@@ -130,6 +130,50 @@ def summarize_manifest(m: dict[str, Any]) -> list[str]:
     return lines
 
 
+def summarize_bundle(bundle: dict[str, Any]) -> list[str]:
+    """Flight-recorder bundle → text: trigger, ring contents, and the
+    explain-consistency verdict over every recorded decision."""
+    from kubernetes_rescheduling_tpu.telemetry.explain import (
+        check_decisions,
+        iter_decisions,
+    )
+
+    rounds = bundle.get("rounds") or []
+    executed = [r for r in rounds if not r.get("skipped")]
+    skipped = len(rounds) - len(executed)
+    lines = [
+        f"  flight-recorder bundle: reason={bundle.get('reason')}"
+        + (f" ({bundle.get('error')})" if bundle.get("error") else ""),
+        f"  rounds ringed: {len(rounds)} ({len(executed)} executed, "
+        f"{skipped} skipped)",
+    ]
+    for r in executed:
+        rec = r.get("record") or {}
+        lines.append(
+            f"    r{r.get('round')}: digest={r.get('digest')} "
+            f"moved={rec.get('moved')} breaker={rec.get('breaker_state')} "
+            f"cost={rec.get('communication_cost'):.4g}"
+            if rec.get("communication_cost") is not None
+            else f"    r{r.get('round')}: digest={r.get('digest')}"
+        )
+    decisions = iter_decisions(rounds)
+    checked, bad = check_decisions(decisions)
+    lines.append(
+        f"  decisions: {checked} recorded, "
+        f"{checked - len(bad)} explain-consistent"
+        + ("" if not bad else f" — {len(bad)} INCONSISTENT")
+    )
+    metrics = bundle.get("metrics") or []
+    lines.append(f"  metrics snapshot: {len(metrics)} series")
+    manifest = bundle.get("manifest") or {}
+    if manifest:
+        lines.append(
+            f"  from: {manifest.get('hostname')} pid {manifest.get('pid')} "
+            f"at {manifest.get('timestamp')}"
+        )
+    return lines
+
+
 def summarize_file(path: str | Path) -> str:
     """Detect the artifact kind from its record shape and summarize."""
     p = Path(path)
@@ -140,12 +184,14 @@ def summarize_file(path: str | Path) -> str:
     if not text:
         return "\n".join(header + ["  (empty)"])
     if text.startswith("{") and "\n" not in text.split("}")[0] or p.suffix == ".json":
-        # whole-file JSON: a manifest or a Chrome trace
+        # whole-file JSON: a manifest, a Chrome trace, or a bundle
         try:
             obj = json.loads(text)
         except json.JSONDecodeError:
             obj = None
         if isinstance(obj, dict):
+            if obj.get("kind") == "flight_recorder_bundle":
+                return "\n".join(header + summarize_bundle(obj))
             if "traceEvents" in obj:
                 return "\n".join(
                     header + [f"  chrome trace: {len(obj['traceEvents'])} spans"]
@@ -162,3 +208,35 @@ def summarize_file(path: str | Path) -> str:
 
 def report(paths: list[str]) -> str:
     return "\n".join(summarize_file(p) for p in paths)
+
+
+def report_explain(paths: list[str]) -> str:
+    """The ``telemetry explain`` report: decision explanations (from
+    ``decision`` events or a bundle's ring), re-derived and rendered."""
+    from kubernetes_rescheduling_tpu.telemetry.explain import (
+        load_decisions,
+        summarize_decisions,
+    )
+
+    out = []
+    for p in paths:
+        out.append(f"== {p} ==")
+        out.extend(summarize_decisions(load_decisions(p)))
+    return "\n".join(out)
+
+
+def report_bundle(paths: list[str]) -> str:
+    """The ``telemetry bundle`` report: summarize flight-recorder bundles."""
+    out = []
+    for p in paths:
+        out.append(f"== {p} ==")
+        try:
+            obj = json.loads(Path(p).read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            out.append(f"  unreadable: {e}")
+            continue
+        if not isinstance(obj, dict) or obj.get("kind") != "flight_recorder_bundle":
+            out.append("  not a flight-recorder bundle")
+            continue
+        out.extend(summarize_bundle(obj))
+    return "\n".join(out)

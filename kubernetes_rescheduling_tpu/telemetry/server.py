@@ -1,0 +1,390 @@
+"""The live ops plane: an in-process, stdlib-only HTTP endpoint plus the
+aggregate (:class:`OpsPlane`) that wires it to the control loop.
+
+Endpoints (``--serve PORT`` on ``reschedule``/``bench``):
+
+- ``GET /metrics``  — live Prometheus text exposition straight from the
+  process :class:`~.registry.MetricsRegistry` (format 0.0.4), scrapeable
+  mid-run — this replaces the old "dump a .prom file and python -m
+  http.server it" workaround.
+- ``GET /healthz``  — JSON health: circuit-breaker state, last-round
+  age, executed/skipped/degraded counts, and the SLO watchdog verdict.
+  Returns **503** while unhealthy (breaker open, an active SLO
+  violation, or a stale loop), 200 otherwise — a liveness probe or the
+  chaos soak can watch the loop degrade and recover in real time.
+- ``GET /events``   — the newest structured-log events as JSON
+  (``?n=`` caps the count; default 256) — the StructuredLogger ring,
+  without grepping JSONL files mid-incident.
+
+The server runs daemon threads and binds 127.0.0.1 by default; port 0
+picks an ephemeral port (tests). Handlers never write to stdout/stderr —
+request accounting goes through ``ops_http_requests_total{endpoint}``.
+
+:class:`OpsPlane` bundles the registry, event logger, SLO watchdog,
+flight recorder, health state, and server into the single object
+``run_controller(ops=...)`` consumes; ``OpsPlane.from_config`` builds it
+from the ``RescheduleConfig.obs`` block. SIGUSR1 (when the plane starts
+on the main thread) dumps a flight-recorder bundle on demand.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import parse_qs, urlsplit
+
+from kubernetes_rescheduling_tpu.telemetry.flight_recorder import (
+    FlightRecorder,
+    state_digest,
+)
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+from kubernetes_rescheduling_tpu.telemetry.spans import get_tracer
+from kubernetes_rescheduling_tpu.telemetry.watchdog import SLORules, Watchdog
+
+
+class HealthState:
+    """Live-readable loop health; the controller updates counts, the
+    breaker/watchdog are read at request time so /healthz can go
+    unhealthy (and recover) BETWEEN rounds, not only after one."""
+
+    def __init__(self, *, max_round_age_s: float = 0.0) -> None:
+        self.max_round_age_s = max_round_age_s
+        self.breaker = None
+        self.watchdog: Watchdog | None = None
+        self.algorithm: str | None = None
+        self.started_ts = time.time()
+        self.last_round_ts: float | None = None
+        self.rounds = 0
+        self.skipped_rounds = 0
+        self.degraded_rounds = 0
+
+    def snapshot(self) -> tuple[dict[str, Any], bool]:
+        breaker_state = getattr(self.breaker, "state", None)
+        age = (
+            time.time() - self.last_round_ts
+            if self.last_round_ts is not None
+            else None
+        )
+        stale = (
+            self.max_round_age_s > 0
+            and age is not None
+            and age > self.max_round_age_s
+        )
+        slo = self.watchdog.status() if self.watchdog is not None else None
+        healthy = (
+            breaker_state != "open"
+            and not stale
+            and (slo is None or slo["healthy"])
+        )
+        return (
+            {
+                "status": "ok" if healthy else "unhealthy",
+                "algorithm": self.algorithm,
+                "breaker": breaker_state,
+                "rounds": self.rounds,
+                "skipped_rounds": self.skipped_rounds,
+                "degraded_rounds": self.degraded_rounds,
+                "last_round_age_s": age,
+                "stale": stale,
+                "uptime_s": time.time() - self.started_ts,
+                "slo": slo,
+            },
+            healthy,
+        )
+
+
+class OpsServer:
+    """Threaded stdlib HTTP server over (registry, health, events)."""
+
+    def __init__(
+        self,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry: MetricsRegistry | None = None,
+        health: HealthState | None = None,
+        events_source=None,  # zero-arg callable -> list[dict]
+    ) -> None:
+        self._port = port
+        self.host = host
+        self.registry = registry
+        self.health = health
+        self.events_source = events_source
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return (
+            self._httpd.server_address[1]
+            if self._httpd is not None
+            else self._port
+        )
+
+    def _reg(self) -> MetricsRegistry:
+        return self.registry if self.registry is not None else get_registry()
+
+    def start(self) -> int:
+        if self._httpd is not None:
+            return self.port
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self._port), handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="krt-ops-server",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+
+def _make_handler(ops: OpsServer):
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "krt-ops/1"
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+            pass  # request accounting is a metric, not a stderr line
+
+        def _respond(self, code: int, body: bytes, content_type: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802 — stdlib signature
+            url = urlsplit(self.path)
+            endpoint = url.path.rstrip("/") or "/"
+            ops._reg().counter(
+                "ops_http_requests_total",
+                "requests served by the live ops endpoint",
+                labelnames=("endpoint",),
+            ).labels(endpoint=endpoint).inc()
+            if endpoint == "/metrics":
+                body = ops._reg().expose().encode()
+                self._respond(
+                    200, body, "text/plain; version=0.0.4; charset=utf-8"
+                )
+            elif endpoint == "/healthz":
+                if ops.health is None:
+                    payload, healthy = {"status": "ok", "detail": "no loop"}, True
+                else:
+                    payload, healthy = ops.health.snapshot()
+                body = json.dumps(payload, default=float).encode()
+                self._respond(
+                    200 if healthy else 503, body, "application/json"
+                )
+            elif endpoint == "/events":
+                try:
+                    n = int(parse_qs(url.query).get("n", ["256"])[0])
+                except ValueError:
+                    n = 256
+                events = (
+                    list(ops.events_source() or [])
+                    if ops.events_source is not None
+                    else []
+                )
+                body = json.dumps(events[-max(n, 0):], default=float).encode()
+                self._respond(200, body, "application/json")
+            else:
+                self._respond(
+                    404,
+                    json.dumps(
+                        {"error": "not found",
+                         "endpoints": ["/metrics", "/healthz", "/events"]}
+                    ).encode(),
+                    "application/json",
+                )
+
+    return Handler
+
+
+@dataclass
+class OpsPlane:
+    """Everything the live ops plane needs, in one handle the controller
+    consumes: per-round observation fans out to the watchdog, the flight
+    recorder, and the health state; breaker-open and crashes trigger
+    bundle dumps."""
+
+    registry: MetricsRegistry | None = None
+    logger: Any = None
+    watchdog: Watchdog | None = None
+    recorder: FlightRecorder | None = None
+    health: HealthState = field(default_factory=HealthState)
+    server: OpsServer | None = None
+    span_tail: int = 12
+    _prev_sigusr1: Any = field(default=None, repr=False)
+    _sig_installed: bool = field(default=False, repr=False)
+
+    @classmethod
+    def from_config(
+        cls,
+        obs,
+        *,
+        registry: MetricsRegistry | None = None,
+        logger=None,
+        bundle_dir: str | None = None,
+    ) -> "OpsPlane":
+        """Build from a ``config.ObsConfig`` block (the CLI/harness path)."""
+        health = HealthState(max_round_age_s=obs.max_round_age_s)
+        watchdog = Watchdog(
+            SLORules(
+                window=obs.slo_window,
+                min_samples=obs.slo_min_samples,
+                latency_p95_s=obs.slo_latency_p95_s,
+                cost_regression_frac=obs.slo_cost_regression_frac,
+                max_retraces=obs.slo_max_retraces,
+            ),
+            registry=registry,
+            logger=logger,
+        )
+        recorder = FlightRecorder(
+            capacity=obs.flight_recorder_rounds,
+            bundle_dir=bundle_dir if bundle_dir is not None else obs.bundle_dir,
+            registry=registry,
+            logger=logger,
+        )
+        plane = cls(
+            registry=registry,
+            logger=logger,
+            watchdog=watchdog,
+            recorder=recorder,
+            health=health,
+        )
+        if obs.serve_port is not None:
+            plane.server = OpsServer(
+                port=obs.serve_port,
+                registry=registry,
+                health=health,
+                events_source=plane._events,
+            )
+        return plane
+
+    def _events(self) -> list[dict]:
+        return self.logger.records if self.logger is not None else []
+
+    # ---- lifecycle ----
+
+    def start(self) -> "OpsPlane":
+        self.health.watchdog = self.watchdog
+        if self.server is not None:
+            if self.server.health is None:
+                self.server.health = self.health
+            if self.server.events_source is None:
+                self.server.events_source = self._events
+            self.server.start()
+        if (
+            self.recorder is not None
+            and threading.current_thread() is threading.main_thread()
+            and not self._sig_installed
+        ):
+            try:
+                self._prev_sigusr1 = signal.signal(
+                    signal.SIGUSR1,
+                    lambda signum, frame: self.recorder.dump("sigusr1"),
+                )
+                self._sig_installed = True
+            except (ValueError, OSError, AttributeError):
+                pass  # non-main thread / platform without SIGUSR1
+        return self
+
+    def close(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+        if self._sig_installed:
+            try:
+                signal.signal(signal.SIGUSR1, self._prev_sigusr1 or signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+            self._sig_installed = False
+
+    def __enter__(self) -> "OpsPlane":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- controller hooks ----
+
+    def bind(self, *, breaker=None, logger=None, algorithm=None) -> None:
+        """Attach the current run's breaker/logger/algorithm (the plane
+        can outlive a single run — the bench harness reuses one across
+        matrix cells)."""
+        if breaker is not None:
+            self.health.breaker = breaker
+        if logger is not None:
+            self.logger = logger
+            if self.watchdog is not None:
+                self.watchdog.logger = logger
+            if self.recorder is not None:
+                self.recorder.logger = logger
+        if algorithm is not None:
+            self.health.algorithm = algorithm
+        self.health.watchdog = self.watchdog
+        if self.watchdog is not None:
+            # a new run binding = a fresh observation window: another
+            # cell's cost scale or a new shape's first compile must not
+            # read as an SLO violation
+            self.watchdog.rebase()
+
+    def observe_round(self, record, state=None, events=()) -> None:
+        self.health.rounds += 1
+        self.health.last_round_ts = time.time()
+        if record.degraded:
+            self.health.degraded_rounds += 1
+        if self.watchdog is not None:
+            self.watchdog.observe_round(record)
+        if self.recorder is not None:
+            spans = [
+                {
+                    "name": ev.name,
+                    "dur_us": ev.dur_us,
+                    "depth": ev.depth,
+                    "args": ev.args,
+                }
+                for ev in get_tracer().tail(self.span_tail)
+            ]
+            self.recorder.record_round(
+                round=record.round,
+                digest=state_digest(state) if state is not None else None,
+                record=record.as_dict(),
+                events=list(events),
+                spans=spans,
+            )
+
+    def observe_skip(self, rnd: int, breaker_state: str | None = None) -> None:
+        self.health.skipped_rounds += 1
+        self.health.last_round_ts = time.time()
+        if self.recorder is not None:
+            self.recorder.record_skip(rnd, breaker=breaker_state)
+
+    def on_breaker_transition(self, rec: dict) -> None:
+        """Wired to ``CircuitBreaker.on_transition``: an OPEN transition
+        dumps a bundle — the moment an operator will want the last N
+        rounds, captured while they are still in memory."""
+        if rec.get("to") == "open" and self.recorder is not None:
+            self.recorder.dump("breaker_open", transition=rec)
+
+    def on_crash(self, exc: BaseException) -> None:
+        if self.recorder is not None:
+            self.recorder.dump("crash", error=repr(exc))

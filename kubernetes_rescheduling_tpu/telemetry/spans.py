@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import itertools
 import json
 import os
 import threading
@@ -124,6 +125,13 @@ class Tracer:
     def events(self) -> list[SpanEvent]:
         with self._lock:
             return list(self._events)
+
+    def tail(self, n: int) -> list[SpanEvent]:
+        """The newest ``n`` spans (oldest-first), without copying the whole
+        ring — the flight recorder reads this once per round."""
+        with self._lock:
+            it = itertools.islice(reversed(self._events), max(n, 0))
+            return list(it)[::-1]
 
     @property
     def dropped(self) -> int:

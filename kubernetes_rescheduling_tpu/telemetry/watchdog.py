@@ -1,0 +1,187 @@
+"""Rolling-window SLO watchdog for the control loop.
+
+Three rule families, each optional, evaluated after every executed round
+over a bounded window of recent rounds:
+
+- **round latency p95** — the p95 of per-round device decision latency
+  exceeds ``latency_p95_s`` (0 disables);
+- **comm-cost regression** — the latest round's communication cost rose
+  more than ``cost_regression_frac`` above the window's best (0 disables);
+- **retrace** — any ``instrument_jit``-ed function re-traced while being
+  watched: its ``jax_traces_total`` rose ``max_retraces`` or more above
+  the BASELINE captured when the watchdog first saw it (0 disables; the
+  steady-state invariant is no new traces — one more means every round
+  is paying a recompile). Baselines — and the rolling windows — reset on
+  :meth:`Watchdog.rebase`, which the ops plane calls when a new run
+  binds, so a bench session's later cells compiling fresh shapes are not
+  misread as retraces.
+
+Entering violation increments ``slo_violations_total{rule}`` and logs an
+``slo_violation`` event; leaving logs ``slo_recovered``. The set of
+currently-active violations (:attr:`Watchdog.active`) is what flips
+``/healthz`` unhealthy — a rule that recovers un-flips it.
+
+jax-free by design, like the registry it reads.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from dataclasses import dataclass
+from typing import Any
+
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+RULE_LATENCY = "round_latency_p95"
+RULE_COST = "comm_cost_regression"
+RULE_RETRACE = "retrace"
+
+
+@dataclass(frozen=True)
+class SLORules:
+    """Thresholds; a zero threshold disables its rule."""
+
+    window: int = 20
+    min_samples: int = 5            # rounds before latency/cost rules judge
+    latency_p95_s: float = 0.0
+    cost_regression_frac: float = 0.0
+    max_retraces: int = 1
+
+    def validate(self) -> "SLORules":
+        if self.window < 2:
+            raise ValueError("window must be >= 2")
+        if self.min_samples < 1:
+            raise ValueError("min_samples must be >= 1")
+        for name in ("latency_p95_s", "cost_regression_frac"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+        if self.max_retraces < 0:
+            raise ValueError("max_retraces must be >= 0")
+        return self
+
+
+def _p95(samples: list[float]) -> float:
+    s = sorted(samples)
+    idx = max(math.ceil(0.95 * len(s)) - 1, 0)
+    return s[idx]
+
+
+class Watchdog:
+    """Feed it one completed round at a time; read ``active`` for health."""
+
+    def __init__(
+        self,
+        rules: SLORules | None = None,
+        *,
+        registry: MetricsRegistry | None = None,
+        logger=None,
+    ) -> None:
+        self.rules = (rules or SLORules()).validate()
+        self.registry = registry
+        self.logger = logger
+        self._lat: collections.deque[float] = collections.deque(
+            maxlen=self.rules.window
+        )
+        self._cost: collections.deque[float] = collections.deque(
+            maxlen=self.rules.window
+        )
+        self._trace_base: dict[str, float] = {}
+        self.active: dict[str, dict[str, Any]] = {}
+        self.violations_seen = 0
+
+    def rebase(self) -> None:
+        """Start a fresh observation window: clear the rolling latency/
+        cost windows, retrace baselines, and active violations. Called
+        when a new run binds to the ops plane — cross-run comparisons
+        (a different algorithm's cost scale, a new shape's first
+        compile) are not SLO signals."""
+        self._lat.clear()
+        self._cost.clear()
+        self._trace_base.clear()
+        self.active = {}
+
+    def _reg(self) -> MetricsRegistry:
+        return self.registry if self.registry is not None else get_registry()
+
+    def observe_round(self, record) -> list[dict[str, Any]]:
+        """Record one executed round and re-evaluate every rule. Returns
+        the NEWLY raised violations (already counted and logged)."""
+        self._lat.append(float(record.decision_latency_s))
+        self._cost.append(float(record.communication_cost))
+        return self.check()
+
+    def check(self) -> list[dict[str, Any]]:
+        r = self.rules
+        now: dict[str, dict[str, Any]] = {}
+        if r.latency_p95_s > 0 and len(self._lat) >= r.min_samples:
+            p95 = _p95(list(self._lat))
+            if p95 > r.latency_p95_s:
+                now[RULE_LATENCY] = {
+                    "p95_s": p95, "threshold_s": r.latency_p95_s,
+                    "window": len(self._lat),
+                }
+        # the baseline excludes the latest sample, so the rule needs at
+        # least 2 samples whatever min_samples says
+        if r.cost_regression_frac > 0 and len(self._cost) >= max(r.min_samples, 2):
+            latest = self._cost[-1]
+            baseline = min(list(self._cost)[:-1])
+            if baseline > 0 and latest > (1.0 + r.cost_regression_frac) * baseline:
+                now[RULE_COST] = {
+                    "cost": latest, "baseline": baseline,
+                    "threshold_frac": r.cost_regression_frac,
+                }
+        if r.max_retraces > 0:
+            # compare against the count first seen for each fn, not the
+            # cumulative total: a fresh shape compiling once (a later
+            # bench cell, the explain kernel's first round) is not a
+            # retrace — only growth while under watch is
+            retraced = {}
+            for rec in self._reg().snapshot():
+                if rec["metric"] != "jax_traces_total":
+                    continue
+                fn = rec["labels"].get("fn", "?")
+                v = rec.get("value", 0)
+                base = self._trace_base.setdefault(fn, v)
+                if v - base >= r.max_retraces:
+                    retraced[fn] = v
+            if retraced:
+                now[RULE_RETRACE] = {
+                    "fns": retraced, "max_retraces": r.max_retraces,
+                }
+
+        raised = []
+        for rule, detail in now.items():
+            if rule not in self.active:
+                raised.append({"rule": rule, **detail})
+                self.violations_seen += 1
+                self._reg().counter(
+                    "slo_violations_total",
+                    "SLO watchdog rules newly entering violation",
+                    labelnames=("rule",),
+                ).labels(rule=rule).inc()
+                if self.logger is not None:
+                    self.logger.warn("slo_violation", rule=rule, **detail)
+        for rule in self.active:
+            if rule not in now and self.logger is not None:
+                self.logger.info("slo_recovered", rule=rule)
+        self.active = now
+        return raised
+
+    @property
+    def healthy(self) -> bool:
+        return not self.active
+
+    def status(self) -> dict[str, Any]:
+        """The /healthz 'slo' block."""
+        return {
+            "healthy": self.healthy,
+            "active": [
+                {"rule": rule, **detail}
+                for rule, detail in sorted(self.active.items())
+            ],
+            "violations_seen": self.violations_seen,
+        }

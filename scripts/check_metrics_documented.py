@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Static check: the metric inventory in OBSERVABILITY.md matches the code.
+
+Every metric name registered anywhere in ``kubernetes_rescheduling_tpu/``
+(via ``registry.counter/gauge/histogram("name", ...)``) must appear in
+OBSERVABILITY.md's inventory table, and every name the table lists must
+still exist in the code — so the operator-facing metric docs can no
+longer drift from what the ``/metrics`` endpoint actually serves.
+
+Source side: a regex over the package for ``.counter("...")`` /
+``.gauge("...")`` / ``.histogram("...")`` call sites with a literal
+first argument (the registry's get-or-create surface; ``\\s*`` spans the
+newline in multi-line calls). A registration whose name is built
+dynamically would be invisible to this check — keep names literal.
+
+Doc side: backticked tokens in the FIRST column of the inventory table's
+rows (lines starting with ``| `` in OBSERVABILITY.md).
+
+Run directly (exit 1 on drift) or through its test twin
+(tests/test_metrics_documented.py).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "kubernetes_rescheduling_tpu"
+DOC = ROOT / "OBSERVABILITY.md"
+
+_REGISTER = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*\"([a-zA-Z_][a-zA-Z0-9_]*)\"", re.S
+)
+_TICKED = re.compile(r"`([a-z_][a-z0-9_]*)`")
+
+
+def code_metrics() -> dict[str, list[str]]:
+    """metric name -> source files registering it."""
+    out: dict[str, list[str]] = {}
+    for path in sorted(PACKAGE.rglob("*.py")):
+        for name in _REGISTER.findall(path.read_text()):
+            out.setdefault(name, []).append(
+                str(path.relative_to(ROOT))
+            )
+    return out
+
+
+def documented_metrics(doc: Path = DOC) -> set[str]:
+    """Backticked metric names from the first column of the inventory
+    table — the table under the '**Metrics**' heading (other tables in
+    the doc describe files/flags, not metrics)."""
+    names: set[str] = set()
+    in_section = False
+    for line in doc.read_text().splitlines():
+        if line.startswith("**Metrics**"):
+            in_section = True
+            continue
+        if in_section and line.startswith("**"):
+            break
+        if in_section and line.startswith("|") and line.count("|") >= 2:
+            first_cell = line.split("|")[1]
+            names.update(_TICKED.findall(first_cell))
+    return names
+
+
+def violations() -> list[str]:
+    code = code_metrics()
+    docs = documented_metrics()
+    out = []
+    for name in sorted(set(code) - docs):
+        out.append(
+            f"registered but not in OBSERVABILITY.md inventory: {name} "
+            f"({', '.join(sorted(set(code[name])))})"
+        )
+    for name in sorted(docs - set(code)):
+        out.append(f"documented but never registered in code: {name}")
+    return out
+
+
+def main() -> int:
+    bad = violations()
+    if bad:
+        sys.stderr.write(
+            "metric inventory drift between code and OBSERVABILITY.md:\n"
+            + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,6 +52,15 @@ else:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy redundant variants excluded from the tier-1 "
+        "`-m 'not slow'` run; every invariant they cover keeps at least "
+        "one fast representative",
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     yield

@@ -451,7 +451,10 @@ def test_top_gain_moves_ranks_by_comm_gain():
     cfg = GlobalSolverConfig(balance_weight=0.0, enforce_capacity=False)
     changed = [(0, 1), (2, 1)]  # move a -> n1 (gain 5), c -> n1 (gain 1)
     top1 = _top_gain_moves(changed, state, graph, cfg, 1)
-    assert top1 == [(0, 1)]
+    assert [(s, t) for s, t, _ in top1] == [(0, 1)]
+    # the returned gain is the move's comm cut at its evaluation state —
+    # what the global DecisionExplanation records as the candidate score
+    assert top1[0][2] == pytest.approx(5.0)
     # non-improving moves are dropped even under the cap: moving b ONTO
     # a's node after a left would cut nothing extra (gain 0 from n1 -> n1
     # is excluded by construction; use a genuinely zero-gain move)
@@ -459,6 +462,8 @@ def test_top_gain_moves_ranks_by_comm_gain():
     assert _top_gain_moves(zero, state, graph, cfg, 5) == []
 
 
+@pytest.mark.slow  # the CLI latency-budget autotune route; plain CLI
+# global solves stay pinned fast by test_cli_solve/test_cli_solve_restarts
 def test_cli_reschedule_budgeted_global(capsys):
     """V7: the live control-loop entry point can use the capacity budget,
     best-of-N restarts, and the wave cap — no longer bench/solve-only."""

@@ -36,6 +36,8 @@ def test_never_worse_than_input():
     assert float(info["objective_after"]) <= float(info["objective_before"]) + 1e-5
 
 
+@pytest.mark.slow  # solution quality vs the true optimum stays pinned
+# fast by test_optimum's gap tests and test_beats_greedy_car
 def test_reaches_zero_cost_when_capacity_allows():
     # loose capacity -> optimum is everything on one node (cost 0)
     wm = mubench_workmodel_c()
@@ -286,6 +288,9 @@ def test_move_cost_accepts_profitable_moves_and_reports_penalty():
     ) <= float(communication_cost(scn.state, scn.graph))
 
 
+@pytest.mark.slow  # sparse/dense move-cost parity stays pinned fast by
+# test_sharded_sparse.test_move_cost_parity_and_gate and
+# test_parallel's restart-selection-under-move-cost case
 def test_move_cost_sparse_matches_dense_semantics():
     """Sparse solver honors disruption pricing the same way."""
     from kubernetes_rescheduling_tpu.core import sparsegraph

@@ -321,6 +321,8 @@ def test_estimated_weights_beat_declared_on_measured_latency():
     assert lat_estimated < lat_declared
 
 
+@pytest.mark.slow  # sensitivity-corner sweep; policy ordering stays
+# pinned fast by test_estimated_weights_beat_declared_on_measured_latency
 def test_constant_extremes_preserve_policy_ordering():
     """The latency claims rest on ORDERINGS (optimized < pile-up and
     optimized < random), not on the loadgen's absolute milliseconds. Pin

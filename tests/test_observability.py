@@ -1,0 +1,778 @@
+"""The live ops plane (ISSUE 3): /metrics /healthz /events endpoint,
+decision explainability, flight recorder, SLO watchdog — plus the
+Prometheus exposition conformance pin and the live chaos-soak
+acceptance test at the bottom."""
+
+import json
+import math
+import os
+import re
+import signal
+import types
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.bench.boundary import CircuitBreaker
+from kubernetes_rescheduling_tpu.bench.controller import run_controller
+from kubernetes_rescheduling_tpu.bench.harness import make_backend, run_chaos_soak
+from kubernetes_rescheduling_tpu.config import ObsConfig, RescheduleConfig
+from kubernetes_rescheduling_tpu.telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    OpsPlane,
+    OpsServer,
+    SLORules,
+    Watchdog,
+    get_registry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.telemetry.explain import (
+    check_decisions,
+    explanation_consistent,
+    greedy_explanation,
+    iter_decisions,
+)
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+
+
+@pytest.fixture()
+def registry():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+def _get(port, path):
+    """(status, body bytes) without raising on non-200."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ---------------- Prometheus exposition conformance ----------------
+
+
+_SAMPLE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s(\S+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v):
+    return v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+
+def parse_exposition(text):
+    """Minimal strict parser for text format 0.0.4: returns
+    (families: name -> {type, help}, samples: (name, labels-frozenset) ->
+    float). Raises on malformed lines or duplicate samples."""
+    families = {}
+    samples = {}
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name, help_text = line[len("# HELP "):].split(" ", 1)
+            families.setdefault(name, {})["help"] = help_text
+        elif line.startswith("# TYPE "):
+            name, kind = line[len("# TYPE "):].split(" ", 1)
+            families.setdefault(name, {})["type"] = kind
+        else:
+            m = _SAMPLE.match(line)
+            assert m, f"malformed sample line: {line!r}"
+            name, labelstr, value = m.groups()
+            labels = {}
+            if labelstr:
+                consumed = 0
+                for lm in _LABEL.finditer(labelstr):
+                    labels[lm.group(1)] = _unescape(lm.group(2))
+                    consumed += len(lm.group(0))
+                stripped = re.sub(r"[,\s]", "", labelstr)
+                joined = re.sub(
+                    r"[,\s]", "", "".join(
+                        f'{k}="{v}"' for k, v in
+                        ((lm.group(1), lm.group(2)) for lm in _LABEL.finditer(labelstr))
+                    )
+                )
+                assert stripped == joined, f"unparsed label text in {line!r}"
+            v = float("inf") if value == "+Inf" else float(value)
+            key = (name, frozenset(labels.items()))
+            assert key not in samples, f"duplicate sample {line!r}"
+            samples[key] = v
+    return families, samples
+
+
+def assert_exposition_conformant(text):
+    """The wire-format invariants the /metrics endpoint must keep."""
+    families, samples = parse_exposition(text)
+    by_family = {}
+    for (name, labels), v in samples.items():
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        fam = base if base in families else name
+        by_family.setdefault(fam, []).append((name, dict(labels), v))
+    for name, meta in families.items():
+        assert "type" in meta, f"{name}: TYPE line missing"
+        rows = by_family.get(name, [])
+        assert rows, f"{name}: family declared but no samples"
+        if meta["type"] == "histogram":
+            series = {}
+            for sample_name, labels, v in rows:
+                key = frozenset(
+                    (k, lv) for k, lv in labels.items() if k != "le"
+                )
+                series.setdefault(key, {"buckets": [], "sum": None, "count": None})
+                if sample_name.endswith("_bucket"):
+                    series[key]["buckets"].append((float(labels["le"]), v))
+                elif sample_name.endswith("_sum"):
+                    series[key]["sum"] = v
+                elif sample_name.endswith("_count"):
+                    series[key]["count"] = v
+                else:
+                    raise AssertionError(f"stray histogram sample {sample_name}")
+            for key, s in series.items():
+                assert s["sum"] is not None and s["count"] is not None
+                buckets = sorted(s["buckets"])
+                assert buckets, f"{name}: histogram with no buckets"
+                assert buckets[-1][0] == math.inf, f"{name}: +Inf bucket missing"
+                counts = [c for _, c in buckets]
+                assert counts == sorted(counts), f"{name}: buckets not cumulative"
+                assert buckets[-1][1] == s["count"], (
+                    f"{name}: +Inf bucket != count"
+                )
+    return families, samples
+
+
+def test_exposition_conformance_generated(registry):
+    """Everything the registry can emit — labeled counters (with chars
+    needing escaping), gauges, histograms — parses and keeps the
+    histogram invariants."""
+    registry.counter("a_total", "As", labelnames=("k",)).labels(
+        k='we"ird\\lab\nel'
+    ).inc(2)
+    registry.gauge("g", "G").set(-1.5)
+    h = registry.histogram("h_seconds", "H", labelnames=("x",), buckets=(0.1, 1.0))
+    for v, x in ((0.05, "a"), (0.5, "a"), (99.0, "a"), (0.2, "b")):
+        h.labels(x=x).observe(v)
+    families, samples = assert_exposition_conformant(registry.expose())
+    assert families["a_total"]["type"] == "counter"
+    assert families["h_seconds"]["type"] == "histogram"
+    assert samples[("a_total", frozenset([("k", 'we"ird\\lab\nel')]))] == 2
+    assert samples[("g", frozenset())] == -1.5
+
+
+def test_exposition_golden_file(registry):
+    """Byte-exact pin of the wire format a scraper sees. Regenerate with
+    tests/fixtures/make_exposition_golden.py if the format deliberately
+    changes."""
+    golden = Path(__file__).parent / "fixtures" / "exposition_golden.prom"
+    registry.counter(
+        "rounds_total", "rescheduling rounds executed", labelnames=("algorithm",)
+    ).labels(algorithm="communication").inc(3)
+    registry.gauge("communication_cost", "cost", labelnames=("algorithm",)).labels(
+        algorithm="communication"
+    ).set(12.5)
+    h = registry.histogram(
+        "decision_seconds", "latency", labelnames=("algorithm",),
+        buckets=(0.001, 0.01, 0.1),
+    ).labels(algorithm="communication")
+    for v in (0.0005, 0.005, 0.05, 0.5):
+        h.observe(v)
+    registry.counter("esc_total", "label escaping", labelnames=("p",)).labels(
+        p='a"b\\c\nd'
+    ).inc()
+    assert registry.expose() == golden.read_text()
+
+
+# ---------------- ops server ----------------
+
+
+class TestOpsServer:
+    def test_metrics_endpoint_serves_live_registry(self, registry):
+        registry.counter("x_total", "x").inc(7)
+        srv = OpsServer(port=0, registry=registry)
+        port = srv.start()
+        try:
+            status, body = _get(port, "/metrics")
+            assert status == 200
+            assert "x_total 7" in body.decode()
+            # LIVE: a later increment shows up on the next scrape
+            registry.counter("x_total").inc()
+            _, body2 = _get(port, "/metrics")
+            assert "x_total 8" in body2.decode()
+            assert_exposition_conformant(body2.decode())
+        finally:
+            srv.stop()
+
+    def test_healthz_follows_breaker_state(self, registry):
+        from kubernetes_rescheduling_tpu.telemetry.server import HealthState
+
+        health = HealthState()
+        breaker = CircuitBreaker(max_consecutive_failures=1, registry=registry)
+        health.breaker = breaker
+        srv = OpsServer(port=0, registry=registry, health=health)
+        port = srv.start()
+        try:
+            status, body = _get(port, "/healthz")
+            assert status == 200 and json.loads(body)["status"] == "ok"
+            breaker.record_failure()  # opens at 1
+            status, body = _get(port, "/healthz")
+            payload = json.loads(body)
+            assert status == 503
+            assert payload["status"] == "unhealthy"
+            assert payload["breaker"] == "open"
+            breaker.record_success()  # re-closes
+            status, _ = _get(port, "/healthz")
+            assert status == 200
+        finally:
+            srv.stop()
+
+    def test_events_endpoint_serves_logger_tail(self, registry):
+        logger = StructuredLogger(name="t")
+        for i in range(10):
+            logger.info("tick", i=i)
+        srv = OpsServer(
+            port=0, registry=registry, events_source=lambda: logger.records
+        )
+        port = srv.start()
+        try:
+            status, body = _get(port, "/events?n=3")
+            assert status == 200
+            events = json.loads(body)
+            assert [e["i"] for e in events] == [7, 8, 9]
+            status, _ = _get(port, "/nope")
+            assert status == 404
+        finally:
+            srv.stop()
+
+    def test_requests_are_counted_not_printed(self, registry):
+        srv = OpsServer(port=0, registry=registry)
+        port = srv.start()
+        try:
+            _get(port, "/healthz")
+            _get(port, "/healthz")
+            fam = registry.counter(
+                "ops_http_requests_total", labelnames=("endpoint",)
+            )
+            assert fam.labels(endpoint="/healthz").value == 2
+        finally:
+            srv.stop()
+
+
+# ---------------- SLO watchdog ----------------
+
+
+def _rec(lat=0.01, cost=10.0):
+    return types.SimpleNamespace(decision_latency_s=lat, communication_cost=cost)
+
+
+class TestWatchdog:
+    def test_latency_p95_rule_fires_and_recovers(self, registry):
+        logger = StructuredLogger(name="t")
+        wd = Watchdog(
+            SLORules(window=8, min_samples=4, latency_p95_s=0.1,
+                     max_retraces=0),
+            registry=registry, logger=logger,
+        )
+        for _ in range(4):
+            assert wd.observe_round(_rec(lat=0.01)) == []
+        raised = []
+        for _ in range(6):
+            raised += wd.observe_round(_rec(lat=1.0))
+        assert any(v["rule"] == "round_latency_p95" for v in raised)
+        assert not wd.healthy
+        fam = registry.counter("slo_violations_total", labelnames=("rule",))
+        assert fam.labels(rule="round_latency_p95").value == 1  # entry, not per-round
+        # recovery: fast rounds push p95 back under
+        for _ in range(8):
+            wd.observe_round(_rec(lat=0.001))
+        assert wd.healthy
+        events = [r["event"] for r in logger.records]
+        assert "slo_violation" in events and "slo_recovered" in events
+
+    def test_cost_regression_rule(self, registry):
+        wd = Watchdog(
+            SLORules(window=10, min_samples=3, cost_regression_frac=0.5,
+                     max_retraces=0),
+            registry=registry,
+        )
+        for c in (10.0, 9.0, 8.0):
+            wd.observe_round(_rec(cost=c))
+        assert wd.healthy
+        wd.observe_round(_rec(cost=20.0))  # > 1.5x the window min (8.0)
+        assert not wd.healthy
+        assert "comm_cost_regression" in wd.active
+
+    def test_retrace_rule_reads_registry(self, registry):
+        wd = Watchdog(SLORules(max_retraces=1), registry=registry)
+        fam = registry.counter("jax_traces_total", "t", labelnames=("fn",))
+        fam.labels(fn="hot").inc()  # steady state: exactly 1
+        wd.observe_round(_rec())
+        assert wd.healthy
+        fam.labels(fn="hot").inc()  # a retrace
+        wd.observe_round(_rec())
+        assert not wd.healthy
+        assert wd.active["retrace"]["fns"] == {"hot": 2}
+
+    def test_cost_rule_min_samples_one_does_not_crash(self, registry):
+        """min_samples=1 is valid config; the regression baseline needs a
+        second sample, so the first round must simply not judge."""
+        wd = Watchdog(
+            SLORules(min_samples=1, cost_regression_frac=0.5, max_retraces=0),
+            registry=registry,
+        )
+        assert wd.observe_round(_rec(cost=10.0)) == []  # no min([]) crash
+        wd.observe_round(_rec(cost=20.0))
+        assert "comm_cost_regression" in wd.active
+
+    def test_rebase_starts_fresh_window(self, registry):
+        """A new run binding rebases: another cell's shape compiling once
+        is not a retrace, and the previous cell's cost scale is not a
+        regression baseline."""
+        wd = Watchdog(
+            SLORules(min_samples=2, cost_regression_frac=0.5, max_retraces=1),
+            registry=registry,
+        )
+        fam = registry.counter("jax_traces_total", "t", labelnames=("fn",))
+        fam.labels(fn="decide").inc()
+        wd.observe_round(_rec(cost=1.0))
+        assert wd.healthy
+        wd.rebase()  # next cell binds
+        fam.labels(fn="decide").inc()  # NEW SHAPE compiles once
+        # cost jumps because the new cell's scenario is bigger — not a
+        # regression, the old window was cleared
+        wd.observe_round(_rec(cost=100.0))
+        assert wd.healthy, wd.active
+        # but a real retrace within the new window still flags
+        fam.labels(fn="decide").inc()
+        wd.observe_round(_rec(cost=100.0))
+        assert "retrace" in wd.active
+
+    def test_rules_validate(self):
+        with pytest.raises(ValueError):
+            SLORules(window=1).validate()
+        with pytest.raises(ValueError):
+            SLORules(latency_p95_s=-1).validate()
+
+
+# ---------------- flight recorder ----------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_dump_self_contained(self, tmp_path, registry):
+        fr = FlightRecorder(capacity=3, bundle_dir=tmp_path, registry=registry)
+        for r in range(1, 6):
+            fr.record_round(round=r, digest=f"d{r}", record={"round": r})
+        assert [e["round"] for e in fr.rounds] == [3, 4, 5]  # newest 3
+        registry.counter("x_total", "x").inc()
+        p = fr.dump("breaker_open", transition={"to": "open"})
+        bundle = json.loads(p.read_text())
+        assert bundle["kind"] == "flight_recorder_bundle"
+        assert bundle["reason"] == "breaker_open"
+        assert len(bundle["rounds"]) == 3
+        assert any(m["metric"] == "x_total" for m in bundle["metrics"])
+        assert bundle["manifest"]["python"]  # provenance rode along
+        fam = registry.counter(
+            "flight_recorder_dumps_total", labelnames=("reason",)
+        )
+        assert fam.labels(reason="breaker_open").value == 1
+
+    def test_dump_is_best_effort_never_raises(self, registry):
+        logger = StructuredLogger(name="t")
+        fr = FlightRecorder(
+            capacity=2, bundle_dir="/proc/definitely/not/writable",
+            registry=registry, logger=logger,
+        )
+        fr.record_round(round=1)
+        assert fr.dump("crash") is None  # swallowed, logged
+        assert any(
+            r["event"] == "flight_dump_failed" for r in logger.records
+        )
+
+    def test_no_bundle_dir_means_no_dump(self, registry):
+        fr = FlightRecorder(capacity=2, registry=registry)
+        assert fr.dump("crash") is None
+
+    def test_sigusr1_dumps_via_ops_plane(self, tmp_path, registry):
+        ops = OpsPlane.from_config(
+            ObsConfig(flight_recorder_rounds=4),
+            registry=registry,
+            bundle_dir=str(tmp_path),
+        ).start()
+        try:
+            ops.recorder.record_round(round=1, record={"round": 1})
+            os.kill(os.getpid(), signal.SIGUSR1)
+            bundles = list(tmp_path.glob("flight_*_sigusr1.json"))
+            assert len(bundles) == 1
+        finally:
+            ops.close()
+        # handler restored: a second USR1 after close must not dump
+        prev = signal.getsignal(signal.SIGUSR1)
+        assert prev in (signal.SIG_DFL, signal.SIG_IGN) or prev is not None
+
+    def test_breaker_open_transition_dumps(self, tmp_path, registry):
+        ops = OpsPlane.from_config(
+            ObsConfig(flight_recorder_rounds=4),
+            registry=registry,
+            bundle_dir=str(tmp_path),
+        )
+        breaker = CircuitBreaker(max_consecutive_failures=2, registry=registry)
+        breaker.on_transition = ops.on_breaker_transition
+        breaker.record_failure()
+        assert not list(tmp_path.glob("*.json"))
+        breaker.record_failure()  # closed -> open
+        bundles = list(tmp_path.glob("flight_*_breaker_open.json"))
+        assert len(bundles) == 1
+        assert json.loads(bundles[0].read_text())["transition"]["to"] == "open"
+
+
+# ---------------- decision explainability ----------------
+
+
+def _sim():
+    b = make_backend("mubench", seed=1)
+    b.inject_imbalance("worker1")
+    return b
+
+
+def test_decide_explain_matches_decide_bitwise(registry):
+    """The explain kernel's DECISION is the plain kernel's decision —
+    same scores, same argmax, same key — across policies and rounds."""
+    import jax.numpy as jnp
+
+    from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+    from kubernetes_rescheduling_tpu.solver.round_loop import (
+        decide,
+        decide_explain,
+    )
+
+    backend = _sim()
+    state = backend.monitor()
+    graph = backend.comm_graph()
+    thr = jnp.asarray(30.0)
+    for policy in ("communication", "spread", "random"):
+        pid = jnp.asarray(POLICY_IDS[policy])
+        for r in range(3):
+            key = jax.random.fold_in(jax.random.PRNGKey(7), r)
+            plain = decide(state, graph, pid, thr, key)
+            explained = decide_explain(state, graph, pid, thr, key, top_k=3)
+            for a, b in zip(plain[:1] + plain[2:], explained[:1] + explained[2:5]):
+                assert int(np.asarray(a)) == int(np.asarray(b))
+            bundle = np.asarray(explained[5])
+            assert bundle.shape == (6, 3)
+            target_i = int(np.asarray(plain[4]))
+            expl = greedy_explanation(
+                bundle, state.node_names,
+                round=r, seq=0, policy=policy,
+                service="s", hazard_node="h",
+                chosen=state.node_names[target_i] if target_i >= 0 else None,
+            )
+            assert explanation_consistent(expl)
+
+
+def test_explanation_consistency_catches_wrong_chosen():
+    expl = {
+        "kind": "greedy",
+        "chosen": "worker2",
+        "candidates": [
+            {"node": "worker1", "node_index": 0, "score": 5.0, "tiebreak": 0.0},
+            {"node": "worker2", "node_index": 1, "score": 3.0, "tiebreak": 0.0},
+        ],
+    }
+    assert not explanation_consistent(expl)
+    expl["chosen"] = "worker1"
+    assert explanation_consistent(expl)
+    # ties resolve by tiebreak then LOWEST node index — the kernel's order
+    tie = {
+        "chosen": "worker1",
+        "candidates": [
+            {"node": "worker3", "node_index": 2, "score": 5.0, "tiebreak": 1.0},
+            {"node": "worker1", "node_index": 0, "score": 5.0, "tiebreak": 1.0},
+        ],
+    }
+    assert explanation_consistent(tie)
+    assert explanation_consistent({"chosen": None, "candidates": []})
+
+
+def test_controller_records_decisions_and_events(registry):
+    logger = StructuredLogger(name="t")
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=3, sleep_after_action_s=0.0,
+        seed=1,
+    )
+    result = run_controller(_sim(), cfg, logger=logger)
+    assert all(len(r.explanations) >= 1 for r in result.rounds)
+    decisions = [r for r in logger.records if r["event"] == "decision"]
+    assert len(decisions) == sum(len(r.explanations) for r in result.rounds)
+    checked, bad = check_decisions(iter_decisions(logger.records))
+    assert checked == len(decisions) and bad == []
+    moved = [d for d in decisions if d.get("applied")]
+    assert moved and all(d["landed"] for d in moved)
+    # the as_dict/rounds.jsonl surface carries them too
+    assert result.rounds[0].as_dict()["explanations"]
+
+
+def test_controller_explain_off_is_explanation_free(registry):
+    logger = StructuredLogger(name="t")
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=2, sleep_after_action_s=0.0,
+        seed=1, obs=ObsConfig(explain=False),
+    )
+    result = run_controller(_sim(), cfg, logger=logger)
+    assert all(r.explanations == () for r in result.rounds)
+    assert not [r for r in logger.records if r["event"] == "decision"]
+
+
+def test_global_round_explanation_scores_match_wave_selection(registry):
+    """Capped global rounds: the explanation's candidate scores are the
+    wave-cap gains, and the chosen move is their argmax."""
+    logger = StructuredLogger(name="t")
+    cfg = RescheduleConfig(
+        algorithm="global", max_rounds=2, sleep_after_action_s=0.0,
+        seed=3, balance_weight=0.5, global_moves_cap=2,
+    )
+    result = run_controller(_sim(), cfg, logger=logger)
+    expls = [e for r in result.rounds for e in r.explanations]
+    assert expls
+    for e in expls:
+        assert e["kind"] == "global"
+        assert explanation_consistent(e)
+        if e["candidates"]:
+            assert e["chosen"] == max(
+                e["candidates"], key=lambda c: c["score"]
+            )["node"]
+
+
+def test_telemetry_explain_and_bundle_reports(tmp_path, registry):
+    from kubernetes_rescheduling_tpu.cli import main as cli_main
+
+    logger = StructuredLogger(name="t", path=tmp_path / "log.jsonl")
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=2, sleep_after_action_s=0.0,
+        seed=1,
+    )
+    fr = FlightRecorder(capacity=8, bundle_dir=tmp_path, registry=registry)
+    result = run_controller(_sim(), cfg, logger=logger)
+    for r in result.rounds:
+        fr.record_round(round=r.round, digest="x", record=r.as_dict())
+    bundle = fr.dump("crash", error="boom")
+
+    import contextlib
+    import io
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli_main(["telemetry", "explain", str(tmp_path / "log.jsonl")])
+    assert rc == 0
+    text = out.getvalue()
+    assert "decisions re-derive" in text and "INCONSISTENT" not in text
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli_main(["telemetry", "bundle", str(bundle)])
+    assert rc == 0
+    text = out.getvalue()
+    assert "reason=crash" in text and "explain-consistent" in text
+    # the plain report auto-detects bundles too
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        assert cli_main(["telemetry", str(bundle)]) == 0
+    assert "flight-recorder bundle" in out.getvalue()
+
+
+def test_harness_serves_session_ops_plane(tmp_path, registry):
+    """A bench session with serve_port wires one ops plane across cells:
+    flight-recorder bundles land under the session dir, and the endpoint
+    is shut down with the session."""
+    from kubernetes_rescheduling_tpu.bench.harness import (
+        ExperimentConfig,
+        run_experiment,
+    )
+    from kubernetes_rescheduling_tpu.bench.loadgen import LoadGenConfig
+
+    cfg = ExperimentConfig(
+        algorithms=("communication",),
+        repeats=1,
+        rounds=2,
+        scenario="mubench",
+        out_dir=str(tmp_path),
+        seed=3,
+        serve_port=0,
+        load=LoadGenConfig(requests_per_phase=128, chunk=128),
+    )
+    summary = run_experiment(cfg)
+    assert summary["runs"][0]["moves"] >= 0
+    # rounds.jsonl carries the decision explanations (logger was attached)
+    rounds_jsonl = list(
+        tmp_path.glob("session_*/communication/run_1/rounds.jsonl")
+    )
+    recs = [
+        json.loads(ln)
+        for ln in rounds_jsonl[0].read_text().splitlines()
+    ]
+    assert all(r["explanations"] for r in recs)
+    for e in (e for r in recs for e in r["explanations"]):
+        assert explanation_consistent(e)
+
+
+# ---------------- config plumbing ----------------
+
+
+def test_config_obs_toml_block(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        "algorithm = 'communication'\n"
+        "[obs]\n"
+        "serve_port = 0\n"
+        "explain_top_k = 5\n"
+        "flight_recorder_rounds = 8\n"
+        "slo_latency_p95_s = 0.25\n"
+    )
+    cfg = RescheduleConfig.from_toml(p)
+    assert cfg.obs.serve_port == 0
+    assert cfg.obs.explain_top_k == 5
+    assert cfg.obs.flight_recorder_rounds == 8
+    assert cfg.obs.slo_latency_p95_s == 0.25
+
+
+def test_config_obs_validation():
+    with pytest.raises(ValueError):
+        ObsConfig(serve_port=70000).validate()
+    with pytest.raises(ValueError):
+        ObsConfig(explain_top_k=0).validate()
+    with pytest.raises(ValueError):
+        ObsConfig(flight_recorder_rounds=0).validate()
+    with pytest.raises(ValueError):
+        RescheduleConfig(obs=ObsConfig(slo_window=1)).validate()
+
+
+# ---------------- acceptance: the LIVE chaos soak ----------------
+
+
+class _ProbingLogger(StructuredLogger):
+    """Probes the live endpoint synchronously as loop events happen —
+    deterministic observation points instead of a racing poller thread:
+    /healthz on every skipped round (the breaker-open window) and on
+    every breaker re-close; /metrics once mid-run."""
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.port = None
+        self.skip_probes = []
+        self.close_probes = []
+        self.mid_metrics = None
+
+    def log(self, level, event, **fields):
+        super().log(level, event, **fields)
+        if self.port is None:
+            return
+        if event == "round_skipped":
+            status, body = _get(self.port, "/healthz")
+            self.skip_probes.append(
+                (fields.get("breaker"), status, json.loads(body))
+            )
+        elif event == "breaker" and fields.get("to") == "closed":
+            status, body = _get(self.port, "/healthz")
+            self.close_probes.append((status, json.loads(body)))
+        elif event == "round":
+            # overwrite each round: the kept capture is still mid-run (the
+            # last executed round's scrape) but has seen the whole soak
+            self.mid_metrics = _get(self.port, "/metrics")[1].decode()
+
+
+def test_live_ops_soak_acceptance(tmp_path, registry):
+    """ISSUE 3 acceptance: the seeded `soak` profile under a LIVE ops
+    plane. /healthz goes unhealthy while the breaker is open and
+    recovers when it re-closes; /metrics served mid-run parses and the
+    final scrape matches the registry exactly; breaker-open leaves a
+    flight-recorder bundle whose decision records pass the
+    explain-consistency check for every executed round."""
+    logger = _ProbingLogger(name="live-soak")
+    ops = OpsPlane.from_config(
+        ObsConfig(serve_port=0, flight_recorder_rounds=64),
+        registry=registry,
+        logger=logger,
+        bundle_dir=str(tmp_path / "fr"),
+    ).start()
+    logger.port = ops.server.port
+    try:
+        from kubernetes_rescheduling_tpu.utils.retry import RetryPolicy
+
+        report = run_chaos_soak(
+            profile="soak",
+            rounds=35,
+            seed=1,
+            chaos_seed=0,
+            retry=RetryPolicy(max_attempts=1),
+            max_consecutive_failures=3,
+            breaker_cooldown_rounds=2,
+            failure_budget_per_round=2,
+            logger=logger,
+            registry=registry,
+            ops=ops,
+        )
+        # the soak's own invariants still hold under observation
+        assert report["records"] + report["skipped_rounds"] == 35
+        assert report["breaker_opens"] >= 1 and report["breaker_closes"] >= 1
+        assert report["skipped_rounds"] >= 1
+
+        # /healthz went unhealthy while the breaker was open ...
+        open_probes = [p for p in logger.skip_probes if p[0] == "open"]
+        assert open_probes, "no skipped-round probe saw the open breaker"
+        for breaker_state, status, payload in open_probes:
+            assert status == 503
+            assert payload["status"] == "unhealthy"
+            assert payload["breaker"] == "open"
+        # ... and recovered the moment it re-closed
+        assert logger.close_probes
+        for status, payload in logger.close_probes:
+            assert status == 200
+            assert payload["breaker"] == "closed"
+
+        # /metrics mid-run parses and carries the loop's series
+        assert logger.mid_metrics is not None
+        families, samples = assert_exposition_conformant(logger.mid_metrics)
+        for name in ("rounds_total", "chaos_faults_total", "decision_seconds"):
+            assert name in families
+
+        # the final scrape is EXACTLY the registry (loop is quiescent)
+        final = _get(logger.port, "/metrics")[1].decode()
+        assert final == registry.expose()
+
+        # health settles with the breaker's final state
+        status, body = _get(logger.port, "/healthz")
+        payload = json.loads(body)
+        assert payload["rounds"] == report["records"]
+        assert payload["skipped_rounds"] == report["skipped_rounds"]
+        if ops.health.breaker.state != "open":
+            assert status == 200
+        else:
+            assert status == 503
+
+        # breaker-open dumped a bundle; its decisions are explain-consistent
+        bundles = sorted((tmp_path / "fr").glob("flight_*_breaker_open.json"))
+        assert len(bundles) == report["breaker_opens"]
+        bundle = json.loads(bundles[-1].read_text())
+        executed = [r for r in bundle["rounds"] if not r.get("skipped")]
+        assert executed
+        for entry in executed:
+            assert entry["digest"]  # snapshot digest recorded
+            expls = entry["record"]["explanations"]
+            assert expls, f"round {entry['round']} recorded no decisions"
+        decisions = iter_decisions(bundle["rounds"])
+        checked, bad = check_decisions(decisions)
+        assert checked >= len(executed)
+        assert bad == [], f"inconsistent decisions in bundle: {bad}"
+        # the watchdog stayed clean: steady-state kernels never retraced
+        assert ops.watchdog.healthy, ops.watchdog.active
+    finally:
+        ops.close()

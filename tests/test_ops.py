@@ -121,6 +121,9 @@ def test_admission_respects_capacity_race():
     assert int(new_node[0]) == 1                         # stays put
 
 
+@pytest.mark.slow  # fused-vs-XLA solver parity stays pinned fast by
+# test_solver_inline_mass_matches_xla_path (which also asserts the
+# inline path actually engaged)
 def test_solver_fused_epilogue_matches_xla_path():
     """The whole global solver, fused epilogue (interpret) vs XLA path.
 
@@ -226,20 +229,16 @@ def test_fused_noise_is_deterministic_per_seed():
     )
 
 
-def test_sparse_mass_score_matches_two_kernel_path():
-    """The round-5 fused mass+score kernel (one launch, M in VMEM
-    scratch) must reproduce the two-kernel path bit for bit: same mass
-    accumulation order, same shared score_core, fed through the same
-    admission stage."""
+def _sparse_chunk_instance():
+    """One two-regular-block sparse chunk with all the score-stage
+    operands — shared by the bit-parity test and the noise seed-offset-law
+    tests. Returns a namespace of arrays plus the chunk geometry."""
+    import types
+
     from kubernetes_rescheduling_tpu.core import sparsegraph
     from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R
     from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
-    from kubernetes_rescheduling_tpu.ops.fused_admission import admission_stage
-    from kubernetes_rescheduling_tpu.ops.sparse_mass import (
-        chunk_local_slabs,
-        sparse_mass_score,
-        sparse_neighbor_mass,
-    )
+    from kubernetes_rescheduling_tpu.ops.sparse_mass import chunk_local_slabs
 
     scn = synthetic_scenario(n_pods=1024, n_nodes=128, powerlaw=True, seed=5)
     adj = np.asarray(scn.graph.adj)
@@ -262,16 +261,41 @@ def test_sparse_mass_score_matches_two_kernel_path():
     starts = toff[blocks] * sg.bu
     u_c, rvu_c = chunk_local_slabs(sg.u_ids, rvu, starts, sg.u_reg)
     tgt_c = assign[jnp.clip(u_c, 0, SP - 1)]
+    return types.SimpleNamespace(
+        sg=sg, blocks=blocks, ids=ids, C=C, N=N, BLOCK_R=BLOCK_R,
+        assign=assign, rv=rv, w_mm=w_mm, toff=toff, tgt_c=tgt_c, rvu_c=rvu_c,
+        cur=assign[jnp.asarray(ids)],
+        c_cpu=jnp.asarray(rng.integers(1, 5, size=C) * 10.0, jnp.float32),
+        c_mem=jnp.zeros((C,), jnp.float32),
+        valid_c=jnp.asarray(rng.random(C) < 0.9),
+        cap=jnp.full((N,), 900.0, jnp.float32),
+        cpu_load=jnp.asarray(rng.uniform(0, 800.0, N), jnp.float32),
+        mem_cap=jnp.full((N,), 1e9, jnp.float32),
+        mem_load=jnp.zeros((N,), jnp.float32),
+        node_valid=jnp.asarray(rng.random(N) < 0.95),
+        rng=rng,
+    )
 
-    cur = assign[jnp.asarray(ids)]
-    c_cpu = jnp.asarray(rng.integers(1, 5, size=C) * 10.0, jnp.float32)
-    c_mem = jnp.zeros((C,), jnp.float32)
-    valid_c = jnp.asarray(rng.random(C) < 0.9)
-    cap = jnp.full((N,), 900.0, jnp.float32)
-    cpu_load = jnp.asarray(rng.uniform(0, 800.0, N), jnp.float32)
-    mem_cap = jnp.full((N,), 1e9, jnp.float32)
-    mem_load = jnp.zeros((N,), jnp.float32)
-    node_valid = jnp.asarray(rng.random(N) < 0.95)
+
+def test_sparse_mass_score_matches_two_kernel_path():
+    """The round-5 fused mass+score kernel (one launch, M in VMEM
+    scratch) must reproduce the two-kernel path bit for bit: same mass
+    accumulation order, same shared score_core, fed through the same
+    admission stage."""
+    from kubernetes_rescheduling_tpu.ops.fused_admission import admission_stage
+    from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+        sparse_mass_score,
+        sparse_neighbor_mass,
+    )
+
+    inst = _sparse_chunk_instance()
+    sg, blocks, ids, C, N = inst.sg, inst.blocks, inst.ids, inst.C, inst.N
+    assign, rv, w_mm, toff = inst.assign, inst.rv, inst.w_mm, inst.toff
+    tgt_c, rvu_c = inst.tgt_c, inst.rvu_c
+    cur, c_cpu, c_mem, valid_c = inst.cur, inst.c_cpu, inst.c_mem, inst.valid_c
+    cap, cpu_load = inst.cap, inst.cpu_load
+    mem_cap, mem_load, node_valid = inst.mem_cap, inst.mem_load, inst.node_valid
+    rng = inst.rng
     lam = 0.5
 
     for mc_pen in (None, jnp.asarray(rng.random(C), jnp.float32)):
@@ -309,3 +333,129 @@ def test_sparse_mass_score_matches_two_kernel_path():
         np.testing.assert_array_equal(np.asarray(got_adm), np.asarray(exp_adm))
         np.testing.assert_array_equal(np.asarray(got_dc), np.asarray(exp_dc))
         np.testing.assert_array_equal(np.asarray(got_dm), np.asarray(exp_dm))
+
+
+def _noise_paths(inst, seed, *, block_c, noise_impl="stateless", temp=0.7):
+    """(fused mass+score, two-kernel) outputs for the SAME chunk with
+    annealing noise ON — the cross-lowering stream comparison."""
+    from kubernetes_rescheduling_tpu.ops.fused_admission import admission_stage
+    from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+        sparse_mass_score,
+        sparse_neighbor_mass,
+    )
+
+    sg = inst.sg
+    common = dict(
+        enforce_capacity=True, use_noise=True, interpret=True,
+        noise_impl=noise_impl,
+    )
+    prop, gain, wants, s_cpu, s_mem = sparse_mass_score(
+        inst.w_mm, inst.tgt_c, inst.rvu_c, inst.blocks, inst.toff,
+        inst.rv[jnp.asarray(inst.ids)],
+        inst.cur, inst.cur, None, inst.c_cpu, inst.c_mem, inst.valid_c,
+        inst.cpu_load, inst.mem_load, inst.cap, inst.mem_cap,
+        inst.node_valid,
+        0.5, temp, seed, 10.0,
+        num_nodes=inst.N, bu=sg.bu, reg_tiles=sg.reg_tiles, **common,
+    )
+    fused = admission_stage(
+        prop, gain, wants, s_cpu, s_mem, inst.cur, inst.valid_c,
+        inst.c_cpu, inst.c_mem,
+        num_nodes=inst.N, enforce_capacity=True, interpret=True,
+        emit_x_rows=False,
+    )
+    M = sparse_neighbor_mass(
+        inst.w_mm, inst.tgt_c, inst.rvu_c, inst.blocks, inst.toff,
+        num_nodes=inst.N, bu=sg.bu, reg_tiles=sg.reg_tiles, interpret=True,
+    ) * inst.rv[jnp.asarray(inst.ids)][:, None]
+    two_kernel = fused_score_admission(
+        M, inst.cur, inst.c_cpu, inst.c_mem, inst.valid_c,
+        inst.cpu_load, inst.mem_load, inst.cap, inst.mem_cap,
+        inst.node_valid,
+        0.5, temp, seed,
+        overload_weight=10.0, block_c=block_c, emit_x_rows=False, **common,
+    )
+    return fused, two_kernel
+
+
+def test_sparse_mass_score_noise_seed_law_interpret():
+    """NOISE-ON cross-lowering parity (the ADVICE round-5 gap): the fused
+    mass+score kernel offsets its PRNG seed by the BLOCK_R-row block
+    index, the standalone score kernel by program_id over block_c-row
+    tiles. With block_c == BLOCK_R and the same base seed the streams
+    coincide — bit-identical decisions; any other tiling de-synchronizes
+    them. The TPU core PRNG has no interpret lowering, so this locks the
+    seed-offset LAW via the stateless noise impl (same offset plumbing,
+    interpret-safe); the hardware stream itself is pinned by the
+    TPU-gated variant below."""
+    inst = _sparse_chunk_instance()
+    fused, aligned = _noise_paths(inst, seed=123, block_c=inst.BLOCK_R)
+    for got, exp in zip(fused, aligned):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(exp))
+    # same seed, same path: deterministic
+    fused2, _ = _noise_paths(inst, seed=123, block_c=inst.BLOCK_R)
+    np.testing.assert_array_equal(np.asarray(fused[0]), np.asarray(fused2[0]))
+    # a different base seed draws a different stream (decisions shift)
+    fused3, _ = _noise_paths(inst, seed=124, block_c=inst.BLOCK_R)
+    assert not np.array_equal(np.asarray(fused[0]), np.asarray(fused3[0]))
+    # and a mis-tiled score kernel (block_c != BLOCK_R) breaks the law:
+    # program_id advances twice per 256 rows, so block 1's rows see a
+    # different seed offset than the fused kernel gave them
+    _, misaligned = _noise_paths(inst, seed=123, block_c=inst.BLOCK_R // 2)
+    assert not np.array_equal(np.asarray(fused[0]), np.asarray(misaligned[0]))
+
+
+def test_sparse_mass_score_noise_seed_law_tpu():
+    """TPU-only twin: the same seed-offset law under the real TPU core
+    PRNG (compiled, not interpret)."""
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("TPU core PRNG needs a real TPU (no interpret lowering)")
+    inst = _sparse_chunk_instance()
+
+    from kubernetes_rescheduling_tpu.ops.fused_admission import admission_stage
+    from kubernetes_rescheduling_tpu.ops.sparse_mass import (
+        sparse_mass_score,
+        sparse_neighbor_mass,
+    )
+
+    sg = inst.sg
+    common = dict(enforce_capacity=True, use_noise=True, interpret=False)
+    prop, gain, wants, s_cpu, s_mem = sparse_mass_score(
+        inst.w_mm, inst.tgt_c, inst.rvu_c, inst.blocks, inst.toff,
+        inst.rv[jnp.asarray(inst.ids)],
+        inst.cur, inst.cur, None, inst.c_cpu, inst.c_mem, inst.valid_c,
+        inst.cpu_load, inst.mem_load, inst.cap, inst.mem_cap,
+        inst.node_valid,
+        0.5, 0.7, 42, 10.0,
+        num_nodes=inst.N, bu=sg.bu, reg_tiles=sg.reg_tiles, **common,
+    )
+    fused = admission_stage(
+        prop, gain, wants, s_cpu, s_mem, inst.cur, inst.valid_c,
+        inst.c_cpu, inst.c_mem,
+        num_nodes=inst.N, enforce_capacity=True, interpret=False,
+        emit_x_rows=False,
+    )
+    M = sparse_neighbor_mass(
+        inst.w_mm, inst.tgt_c, inst.rvu_c, inst.blocks, inst.toff,
+        num_nodes=inst.N, bu=sg.bu, reg_tiles=sg.reg_tiles, interpret=False,
+    ) * inst.rv[jnp.asarray(inst.ids)][:, None]
+    two_kernel = fused_score_admission(
+        M, inst.cur, inst.c_cpu, inst.c_mem, inst.valid_c,
+        inst.cpu_load, inst.mem_load, inst.cap, inst.mem_cap,
+        inst.node_valid,
+        0.5, 0.7, 42,
+        overload_weight=10.0, block_c=inst.BLOCK_R, emit_x_rows=False,
+        **common,
+    )
+    for got, exp in zip(fused, two_kernel):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(exp))
+
+
+def test_solver_score_block_pins_seed_offset_law():
+    """The production guard the ADVICE asked for: the sparse solver's
+    score-kernel tile size must equal BLOCK_R, or noise-on decisions
+    would silently diverge between its two lowerings of the same sweep."""
+    from kubernetes_rescheduling_tpu.core.sparsegraph import BLOCK_R
+    from kubernetes_rescheduling_tpu.solver import sparse_solver
+
+    assert sparse_solver._SCORE_BLOCK_C == BLOCK_R

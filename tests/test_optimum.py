@@ -60,16 +60,12 @@ def test_brute_force_capacity_binding():
     assert obj == pytest.approx(milp, abs=1e-6)
 
 
-def test_solver_gap_small_instances():
-    """Regression pin: across 10 tiny instances the solver's comm cost is
-    within 5% of the true optimum in aggregate (and never worse than the
-    input, which is separately guaranteed). Round 4 measured >=5/10 exact
-    and <=10% aggregate; round 5's pairwise-swap phase lifted that to
-    9/10 exact and 0.7% aggregate — the pin tightens accordingly."""
+def _gap_over_seeds(seeds):
+    """(total_solver, total_opt, exact_hits) across tiny instances —
+    shared by the fast tier-1 pin and the full slow statistical pin."""
     total_solver = 0.0
     total_opt = 0.0
     exact_hits = 0
-    seeds = range(10)
     for seed in seeds:
         state, graph = _tiny_instance(8, 3, seed, cap_m=350.0)
         cfg = GlobalSolverConfig(sweeps=9, balance_weight=0.0)
@@ -98,5 +94,27 @@ def test_solver_gap_small_instances():
         total_opt += opt
         if solver_cost <= opt + 1e-6:
             exact_hits += 1
+    return total_solver, total_opt, exact_hits
+
+
+def test_solver_gap_small_instances_fast():
+    """Tier-1 pin of solution quality vs the true optimum: 4 tiny
+    instances, aggregate gap <= 5%, most exactly optimal (the round-5
+    swap phase hits 4/4 on these seeds; >= 3 tolerates one regression
+    without flaking)."""
+    total_solver, total_opt, exact_hits = _gap_over_seeds(range(4))
+    assert total_solver <= total_opt * 1.05
+    assert exact_hits >= 3
+
+
+@pytest.mark.slow  # the full statistical pin; tier-1 keeps the 4-seed fast
+# variant above, which covers the same invariant at the same thresholds
+def test_solver_gap_small_instances():
+    """Regression pin: across 10 tiny instances the solver's comm cost is
+    within 5% of the true optimum in aggregate (and never worse than the
+    input, which is separately guaranteed). Round 4 measured >=5/10 exact
+    and <=10% aggregate; round 5's pairwise-swap phase lifted that to
+    9/10 exact and 0.7% aggregate — the pin tightens accordingly."""
+    total_solver, total_opt, exact_hits = _gap_over_seeds(range(10))
     assert total_solver <= total_opt * 1.05
     assert exact_hits >= 8

@@ -54,6 +54,8 @@ def test_solve_with_restarts_single_matches_global_assign():
     np.testing.assert_array_equal(np.asarray(st1.pod_node), np.asarray(st2.pod_node))
 
 
+@pytest.mark.slow  # best-of-N >= single stays pinned fast by
+# test_parallel_restarts_beats_or_matches_single below
 def test_solve_with_restarts_multi_beats_or_matches_single_powerlaw():
     """The VERDICT-r1 wiring requirement: best-of-N on the mesh is never
     worse than a single solve on the power-law scenario."""
@@ -112,6 +114,9 @@ def test_solve_with_restarts_single_device_sequential():
     assert float(info["objective_after"]) <= before
 
 
+@pytest.mark.slow  # tp-sharded == single-device stays pinned fast by
+# test_sharded_solve_with_restarts_matches_dp_only and the
+# capacity+noise sharded case below
 def test_sharded_global_assign_matches_single_device():
     """The node-sharded SPMD solver (tp=4) makes the same decisions as the
     single-device solver with annealing off — the collectives (all_gather
@@ -261,6 +266,8 @@ def test_sharded_choose_node_matches_unsharded(policy):
     assert got == expected
 
 
+@pytest.mark.slow  # move-cost parity across lowerings stays pinned
+# fast by test_sharded_sparse.test_move_cost_parity_and_gate
 def test_sharded_move_cost_parity_with_single_chip():
     """Disruption pricing composes with tp: the node-sharded dense solver
     makes the same decisions as global_assign under move_cost (noise off,

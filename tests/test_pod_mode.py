@@ -77,6 +77,8 @@ def test_pod_mode_splits_replicas_where_service_mode_cannot_move():
     assert (loads <= 250.0).all()
 
 
+@pytest.mark.slow  # pod-mode never-worse stays pinned fast by the
+# splits-replicas and capacity-stuck controller cases
 def test_pod_mode_never_worse_at_scale():
     scn = synthetic_scenario(
         n_pods=1024, n_nodes=16, powerlaw=True, seed=7, replicas=2,
@@ -115,6 +117,9 @@ def test_pod_graph_from_sparse_matches_dense():
     )
 
 
+@pytest.mark.slow  # dp/tp mesh composition stays pinned fast by
+# test_parallel's dp/tp cases; pod-graph routing by the other
+# pod-mode tests
 def test_pod_mode_with_restarts_and_tp():
     """Per-replica placement is a production path: restarts and tp route
     through solve_with_restarts on the pod graph."""

@@ -45,6 +45,8 @@ def test_bit_parity_with_single_chip_sparse():
     assert int(info_shard["tp"]) == 4
 
 
+@pytest.mark.slow  # tier-1 keeps bit parity via the single-chip case above
+# and hub coverage via test_sparse_solver's hub-blocks test
 def test_bit_parity_with_hub_groups():
     # star services force hub blocks → the hub-group pass must stay in
     # lockstep with the single-chip path too
@@ -80,6 +82,8 @@ def test_bit_parity_with_hub_groups():
     )
 
 
+@pytest.mark.slow  # never-worse stays pinned fast by test_sparse_solver's
+# test_sparse_solver_never_worse_and_improves
 def test_never_worse_with_full_objective():
     scn, sg = _scn(seed=4)
     mesh = make_mesh(8, shape=(1, 8))
@@ -139,6 +143,9 @@ def test_move_cost_parity_and_gate():
         assert gain > float(info_h["move_penalty"])
 
 
+@pytest.mark.slow  # dp/tp routing + restart composition stays pinned fast
+# by test_sparse_dp_of_tp_restarts_decision_parity below (which asserts the
+# tp route, the restart count, and full decision parity)
 def test_sparse_restarts_through_production_entry():
     """solve_with_restarts(sparse_graph=...) runs dp restarts of sparse
     solves (never worse than the best single restart) and routes tp>1 to

@@ -32,6 +32,12 @@ Environment knobs:
   BENCH_REPS      timed repetitions (default 5)
   BENCH_RESTARTS  best-of-N solves over the device mesh (default 1)
   BENCH_TRACE_DIR write a jax.profiler trace of the timed loop here
+  BENCH_LEDGER    append the headline reading to this perf-ledger JSONL
+                  (telemetry.perf_ledger schema; `telemetry perf` trends it)
+
+Integer knobs are parsed with a clear error naming the variable — a typo'd
+``BENCH_RESTARTS=two`` exits with the offending name/value instead of a
+bare ValueError traceback.
 """
 
 from __future__ import annotations
@@ -44,6 +50,43 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+
+def _env_int(name: str, default: int) -> int:
+    """Integer env knob with a diagnosable failure mode: the error names
+    the VARIABLE and the value it rejected."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise SystemExit(
+            f"bench: {name} must be an integer, got {raw!r}"
+        ) from None
+
+
+def _ledger_append(result: dict) -> None:
+    """BENCH_LEDGER: append the headline reading to a perf ledger so
+    `telemetry perf` can trend driver rounds without re-ingesting the
+    raw snapshots."""
+    path = os.environ.get("BENCH_LEDGER")
+    if not path:
+        return
+    from kubernetes_rescheduling_tpu.telemetry.perf_ledger import PerfLedger
+
+    extra = result.get("extra", {})
+    devices = extra.get("devices") or []
+    PerfLedger(path).append(
+        metric=result["metric"],
+        value=result["value"],
+        unit=result.get("unit", "ms"),
+        scenario=str(extra.get("scenario", "bench")),
+        device_kind=str(devices[0]) if devices else "unknown",
+        digest="bench-history",
+        better="lower",
+        vs_baseline=result.get("vs_baseline"),
+    )
 
 
 def measure_rtt_ms(reps: int = 7) -> float:
@@ -203,15 +246,17 @@ def _sparse50k_problem():
 
 def main() -> int:
     scenario = os.environ.get("BENCH_SCENARIO", "large")
-    sweeps = int(os.environ.get("BENCH_SWEEPS", "9"))
-    reps = int(os.environ.get("BENCH_REPS", "5"))
-    restarts = int(os.environ.get("BENCH_RESTARTS", "1"))
+    sweeps = _env_int("BENCH_SWEEPS", 9)
+    reps = _env_int("BENCH_REPS", 5)
+    restarts = _env_int("BENCH_RESTARTS", 1)
     solver_kind = os.environ.get("BENCH_SOLVER", "dense")
 
     baseline_ms = 100.0  # BASELINE.md: <100 ms/round at 10k x 1k
 
     if scenario in ("trace", "trace50k"):
-        print(json.dumps(bench_trace(sweeps, baseline_ms, scenario, solver_kind)))
+        result = bench_trace(sweeps, baseline_ms, scenario, solver_kind)
+        _ledger_append(result)
+        print(json.dumps(result))
         return 0
 
     from kubernetes_rescheduling_tpu.objectives import communication_cost
@@ -397,9 +442,7 @@ def main() -> int:
     # RTT attribution — comparable run-to-run without the variance
     # footnote.
     headline_ms = device_prep_ms if device_prep_ms is not None else device_ms
-    print(
-        json.dumps(
-            {
+    result = {
                 "metric": f"device_round_ms_{scenario}",
                 "value": round(headline_ms, 3),
                 "unit": "ms",
@@ -430,8 +473,8 @@ def main() -> int:
                     **restart_extra,
                 },
             }
-        )
-    )
+    _ledger_append(result)
+    print(json.dumps(result))
     return 0
 
 

@@ -38,6 +38,7 @@ from kubernetes_rescheduling_tpu.telemetry import (
     pull,
     span,
 )
+from kubernetes_rescheduling_tpu.telemetry import costmodel
 from kubernetes_rescheduling_tpu.telemetry.explain import (
     greedy_explanation,
     solver_explanation,
@@ -297,6 +298,28 @@ def run_controller(
         graph_src = lambda: graph  # noqa: E731
     result = ControllerResult()
 
+    # per-round device observability: which instrumented kernel this run's
+    # rounds dispatch (preference order — the roofline publishes for the
+    # first label with a captured cost snapshot)
+    if config.algorithm == "global" or config.moves_per_round == "all":
+        # prefer THIS run's solver family: the cost book is process-global,
+        # so a dense-first list would publish the dense kernel's static
+        # cost against a sparse round's latency in a mixed bench session.
+        # The dense labels stay as FALLBACK on the sparse path because
+        # global_assign_sparse genuinely routes small graphs through the
+        # dense kernel — there the dense attribution is the true one.
+        if config.solver_backend == "sparse":
+            roofline_fns = (
+                "global_assign_sparse", "sharded_restarts_sparse",
+                "global_assign", "sharded_restarts_dense",
+            )
+        else:
+            roofline_fns = ("global_assign", "sharded_restarts_dense")
+    elif explain_k > 0:
+        roofline_fns = ("controller_decide_explain",)
+    else:
+        roofline_fns = ("controller_decide",)
+
     mgr = CheckpointManager(checkpoint_dir) if checkpoint_dir else None
     start_round = 1
     if mgr is not None:
@@ -395,6 +418,14 @@ def run_controller(
             record.load_std = float(load_std(state))
             result.rounds.append(record)
             _emit_round_metrics(registry, config.algorithm, record)
+            # device-side observability: live memory_stats gauges plus the
+            # round's achieved-FLOP/s / bytes/s roofline against the
+            # decision kernel's captured static cost
+            costmodel.observe_round_device(
+                registry,
+                fn_labels=roofline_fns,
+                seconds=record.decision_latency_s,
+            )
             if record.degraded:
                 registry.counter(
                     "degraded_rounds_total",

@@ -43,7 +43,11 @@ from kubernetes_rescheduling_tpu.bench.sinks import (
     communication_cost_sink,
     node_std_sink,
 )
-from kubernetes_rescheduling_tpu.config import ChaosConfig, RescheduleConfig
+from kubernetes_rescheduling_tpu.config import (
+    ChaosConfig,
+    PerfConfig,
+    RescheduleConfig,
+)
 from kubernetes_rescheduling_tpu.core.topology import _random_workmodel
 from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, mubench_workmodel_c
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
@@ -116,6 +120,17 @@ class ExperimentConfig:
     # land in bundle_dir (None = <session>/flight_recorder).
     serve_port: int | None = None
     bundle_dir: str | None = None
+    # Perf ledger: every finished cell appends ONE decisions/sec reading
+    # (keyed by metric/scenario+algorithm/device kind/config digest) to an
+    # append-only JSONL ledger; the rolling-window detector judges each
+    # series and feeds the ops plane's perf_regression SLO rule. None =
+    # <session>/perf_ledger.jsonl; point it at a shared file to trend
+    # across sessions.
+    perf_enabled: bool = True
+    perf_ledger: str | None = None
+    perf_window: int = 5
+    perf_regression_frac: float = 0.2
+    perf_baseline: str = "median"    # "median" | "best" of the window
 
     def __post_init__(self):
         # fail invalid solver combinations in milliseconds at construction,
@@ -129,6 +144,12 @@ class ExperimentConfig:
             solver_tp=self.solver_tp,
             moves_per_round=self.moves_per_round,
             global_moves_cap=self.global_moves_cap,
+        ).validate()
+        PerfConfig(
+            ledger_path=self.perf_ledger,
+            window=self.perf_window,
+            regression_frac=self.perf_regression_frac,
+            baseline=self.perf_baseline,
         ).validate()
         if self.placement_unit == "pod" and self.backend == "k8s":
             # K8sBackend.apply_move rejects per-pod moves (the Deployment
@@ -299,10 +320,25 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
     ``/healthz`` tracks the currently-running cell's breaker/SLO state,
     and flight-recorder bundles land under ``<session>/flight_recorder``.
     """
+    from kubernetes_rescheduling_tpu.telemetry import perf_ledger as pl
+
     stamp = cfg.session_name or time.strftime("%Y%m%d_%H%M%S")
     session = Path(cfg.out_dir) / f"session_{stamp}"
     cfg_dict = dataclasses.asdict(cfg)
     summary: dict = {"config": cfg_dict, "runs": []}
+
+    # one ledger for the session (or a shared cross-session file): every
+    # cell appends its decisions/sec reading, keyed so only like-for-like
+    # readings (same scenario+algorithm, device kind, config) compare
+    ledger = (
+        pl.PerfLedger(cfg.perf_ledger or session / "perf_ledger.jsonl")
+        if cfg.perf_enabled
+        else None
+    )
+    cell_digest = pl.config_digest(
+        {k: v for k, v in cfg_dict.items() if k not in ("out_dir", "session_name")}
+    )
+    device_kind = jax.devices()[0].platform
 
     ops = None
     if cfg.serve_port is not None:
@@ -612,6 +648,33 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                 # crash keeps the counters up to the finished cells
                 get_registry().dump_jsonl(run_dir / "metrics.jsonl")
                 summary["runs"].append(run_record)
+                if ledger is not None:
+                    # one ledger entry per cell, then re-judge every series:
+                    # a regression arms the ops plane's perf_regression SLO
+                    # rule (and /healthz) the moment the cell finishes
+                    ledger.append(
+                        metric="decisions_per_sec",
+                        value=result.decisions_per_sec,
+                        unit="1/s",
+                        scenario=f"{cfg.scenario}/{algo}",
+                        device_kind=device_kind,
+                        digest=cell_digest,
+                        better="higher",
+                        run=run_i,
+                        seed=seed,
+                    )
+                    if ops is not None:
+                        # judge only when someone is listening: re-reading
+                        # and re-detecting a shared cross-session ledger
+                        # per cell is O(history) for nothing otherwise
+                        ops.observe_perf(
+                            pl.detect(
+                                ledger.entries(),
+                                window=cfg.perf_window,
+                                threshold_frac=cfg.perf_regression_frac,
+                                baseline=cfg.perf_baseline,
+                            )
+                        )
 
         # per-algorithm aggregates (mean over runs). Final-placement metrics
         # average over every run; loop-phase metrics (decision rate, disruption)

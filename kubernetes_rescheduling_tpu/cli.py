@@ -159,6 +159,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["service", "pod"],
                    help="pod = every replica places independently (global "
                         "algorithm, sim backend)")
+    r.add_argument("--perf-ledger", default=None, metavar="PATH",
+                   help="append this run's decisions/sec to the perf ledger "
+                        "at PATH and judge it with the [perf] block's "
+                        "rolling-window detector; a regression arms the "
+                        "ops plane's perf_regression rule when --serve is "
+                        "active (render trends with `telemetry perf PATH`)")
     _add_resilience_flags(r)
     _add_telemetry_flags(r)
     _add_serve_flags(r)
@@ -284,8 +290,21 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("paths", nargs="+",
                    help="artifact files (kind detected from record shape); "
                         "an optional leading mode word — 'report' "
-                        "(default), 'explain', or 'bundle' — selects the "
-                        "rendering")
+                        "(default), 'explain', 'bundle', or 'perf' — "
+                        "selects the rendering; 'perf' takes perf-ledger "
+                        "JSONL files and/or historical BENCH_r*.json / "
+                        "MULTICHIP_r*.json snapshots and renders the trend "
+                        "table with improved/flat/regressed verdicts")
+    m.add_argument("--perf-window", type=int, default=5,
+                   help="perf mode: prior readings each series is judged "
+                        "against")
+    m.add_argument("--perf-threshold", type=float, default=0.2,
+                   help="perf mode: fraction above baseline that counts as "
+                        "a regression")
+    m.add_argument("--perf-baseline", default="median",
+                   choices=["median", "best"],
+                   help="perf mode: judge against the window's median or "
+                        "its best reading")
     return p
 
 
@@ -323,10 +342,11 @@ def cmd_telemetry(args) -> str:
         report,
         report_bundle,
         report_explain,
+        report_perf,
     )
 
     mode, paths = "report", list(args.paths)
-    if paths and paths[0] in ("report", "explain", "bundle"):
+    if paths and paths[0] in ("report", "explain", "bundle", "perf"):
         mode, paths = paths[0], paths[1:]
     if not paths:
         raise SystemExit(f"telemetry {mode}: no artifact paths given")
@@ -334,6 +354,13 @@ def cmd_telemetry(args) -> str:
         return report_explain(paths)
     if mode == "bundle":
         return report_bundle(paths)
+    if mode == "perf":
+        return report_perf(
+            paths,
+            window=args.perf_window,
+            threshold_frac=args.perf_threshold,
+            baseline=args.perf_baseline,
+        )
     return report(paths)
 
 
@@ -360,12 +387,56 @@ def _build_ops_plane(args, config):
     return ops, logger
 
 
+def _reschedule_perf(args, cfg, result, ops, algo) -> dict | None:
+    """The ``[perf]``/``--perf-ledger`` consumer on the reschedule path:
+    append this run's decisions/sec, judge every series with the block's
+    knobs, arm the ops plane's perf_regression rule, and return the
+    verdict statuses for the command's JSON output."""
+    if not (cfg.perf.enabled and cfg.perf.ledger_path):
+        return None
+    import dataclasses as _dc
+
+    import jax
+
+    from kubernetes_rescheduling_tpu.telemetry import perf_ledger as pl
+
+    ledger = pl.PerfLedger(cfg.perf.ledger_path)
+    # seed excluded: repeated runs of the same setup form ONE series
+    digest_src = {
+        k: v for k, v in _dc.asdict(cfg).items() if k not in ("seed", "perf")
+    }
+    ledger.append(
+        metric="decisions_per_sec",
+        value=result.decisions_per_sec,
+        unit="1/s",
+        scenario=f"{getattr(args, 'scenario', 'k8s')}/{algo}",
+        device_kind=jax.devices()[0].platform,
+        config=digest_src,
+        better="higher",
+        seed=cfg.seed,
+    )
+    verdicts = pl.detect(
+        ledger.entries(),
+        window=cfg.perf.window,
+        threshold_frac=cfg.perf.regression_frac,
+        baseline=cfg.perf.baseline,
+        min_history=cfg.perf.min_history,
+    )
+    if ops is not None:
+        ops.observe_perf(verdicts)
+    return {k: v["status"] for k, v in sorted(verdicts.items())}
+
+
 def cmd_reschedule(args) -> dict:
     import jax
 
     from kubernetes_rescheduling_tpu.bench.controller import run_controller
     from kubernetes_rescheduling_tpu.bench.harness import make_backend
-    from kubernetes_rescheduling_tpu.config import ChaosConfig, RescheduleConfig
+    from kubernetes_rescheduling_tpu.config import (
+        ChaosConfig,
+        PerfConfig,
+        RescheduleConfig,
+    )
 
     algo = _norm_algo(args.algorithm)
     if args.backend == "k8s" and args.placement_unit == "pod":
@@ -411,6 +482,7 @@ def cmd_reschedule(args) -> dict:
         seed=args.seed,
         chaos=ChaosConfig(profile=args.chaos_profile, seed=args.chaos_seed),
         max_consecutive_failures=args.max_consecutive_failures,
+        perf=PerfConfig(ledger_path=args.perf_ledger),
     )
     ops, logger = _build_ops_plane(args, cfg)
     try:
@@ -418,10 +490,11 @@ def cmd_reschedule(args) -> dict:
             backend, cfg, key=jax.random.PRNGKey(args.seed),
             logger=logger, ops=ops,
         )
+        perf = _reschedule_perf(args, cfg, result, ops, algo)
     finally:
         if ops is not None:
             ops.close()
-    return {
+    out = {
         "algorithm": algo,
         "rounds": [rec.as_dict() for rec in result.rounds],
         "moves": result.moves,
@@ -431,6 +504,9 @@ def cmd_reschedule(args) -> dict:
         "boundary_failures": result.boundary_failures,
         "breaker_transitions": result.breaker_transitions,
     }
+    if perf is not None:
+        out["perf"] = perf
+    return out
 
 
 def cmd_bench(args) -> dict:

@@ -86,6 +86,37 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class PerfConfig:
+    """Performance-ledger block (``[perf]`` in TOML): where the
+    append-only perf ledger lives and how its rolling-window regression
+    detector judges. jax-free; ``telemetry.perf_ledger`` consumes it.
+
+    ``ledger_path = None`` means the consumer picks a default (the bench
+    harness writes ``<session>/perf_ledger.jsonl``); ``enabled = False``
+    turns ledger writes and detection off entirely."""
+
+    enabled: bool = True
+    ledger_path: str | None = None
+    window: int = 5                  # prior readings judged against
+    regression_frac: float = 0.2     # threshold above baseline = regressed
+    baseline: str = "median"         # "median" | "best" of the window
+    min_history: int = 2             # readings before a series is judged
+
+    def validate(self) -> "PerfConfig":
+        if self.window < 1:
+            raise ValueError("perf window must be >= 1")
+        if self.regression_frac < 0:
+            raise ValueError("perf regression_frac must be >= 0")
+        if self.baseline not in ("median", "best"):
+            raise ValueError(
+                f"perf baseline must be 'median' or 'best', got {self.baseline!r}"
+            )
+        if self.min_history < 1:
+            raise ValueError("perf min_history must be >= 1")
+        return self
+
+
+@dataclass(frozen=True)
 class RescheduleConfig:
     """One config object for a rescheduling run."""
 
@@ -161,6 +192,9 @@ class RescheduleConfig:
     # Observability: the live ops plane (HTTP endpoint, decision
     # explainability, flight recorder, SLO watchdog) — see ObsConfig.
     obs: ObsConfig = field(default_factory=ObsConfig)
+    # Performance ledger: append-only perf history + rolling-window
+    # regression detection — see PerfConfig.
+    perf: PerfConfig = field(default_factory=PerfConfig)
 
     def validate(self) -> "RescheduleConfig":
         valid = set(POLICIES) | {"global"}
@@ -204,6 +238,7 @@ class RescheduleConfig:
                 )
         self.retry.validate()
         self.obs.validate()
+        self.perf.validate()
         if self.max_consecutive_failures < 0:
             raise ValueError("max_consecutive_failures must be >= 0")
         if self.breaker_cooldown_rounds < 1:
@@ -226,4 +261,6 @@ class RescheduleConfig:
             data["chaos"] = ChaosConfig(**data["chaos"])
         if isinstance(data.get("obs"), dict):
             data["obs"] = ObsConfig(**data["obs"])
+        if isinstance(data.get("perf"), dict):
+            data["perf"] = PerfConfig(**data["perf"])
         return cls(**data).validate()

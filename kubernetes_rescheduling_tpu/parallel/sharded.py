@@ -21,6 +21,7 @@ from kubernetes_rescheduling_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
 from kubernetes_rescheduling_tpu.policies.scoring import (
     node_features,
     policy_key_table,
@@ -121,7 +122,10 @@ def _run_shard(mesh: Mesh, config: GlobalSolverConfig, solver=global_assign,
             _, (pods, objs, pens) = jax.lax.scan(body, 0, keys_block)
             return pods, objs, pens
 
-        fn = jax.jit(run_shard)
+        # instrumented: the controller's restart rounds dispatch this once
+        # per round — retraces become visible, and the compiled program's
+        # cost/HBM snapshot lands under fn="sharded_restarts_<tag>"
+        fn = instrument_jit(run_shard, name=f"sharded_restarts_{solver_tag}")
         _RUN_SHARD_CACHE[cache_key] = fn
     return fn
 

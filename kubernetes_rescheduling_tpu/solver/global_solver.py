@@ -52,6 +52,7 @@ from jax import lax
 
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
 from kubernetes_rescheduling_tpu.ops.fused_admission import (
     fused_neighbor_mass,
     fused_score_admission,
@@ -438,7 +439,11 @@ def prepare_weights(
     return build_pair_weights(graph.adj, rv, SP=SP, dtype=config.matmul_dtype)
 
 
-@partial(jax.jit, static_argnames=("config",))
+# instrument_jit instead of bare jax.jit: the controller's global rounds
+# dispatch this kernel once per round, so the same 1-trace steady-state
+# invariant (and the compiled-cost/HBM capture at first compile) applies
+# to the batched solver as to the greedy decision kernel
+@partial(instrument_jit, name="global_assign", static_argnames=("config",))
 def global_assign(
     state: ClusterState,
     graph: CommGraph,

@@ -55,6 +55,7 @@ from kubernetes_rescheduling_tpu.core.sparsegraph import (
 )
 from kubernetes_rescheduling_tpu.core.state import ClusterState
 from kubernetes_rescheduling_tpu.objectives.metrics import load_std
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
 from kubernetes_rescheduling_tpu.ops.fused_admission import (
     admission_stage,
     fused_score_admission,
@@ -259,7 +260,10 @@ def hub_slab(sgraph: SparseCommGraph, blocks, rv_s, SPX: int):
     return u_g, rvu_g
 
 
-@partial(jax.jit, static_argnames=("config",))
+# instrumented like the dense twin: per-round dispatches must show one
+# trace, and the compiled sparse program's cost/HBM snapshot is captured
+# at first compile under fn="global_assign_sparse"
+@partial(instrument_jit, name="global_assign_sparse", static_argnames=("config",))
 def _global_assign_sparse(
     state: ClusterState,
     sgraph: SparseCommGraph,

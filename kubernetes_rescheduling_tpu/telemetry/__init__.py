@@ -28,8 +28,16 @@ that lives now:
 - :mod:`flight_recorder` — bounded ring of recent rounds, dumped as a
   self-contained diagnostics bundle on breaker-open / crash / SIGUSR1.
 - :mod:`watchdog` — rolling-window SLO rules (latency p95, comm-cost
-  regression, retraces) feeding ``/healthz`` and
-  ``slo_violations_total{rule}``.
+  regression, retraces, perf-ledger regressions) feeding ``/healthz``
+  and ``slo_violations_total{rule}``.
+- :mod:`costmodel` — compiled-cost introspection: XLA
+  ``cost_analysis``/``memory_analysis`` captured at each instrumented
+  kernel's first compile (``jax_cost_*``/``jax_hbm_*`` gauges), live
+  ``device.memory_stats()`` sampling, and per-round roofline numbers.
+- :mod:`perf_ledger` — append-only JSONL perf history keyed by
+  (metric, scenario, device kind, config digest) with a rolling-window
+  regression detector (the ``telemetry perf`` trend table and the
+  watchdog's ``perf_regression`` rule).
 
 Everything routes through one default :class:`MetricsRegistry`
 (:func:`get_registry`) unless a caller injects its own; the registry is
@@ -50,6 +58,7 @@ from kubernetes_rescheduling_tpu.telemetry.spans import (
     get_tracer,
     set_tracer,
     span,
+    trace_to,
 )
 from kubernetes_rescheduling_tpu.telemetry.accounting import (
     count_reconcile,
@@ -62,9 +71,15 @@ from kubernetes_rescheduling_tpu.telemetry.manifest import (
     run_manifest,
     write_manifest,
 )
+from kubernetes_rescheduling_tpu.telemetry.costmodel import (
+    CostBook,
+    get_costbook,
+    sample_device_memory,
+)
 from kubernetes_rescheduling_tpu.telemetry.explain import (
     explanation_consistent,
 )
+from kubernetes_rescheduling_tpu.telemetry.perf_ledger import PerfLedger
 from kubernetes_rescheduling_tpu.telemetry.flight_recorder import FlightRecorder
 from kubernetes_rescheduling_tpu.telemetry.server import (
     HealthState,
@@ -84,6 +99,7 @@ __all__ = [
     "get_tracer",
     "set_tracer",
     "span",
+    "trace_to",
     "count_reconcile",
     "instrument_jit",
     "pull",
@@ -91,6 +107,10 @@ __all__ = [
     "timed_call",
     "run_manifest",
     "write_manifest",
+    "CostBook",
+    "get_costbook",
+    "sample_device_memory",
+    "PerfLedger",
     "explanation_consistent",
     "FlightRecorder",
     "HealthState",

@@ -20,6 +20,13 @@ A steady-state loop therefore shows ``jax_calls_total = N`` and
 
 :func:`pull` counts device→host transfers (the tunnel round trips that
 dominate small-problem latency) as ``device_transfers_total{site=...}``.
+
+On the FIRST trace of each instrumented function the wrapper additionally
+captures the compiled executable's static cost — XLA ``cost_analysis()``
+flops/bytes and ``memory_analysis()`` argument/output/temp bytes — into
+the :mod:`costmodel` book and the ``jax_cost_*``/``jax_hbm_*`` gauges
+(one extra AOT compile per function per process, never re-paid on cache
+hits or later retraces; ``KRT_COST_CAPTURE=0`` disables it).
 """
 
 from __future__ import annotations
@@ -31,6 +38,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from kubernetes_rescheduling_tpu.telemetry import costmodel
 from kubernetes_rescheduling_tpu.telemetry.registry import (
     MetricsRegistry,
     get_registry,
@@ -102,6 +110,32 @@ def instrument_jit(
                 "wall time of calls that triggered a trace+compile",
                 labelnames=("fn",),
             ).labels(fn=fn_label).observe(dt)
+        if not state.get("cost_done"):
+            # compiled-cost capture: ONE AOT compile per fn LABEL per
+            # process — the book is the dedup, so distinct wrappers
+            # sharing a label (the sharded-restarts cache builds one per
+            # (mesh, config)) never re-pay the compile. Tracer args (this
+            # call ran inside an outer trace) defer the attempt to the
+            # next concrete call; a concrete attempt — success or failure
+            # — settles it for good, so a backend that cannot answer is
+            # asked exactly once.
+            if costmodel.get_costbook().get(fn_label) is not None:
+                state["cost_done"] = True
+                costmodel.republish(fn_label, reg)
+            elif state["traces"] > 0 and not costmodel.has_tracers(args, kwargs):
+                costmodel.capture_compiled_cost(
+                    fn, fn_label, args, kwargs,
+                    jit_kwargs=jit_kwargs, registry=reg,
+                )
+                state["cost_done"] = True
+        elif state["traces"] == before and state.get("pub_reg") is not reg:
+            # registries are swapped mid-process (tests, bench cells) while
+            # this kernel stays compiled — republish the captured gauges so
+            # the CURRENT registry's /metrics carries them. Memoized per
+            # registry object: steady-state hot loops must not re-set six
+            # gauges on every dispatch
+            if costmodel.republish(fn_label, reg):
+                state["pub_reg"] = reg
         return out
 
     wrapper.traces = lambda: state["traces"]

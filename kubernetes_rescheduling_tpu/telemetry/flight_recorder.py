@@ -103,7 +103,10 @@ class FlightRecorder:
     # ---- dumping ----
 
     def snapshot(self, reason: str, **fields: Any) -> dict[str, Any]:
-        """The bundle object — self-contained: ring + metrics + manifest."""
+        """The bundle object — self-contained: ring + metrics + manifest
+        + the compiled-cost book (what the kernels in these rounds cost,
+        even if the process dies before anyone scrapes /metrics)."""
+        from kubernetes_rescheduling_tpu.telemetry.costmodel import get_costbook
         from kubernetes_rescheduling_tpu.telemetry.manifest import run_manifest
 
         return {
@@ -113,6 +116,7 @@ class FlightRecorder:
             **fields,
             "rounds": self.rounds,
             "metrics": self._reg().snapshot(),
+            "device_costs": get_costbook().as_dict(),
             "manifest": run_manifest(),
         }
 

@@ -57,6 +57,29 @@ def _jax_info() -> dict[str, Any]:
         return {"imported": True, "version": jax.__version__, "error": str(e)}
 
 
+def _device_costs() -> dict[str, Any]:
+    """Compiled-cost snapshots captured so far (costmodel.CostBook) plus a
+    live device-memory sample — the device-side half of the provenance: a
+    latency number without the kernel's flops/HBM footprint next to it is
+    not reproducible evidence. jax-free (the book is plain dicts; the
+    memory sample reads ``sys.modules`` like :func:`_jax_info`)."""
+    from kubernetes_rescheduling_tpu.telemetry.costmodel import (
+        get_costbook,
+        sample_device_memory,
+    )
+    from kubernetes_rescheduling_tpu.telemetry.registry import MetricsRegistry
+
+    try:
+        return {
+            "kernels": get_costbook().as_dict(),
+            # scratch registry: writing a manifest must not mutate the
+            # process registry's gauges as a side effect
+            "device_memory": sample_device_memory(MetricsRegistry()),
+        }
+    except Exception:  # noqa: BLE001 — provenance must not fail the run
+        return {"kernels": {}, "device_memory": []}
+
+
 def run_manifest(config: dict[str, Any] | None = None) -> dict[str, Any]:
     import numpy as np
 
@@ -70,6 +93,7 @@ def run_manifest(config: dict[str, Any] | None = None) -> dict[str, Any]:
         "pid": os.getpid(),
         "numpy": np.__version__,
         "jax": _jax_info(),
+        "device_costs": _device_costs(),
         "git": _git_rev(cwd=str(Path(__file__).resolve().parent)),
     }
 

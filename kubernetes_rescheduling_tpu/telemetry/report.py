@@ -225,6 +225,64 @@ def report_explain(paths: list[str]) -> str:
     return "\n".join(out)
 
 
+def report_perf(
+    paths: list[str],
+    *,
+    window: int = 5,
+    threshold_frac: float = 0.2,
+    baseline: str = "median",
+) -> str:
+    """The ``telemetry perf`` report: load perf-ledger JSONL files and/or
+    historical driver snapshots (``BENCH_r*.json`` / ``MULTICHIP_r*.json``
+    — auto-detected and ingested), judge every series with the
+    rolling-window detector, and render the trend table with per-metric
+    verdicts."""
+    from kubernetes_rescheduling_tpu.telemetry import perf_ledger as pl
+
+    ledger_recs: list[dict[str, Any]] = []
+    history: list[dict[str, Any]] = []
+    loaded: list[str] = []
+    for p in paths:
+        path = Path(p)
+        if not path.is_file():
+            loaded.append(f"  {p}: not a file")
+            continue
+        ingested = pl.ingest_bench_file(path)
+        if ingested:
+            history.extend(ingested)
+            loaded.append(f"  {p}: {len(ingested)} snapshot record(s)")
+            continue
+        try:
+            records = _read_jsonl(path)
+        except json.JSONDecodeError:
+            loaded.append(f"  {p}: not JSONL")
+            continue
+        recs = [
+            r
+            for r in records
+            if isinstance(r, dict) and "metric" in r and "seq" in r
+        ]
+        if recs:
+            ledger_recs.extend(recs)
+            loaded.append(f"  {p}: {len(recs)} ledger record(s)")
+        else:
+            loaded.append(f"  {p}: no perf records")
+    # ingested snapshots are HISTORY by definition: rank them (in CLI arg
+    # order) strictly before every ledger record via negative seqs, so a
+    # ledger that shares a series with the snapshots (BENCH_LEDGER) is
+    # judged today-against-history, never history-against-today
+    for i, rec in enumerate(history):
+        rec["seq"] = i - len(history)
+    entries = history + ledger_recs
+    out = ["== perf ledger =="] + loaded
+    verdicts = pl.detect(
+        entries, window=window, threshold_frac=threshold_frac,
+        baseline=baseline,
+    )
+    out.extend(pl.render_table(verdicts))
+    return "\n".join(out)
+
+
 def report_bundle(paths: list[str]) -> str:
     """The ``telemetry bundle`` report: summarize flight-recorder bundles."""
     out = []

@@ -65,6 +65,10 @@ class HealthState:
         self.rounds = 0
         self.skipped_rounds = 0
         self.degraded_rounds = 0
+        # latest perf-ledger verdict summary (OpsPlane.observe_perf) —
+        # unhealthiness itself flows through the watchdog's
+        # perf_regression rule; this is the human-readable "what & why"
+        self.perf: dict | None = None
 
     def snapshot(self) -> tuple[dict[str, Any], bool]:
         breaker_state = getattr(self.breaker, "state", None)
@@ -96,6 +100,7 @@ class HealthState:
                 "stale": stale,
                 "uptime_s": time.time() - self.started_ts,
                 "slo": slo,
+                "perf": self.perf,
             },
             healthy,
         )
@@ -371,6 +376,23 @@ class OpsPlane:
                 events=list(events),
                 spans=spans,
             )
+
+    def observe_perf(self, verdicts: dict) -> None:
+        """Feed a perf-ledger verdict set (``perf_ledger.detect``): arms/
+        clears the watchdog's ``perf_regression`` rule and records the
+        latest verdict summary on ``/healthz`` (the bench harness calls
+        this after each cell's ledger append)."""
+        statuses = sorted(
+            (k, v.get("status")) for k, v in (verdicts or {}).items()
+        )
+        regressed = [k for k, s in statuses if s == "regressed"]
+        self.health.perf = {
+            "verdict": "regressed" if regressed else "ok",
+            "regressed": regressed,
+            "series": dict(statuses),
+        }
+        if self.watchdog is not None:
+            self.watchdog.observe_perf(verdicts)
 
     def observe_skip(self, rnd: int, breaker_state: str | None = None) -> None:
         self.health.skipped_rounds += 1

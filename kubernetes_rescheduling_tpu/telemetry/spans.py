@@ -6,9 +6,10 @@ nesting Perfetto reconstructs from timestamps per thread). The resulting
 file loads directly in https://ui.perfetto.dev or ``chrome://tracing``.
 
 ``span(..., profile_dir=...)`` folds the ``jax.profiler`` integration
-(``utils.profiling.trace_to``) under the same API: the host span is
-recorded AND the region runs under a device trace for TensorBoard — one
-call site instead of two nested context managers.
+(:func:`trace_to`, which lives HERE now — ``utils.profiling`` re-exports
+it as a deprecation shim) under the same API: the host span is recorded
+AND the region runs under a device trace for TensorBoard — one call
+site instead of two nested context managers.
 
 Span durations also feed the metrics registry (histogram
 ``span_seconds{span=...}``), so the exposition dump carries per-region
@@ -32,6 +33,23 @@ from kubernetes_rescheduling_tpu.telemetry.registry import (
     MetricsRegistry,
     get_registry,
 )
+
+
+@contextlib.contextmanager
+def trace_to(log_dir: str | None):
+    """``jax.profiler.trace`` when a directory is given, no-op otherwise.
+
+    Canonical home of the device-profiler integration (it was
+    ``utils.profiling.trace_to``; that module keeps a deprecation
+    re-export pinned to this object). ``span(..., profile_dir=...)``
+    routes through it."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
 
 
 @dataclass(frozen=True)
@@ -93,8 +111,6 @@ class Tracer:
         t0 = time.perf_counter()
         try:
             if profile_dir is not None:
-                from kubernetes_rescheduling_tpu.utils.profiling import trace_to
-
                 with trace_to(profile_dir):
                     yield
             else:

@@ -16,6 +16,16 @@ over a bounded window of recent rounds:
   binds, so a bench session's later cells compiling fresh shapes are not
   misread as retraces.
 
+A fourth rule is fed EXTERNALLY rather than per round: **perf
+regression** (:meth:`Watchdog.observe_perf`) takes the perf ledger's
+rolling-window verdicts (``telemetry.perf_ledger.detect``) — the bench
+harness calls it after each cell. A newly regressed metric increments
+``perf_regressions_total{metric}`` (plus the generic
+``slo_violations_total{rule="perf_regression"}`` on rule entry), and the
+rule stays active until a later verdict set clears the metric. Unlike the
+per-round windows, perf state survives :meth:`rebase` — it describes the
+ledger's cross-run history, not the current run's window.
+
 Entering violation increments ``slo_violations_total{rule}`` and logs an
 ``slo_violation`` event; leaving logs ``slo_recovered``. The set of
 currently-active violations (:attr:`Watchdog.active`) is what flips
@@ -39,6 +49,7 @@ from kubernetes_rescheduling_tpu.telemetry.registry import (
 RULE_LATENCY = "round_latency_p95"
 RULE_COST = "comm_cost_regression"
 RULE_RETRACE = "retrace"
+RULE_PERF = "perf_regression"
 
 
 @dataclass(frozen=True)
@@ -90,6 +101,7 @@ class Watchdog:
             maxlen=self.rules.window
         )
         self._trace_base: dict[str, float] = {}
+        self._perf_active: dict[str, dict[str, Any]] = {}
         self.active: dict[str, dict[str, Any]] = {}
         self.violations_seen = 0
 
@@ -98,11 +110,18 @@ class Watchdog:
         cost windows, retrace baselines, and active violations. Called
         when a new run binds to the ops plane — cross-run comparisons
         (a different algorithm's cost scale, a new shape's first
-        compile) are not SLO signals."""
+        compile) are not SLO signals. Perf-ledger regressions are NOT
+        cleared: they judge cross-run history by design, and a new cell
+        binding must not mask yesterday's cliff — only a recovered
+        verdict set (:meth:`observe_perf`) clears them."""
         self._lat.clear()
         self._cost.clear()
         self._trace_base.clear()
-        self.active = {}
+        self.active = (
+            {RULE_PERF: self.active[RULE_PERF]}
+            if RULE_PERF in self.active
+            else {}
+        )
 
     def _reg(self) -> MetricsRegistry:
         return self.registry if self.registry is not None else get_registry()
@@ -112,6 +131,27 @@ class Watchdog:
         the NEWLY raised violations (already counted and logged)."""
         self._lat.append(float(record.decision_latency_s))
         self._cost.append(float(record.communication_cost))
+        return self.check()
+
+    def observe_perf(self, verdicts: dict[str, dict[str, Any]]) -> list[dict[str, Any]]:
+        """Feed one perf-ledger verdict set (``perf_ledger.detect``).
+        Metrics whose status is ``regressed`` arm the ``perf_regression``
+        rule; each NEWLY regressed metric counts once in
+        ``perf_regressions_total{metric}``. A verdict set with no
+        regressions clears the rule (the recovery path). Returns the
+        newly raised violations, like :meth:`observe_round`."""
+        regressed = {
+            k: v for k, v in (verdicts or {}).items()
+            if v.get("status") == "regressed"
+        }
+        for key in regressed:
+            if key not in self._perf_active:
+                self._reg().counter(
+                    "perf_regressions_total",
+                    "perf-ledger metrics newly judged regressed",
+                    labelnames=("metric",),
+                ).labels(metric=key).inc()
+        self._perf_active = regressed
         return self.check()
 
     def check(self) -> list[dict[str, Any]]:
@@ -152,6 +192,18 @@ class Watchdog:
                 now[RULE_RETRACE] = {
                     "fns": retraced, "max_retraces": r.max_retraces,
                 }
+        if self._perf_active:
+            now[RULE_PERF] = {
+                "metrics": {
+                    k: {
+                        "current": v.get("current"),
+                        "baseline": v.get("baseline"),
+                        "ratio": v.get("ratio"),
+                    }
+                    for k, v in sorted(self._perf_active.items())
+                },
+                "count": len(self._perf_active),
+            }
 
         raised = []
         for rule, detail in now.items():

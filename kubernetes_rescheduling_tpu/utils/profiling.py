@@ -1,19 +1,24 @@
-"""Profiling: wall-clock timers, decision-latency histograms, device traces.
+"""Profiling: wall-clock timers and decision-latency histograms.
 
 The north-star metric is rescheduling decisions/sec (BASELINE.md); the
 reference measures only whole-run wall time (main.py:126-135). Here every
-decision gets a latency sample and the distribution is inspectable; for
-device-level analysis ``trace_to`` wraps ``jax.profiler.trace`` so a block
-can be profiled under TensorBoard.
+decision gets a latency sample and the distribution is inspectable. The
+device-profiler integration (``trace_to``) lives in
+``telemetry.spans`` now; the re-export below is a deprecation shim.
 """
 
 from __future__ import annotations
 
-import contextlib
 import time
 from dataclasses import dataclass
 
 from kubernetes_rescheduling_tpu.telemetry.registry import Histogram
+
+# Deprecated re-export: trace_to moved to telemetry.spans (the module
+# that already owned the rest of the profiler integration). Import it
+# from there; this name stays ONLY so existing call sites keep working,
+# and it is pinned to be the SAME object (tests enforce identity).
+from kubernetes_rescheduling_tpu.telemetry.spans import trace_to  # noqa: F401
 
 
 @dataclass
@@ -44,15 +49,3 @@ class LatencyHistogram(Histogram):
 
     def add(self, seconds: float) -> None:
         self.observe(seconds)
-
-
-@contextlib.contextmanager
-def trace_to(log_dir: str | None):
-    """``jax.profiler.trace`` when a directory is given, no-op otherwise."""
-    if log_dir is None:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(log_dir):
-        yield

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Static check: perf-ledger JSONL files keep their schema invariants.
+
+Every record must be a JSON object carrying the required keys
+(``telemetry.perf_ledger.REQUIRED_KEYS``: schema, seq, metric, value,
+unit, scenario, device_kind, config_digest, better), its value must be a
+finite number (NaN/inf would silently poison every median downstream),
+``better`` must be a known direction, and ``seq`` must be STRICTLY
+MONOTONE within the file — an interleaved or rewritten ledger is
+corrupt, not merely stale, and the detector's "newest reading" pick
+would judge the wrong sample.
+
+Usage:
+    python scripts/check_perf_ledger.py LEDGER.jsonl [...]
+
+With no arguments it self-checks: a synthetic ledger written through
+``PerfLedger`` plus one built by ingesting the repo's checked-in
+``BENCH_r*.json`` / ``MULTICHIP_r*.json`` history must both validate —
+so the writer, the ingester, and this checker cannot drift apart. Run
+directly (exit 1 on violation) or through the test twin
+(tests/test_perf_ledger_check.py).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from kubernetes_rescheduling_tpu.telemetry.perf_ledger import (  # noqa: E402
+    PerfLedger,
+    ingest_history,
+    validate_entry,
+)
+
+
+def check_ledger_file(path: str | Path) -> list[str]:
+    """Schema violations in one ledger file (empty = clean)."""
+    p = Path(path)
+    if not p.is_file():
+        return [f"{p}: not a file"]
+    out: list[str] = []
+    last_seq: int | None = None
+    for i, line in enumerate(p.read_text().splitlines(), start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            out.append(f"{p}:{i}: not JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            out.append(f"{p}:{i}: record is not an object")
+            continue
+        for bad in validate_entry(rec):
+            out.append(f"{p}:{i}: {bad}")
+        seq = rec.get("seq")
+        if isinstance(seq, int):
+            if last_seq is not None and seq <= last_seq:
+                out.append(
+                    f"{p}:{i}: seq {seq} not monotone (follows {last_seq})"
+                )
+            last_seq = seq
+    if last_seq is None:
+        out.append(f"{p}: no ledger records")
+    return out
+
+
+def self_check() -> list[str]:
+    """No-args mode: the writer and the history ingester must both
+    produce ledgers this checker accepts."""
+    out: list[str] = []
+    with tempfile.TemporaryDirectory() as td:
+        synth = Path(td) / "synthetic.jsonl"
+        led = PerfLedger(synth)
+        for i, v in enumerate((10.0, 9.5, 9.8, 12.0)):
+            led.append(
+                metric="decisions_per_sec", value=v, unit="1/s",
+                scenario="selfcheck", device_kind="cpu",
+                digest="selfcheck", better="higher", run=i,
+            )
+        out.extend(check_ledger_file(synth))
+
+        history = sorted(ROOT.glob("BENCH_r*.json")) + sorted(
+            ROOT.glob("MULTICHIP_r*.json")
+        )
+        if history:
+            ingested = Path(td) / "history.jsonl"
+            ingest_history(history, PerfLedger(ingested))
+            out.extend(check_ledger_file(ingested))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    bad = (
+        [v for p in argv for v in check_ledger_file(p)]
+        if argv
+        else self_check()
+    )
+    if bad:
+        sys.stderr.write(
+            "perf-ledger schema violations:\n"
+            + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

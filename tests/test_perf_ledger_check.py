@@ -1,0 +1,89 @@
+"""CI twin of ``scripts/check_perf_ledger.py``: ledger JSONL files keep
+their schema (required keys, finite values, strictly monotone seq) —
+validated against a synthetic ledger written through ``PerfLedger`` AND
+one built from the checked-in ``BENCH_r*.json`` history, plus pinned
+rejection of each corruption class."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubernetes_rescheduling_tpu.telemetry.perf_ledger import (
+    PerfLedger,
+    ingest_history,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def checker():
+    path = REPO / "scripts" / "check_perf_ledger.py"
+    spec = importlib.util.spec_from_file_location("check_perf_ledger", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_perf_ledger", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _synthetic(path):
+    led = PerfLedger(path)
+    for i, v in enumerate((10.0, 9.0, 11.0)):
+        led.append(
+            metric="decisions_per_sec", value=v, unit="1/s",
+            scenario="t", device_kind="cpu", digest="d", better="higher",
+            run=i,
+        )
+    return path
+
+
+def test_synthetic_ledger_validates(checker, tmp_path):
+    path = _synthetic(tmp_path / "ok.jsonl")
+    assert checker.check_ledger_file(path) == []
+
+
+def test_ledger_from_checked_in_bench_history_validates(checker, tmp_path):
+    history = sorted(REPO.glob("BENCH_r0*.json")) + sorted(
+        REPO.glob("MULTICHIP_r0*.json")
+    )
+    assert history, "checked-in bench snapshots are part of this pin"
+    path = tmp_path / "hist.jsonl"
+    ingest_history(history, PerfLedger(path))
+    assert checker.check_ledger_file(path) == []
+
+
+@pytest.mark.parametrize(
+    "mutate, expect",
+    [
+        (lambda r: r.pop("metric"), "missing key 'metric'"),
+        (lambda r: r.update(value=float("nan")), "non-finite"),
+        (lambda r: r.update(value="fast"), "must be a number"),
+        (lambda r: r.update(seq=0), "not monotone"),
+        (lambda r: r.update(better="sideways"), "better must be"),
+    ],
+)
+def test_corruptions_are_rejected(checker, tmp_path, mutate, expect):
+    path = _synthetic(tmp_path / "bad.jsonl")
+    recs = [json.loads(ln) for ln in path.read_text().splitlines()]
+    mutate(recs[-1])
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    bad = checker.check_ledger_file(path)
+    assert any(expect in v for v in bad), bad
+
+
+def test_non_json_and_missing_files_flagged(checker, tmp_path):
+    p = tmp_path / "junk.jsonl"
+    p.write_text("{broken\n")
+    assert any("not JSON" in v for v in checker.check_ledger_file(p))
+    assert checker.check_ledger_file(tmp_path / "nope.jsonl")
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("\n")
+    assert any("no ledger records" in v for v in checker.check_ledger_file(empty))
+
+
+def test_script_self_check_passes(checker):
+    assert checker.self_check() == []
+    assert checker.main([]) == 0

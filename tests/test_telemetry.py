@@ -210,13 +210,41 @@ def test_instrument_jit_counts_exactly_one_steady_state_trace(registry):
         jax.block_until_ready(g(jnp.arange(7.0) + i))
     fam = registry.counter("jax_traces_total", labelnames=("fn",))
     assert fam.labels(fn="steady").value == 1
-    assert calls["n"] == 1
+    # the body runs once for the real trace and once more for the
+    # first-compile cost capture (the AOT lower of the raw fn) — the
+    # capture run is NOT a counted trace, and never repeats: cache hits
+    # re-dispatch the compiled kernel without touching Python
+    assert calls["n"] == 2
     assert (
         registry.counter("jax_calls_total", labelnames=("fn",))
         .labels(fn="steady")
         .value
         == 4
     )
+    captures = registry.counter("jax_cost_captures_total", labelnames=("fn",))
+    assert captures.labels(fn="steady").value == 1
+
+
+def test_instrument_jit_capture_disabled_keeps_single_body_run(
+    registry, monkeypatch
+):
+    """KRT_COST_CAPTURE=0 restores the historical contract exactly: one
+    Python-body run, no extra AOT compile, no cost gauges."""
+    monkeypatch.setenv("KRT_COST_CAPTURE", "0")
+    calls = {"n": 0}
+
+    def f(x):
+        calls["n"] += 1
+        return x + 1.0
+
+    g = instrument_jit(f, name="steady_nocap")
+    for i in range(3):
+        jax.block_until_ready(g(jnp.arange(5.0) + i))
+    assert calls["n"] == 1
+    from kubernetes_rescheduling_tpu.telemetry.costmodel import get_costbook
+
+    assert get_costbook().get("steady_nocap") is None
+    assert 'jax_cost_flops{fn="steady_nocap"}' not in registry.expose()
 
 
 def test_instrument_jit_catches_shape_polymorphism(registry):

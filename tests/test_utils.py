@@ -47,6 +47,17 @@ def test_trace_to_noop():
         pass  # must not require jax.profiler
 
 
+def test_trace_to_is_the_spans_object():
+    """The deprecation shim resolves to the ONE implementation in
+    telemetry.spans — both import paths are the same object, so a fix
+    lands in both and the duplicate can never drift back."""
+    from kubernetes_rescheduling_tpu.telemetry import spans
+    from kubernetes_rescheduling_tpu.utils import profiling
+
+    assert profiling.trace_to is spans.trace_to
+    assert trace_to is spans.trace_to  # the utils package re-export too
+
+
 def test_state_roundtrip(tmp_path):
     scn = mubench_scenario()
     save_state(scn.state, tmp_path / "ckpt", extra={"round": 3})

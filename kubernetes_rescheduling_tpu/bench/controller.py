@@ -30,7 +30,11 @@ from kubernetes_rescheduling_tpu.bench.boundary import (
     CircuitBreaker,
 )
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
-from kubernetes_rescheduling_tpu.objectives.metrics import communication_cost, load_std
+from kubernetes_rescheduling_tpu.objectives.metrics import (
+    communication_cost,
+    communication_cost_attribution,
+    load_std,
+)
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS
 from kubernetes_rescheduling_tpu.telemetry import (
     get_registry,
@@ -38,6 +42,7 @@ from kubernetes_rescheduling_tpu.telemetry import (
     pull,
     span,
 )
+from kubernetes_rescheduling_tpu.telemetry import attribution as attribution_mod
 from kubernetes_rescheduling_tpu.telemetry import costmodel
 from kubernetes_rescheduling_tpu.telemetry.explain import (
     greedy_explanation,
@@ -79,6 +84,13 @@ class RoundRecord:
     # decision explainability: one DecisionExplanation dict per decide/
     # solve this round (telemetry.explain) — empty when explain is off
     explanations: tuple[dict, ...] = ()
+    # every move that LANDED this round as (service, landed_node) pairs —
+    # the provenance tracker's input (services_moved keeps only names)
+    applied_moves: tuple[tuple[str, str], ...] = ()
+    # cost attribution (telemetry.attribution): per-edge/per-node-pair
+    # decomposition of communication_cost plus move provenance — None
+    # when attribution is off
+    attribution: dict | None = None
 
     @property
     def decision_latency_s(self) -> float:
@@ -146,6 +158,16 @@ _decide = instrument_jit(decide, name="controller_decide")
 # invariant: 1 trace per (shape, top_k) signature.
 _decide_explain = instrument_jit(
     decide_explain, name="controller_decide_explain",
+    static_argnames=("top_k",),
+)
+
+# the cost-decomposition kernel (objectives.metrics): per-node-pair
+# matrix collapse + top-k edge attribution, produced alongside the scalar
+# objective and pulled as ONE bundled transfer (site="attribution").
+# Same steady-state invariant as the decision kernels: 1 trace per
+# (shape, top_k) signature — jax_traces_total{fn="controller_attribution"}.
+_attribution = instrument_jit(
+    communication_cost_attribution, name="controller_attribution",
     static_argnames=("top_k",),
 )
 
@@ -286,6 +308,15 @@ def run_controller(
         if config.obs.explain and (ops is not None or logger is not None)
         else 0
     )
+    # cost attribution rides the same gate: on when configured AND someone
+    # is listening — the bare loop pays no extra kernel and no extra
+    # transfer (the per-round transfer budget stays the historical one)
+    attr_k = (
+        config.obs.attribution_top_k
+        if config.obs.attribution and (ops is not None or logger is not None)
+        else 0
+    )
+    timeline = attribution_mod.PlacementTimeline() if attr_k > 0 else None
     # decisions may run on an estimated graph; TELEMETRY always reports on
     # the backend's declared graph so round costs stay comparable across
     # configurations (and with the harness's before/after metrics)
@@ -374,6 +405,10 @@ def run_controller(
             "backend unavailable: initial monitor() failed after retries "
             "(no last good snapshot to degrade to)"
         )
+    if timeline is not None:
+        # provenance model: the initial residency collapse (host-side,
+        # once per run) the per-move cost deltas telescope from
+        timeline.bind(state, metric_graph)
     try:
         for rnd in range(start_round, config.max_rounds + 1):
             mode = boundary.begin_round(rnd)
@@ -416,6 +451,39 @@ def run_controller(
             record.boundary_failures = boundary.round_failures
             record.communication_cost = float(communication_cost(state, metric_graph))
             record.load_std = float(load_std(state))
+            if attr_k > 0:
+                # the decomposition of the scalar just recorded: one
+                # bundled device transfer, same state + metric graph, so
+                # per-edge contributions sum back to it (f32 tolerance —
+                # the attribution_consistent invariant)
+                bundle = pull(
+                    _attribution(state, metric_graph, top_k=attr_k),
+                    site=attribution_mod.ATTRIBUTION_SITE,
+                )
+                attr = attribution_mod.decode_attribution(
+                    bundle,
+                    node_names=state.node_names,
+                    service_names=metric_graph.names,
+                    top_k=attr_k,
+                    num_nodes=state.num_nodes,
+                    num_services=metric_graph.num_services,
+                )
+                attr["round"] = rnd
+                attr["algorithm"] = config.algorithm
+                attr.update(
+                    timeline.observe_round(
+                        rnd,
+                        record.applied_moves,
+                        pod_level=config.placement_unit == "pod",
+                    )
+                )
+                record.attribution = attr
+                attribution_mod.publish_attribution(
+                    registry, attr, top_k=attr_k
+                )
+                attribution_mod.get_attribution_book().update(
+                    config.algorithm, rnd, attr
+                )
             result.rounds.append(record)
             _emit_round_metrics(registry, config.algorithm, record)
             # device-side observability: live memory_stats gauges plus the
@@ -492,6 +560,7 @@ def _greedy_round(
     k_moves = config.moves_per_round
     first_hazard: str | None = None
     moved_names: list[str] = []
+    applied_moves: list[tuple[str, str]] = []
     first_target: str | None = None
     latencies: list[float] = []
     explanations: list[dict] = []
@@ -576,6 +645,7 @@ def _greedy_round(
         if landed is None:
             break
         moved_names.append(service_name)
+        applied_moves.append((service_name, landed))
         if first_target is None:
             first_target = landed
         if i + 1 < k_moves:
@@ -603,6 +673,7 @@ def _greedy_round(
         services_moved=tuple(moved_names),
         decision_latencies_s=tuple(latencies),
         explanations=tuple(explanations),
+        applied_moves=tuple(applied_moves),
     )
 
 
@@ -850,13 +921,21 @@ def _pod_round(
     batch = getattr(boundary, "apply_pod_moves", None)
     moved_services: set[str] = set()
     landed_moves: list[MoveRequest] = []
+    applied_moves: list[tuple[str, str]] = []  # (service, LANDED node)
     if batch is not None:
         landed = set(batch(moves)) if moves else set()
         landed_moves = [mv for mv in moves if mv.pod in landed]
+        # the sim's batch wave places exactly at the requested node — the
+        # target IS the landed node on this path
+        applied_moves = [(mv.service, mv.target_node) for mv in landed_moves]
     else:
         for mv in moves:
-            if boundary.apply_move(mv) is not None:
+            landed_node = boundary.apply_move(mv)
+            if landed_node is not None:
                 landed_moves.append(mv)
+                # record where the move actually LANDED (a scheduler —
+                # or an injected fault — may override the target)
+                applied_moves.append((mv.service, landed_node))
     moved_services = {mv.service for mv in landed_moves}
     moved_any = bool(moved_services)
 
@@ -903,6 +982,10 @@ def _pod_round(
         objective_after=obj_after,
         solver_improved=improved,
         explanations=explanations,
+        # pod-level provenance: each landed REPLICA hop (a service may
+        # appear once per pod) — the timeline records residency without
+        # service-collapsed cost deltas for these
+        applied_moves=tuple(applied_moves),
     )
 
 
@@ -988,6 +1071,7 @@ def _global_round(
 
     moved_any = False
     moved_names: list[str] = []
+    applied_moves: list[tuple[str, str]] = []
     for s, target in changed:
         landed = boundary.apply_move(
             MoveRequest(
@@ -999,6 +1083,7 @@ def _global_round(
         moved_any = moved_any or landed is not None
         if landed is not None:
             moved_names.append(graph.names[s])
+            applied_moves.append((graph.names[s], landed))
 
     explanations: tuple[dict, ...] = ()
     if explain:
@@ -1039,4 +1124,5 @@ def _global_round(
         objective_after=obj_after,
         solver_improved=improved,
         explanations=explanations,
+        applied_moves=tuple(applied_moves),
     )

@@ -15,7 +15,8 @@ Subcommands:
               event logs, manifests, Chrome traces, flight-recorder
               bundles) as a report; ``telemetry explain`` renders
               decision explanations, ``telemetry bundle`` summarizes a
-              flight-recorder bundle
+              flight-recorder bundle, ``telemetry topo`` renders cost
+              attribution / node-pair topology / move provenance
 
 ``reschedule``/``bench``/``trace`` take ``--metrics-out``/``--trace-out``:
 see OBSERVABILITY.md for the artifact set each flag produces.
@@ -285,16 +286,23 @@ def build_parser() -> argparse.ArgumentParser:
              "bundles) as a readable report; 'telemetry explain <files>' "
              "renders decision explanations, 'telemetry bundle <file>' "
              "summarizes a flight-recorder bundle (incl. the "
-             "explain-consistency verdict)",
+             "explain-consistency verdict), 'telemetry topo <files>' "
+             "renders cost attribution, the node-pair heatmap, and move "
+             "provenance",
     )
     m.add_argument("paths", nargs="+",
                    help="artifact files (kind detected from record shape); "
                         "an optional leading mode word — 'report' "
-                        "(default), 'explain', 'bundle', or 'perf' — "
-                        "selects the rendering; 'perf' takes perf-ledger "
-                        "JSONL files and/or historical BENCH_r*.json / "
-                        "MULTICHIP_r*.json snapshots and renders the trend "
-                        "table with improved/flat/regressed verdicts")
+                        "(default), 'explain', 'bundle', 'perf', or "
+                        "'topo' — selects the rendering; 'perf' takes "
+                        "perf-ledger JSONL files and/or historical "
+                        "BENCH_r*.json / MULTICHIP_r*.json snapshots and "
+                        "renders the trend table with "
+                        "improved/flat/regressed verdicts; 'topo' takes "
+                        "rounds.jsonl files or flight-recorder bundles and "
+                        "renders the cost-attribution table, node-pair "
+                        "heatmap, and move-provenance trail with the "
+                        "sum-consistency verdict")
     m.add_argument("--perf-window", type=int, default=5,
                    help="perf mode: prior readings each series is judged "
                         "against")
@@ -343,10 +351,11 @@ def cmd_telemetry(args) -> str:
         report_bundle,
         report_explain,
         report_perf,
+        report_topo,
     )
 
     mode, paths = "report", list(args.paths)
-    if paths and paths[0] in ("report", "explain", "bundle", "perf"):
+    if paths and paths[0] in ("report", "explain", "bundle", "perf", "topo"):
         mode, paths = paths[0], paths[1:]
     if not paths:
         raise SystemExit(f"telemetry {mode}: no artifact paths given")
@@ -354,6 +363,8 @@ def cmd_telemetry(args) -> str:
         return report_explain(paths)
     if mode == "bundle":
         return report_bundle(paths)
+    if mode == "topo":
+        return report_topo(paths)
     if mode == "perf":
         return report_perf(
             paths,

@@ -56,6 +56,13 @@ class ObsConfig:
     explain: bool = True                 # record DecisionExplanations when a
                                          # logger or ops plane is attached
     explain_top_k: int = 3               # candidates/hazard nodes per decision
+    attribution: bool = True             # per-round cost attribution (edge/
+                                         # node-pair decomposition + move
+                                         # provenance) when a logger or ops
+                                         # plane is attached
+    attribution_top_k: int = 8           # service edges / node pairs recorded
+    attribution_drift_frac: float = 0.0  # attribution_drift SLO rule: top-1
+                                         # edge share of total cost (0 = off)
     flight_recorder_rounds: int = 16     # ring capacity (rounds)
     bundle_dir: str = "flight_recorder"  # where trigger dumps land
     max_round_age_s: float = 0.0         # /healthz staleness rule (0 = off)
@@ -70,6 +77,10 @@ class ObsConfig:
             raise ValueError(f"serve_port must be in [0, 65535], got {self.serve_port}")
         if self.explain_top_k < 1:
             raise ValueError("explain_top_k must be >= 1")
+        if self.attribution_top_k < 1:
+            raise ValueError("attribution_top_k must be >= 1")
+        if not (0.0 <= self.attribution_drift_frac <= 1.0):
+            raise ValueError("attribution_drift_frac must be in [0, 1]")
         if self.flight_recorder_rounds < 1:
             raise ValueError("flight_recorder_rounds must be >= 1")
         if self.max_round_age_s < 0:

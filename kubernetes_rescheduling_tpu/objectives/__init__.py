@@ -7,18 +7,22 @@ terms inside the batched solver.
 
 from kubernetes_rescheduling_tpu.objectives.metrics import (
     communication_cost,
+    communication_cost_attribution,
     communication_cost_deployment,
     load_std,
     node_cpu_pct_rounded,
+    node_pair_cost_matrix,
     capacity_violation,
     objective_summary,
 )
 
 __all__ = [
     "communication_cost",
+    "communication_cost_attribution",
     "communication_cost_deployment",
     "load_std",
     "node_cpu_pct_rounded",
+    "node_pair_cost_matrix",
     "capacity_violation",
     "objective_summary",
 ]

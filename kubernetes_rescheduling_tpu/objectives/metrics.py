@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
 
@@ -101,6 +102,98 @@ def capacity_violation(state: ClusterState) -> jax.Array:
     """
     over = jnp.maximum(state.node_cpu_used() - state.node_cpu_cap, 0.0)
     return jnp.sum(jnp.where(state.node_valid, over, 0.0))
+
+
+def _masked_adj(graph: CommGraph) -> jax.Array:
+    return graph.adj * graph.service_valid[:, None] * graph.service_valid[None, :]
+
+
+def node_pair_cost_matrix(state: ClusterState, graph: CommGraph) -> jax.Array:
+    """f32[N, N] — the communication cost decomposed over node pairs.
+
+    ``M[a, b]`` is the pair-weighted traffic between nodes ``a`` and ``b``
+    (ordered; symmetric because ``adj`` is): Σ_{i,j} adj[i,j]·occ[i,a]·occ[j,b]
+    with the diagonal zeroed (same-node pairs carry no cost). By
+    construction ``0.5·ΣM == communication_cost`` — the matrix is an exact
+    decomposition of the scalar objective, not a second estimate.
+    """
+    occ = state.service_node_counts(graph.num_services)  # f32[S, N]
+    adj = _masked_adj(graph)
+    m = occ.T @ adj @ occ                                # f32[N, N]
+    n = state.num_nodes
+    return m * (1.0 - jnp.eye(n, dtype=m.dtype))
+
+
+def communication_cost_attribution(
+    state: ClusterState, graph: CommGraph, *, top_k: int = 8
+) -> jax.Array:
+    """The on-device cost-decomposition kernel: everything the host needs
+    to attribute ``communication_cost`` to service edges and node pairs,
+    as ONE flat f32 bundle (pulled in a single transfer,
+    ``site="attribution"`` — same discipline as ``decide_explain``).
+
+    Layout (k = min(top_k, S·S), N = num_nodes)::
+
+        [0]                total      — 0.5·ΣM == communication_cost
+        [1]                tail       — total − Σ(top-k edge costs)
+        [2 : 2+5k]         edge rows  — k×(src_service, dst_service,
+                                       src_node, dst_node, cost); index
+                                       slots are −1 on empty/padding rows
+        [2+5k : 2+5k+N·N]  M          — the node-pair matrix, row-major
+
+    Edges are unordered service pairs ranked by their cost contribution
+    ``adj[i,j]·cross_pairs(i,j)`` (each pair counted ONCE, so the edge
+    costs plus the tail sum to the scalar — the consistency invariant
+    ``telemetry.attribution`` enforces). ``src_node``/``dst_node`` are the
+    dominant cross-node placement of the pair: the (a≠b) node pair
+    holding the most communicating replica pairs.
+    """
+    num_s = graph.num_services
+    n = state.num_nodes
+    occ = state.service_node_counts(num_s)               # f32[S, N]
+    adj = _masked_adj(graph)
+    tot = occ.sum(axis=1)                                # f32[S]
+    same = occ @ occ.T
+    cross = tot[:, None] * tot[None, :] - same
+    contrib = adj * cross                                # f32[S, S], symmetric
+    # ONE source of truth for the node-pair collapse (XLA CSEs the shared
+    # occ/adj subexpressions — calling it costs nothing inside this jit)
+    m = node_pair_cost_matrix(state, graph)
+    total = 0.5 * jnp.sum(m)
+
+    k = max(1, min(int(top_k), num_s * num_s))
+    upper = jnp.triu(jnp.ones((num_s, num_s), dtype=bool), k=1)
+    vals = jnp.where(upper, contrib, -jnp.inf)
+    top_v, top_i = lax.top_k(vals.reshape(-1), k)
+    src = top_i // num_s
+    dst = top_i % num_s
+    ok = jnp.isfinite(top_v) & (top_v > 0)
+
+    def dominant_pair(i, j):
+        pair = occ[i][:, None] * occ[j][None, :]
+        pair = pair * (1.0 - jnp.eye(n, dtype=pair.dtype))
+        flat = jnp.argmax(pair.reshape(-1))
+        has = jnp.max(pair) > 0
+        return (
+            jnp.where(has, flat // n, -1),
+            jnp.where(has, flat % n, -1),
+        )
+
+    a, b = jax.vmap(dominant_pair)(src, dst)
+    rows = jnp.stack(
+        [
+            jnp.where(ok, src, -1).astype(jnp.float32),
+            jnp.where(ok, dst, -1).astype(jnp.float32),
+            jnp.where(ok, a, -1).astype(jnp.float32),
+            jnp.where(ok, b, -1).astype(jnp.float32),
+            jnp.where(ok, top_v, 0.0),
+        ],
+        axis=1,
+    )                                                    # f32[k, 5]
+    tail = total - jnp.sum(jnp.where(ok, top_v, 0.0))
+    return jnp.concatenate(
+        [jnp.stack([total, tail]), rows.reshape(-1), m.reshape(-1)]
+    )
 
 
 def objective_summary(state: ClusterState, graph: CommGraph) -> dict[str, jax.Array]:

@@ -25,6 +25,11 @@ that lives now:
 - :mod:`explain` — decision explainability: per-decision
   ``DecisionExplanation`` records whose chosen move re-derives as the
   argmax of the recorded candidate scores (consistency-checked).
+- :mod:`attribution` — communication-cost attribution & topology plane:
+  per-edge/per-node-pair decomposition of the cost scalar (one bundled
+  device transfer per round), cardinality-bounded topology gauges, and
+  the placement-timeline / move-provenance tracker whose per-move edge
+  deltas telescope to the round's objective delta (consistency-checked).
 - :mod:`flight_recorder` — bounded ring of recent rounds, dumped as a
   self-contained diagnostics bundle on breaker-open / crash / SIGUSR1.
 - :mod:`watchdog` — rolling-window SLO rules (latency p95, comm-cost
@@ -79,6 +84,12 @@ from kubernetes_rescheduling_tpu.telemetry.costmodel import (
 from kubernetes_rescheduling_tpu.telemetry.explain import (
     explanation_consistent,
 )
+from kubernetes_rescheduling_tpu.telemetry.attribution import (
+    AttributionBook,
+    PlacementTimeline,
+    attribution_consistent,
+    get_attribution_book,
+)
 from kubernetes_rescheduling_tpu.telemetry.perf_ledger import PerfLedger
 from kubernetes_rescheduling_tpu.telemetry.flight_recorder import FlightRecorder
 from kubernetes_rescheduling_tpu.telemetry.server import (
@@ -112,6 +123,10 @@ __all__ = [
     "sample_device_memory",
     "PerfLedger",
     "explanation_consistent",
+    "AttributionBook",
+    "PlacementTimeline",
+    "attribution_consistent",
+    "get_attribution_book",
     "FlightRecorder",
     "HealthState",
     "OpsPlane",

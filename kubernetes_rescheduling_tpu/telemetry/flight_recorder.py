@@ -106,6 +106,9 @@ class FlightRecorder:
         """The bundle object — self-contained: ring + metrics + manifest
         + the compiled-cost book (what the kernels in these rounds cost,
         even if the process dies before anyone scrapes /metrics)."""
+        from kubernetes_rescheduling_tpu.telemetry.attribution import (
+            get_attribution_book,
+        )
         from kubernetes_rescheduling_tpu.telemetry.costmodel import get_costbook
         from kubernetes_rescheduling_tpu.telemetry.manifest import run_manifest
 
@@ -117,6 +120,7 @@ class FlightRecorder:
             "rounds": self.rounds,
             "metrics": self._reg().snapshot(),
             "device_costs": get_costbook().as_dict(),
+            "attribution": get_attribution_book().as_dict(),
             "manifest": run_manifest(),
         }
 

@@ -80,6 +80,20 @@ def _device_costs() -> dict[str, Any]:
         return {"kernels": {}, "device_memory": []}
 
 
+def _attribution_book() -> dict[str, Any]:
+    """Latest per-algorithm cost-attribution summary (where the
+    communication cost sits: total, top edge, moves tracked) — the
+    topology-plane half of the provenance. jax-free, best-effort."""
+    from kubernetes_rescheduling_tpu.telemetry.attribution import (
+        get_attribution_book,
+    )
+
+    try:
+        return get_attribution_book().as_dict()
+    except Exception:  # noqa: BLE001 — provenance must not fail the run
+        return {}
+
+
 def run_manifest(config: dict[str, Any] | None = None) -> dict[str, Any]:
     import numpy as np
 
@@ -94,6 +108,7 @@ def run_manifest(config: dict[str, Any] | None = None) -> dict[str, Any]:
         "numpy": np.__version__,
         "jax": _jax_info(),
         "device_costs": _device_costs(),
+        "attribution": _attribution_book(),
         "git": _git_rev(cwd=str(Path(__file__).resolve().parent)),
     }
 

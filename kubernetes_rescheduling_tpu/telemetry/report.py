@@ -163,6 +163,17 @@ def summarize_bundle(bundle: dict[str, Any]) -> list[str]:
         f"{checked - len(bad)} explain-consistent"
         + ("" if not bad else f" — {len(bad)} INCONSISTENT")
     )
+    from kubernetes_rescheduling_tpu.telemetry.attribution import (
+        check_attribution,
+    )
+
+    a_checked, a_bad = check_attribution(rounds)
+    if a_checked:
+        lines.append(
+            f"  attribution: {a_checked} recorded, "
+            f"{a_checked - len(a_bad)} sum-consistent"
+            + ("" if not a_bad else f" — {len(a_bad)} INCONSISTENT")
+        )
     metrics = bundle.get("metrics") or []
     lines.append(f"  metrics snapshot: {len(metrics)} series")
     manifest = bundle.get("manifest") or {}
@@ -280,6 +291,79 @@ def report_perf(
         baseline=baseline,
     )
     out.extend(pl.render_table(verdicts))
+    return "\n".join(out)
+
+
+def _topo_rounds(path: Path) -> list[dict[str, Any]]:
+    """Round records (dicts carrying `attribution`) from a rounds.jsonl
+    file or a flight-recorder bundle's ring."""
+    text = path.read_text().strip()
+    if not text:
+        return []
+    if text.startswith("{") and path.suffix == ".json":
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            return []
+        if isinstance(obj, dict) and obj.get("kind") == "flight_recorder_bundle":
+            return list(obj.get("rounds") or ())
+        return [obj] if isinstance(obj, dict) else []
+    return _read_jsonl(path)
+
+
+def report_topo(paths: list[str]) -> str:
+    """The ``telemetry topo`` report: cost attribution & topology — the
+    latest round's edge-attribution table and node-pair heatmap, the
+    placement/provenance trail over all rounds, and the sum-consistency
+    verdict (per-edge contributions re-derive the recorded cost scalar;
+    per-move deltas re-derive the objective delta)."""
+    from kubernetes_rescheduling_tpu.telemetry.attribution import (
+        check_attribution,
+        iter_attributions,
+        render_edges,
+        render_heatmap,
+        render_provenance,
+        render_residency,
+        residency_from_rounds,
+    )
+
+    out = []
+    for p in paths:
+        out.append(f"== {p} ==")
+        path = Path(p)
+        if not path.is_file():
+            out.append("  not a file")
+            continue
+        rounds = _topo_rounds(path)
+        attrs = iter_attributions(rounds)
+        if not attrs:
+            out.append("  no attribution records (was obs.attribution off?)")
+            continue
+        latest = attrs[-1][0]
+        rnd = latest.get("round", "?")
+        total = latest.get("total")
+        out.append(
+            f"  rounds with attribution: {len(attrs)}; latest r{rnd} "
+            f"total cost {total:.4g}"
+        )
+        out.extend(render_edges(latest))
+        out.extend(render_heatmap(latest))
+        out.append("  residency (service -> node over rounds):")
+        out.extend(
+            f"  {ln}" for ln in render_residency(residency_from_rounds(rounds))
+        )
+        out.append("  move provenance:")
+        out.extend(render_provenance(rounds))
+        checked, bad = check_attribution(rounds)
+        out.append(
+            f"  consistency: {checked - len(bad)}/{checked} rounds re-derive "
+            f"their cost scalar and move deltas from the recorded attribution"
+        )
+        for a in bad:
+            out.append(
+                f"    INCONSISTENT: r{a.get('round', '?')} total="
+                f"{a.get('total')} does not re-derive from its parts"
+            )
     return "\n".join(out)
 
 

@@ -13,8 +13,9 @@ Endpoints (``--serve PORT`` on ``reschedule``/``bench``):
   violation, or a stale loop), 200 otherwise — a liveness probe or the
   chaos soak can watch the loop degrade and recover in real time.
 - ``GET /events``   — the newest structured-log events as JSON
-  (``?n=`` caps the count; default 256) — the StructuredLogger ring,
-  without grepping JSONL files mid-incident.
+  (``?n=`` tail-limits for cheap polling; default = the full ring,
+  which is itself bounded) — the StructuredLogger ring, without
+  grepping JSONL files mid-incident.
 
 The server runs daemon threads and binds 127.0.0.1 by default; port 0
 picks an ephemeral port (tests). Handlers never write to stdout/stderr —
@@ -60,8 +61,13 @@ class HealthState:
         self.breaker = None
         self.watchdog: Watchdog | None = None
         self.algorithm: str | None = None
+        # ages/uptime compute from the MONOTONIC clock — an NTP step must
+        # neither force a spurious 503 nor mask genuine staleness; the
+        # wall-clock twins exist for display only
         self.started_ts = time.time()
+        self._started_mono = time.monotonic()
         self.last_round_ts: float | None = None
+        self._last_round_mono: float | None = None
         self.rounds = 0
         self.skipped_rounds = 0
         self.degraded_rounds = 0
@@ -70,11 +76,16 @@ class HealthState:
         # perf_regression rule; this is the human-readable "what & why"
         self.perf: dict | None = None
 
+    def mark_round(self) -> None:
+        """Stamp 'a round just finished' on both clocks."""
+        self.last_round_ts = time.time()
+        self._last_round_mono = time.monotonic()
+
     def snapshot(self) -> tuple[dict[str, Any], bool]:
         breaker_state = getattr(self.breaker, "state", None)
         age = (
-            time.time() - self.last_round_ts
-            if self.last_round_ts is not None
+            time.monotonic() - self._last_round_mono
+            if self._last_round_mono is not None
             else None
         )
         stale = (
@@ -97,8 +108,9 @@ class HealthState:
                 "skipped_rounds": self.skipped_rounds,
                 "degraded_rounds": self.degraded_rounds,
                 "last_round_age_s": age,
+                "last_round_ts": self.last_round_ts,  # wall anchor, display
                 "stale": stale,
-                "uptime_s": time.time() - self.started_ts,
+                "uptime_s": time.monotonic() - self._started_mono,
                 "slo": slo,
                 "perf": self.perf,
             },
@@ -200,16 +212,23 @@ def _make_handler(ops: OpsServer):
                     200 if healthy else 503, body, "application/json"
                 )
             elif endpoint == "/events":
-                try:
-                    n = int(parse_qs(url.query).get("n", ["256"])[0])
-                except ValueError:
-                    n = 256
                 events = (
                     list(ops.events_source() or [])
                     if ops.events_source is not None
                     else []
                 )
-                body = json.dumps(events[-max(n, 0):], default=float).encode()
+                # ?n= tail-limits the response (cheap polling of the last
+                # few events); default is the FULL ring — which is itself
+                # bounded (StructuredLogger's in-memory view is a ring
+                # buffer), so an unqualified GET cannot grow unboundedly
+                raw = parse_qs(url.query).get("n")
+                try:
+                    n = min(max(int(raw[0]), 0), len(events)) if raw else len(events)
+                except ValueError:
+                    n = len(events)
+                body = json.dumps(
+                    events[len(events) - n:], default=float
+                ).encode()
                 self._respond(200, body, "application/json")
             else:
                 self._respond(
@@ -259,6 +278,9 @@ class OpsPlane:
                 latency_p95_s=obs.slo_latency_p95_s,
                 cost_regression_frac=obs.slo_cost_regression_frac,
                 max_retraces=obs.slo_max_retraces,
+                attribution_drift_frac=getattr(
+                    obs, "attribution_drift_frac", 0.0
+                ),
             ),
             registry=registry,
             logger=logger,
@@ -354,7 +376,7 @@ class OpsPlane:
 
     def observe_round(self, record, state=None, events=()) -> None:
         self.health.rounds += 1
-        self.health.last_round_ts = time.time()
+        self.health.mark_round()
         if record.degraded:
             self.health.degraded_rounds += 1
         if self.watchdog is not None:
@@ -396,7 +418,7 @@ class OpsPlane:
 
     def observe_skip(self, rnd: int, breaker_state: str | None = None) -> None:
         self.health.skipped_rounds += 1
-        self.health.last_round_ts = time.time()
+        self.health.mark_round()
         if self.recorder is not None:
             self.recorder.record_skip(rnd, breaker=breaker_state)
 

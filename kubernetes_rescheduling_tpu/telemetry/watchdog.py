@@ -50,6 +50,7 @@ RULE_LATENCY = "round_latency_p95"
 RULE_COST = "comm_cost_regression"
 RULE_RETRACE = "retrace"
 RULE_PERF = "perf_regression"
+RULE_ATTRIBUTION = "attribution_drift"
 
 
 @dataclass(frozen=True)
@@ -61,6 +62,12 @@ class SLORules:
     latency_p95_s: float = 0.0
     cost_regression_frac: float = 0.0
     max_retraces: int = 1
+    # attribution drift: the top-1 service edge's share of total
+    # communication cost exceeding this fraction means one edge dominates
+    # the objective — the placement (or the traffic estimate feeding it)
+    # has collapsed onto a single hot pair (0 disables; needs per-round
+    # attribution records — see telemetry.attribution)
+    attribution_drift_frac: float = 0.0
 
     def validate(self) -> "SLORules":
         if self.window < 2:
@@ -72,6 +79,8 @@ class SLORules:
                 raise ValueError(f"{name} must be >= 0")
         if self.max_retraces < 0:
             raise ValueError("max_retraces must be >= 0")
+        if not (0.0 <= self.attribution_drift_frac <= 1.0):
+            raise ValueError("attribution_drift_frac must be in [0, 1]")
         return self
 
 
@@ -102,6 +111,7 @@ class Watchdog:
         )
         self._trace_base: dict[str, float] = {}
         self._perf_active: dict[str, dict[str, Any]] = {}
+        self._attr: dict[str, Any] | None = None  # latest round's attribution
         self.active: dict[str, dict[str, Any]] = {}
         self.violations_seen = 0
 
@@ -117,6 +127,7 @@ class Watchdog:
         self._lat.clear()
         self._cost.clear()
         self._trace_base.clear()
+        self._attr = None
         self.active = (
             {RULE_PERF: self.active[RULE_PERF]}
             if RULE_PERF in self.active
@@ -131,6 +142,9 @@ class Watchdog:
         the NEWLY raised violations (already counted and logged)."""
         self._lat.append(float(record.decision_latency_s))
         self._cost.append(float(record.communication_cost))
+        attr = getattr(record, "attribution", None)
+        if isinstance(attr, dict):
+            self._attr = attr
         return self.check()
 
     def observe_perf(self, verdicts: dict[str, dict[str, Any]]) -> list[dict[str, Any]]:
@@ -192,6 +206,22 @@ class Watchdog:
                 now[RULE_RETRACE] = {
                     "fns": retraced, "max_retraces": r.max_retraces,
                 }
+        if r.attribution_drift_frac > 0 and self._attr is not None:
+            # the LATEST round's attribution judges: one edge carrying
+            # more than the configured fraction of total cost means the
+            # objective has collapsed onto a single hot pair
+            edges = self._attr.get("edges") or ()
+            total = self._attr.get("total") or 0.0
+            if edges and total > 0:
+                top = edges[0]
+                share = top.get("cost", 0.0) / total
+                if share > r.attribution_drift_frac:
+                    now[RULE_ATTRIBUTION] = {
+                        "edge": f"{top.get('src_service')}->{top.get('dst_service')}",
+                        "share": share,
+                        "threshold_frac": r.attribution_drift_frac,
+                        "total": total,
+                    }
         if self._perf_active:
             now[RULE_PERF] = {
                 "metrics": {

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Static check: every ``@pytest.mark.slow`` carries its justification.
+
+The tier-1 run excludes ``slow`` tests, so each mark is a claim: "every
+invariant this test covers keeps at least one fast representative" (the
+marker registration in tests/conftest.py). PRs 3–4 applied the
+convention by hand — a comment ON the marker line (continued by
+immediately-following full-line comments) naming the surviving fast pin.
+This checker enforces it:
+
+- any line applying the mark — decorator form, ``marks=pytest.mark.slow``
+  inside ``pytest.param``, or a module-level ``pytestmark`` — must carry
+  a same-line ``#`` comment;
+- the justification (same-line comment + any directly-following
+  full-line comments, up to the decorated ``def``/next decorator) must
+  say the coverage survives — it must mention ``pin``/``fast``/
+  ``tier-1`` — AND name where: a ``test_*``/``Test*`` reference, or a
+  positional one (``above``/``below``/``... cases``/the harness matrix).
+
+Usage:
+    python scripts/check_slow_justified.py [TESTFILE.py ...]
+
+With no arguments it self-checks the repo's own ``tests/`` directory —
+the checked-in suite must satisfy the convention it documents. Run
+directly (exit 1 on violation) or through the test twin
+(tests/test_slow_justified.py).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+TESTS = ROOT / "tests"
+
+# any spelling of the mark: decorator (@pytest.mark.slow), parametrize
+# (marks=pytest.mark.slow), or module-level (pytestmark = ...) — every
+# form removes coverage from tier-1, so every form owes a justification
+_MARK = re.compile(r"^[^#]*\bpytest\.mark\.slow\b(?P<tail>.*)$")
+_COMMENT_LINE = re.compile(r"^\s*#(.*)$")
+# "the coverage survives": the justification must say the invariant
+# stays pinned fast somewhere
+_SURVIVES = re.compile(r"\b(pin|pinned|pins|fast|tier-1)\b", re.I)
+# "...and names where": a concrete test reference or a positional one
+_NAMES_PIN = re.compile(
+    r"(test_[a-zA-Z0-9_]+|Test[A-Za-z0-9_]+|\babove\b|\bbelow\b|"
+    r"\bcases\b|\bmatrix\b)"
+)
+
+
+def _justification(lines: list[str], idx: int) -> str:
+    """The marker's comment text: same-line tail + following full-line
+    comments (the continuation convention), stopped by code."""
+    m = _MARK.match(lines[idx])
+    parts = []
+    tail = m.group("tail")
+    if "#" in tail:
+        parts.append(tail.split("#", 1)[1])
+    j = idx + 1
+    while j < len(lines):
+        cm = _COMMENT_LINE.match(lines[j])
+        if cm is None:
+            break
+        parts.append(cm.group(1))
+        j += 1
+    return " ".join(p.strip() for p in parts)
+
+
+def check_file(path: str | Path) -> list[str]:
+    """Violations in one test file (empty = clean)."""
+    p = Path(path)
+    if not p.is_file():
+        return [f"{p}: not a file"]
+    out: list[str] = []
+    lines = p.read_text().splitlines()
+    for i, line in enumerate(lines):
+        m = _MARK.match(line)
+        if m is None:
+            continue
+        if "#" not in m.group("tail"):
+            out.append(
+                f"{p}:{i + 1}: pytest.mark.slow without a same-line "
+                f"justification comment"
+            )
+            continue
+        just = _justification(lines, i)
+        if not _SURVIVES.search(just):
+            out.append(
+                f"{p}:{i + 1}: slow justification does not say the "
+                f"coverage stays pinned fast: {just!r}"
+            )
+        elif not _NAMES_PIN.search(just):
+            out.append(
+                f"{p}:{i + 1}: slow justification does not NAME the "
+                f"surviving fast pin (a test_*/Test* reference or "
+                f"above/below/cases/matrix): {just!r}"
+            )
+    return out
+
+
+def violations(paths: list[str] | None = None) -> list[str]:
+    if paths:
+        files = [Path(p) for p in paths]
+    else:
+        files = sorted(TESTS.glob("test_*.py"))
+    out: list[str] = []
+    for f in files:
+        out.extend(check_file(f))
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    bad = violations(args)
+    if bad:
+        sys.stderr.write(
+            "unjustified @pytest.mark.slow markers:\n"
+            + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Regenerate exposition_golden.prom — the byte-exact wire-format pin
+``tests/test_observability.py::test_exposition_golden_file`` compares
+against. Keep the registrations here IDENTICAL to that test's."""
+
+from pathlib import Path
+
+from kubernetes_rescheduling_tpu.telemetry.attribution import (
+    publish_attribution,
+)
+from kubernetes_rescheduling_tpu.telemetry.registry import MetricsRegistry
+
+
+def build_registry() -> MetricsRegistry:
+    registry = MetricsRegistry()
+    registry.counter(
+        "rounds_total", "rescheduling rounds executed", labelnames=("algorithm",)
+    ).labels(algorithm="communication").inc(3)
+    registry.gauge(
+        "communication_cost", "cost", labelnames=("algorithm",)
+    ).labels(algorithm="communication").set(12.5)
+    h = registry.histogram(
+        "decision_seconds", "latency", labelnames=("algorithm",),
+        buckets=(0.001, 0.01, 0.1),
+    ).labels(algorithm="communication")
+    for v in (0.0005, 0.005, 0.05, 0.5):
+        h.observe(v)
+    registry.counter("esc_total", "label escaping", labelnames=("p",)).labels(
+        p='a"b\\c\nd'
+    ).inc()
+    publish_attribution(
+        registry,
+        {
+            "total": 10.0,
+            "tail": 1.0,
+            "edges": [
+                {"src_service": "a", "dst_service": "b", "src_node": "n0",
+                 "dst_node": "n1", "cost": 6.0},
+            ],
+            "node_pairs": [["n0", "n1", 12.0], ["n1", "n0", 12.0]],
+            "ingress": {"n0": 5.0, "n1": 5.0},
+            "egress": {"n0": 5.0, "n1": 5.0},
+        },
+        top_k=2,
+    )
+    return registry
+
+
+if __name__ == "__main__":
+    out = Path(__file__).parent / "exposition_golden.prom"
+    out.write_text(build_registry().expose())
+    print(f"wrote {out}")

@@ -190,7 +190,71 @@ def test_exposition_golden_file(registry):
     registry.counter("esc_total", "label escaping", labelnames=("p",)).labels(
         p='a"b\\c\nd'
     ).inc()
+    # the attribution plane's topology families render through the same
+    # path (exercised via the real publisher, not hand-set gauges)
+    from kubernetes_rescheduling_tpu.telemetry.attribution import (
+        publish_attribution,
+    )
+
+    publish_attribution(
+        registry,
+        {
+            "total": 10.0,
+            "tail": 1.0,
+            "edges": [
+                {"src_service": "a", "dst_service": "b", "src_node": "n0",
+                 "dst_node": "n1", "cost": 6.0},
+            ],
+            "node_pairs": [["n0", "n1", 12.0], ["n1", "n0", 12.0]],
+            "ingress": {"n0": 5.0, "n1": 5.0},
+            "egress": {"n0": 5.0, "n1": 5.0},
+        },
+        top_k=2,
+    )
     assert registry.expose() == golden.read_text()
+
+
+def test_exposition_conformance_attribution_families(registry):
+    """Strict-parser pass over the attribution metric families as a
+    LIVE controller emits them (multi-round, stale pairs zeroed)."""
+    from kubernetes_rescheduling_tpu.telemetry.attribution import (
+        publish_attribution,
+    )
+
+    for rnd in range(3):
+        publish_attribution(
+            registry,
+            {
+                "total": 10.0 + rnd,
+                "tail": 0.0,
+                "edges": [
+                    {"src_service": "a", "dst_service": "b",
+                     "src_node": f"n{rnd % 2}", "dst_node": "n2",
+                     "cost": 10.0 + rnd},
+                ],
+                "node_pairs": [
+                    [f"n{rnd % 2}", "n2", 2 * (10.0 + rnd)],
+                    ["n2", f"n{rnd % 2}", 2 * (10.0 + rnd)],
+                ],
+                "ingress": {"n0": 5.0, "n1": 0.0, "n2": 5.0 + rnd},
+                "egress": {"n0": 5.0, "n1": 0.0, "n2": 5.0 + rnd},
+            },
+            top_k=3,
+        )
+    families, samples = assert_exposition_conformant(registry.expose())
+    for name in (
+        "comm_cost_node_pair",
+        "comm_cost_node_ingress",
+        "comm_cost_node_egress",
+        "comm_cost_edge_topk",
+    ):
+        assert families[name]["type"] == "gauge"
+    # rank labels are the fixed budget; the alternating node pair from
+    # round 1 is still exposed but zeroed
+    assert samples[("comm_cost_edge_topk", frozenset([("rank", "0")]))] == 12.0
+    assert samples[
+        ("comm_cost_node_pair", frozenset([("src", "n1"), ("dst", "n2")]))
+    ] == 0.0
 
 
 # ---------------- ops server ----------------
@@ -251,6 +315,81 @@ class TestOpsServer:
             assert [e["i"] for e in events] == [7, 8, 9]
             status, _ = _get(port, "/nope")
             assert status == 404
+        finally:
+            srv.stop()
+
+    def test_events_tail_limit_bounds_and_defaults(self, registry):
+        """`?n=` tail-limits the response; DEFAULT is the full (bounded)
+        ring; n is clamped, order is oldest→newest, content type JSON."""
+        import urllib.request
+
+        logger = StructuredLogger(name="t", max_records=16)
+        for i in range(20):
+            logger.info("tick", i=i)
+        srv = OpsServer(
+            port=0, registry=registry, events_source=lambda: logger.records
+        )
+        port = srv.start()
+        try:
+            # default: the full ring (itself bounded at max_records)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/events", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == "application/json"
+                events = json.loads(resp.read())
+            assert [e["i"] for e in events] == list(range(4, 20))
+            # tail limit: the NEWEST n, oldest->newest within the tail
+            _, body = _get(port, "/events?n=2")
+            assert [e["i"] for e in json.loads(body)] == [18, 19]
+            # clamped: n beyond the ring returns the whole ring, not 500
+            _, body = _get(port, "/events?n=9999")
+            assert len(json.loads(body)) == 16
+            # n=0 and junk are bounded too
+            _, body = _get(port, "/events?n=0")
+            assert json.loads(body) == []
+            _, body = _get(port, "/events?n=bogus")
+            assert len(json.loads(body)) == 16
+        finally:
+            srv.stop()
+
+    def test_healthz_round_age_survives_wall_clock_step(
+        self, registry, monkeypatch
+    ):
+        """An NTP wall-clock step must not fake staleness (or mask it):
+        the round age computes from the MONOTONIC clock; wall time is
+        display-only."""
+        import time as time_mod
+
+        from kubernetes_rescheduling_tpu.telemetry.server import HealthState
+
+        health = HealthState(max_round_age_s=60.0)
+        health.mark_round()
+        payload, healthy = health.snapshot()
+        assert healthy and payload["last_round_age_s"] < 1.0
+
+        real_time = time_mod.time
+        # wall clock jumps A DAY forward (NTP step): age must not move
+        monkeypatch.setattr(time_mod, "time", lambda: real_time() + 86400.0)
+        payload, healthy = health.snapshot()
+        assert healthy, "wall-clock step must not force a spurious 503"
+        assert payload["last_round_age_s"] < 1.0
+        assert not payload["stale"]
+
+        # genuine staleness is still caught: the MONOTONIC clock advances
+        real_mono = time_mod.monotonic
+        monkeypatch.setattr(
+            time_mod, "monotonic", lambda: real_mono() + 120.0
+        )
+        payload, healthy = health.snapshot()
+        assert payload["stale"] and not healthy
+        # and the server surfaces it as a 503
+        srv = OpsServer(port=0, registry=registry, health=health)
+        port = srv.start()
+        try:
+            status, body = _get(port, "/healthz")
+            assert status == 503
+            assert json.loads(body)["stale"] is True
         finally:
             srv.stop()
 
@@ -622,6 +761,13 @@ def test_harness_serves_session_ops_plane(tmp_path, registry):
     assert all(r["explanations"] for r in recs)
     for e in (e for r in recs for e in r["explanations"]):
         assert explanation_consistent(e)
+    # ... and the cost attribution, sum-consistent per round
+    from kubernetes_rescheduling_tpu.telemetry.attribution import (
+        check_attribution,
+    )
+
+    checked, bad = check_attribution(recs)
+    assert checked == len(recs) and bad == []
 
 
 # ---------------- config plumbing ----------------

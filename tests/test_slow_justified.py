@@ -1,0 +1,164 @@
+"""CI twin of ``scripts/check_slow_justified.py``: every
+slow marker must carry the justification comment naming its
+surviving fast pin (the PR 3–4 convention, now enforced) — validated
+over the checked-in suite plus pinned acceptance/rejection of the
+comment shapes the convention allows."""
+
+import importlib.util
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def checker():
+    path = REPO / "scripts" / "check_slow_justified.py"
+    spec = importlib.util.spec_from_file_location("check_slow_justified", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_slow_justified", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# assembled so this file's own fixtures never contain the literal
+# marker the checker greps for
+MARK = "@pytest." + "mark." + "slow"
+
+
+def _write(tmp_path, body):
+    p = tmp_path / "test_x.py"
+    p.write_text(textwrap.dedent(body).replace("@SLOW", MARK))
+    return p
+
+
+def test_checked_in_suite_is_justified(checker):
+    """The no-args self-check: the repo's own tests satisfy the
+    convention the checker documents."""
+    assert checker.violations() == []
+    assert checker.main([]) == 0
+
+
+def test_same_line_plus_continuation_accepted(checker, tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        import pytest
+
+        @SLOW  # parity stays pinned fast by
+        # test_fast_twin_case below
+        def test_heavy():
+            pass
+        """,
+    )
+    assert checker.check_file(p) == []
+
+
+def test_bare_marker_rejected(checker, tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        import pytest
+
+        @SLOW
+        def test_heavy():
+            pass
+        """,
+    )
+    bad = checker.check_file(p)
+    assert len(bad) == 1 and "without a same-line" in bad[0]
+    assert checker.main([str(p)]) == 1
+
+
+def test_parametrize_and_module_level_forms_are_caught(checker, tmp_path):
+    """Non-decorator spellings remove tier-1 coverage just the same —
+    the checker must not let them bypass the convention."""
+    p = _write(
+        tmp_path,
+        """\
+        import pytest
+
+        @pytest.mark.parametrize("n", [
+            pytest.param(10_000, marks=@SLOW),
+        ])
+        def test_scale(n):
+            pass
+        """.replace("marks=@SLOW", "marks=" + MARK.lstrip("@")),
+    )
+    bad = checker.check_file(p)
+    assert len(bad) == 1 and "without a same-line" in bad[0]
+    p2 = _write(
+        tmp_path,
+        """\
+        import pytest
+
+        pytestmark = @SLOW  # whole module redundant; stays pinned fast by
+        # test_fast_module's cases
+        """,
+    )
+    assert checker.check_file(p2) == []
+
+
+def test_comment_without_survival_claim_rejected(checker, tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        import pytest
+
+        @SLOW  # this one is just heavy
+        def test_heavy():
+            pass
+        """,
+    )
+    bad = checker.check_file(p)
+    assert len(bad) == 1 and "stays pinned fast" in bad[0]
+
+
+def test_comment_without_named_pin_rejected(checker, tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        import pytest
+
+        @SLOW  # redundant; coverage stays pinned fast elsewhere
+        def test_heavy():
+            pass
+        """,
+    )
+    bad = checker.check_file(p)
+    assert len(bad) == 1 and "NAME the surviving fast pin" in bad[0]
+
+
+def test_continuation_stops_at_code(checker, tmp_path):
+    """A comment AFTER the def is not a continuation — the marker line
+    itself must justify."""
+    p = _write(
+        tmp_path,
+        """\
+        import pytest
+
+        @SLOW  # heavy variant
+        def test_heavy():
+            # fast pin: test_fast_twin (this comment must NOT count)
+            pass
+        """,
+    )
+    bad = checker.check_file(p)
+    assert len(bad) == 1
+
+
+def test_fast_tests_unconstrained(checker, tmp_path):
+    p = _write(
+        tmp_path,
+        """\
+        import pytest
+
+        @pytest.mark.parametrize("x", [1, 2])
+        def test_fast(x):
+            pass
+        """,
+    )
+    assert checker.check_file(p) == []

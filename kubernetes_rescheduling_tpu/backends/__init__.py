@@ -11,6 +11,9 @@
   monitor failures, stale/partial snapshots, move timeouts/mis-lands,
   node flap), the chaos-engineering surface the resilience layer is
   tested against.
+- ``FleetBackend`` — N per-tenant backends behind one handle for the
+  multiplexed fleet controller (each tenant keeps its own failure
+  domain; chaos composes per tenant).
 """
 
 from kubernetes_rescheduling_tpu.backends.base import Backend, MoveRequest
@@ -24,6 +27,7 @@ from kubernetes_rescheduling_tpu.backends.chaos import (
     PROFILES as CHAOS_PROFILES,
     with_chaos,
 )
+from kubernetes_rescheduling_tpu.backends.fleet import FleetBackend, make_fleet
 
 __all__ = [
     "Backend",
@@ -38,4 +42,6 @@ __all__ = [
     "ChaosTimeoutError",
     "CHAOS_PROFILES",
     "with_chaos",
+    "FleetBackend",
+    "make_fleet",
 ]

@@ -1,0 +1,94 @@
+"""Fleet backend: N per-tenant cluster backends behind one handle.
+
+Fleet mode multiplexes ONE device plane over MANY clusters; on the host
+side each tenant keeps its own backend (its own pod table, clock,
+events, faults). :class:`FleetBackend` is deliberately NOT a
+``Backend`` — the multiplexed controller talks to every tenant through
+that tenant's OWN :class:`~bench.boundary.BoundaryClient` (retry +
+breaker per tenant, the isolation the fleet loop is built around), so an
+aggregate ``monitor()`` would be a trap: it would couple tenants' failure
+domains back together. What the aggregate owns is construction, naming,
+and fleet-wide conveniences (imbalance injection, event collection).
+
+Chaos composes per tenant: ``chaos_tenants`` wraps ONLY those tenants'
+backends in the named :mod:`backends.chaos` profile (seeded per tenant),
+which is how the isolation acceptance test arranges "tenant 3 is on
+fire, tenants 0-2 must not notice".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubernetes_rescheduling_tpu.backends.base import Backend
+
+
+@dataclass
+class FleetBackend:
+    """N tenant backends, index-aligned with ``tenant_names``."""
+
+    backends: list[Backend]
+    tenant_names: list[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.backends:
+            raise ValueError("a fleet needs at least one tenant backend")
+        if not self.tenant_names:
+            self.tenant_names = [
+                f"tenant{i}" for i in range(len(self.backends))
+            ]
+        if len(self.tenant_names) != len(self.backends):
+            raise ValueError(
+                f"{len(self.tenant_names)} tenant names for "
+                f"{len(self.backends)} backends"
+            )
+        if len(set(self.tenant_names)) != len(self.tenant_names):
+            raise ValueError("tenant names must be unique")
+
+    @property
+    def num_tenants(self) -> int:
+        return len(self.backends)
+
+    def __iter__(self):
+        return iter(zip(self.tenant_names, self.backends))
+
+    def inject_imbalance(self) -> None:
+        """The cordon trick, per tenant (each onto its own first node) —
+        the fleet twin of the harness's per-cell injection."""
+        for b in self.backends:
+            inject = getattr(b, "inject_imbalance", None)
+            if inject is not None:
+                inject(b.node_names[0])
+
+    def events(self) -> dict[str, list[dict]]:
+        """Per-tenant backend event logs (sim backends only)."""
+        return {
+            name: list(getattr(b, "events", ()))
+            for name, b in zip(self.tenant_names, self.backends)
+        }
+
+
+def make_fleet(
+    scenario: str,
+    tenants: int,
+    *,
+    seed: int = 0,
+    workmodel_path: str | None = None,
+) -> FleetBackend:
+    """Build an N-tenant fleet of hermetic simulators for a scenario.
+
+    Every tenant gets the scenario's cluster shape with its OWN seed
+    (``seed*1000 + t`` — the harness's per-run seeding convention), so
+    tenants share array shapes (the fleet-stacking requirement: one
+    compiled program serves the whole fleet) while their topologies,
+    initial placements, and load noise differ.
+    """
+    from kubernetes_rescheduling_tpu.bench.harness import make_backend
+
+    if tenants < 1:
+        raise ValueError(f"tenants must be >= 1, got {tenants}")
+    backends = [
+        make_backend(scenario, seed * 1000 + t, workmodel_path=workmodel_path)
+        for t in range(tenants)
+    ]
+    return FleetBackend(backends=list(backends))

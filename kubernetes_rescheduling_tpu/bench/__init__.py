@@ -9,9 +9,13 @@
   success/error counts and latency percentiles.
 - ``harness`` — the algorithm × repeat experiment matrix with per-session
   result directories (reference auto_full_pipeline_repeat.sh).
+- ``fleet`` — the multiplexed fleet round loop: one boundary + breaker
+  per tenant, ONE vmap-batched device solve per round for the whole
+  fleet (ROADMAP item 1's controller-architecture refactor).
 """
 
 from kubernetes_rescheduling_tpu.bench.controller import ControllerResult, run_controller
+from kubernetes_rescheduling_tpu.bench.fleet import FleetResult, run_fleet_controller
 from kubernetes_rescheduling_tpu.bench.harness import ExperimentConfig, run_experiment
 from kubernetes_rescheduling_tpu.bench.loadgen import (
     LoadGenConfig,
@@ -23,6 +27,8 @@ from kubernetes_rescheduling_tpu.bench.sinks import CsvSink, JsonlSink
 __all__ = [
     "ControllerResult",
     "run_controller",
+    "FleetResult",
+    "run_fleet_controller",
     "CsvSink",
     "JsonlSink",
     "ExperimentConfig",

@@ -175,8 +175,13 @@ class BoundaryClient:
         logger: StructuredLogger | None = None,
         registry: MetricsRegistry | None = None,
         sleeper: Callable[[float], None] | None = None,
+        tenant: str | None = None,
     ):
         self.backend = backend
+        # fleet mode: which tenant this boundary fronts — part of the
+        # solver-cache key (see solver_cache) so multiplexed tenants
+        # sharing host plumbing neither cross-pollinate nor thrash
+        self.tenant = tenant
         self.policy = (policy or RetryPolicy()).validate()
         # every boundary call treats a None return as transient (the
         # protocol's "failed, skip" signal) — precomputed once
@@ -263,6 +268,26 @@ class BoundaryClient:
         while hasattr(b, "inner"):
             b = b.inner
         return b
+
+    def solver_cache(self, name: str) -> dict:
+        """A named, TENANT-AWARE mutable cache slot on the raw backend.
+
+        The controller's per-round solver caches (sparse graph, pod
+        graph) historically hung as single attributes on the raw backend
+        — one slot per backend instance. Under fleet multiplexing that
+        key is wrong twice over: two tenants routed over shared host
+        plumbing would cross-pollinate one slot, and alternating tenants
+        would evict each other every round, silently rebuilding a
+        per-round cost the cache exists to remove. The slot is therefore
+        keyed ``(name, tenant)`` on the raw backend (still surviving this
+        run's chaos wrappers, the PR-2 contract); callers own the dict's
+        contents and their own invalidation rule."""
+        host = self.raw_backend
+        caches = getattr(host, "_solver_caches", None)
+        if caches is None:
+            caches = {}
+            host._solver_caches = caches
+        return caches.setdefault((name, self.tenant), {})
 
     def advance(self, seconds: float) -> None:
         self.backend.advance(seconds)

@@ -879,14 +879,18 @@ def _pod_round(
         np.asarray(state.pod_service).tobytes(),
         np.asarray(state.pod_valid).tobytes(),
     )
-    # cache on the RAW backend (not this run's wrappers), so repeated runs
-    # against the same backend keep the reuse
-    cache_host = getattr(boundary, "raw_backend", boundary)
-    cache = getattr(cache_host, "_pod_graph_cache", None)
-    if cache is None or cache[0] is not graph or cache[1] != sig:
-        cache = (graph, sig, pod_level_graph(state, graph))
-        cache_host._pod_graph_cache = cache
-    pod_graph = cache[2]
+    # tenant-aware slot on the RAW backend (boundary.solver_cache): keyed
+    # past this run's wrappers so repeated runs keep the reuse, and past
+    # the tenant so fleet multiplexing neither cross-pollinates nor
+    # rebuilds per round
+    cache = boundary.solver_cache("pod_graph")
+    if cache.get("graph") is not graph or cache.get("sig") != sig:
+        # build BEFORE keying: a failed build must not leave a matching
+        # key over a stale value (the backend — and so the slot — can
+        # outlive this run and be retried)
+        value = pod_level_graph(state, graph)
+        cache["graph"], cache["sig"], cache["value"] = graph, sig, value
+    pod_graph = cache["value"]
     with span("controller/pod_solve", round=rnd):
         new_state, info = jax.block_until_ready(
             global_assign_pods(
@@ -1008,17 +1012,19 @@ def _global_round(
     sparse_graph = None
     if config.solver_backend == "sparse":
         # block-local pair weights. The SparseCommGraph is cached per
-        # (backend, graph) pair: the controller re-solves the same declared
-        # graph every round, and the host-side build pulls the full
-        # adjacency; streaming re-estimated graphs rebuild each round.
+        # (backend, tenant, graph): the controller re-solves the same
+        # declared graph every round, and the host-side build pulls the
+        # full adjacency; streaming re-estimated graphs rebuild each
+        # round (boundary.solver_cache — tenant-keyed so fleet
+        # multiplexing cannot cross-pollinate or thrash the slot).
         from kubernetes_rescheduling_tpu.core import sparsegraph
 
-        cache_host = getattr(boundary, "raw_backend", boundary)
-        cache = getattr(cache_host, "_sparse_graph_cache", None)
-        if cache is None or cache[0] is not graph:
-            cache = (graph, sparsegraph.from_comm_graph(graph))
-            cache_host._sparse_graph_cache = cache
-        sparse_graph = cache[1]
+        cache = boundary.solver_cache("sparse_graph")
+        if cache.get("graph") is not graph:
+            # build BEFORE keying (see the pod-graph cache note)
+            value = sparsegraph.from_comm_graph(graph)
+            cache["graph"], cache["value"] = graph, value
+        sparse_graph = cache["value"]
     with span("controller/global_solve", round=rnd):
         new_state, info = jax.block_until_ready(
             solve_with_restarts(

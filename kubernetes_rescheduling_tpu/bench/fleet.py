@@ -1,0 +1,487 @@
+"""The multiplexed fleet round loop — one device plane, N tenants.
+
+``run_controller`` is one-backend-one-loop: per round it dispatches one
+decision kernel, pays the per-solve fixed cost once, and serves one
+cluster. :func:`run_fleet_controller` refactors that into a MULTIPLEXED
+round loop for N same-shaped tenants:
+
+- **one** :class:`~bench.boundary.BoundaryClient` + circuit breaker +
+  retry budget **per tenant** — every tenant keeps its own failure
+  domain, retry clock (the backend's own ``advance``), and degraded/skip
+  semantics;
+- **one shared device plane** — per round, ONE batched
+  :func:`solver.fleet.fleet_solve` dispatch decides for every active
+  tenant (vmap plane; ``parallel.fleet.fleet_solve_dp`` shards the
+  tenant axis one-per-device instead), and ONE batched
+  :func:`solver.fleet.fleet_metrics` dispatch closes the round's
+  reporting — the per-solve fixed cost RESULTS.md round 5 measured as
+  the dominant term amortizes across the fleet;
+- **per-tenant round streams** — each tenant accumulates its own
+  :class:`~bench.controller.RoundRecord` list inside its own
+  :class:`~bench.controller.ControllerResult`, with the solo loop's
+  accounting invariant per tenant:
+  ``max_rounds == len(result.rounds) + result.skipped_rounds``.
+
+Isolation is the design center: a tenant whose breaker is open (or whose
+backend is dark) contributes a COUNTED skip and a masked slot in the
+batch — the batched kernel's rows are independent per tenant (vmap), so
+the other tenants' decisions are bit-exact with what a solo loop would
+have made (test-pinned: a seeded chaos soak on one tenant leaves every
+other tenant's executed-round counts and comm-cost trajectories
+identical to a no-chaos run).
+
+Decision keys derive per tenant as ``fold_in(key, tenant_index)`` and
+per round exactly as the solo loop derives them, so
+``run_fleet_controller(fleet, cfg, key=k)`` makes the same decisions as
+N solo ``run_controller(backend_t, cfg, key=fold_in(k, t))`` runs.
+
+Scope: fleet mode batches the GREEDY decision kernel (one move per
+tenant per round — ``config.validate()`` enforces it); global/pod solves
+keep the solo loop. Checkpoint/resume is solo-only for now.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+from kubernetes_rescheduling_tpu.backends.chaos import with_chaos
+from kubernetes_rescheduling_tpu.backends.fleet import FleetBackend
+from kubernetes_rescheduling_tpu.backends.k8s import PlacementMechanism
+from kubernetes_rescheduling_tpu.bench.boundary import (
+    HALF_OPEN,
+    OPEN,
+    BoundaryClient,
+    CircuitBreaker,
+)
+from kubernetes_rescheduling_tpu.bench.controller import (
+    ControllerResult,
+    RoundRecord,
+)
+from kubernetes_rescheduling_tpu.config import RescheduleConfig
+from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.solver.fleet import (
+    ROW_MOST,
+    ROW_SERVICE,
+    ROW_TARGET,
+    ROW_VICTIM,
+    fleet_metrics,
+    fleet_solve,
+    stack_tenants,
+)
+from kubernetes_rescheduling_tpu.telemetry import get_registry, pull, span
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+
+
+@dataclass
+class FleetResult:
+    """Per-tenant round streams plus fleet-level accounting."""
+
+    tenants: tuple[str, ...] = ()
+    results: dict[str, ControllerResult] = field(default_factory=dict)
+    # batched fleet_solve dispatches (== rounds with >= 1 active tenant)
+    batched_solves: int = 0
+    # total fenced device time across those dispatches
+    device_solve_s: float = 0.0
+
+    @property
+    def total_rounds(self) -> int:
+        return sum(len(r.rounds) for r in self.results.values())
+
+    @property
+    def total_skipped(self) -> int:
+        return sum(r.skipped_rounds for r in self.results.values())
+
+    @property
+    def amortized_solve_ms_per_tenant_round(self) -> float:
+        """Fenced batched-solve ms amortized over executed tenant-rounds
+        — the fleet headline quantity (one sequential loop pays the whole
+        per-dispatch fixed cost per tenant; this is what batching buys)."""
+        n = self.total_rounds
+        return (self.device_solve_s / n * 1e3) if n else 0.0
+
+
+class _Tenant:
+    """Host-side runtime of one tenant: its boundary, last good snapshot,
+    graph, key stream, and result accumulator."""
+
+    def __init__(self, name, backend, config, *, logger, registry, key):
+        self.name = name
+        self.breaker = CircuitBreaker(
+            max_consecutive_failures=config.max_consecutive_failures,
+            cooldown_rounds=config.breaker_cooldown_rounds,
+            logger=logger,
+            registry=registry,
+        )
+        self.boundary = BoundaryClient(
+            backend,
+            policy=config.retry,
+            breaker=self.breaker,
+            failure_budget_per_round=config.failure_budget_per_round,
+            logger=logger,
+            registry=registry,
+            tenant=name,
+        )
+        self.graph = self.boundary.comm_graph()
+        self.key = key
+        self.state = None
+        self.result = ControllerResult()
+
+    def health_row(self) -> dict:
+        return {
+            "breaker": self.breaker.state,
+            "rounds": len(self.result.rounds),
+            "skipped_rounds": self.result.skipped_rounds,
+            "degraded_rounds": self.result.degraded_rounds,
+        }
+
+
+# per-round decision keys for the whole fleet in ONE dispatch: each
+# tenant's key derives exactly as the solo greedy round derives its first
+# decide key (fold_in the round index, then split and take the second
+# row) — bit-exact with N solo runs under jax_threefry_partitionable
+@jax.jit
+def _round_keys(tenant_keys: jax.Array, rnd: jax.Array) -> jax.Array:
+    return jax.vmap(
+        lambda k: jax.random.split(jax.random.fold_in(k, rnd))[1]
+    )(tenant_keys)
+
+
+def run_fleet_controller(
+    fleet: FleetBackend,
+    config: RescheduleConfig,
+    *,
+    key: jax.Array | None = None,
+    logger: StructuredLogger | None = None,
+    registry=None,
+    ops=None,
+    on_round=None,
+) -> FleetResult:
+    """Run ``config.max_rounds`` multiplexed rounds over a fleet.
+
+    ``config.fleet`` selects the device plane (``vmap`` | ``dp``) and —
+    together with ``config.chaos`` — which tenants get fault injection:
+    with a profile set, ``fleet.chaos_tenants`` wraps ONLY those tenant
+    indices (empty = every tenant, the solo loop's semantics), each
+    seeded ``chaos.seed + index`` so fault streams stay independent.
+
+    ``on_round(tenant_name, record, state)`` fires per executed
+    tenant-round (the harness's load-sustaining hook, tenant-labeled).
+
+    ``ops`` attaches the live plane: ``/healthz`` grows a ``fleet`` block
+    with one row per tenant (breaker state + round counts). A single
+    tenant's open breaker reads as degraded service in that block — it
+    does not 503 the whole endpoint.
+    """
+    config = config.validate()
+    if config.fleet.tenants and config.fleet.tenants != fleet.num_tenants:
+        raise ValueError(
+            f"config.fleet.tenants={config.fleet.tenants} but the fleet "
+            f"backend has {fleet.num_tenants} tenants"
+        )
+    # enforce the fleet gate even when the config's [fleet] block is off
+    # (tenants=0) — the caller handed us a fleet regardless
+    if (
+        config.algorithm not in POLICY_IDS
+        or config.moves_per_round != 1
+        or config.placement_unit != "service"
+    ):
+        raise ValueError(
+            "fleet mode batches the greedy decision kernel: it requires a "
+            "greedy algorithm with moves_per_round=1 and "
+            "placement_unit='service'"
+        )
+    registry = registry if registry is not None else get_registry()
+    key = key if key is not None else jax.random.PRNGKey(config.seed)
+
+    backends = list(fleet.backends)
+    if config.chaos.profile != "none":
+        hit = set(config.fleet.chaos_tenants) or set(range(len(backends)))
+        backends = [
+            with_chaos(
+                b, config.chaos.profile, seed=config.chaos.seed + t,
+                registry=registry,
+            )
+            if t in hit
+            else b
+            for t, b in enumerate(backends)
+        ]
+
+    tenants = [
+        _Tenant(
+            name,
+            backend,
+            config,
+            logger=logger,
+            registry=registry,
+            key=jax.random.fold_in(key, t),
+        )
+        for t, (name, backend) in enumerate(
+            zip(fleet.tenant_names, backends)
+        )
+    ]
+    T = len(tenants)
+    registry.gauge(
+        "fleet_tenants", "tenants served by the multiplexed fleet loop"
+    ).set(T)
+    if ops is not None:
+        ops.bind(logger=logger, algorithm=config.algorithm)
+        ops.health.fleet = {t.name: t.health_row() for t in tenants}
+        for t in tenants:
+            # a tenant breaker opening is exactly the moment the flight
+            # recorder should dump, same as the solo loop's wiring
+            t.breaker.on_transition = ops.on_breaker_transition
+
+    if config.fleet.plane == "dp":
+        from kubernetes_rescheduling_tpu.parallel.fleet import fleet_solve_dp
+
+        solve_fn = fleet_solve_dp
+    else:
+        solve_fn = fleet_solve
+
+    pid = jnp.asarray(POLICY_IDS[config.algorithm])
+    thr = jnp.asarray(config.hazard_threshold_pct)
+    # graphs and tenant key roots are static per tenant — stacked ONCE
+    stacked_graphs = stack_tenants([t.graph for t in tenants])
+    stacked_keys = jnp.stack([t.key for t in tenants])
+
+    # startup: the solo loop's bounded probe per tenant, WITHOUT the solo
+    # loop's hard failure — a tenant that stays dark simply starts with
+    # no snapshot (its rounds are counted skips until a monitor lands);
+    # only a fleet where EVERY tenant is dark is an error
+    for t in tenants:
+        for _ in range(max(3, config.max_consecutive_failures + 1)):
+            t.state = t.boundary.monitor()
+            if t.state is not None:
+                break
+    if all(t.state is None for t in tenants):
+        raise ConnectionError(
+            "fleet unavailable: every tenant's initial monitor() failed "
+            "after retries"
+        )
+
+    result = FleetResult(tenants=tuple(t.name for t in tenants))
+
+    def skip_round(t: _Tenant, rnd: int) -> None:
+        t.result.skipped_rounds += 1
+        registry.counter(
+            "fleet_rounds_skipped_total",
+            "tenant rounds frozen by that tenant's open breaker (or a "
+            "dark backend) — counted, never silently lost",
+            labelnames=("tenant",),
+        ).labels(tenant=t.name).inc()
+        if logger is not None:
+            logger.info(
+                "fleet_round_skipped",
+                tenant=t.name,
+                round=rnd,
+                breaker=t.breaker.state,
+                consecutive_failures=t.breaker.consecutive_failures,
+            )
+        if ops is not None:
+            # counted on the plane too: /healthz skip totals move, and
+            # mark_round keeps a skip-heavy stretch from reading as a
+            # stale loop (the solo loop's observe_skip contract)
+            ops.observe_skip(rnd, breaker_state=t.breaker.state)
+        t.boundary.advance(config.sleep_after_action_s)
+
+    def _run_rounds() -> None:
+        for rnd in range(1, config.max_rounds + 1):
+            active: list[int] = []
+            for i, t in enumerate(tenants):
+                mode = t.boundary.begin_round(rnd)
+                if mode == OPEN:
+                    skip_round(t, rnd)
+                    continue
+                if mode == HALF_OPEN or t.state is None:
+                    # half-open probe, or a tenant that has never produced a
+                    # snapshot: one monitor decides whether this round runs
+                    probe = t.boundary.monitor()
+                    if probe is None:
+                        skip_round(t, rnd)
+                        continue
+                    t.state = probe
+                active.append(i)
+            if not active:
+                # the whole fleet skipped — nothing to dispatch this round
+                if ops is not None:
+                    ops.health.fleet = {t.name: t.health_row() for t in tenants}
+                continue
+
+            # ONE batched solve for every tenant slot: inactive slots carry a
+            # placeholder snapshot (shapes must stay static — 1 trace) and
+            # are masked so they can never emit a move
+            filler = tenants[active[0]].state
+            stacked_states = stack_tenants(
+                [t.state if t.state is not None else filler for t in tenants]
+            )
+            mask = np.zeros((T,), dtype=bool)
+            mask[active] = True
+            keys = _round_keys(stacked_keys, jnp.asarray(rnd))
+            t0 = time.perf_counter()
+            with span("fleet/solve", round=rnd, tenants=len(active)):
+                decisions_dev, hazard_dev = jax.block_until_ready(
+                    solve_fn(
+                        stacked_states, stacked_graphs, pid, thr, keys,
+                        jnp.asarray(mask),
+                    )
+                )
+            solve_s = time.perf_counter() - t0
+            result.batched_solves += 1
+            result.device_solve_s += solve_s
+            # the whole fleet's decisions in two counted transfers
+            decisions = pull(decisions_dev, site="fleet_decision")
+            hazard = pull(hazard_dev, site="fleet_hazard")
+            # the shared dispatch's cost, attributed evenly to the tenants
+            # that used it — the amortization IS the fleet-mode story
+            per_tenant_s = solve_s / len(active)
+
+            records: dict[int, RoundRecord] = {}
+            for i in active:
+                t = tenants[i]
+                most_i = int(decisions[i, ROW_MOST])
+                victim_i = int(decisions[i, ROW_VICTIM])
+                svc_i = int(decisions[i, ROW_SERVICE])
+                target_i = int(decisions[i, ROW_TARGET])
+                state = t.state
+                service_name = t.graph.names[svc_i] if victim_i >= 0 else None
+                moved_name: str | None = None
+                landed: str | None = None
+                first_hazard = (
+                    state.node_names[most_i] if most_i >= 0 else None
+                )
+                if most_i >= 0 and victim_i >= 0 and target_i >= 0:
+                    hazard_names = tuple(
+                        state.node_names[j]
+                        for j in range(state.num_nodes)
+                        if bool(hazard[i, j])
+                    )
+                    landed = t.boundary.apply_move(
+                        MoveRequest(
+                            service=service_name,
+                            target_node=state.node_names[target_i],
+                            hazard_nodes=hazard_names,
+                            mechanism=PlacementMechanism[config.algorithm],
+                        )
+                    )
+                    if landed is not None:
+                        moved_name = service_name
+                t.boundary.advance(config.sleep_after_action_s)
+                new_state = t.boundary.monitor()
+                degraded = new_state is None
+                if not degraded:
+                    t.state = new_state
+                records[i] = RoundRecord(
+                    round=rnd,
+                    moved=moved_name is not None,
+                    most_hazard=first_hazard,
+                    service=moved_name,
+                    target=landed,
+                    communication_cost=0.0,  # filled from the batched metrics
+                    load_std=0.0,
+                    services_moved=(moved_name,) if moved_name else (),
+                    decision_latencies_s=(per_tenant_s,),
+                    breaker_state=t.breaker.state,
+                    degraded=degraded,
+                    boundary_failures=t.boundary.round_failures,
+                    applied_moves=(
+                        ((moved_name, landed),) if moved_name else ()
+                    ),
+                )
+
+            # ONE batched metrics dispatch + ONE transfer closes the round's
+            # reporting for every active tenant (the solo loop pays 2 scalar
+            # pulls per tenant here)
+            filler = tenants[active[0]].state
+            stacked_after = stack_tenants(
+                [t.state if t.state is not None else filler for t in tenants]
+            )
+            metrics = pull(
+                fleet_metrics(stacked_after, stacked_graphs),
+                site="fleet_metrics",
+            )
+            for i in active:
+                t = tenants[i]
+                rec = records[i]
+                rec.communication_cost = float(metrics[i, 0])
+                rec.load_std = float(metrics[i, 1])
+                t.result.rounds.append(rec)
+                registry.counter(
+                    "fleet_rounds_total",
+                    "tenant rounds executed by the multiplexed fleet loop",
+                    labelnames=("tenant",),
+                ).labels(tenant=t.name).inc()
+                if rec.moved:
+                    registry.counter(
+                        "fleet_moves_total",
+                        "deployments moved per tenant by fleet rounds",
+                        labelnames=("tenant",),
+                    ).labels(tenant=t.name).inc()
+                if rec.degraded:
+                    registry.counter(
+                        "fleet_degraded_rounds_total",
+                        "tenant rounds finished on a stale snapshot after "
+                        "the post-move monitor failed",
+                        labelnames=("tenant",),
+                    ).labels(tenant=t.name).inc()
+                registry.gauge(
+                    "fleet_communication_cost",
+                    "per-tenant communication cost after the most recent "
+                    "fleet round",
+                    labelnames=("tenant",),
+                ).labels(tenant=t.name).set(rec.communication_cost)
+                registry.gauge(
+                    "fleet_load_std",
+                    "per-tenant node CPU-% standard deviation after the "
+                    "most recent fleet round",
+                    labelnames=("tenant",),
+                ).labels(tenant=t.name).set(rec.load_std)
+                round_event = dict(
+                    tenant=t.name,
+                    round=rnd,
+                    moved=rec.moved,
+                    service=rec.service,
+                    target=rec.target,
+                    communication_cost=rec.communication_cost,
+                    load_std=rec.load_std,
+                    breaker=rec.breaker_state,
+                    degraded=rec.degraded,
+                    boundary_failures=rec.boundary_failures,
+                )
+                if logger is not None:
+                    logger.info("fleet_round", **round_event)
+                if ops is not None:
+                    # the solo loop's per-round plane feed, per tenant-round:
+                    # health counters + mark_round, the watchdog, and the
+                    # flight-recorder ring (so a breaker-open bundle carries
+                    # the fleet's recent rounds)
+                    ops.observe_round(
+                        rec,
+                        t.state,
+                        events=[{"event": "fleet_round", **round_event}],
+                    )
+                if on_round is not None:
+                    on_round(t.name, rec, t.state)
+            if ops is not None:
+                ops.health.fleet = {t.name: t.health_row() for t in tenants}
+
+    # the always-on crash-dump path (the solo loop's contract):
+    # whatever escapes the multiplexed loop leaves a flight-recorder
+    # bundle behind before propagating
+    try:
+        _run_rounds()
+    except BaseException as e:
+        if ops is not None:
+            ops.on_crash(e)
+        raise
+
+    for t in tenants:
+        t.result.breaker_transitions = list(t.breaker.transitions)
+        t.result.boundary_failures = t.boundary.total_failures
+        result.results[t.name] = t.result
+    return result

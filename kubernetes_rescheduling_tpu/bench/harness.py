@@ -289,6 +289,39 @@ def make_backend(
     raise ValueError(f"unknown scenario {scenario!r}")
 
 
+def make_fleet_problem(
+    tenants: int = 16,
+    n_services: int = 2000,
+    n_nodes: int = 256,
+    seed: int = 0,
+):
+    """The fleet-mode bench problem: N same-shaped power-law tenants.
+
+    Each tenant is its own mesh (per-tenant seed — the fleet seeding
+    convention of ``backends.fleet.make_fleet``) over an identical
+    cluster shape, so the stacked batch compiles once. Returns
+    ``(states, graphs)`` index-aligned lists; ``bench.py``'s fleet cell
+    stacks them with ``solver.fleet.stack_tenants`` and measures the
+    amortized per-tenant decision cost of ONE batched dispatch against
+    N sequential solo dispatches."""
+    from kubernetes_rescheduling_tpu.core.topology import state_from_workmodel
+
+    states, graphs = [], []
+    for t in range(tenants):
+        rng = np.random.default_rng(seed * 1000 + t)
+        wm = _random_workmodel(n_services, rng, powerlaw=True, mean_degree=4.0)
+        graphs.append(wm.comm_graph())
+        states.append(
+            state_from_workmodel(
+                wm,
+                node_names=[f"w{i:03d}" for i in range(n_nodes)],
+                node_cpu_cap_m=2_000.0,
+                seed=seed * 1000 + t,
+            )
+        )
+    return states, graphs
+
+
 def make_experiment_backend(cfg: ExperimentConfig, seed: int, **k8s_apis):
     """Backend for one matrix cell: the hermetic simulator, or the live
     cluster adapter when ``cfg.backend == "k8s"`` (the reference's pipeline

@@ -160,6 +160,18 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["service", "pod"],
                    help="pod = every replica places independently (global "
                         "algorithm, sim backend)")
+    r.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="fleet mode: run N same-shaped tenants of the "
+                        "scenario under the multiplexed controller — one "
+                        "boundary + breaker per tenant, ONE batched device "
+                        "solve per round (sim backend, greedy algorithms)")
+    r.add_argument("--fleet-plane", default="vmap", choices=["vmap", "dp"],
+                   help="device batching for --fleet: 'vmap' (leading "
+                        "tenant axis, one program) or 'dp' (one tenant per "
+                        "device over the mesh)")
+    r.add_argument("--fleet-chaos-tenants", default="", metavar="I,J,...",
+                   help="tenant indices the --chaos-profile wraps (empty = "
+                        "all tenants) — the per-tenant fault-isolation knob")
     r.add_argument("--perf-ledger", default=None, metavar="PATH",
                    help="append this run's decisions/sec to the perf ledger "
                         "at PATH and judge it with the [perf] block's "
@@ -438,6 +450,113 @@ def _reschedule_perf(args, cfg, result, ops, algo) -> dict | None:
     return {k: v["status"] for k, v in sorted(verdicts.items())}
 
 
+def _parse_tenant_list(raw: str) -> tuple[int, ...]:
+    try:
+        return tuple(int(x) for x in raw.split(",") if x.strip())
+    except ValueError:
+        raise SystemExit(
+            f"--fleet-chaos-tenants must be comma-separated ints, got {raw!r}"
+        ) from None
+
+
+def cmd_fleet_reschedule(args, algo: str) -> dict:
+    """The ``reschedule --fleet N`` path: N tenants of the scenario under
+    the multiplexed controller, reporting per-tenant round streams plus
+    the amortized batched-solve cost."""
+    import jax
+
+    from kubernetes_rescheduling_tpu.backends.fleet import make_fleet
+    from kubernetes_rescheduling_tpu.bench.fleet import run_fleet_controller
+    from kubernetes_rescheduling_tpu.config import (
+        ChaosConfig,
+        FleetConfig,
+        RescheduleConfig,
+    )
+
+    if args.backend != "sim":
+        raise SystemExit(
+            "--fleet requires the sim backend (one live cluster is one "
+            "tenant; fleet mode multiplexes hermetic tenants)"
+        )
+    if args.perf_ledger:
+        # fail loudly rather than silently dropping a documented flag —
+        # the solo path's decisions/sec series has no fleet consumer yet
+        raise SystemExit(
+            "--perf-ledger is not supported with --fleet yet (the fleet "
+            "headline rides the BENCH_SCENARIO=fleet cell's ledger "
+            "append instead)"
+        )
+    # every solver-shaping flag flows into the config so the fleet
+    # validation actually sees it: --fleet with --moves-per-round 3 or
+    # --placement-unit pod must REJECT, not silently run something else
+    cfg = RescheduleConfig(
+        algorithm=algo,
+        max_rounds=args.rounds,
+        hazard_threshold_pct=args.threshold,
+        sleep_after_action_s=0.0,
+        moves_per_round=args.moves_per_round,
+        global_moves_cap=args.global_moves_cap,
+        balance_weight=args.balance_weight,
+        move_cost=args.move_cost,
+        solver_backend=args.solver_backend,
+        placement_unit=args.placement_unit,
+        solver_restarts=args.restarts,
+        solver_tp=args.tp,
+        seed=args.seed,
+        chaos=ChaosConfig(profile=args.chaos_profile, seed=args.chaos_seed),
+        max_consecutive_failures=args.max_consecutive_failures,
+        fleet=FleetConfig(
+            tenants=args.fleet,
+            plane=args.fleet_plane,
+            chaos_tenants=_parse_tenant_list(args.fleet_chaos_tenants),
+        ),
+    )
+    try:
+        cfg.validate()
+    except ValueError as e:
+        # a clean CLI exit before any tenant backends are built
+        raise SystemExit(f"--fleet: {e}") from None
+    fleet = make_fleet(
+        args.scenario, args.fleet, seed=args.seed,
+        workmodel_path=args.workmodel,
+    )
+    if args.imbalance:
+        fleet.inject_imbalance()
+    ops, logger = _build_ops_plane(args, cfg)
+    try:
+        result = run_fleet_controller(
+            fleet, cfg, key=jax.random.PRNGKey(args.seed),
+            logger=logger, ops=ops,
+        )
+    finally:
+        if ops is not None:
+            ops.close()
+    return {
+        "algorithm": algo,
+        "fleet": {"tenants": args.fleet, "plane": args.fleet_plane},
+        "batched_solves": result.batched_solves,
+        "amortized_solve_ms_per_tenant_round": round(
+            result.amortized_solve_ms_per_tenant_round, 4
+        ),
+        "per_tenant": {
+            name: {
+                "rounds": len(r.rounds),
+                "skipped_rounds": r.skipped_rounds,
+                "degraded_rounds": r.degraded_rounds,
+                "moves": r.moves,
+                "boundary_failures": r.boundary_failures,
+                "final_communication_cost": (
+                    r.rounds[-1].communication_cost if r.rounds else None
+                ),
+                "final_load_std": (
+                    r.rounds[-1].load_std if r.rounds else None
+                ),
+            }
+            for name, r in result.results.items()
+        },
+    }
+
+
 def cmd_reschedule(args) -> dict:
     import jax
 
@@ -450,6 +569,8 @@ def cmd_reschedule(args) -> dict:
     )
 
     algo = _norm_algo(args.algorithm)
+    if args.fleet:
+        return cmd_fleet_reschedule(args, algo)
     if args.backend == "k8s" and args.placement_unit == "pod":
         # fail before any cluster work: K8sBackend rejects per-pod moves
         # (the Deployment mechanism cannot pin one replica), so the run

@@ -46,6 +46,44 @@ class ChaosConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Fleet-mode block (``[fleet]`` in TOML): N same-shaped tenants
+    solved by ONE batched device program per round under the multiplexed
+    controller (``bench.fleet``). jax-free, like the other blocks, so
+    config import stays light.
+
+    ``tenants == 0`` means fleet mode is off (the historical
+    one-backend-one-loop controller). ``plane`` selects the device
+    batching: ``"vmap"`` (one program, leading tenant axis —
+    ``solver.fleet``) or ``"dp"`` (one tenant per device over the mesh's
+    dp axis — ``parallel.fleet``). ``chaos_tenants`` wraps ONLY those
+    tenant indices in the run's chaos profile — the per-tenant fault
+    domain the isolation tests pin."""
+
+    tenants: int = 0
+    plane: str = "vmap"                  # "vmap" | "dp"
+    chaos_tenants: tuple[int, ...] = ()  # tenant indices the chaos profile hits
+
+    def validate(self) -> "FleetConfig":
+        if self.tenants < 0:
+            raise ValueError(f"fleet tenants must be >= 0, got {self.tenants}")
+        if self.plane not in ("vmap", "dp"):
+            raise ValueError(
+                f"fleet plane must be 'vmap' or 'dp', got {self.plane!r}"
+            )
+        for t in self.chaos_tenants:
+            if not (isinstance(t, int) and t >= 0):
+                raise ValueError(
+                    f"chaos_tenants must be non-negative ints, got {t!r}"
+                )
+            if self.tenants and t >= self.tenants:
+                raise ValueError(
+                    f"chaos tenant {t} out of range for {self.tenants} tenants"
+                )
+        return self
+
+
+@dataclass(frozen=True)
 class ObsConfig:
     """Live ops plane block: the in-process HTTP endpoint
     (``telemetry.server``), decision explainability, the flight recorder,
@@ -200,6 +238,11 @@ class RescheduleConfig:
     breaker_cooldown_rounds: int = 2
     failure_budget_per_round: int = 0
 
+    # Fleet mode: N tenants multiplexed over one device plane — see
+    # FleetConfig. With tenants > 0 the `chaos` block above applies only
+    # to the tenant indices in fleet.chaos_tenants.
+    fleet: FleetConfig = field(default_factory=FleetConfig)
+
     # Observability: the live ops plane (HTTP endpoint, decision
     # explainability, flight recorder, SLO watchdog) — see ObsConfig.
     obs: ObsConfig = field(default_factory=ObsConfig)
@@ -250,6 +293,22 @@ class RescheduleConfig:
         self.retry.validate()
         self.obs.validate()
         self.perf.validate()
+        self.fleet.validate()
+        if self.fleet.tenants > 0:
+            # the batched fleet kernel is the GREEDY decision vmapped over
+            # tenants; the global/pod solvers keep the solo loop (their
+            # fleet story is the dp plane's one-solve-per-device future)
+            if self.algorithm == "global" or self.moves_per_round != 1:
+                raise ValueError(
+                    "fleet mode batches the greedy decision kernel: it "
+                    "requires a greedy algorithm with moves_per_round=1 "
+                    f"(got algorithm={self.algorithm!r}, "
+                    f"moves_per_round={self.moves_per_round!r})"
+                )
+            if self.placement_unit != "service":
+                raise ValueError(
+                    "fleet mode requires placement_unit='service'"
+                )
         if self.max_consecutive_failures < 0:
             raise ValueError("max_consecutive_failures must be >= 0")
         if self.breaker_cooldown_rounds < 1:
@@ -270,6 +329,11 @@ class RescheduleConfig:
             data["retry"] = RetryPolicy(**data["retry"])
         if isinstance(data.get("chaos"), dict):
             data["chaos"] = ChaosConfig(**data["chaos"])
+        if isinstance(data.get("fleet"), dict):
+            fl = dict(data["fleet"])
+            if isinstance(fl.get("chaos_tenants"), list):
+                fl["chaos_tenants"] = tuple(fl["chaos_tenants"])
+            data["fleet"] = FleetConfig(**fl)
         if isinstance(data.get("obs"), dict):
             data["obs"] = ObsConfig(**data["obs"])
         if isinstance(data.get("perf"), dict):

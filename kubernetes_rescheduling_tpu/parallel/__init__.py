@@ -17,6 +17,9 @@ TPU-natively, the solver's collectives ride ICI via XLA:
   current-score/slack contributions — O(C) scalars over ICI per step.
 - ``sharded_solve_with_restarts`` — dp restarts *of* tp-sharded solves:
   the two axes composed on one mesh, best-of-N selected on device.
+- ``fleet_solve_dp`` — fleet mode's dp plane: the multi-tenant decision
+  batch (``solver.fleet``) with the tenant axis sharded one-per-device
+  over ``dp``, via the same cached shard_map pattern as the restarts.
 """
 
 from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh
@@ -32,6 +35,7 @@ from kubernetes_rescheduling_tpu.parallel.sharded_solver import (
 from kubernetes_rescheduling_tpu.parallel.sharded_sparse import (
     sharded_sparse_assign,
 )
+from kubernetes_rescheduling_tpu.parallel.fleet import fleet_solve_dp
 
 __all__ = [
     "make_mesh",
@@ -41,4 +45,5 @@ __all__ = [
     "sharded_sparse_assign",
     "sharded_solve_with_restarts",
     "solve_with_restarts",
+    "fleet_solve_dp",
 ]

@@ -1,0 +1,95 @@
+"""Fleet mode, dp-mesh plane: one tenant per device.
+
+The vmap plane (``solver.fleet``) batches tenants into one program on
+one device — the right shape when the per-tenant kernel is small and
+fixed cost dominates. On a multi-chip mesh the same tenant axis can
+instead shard over ``dp``, exactly the way the sharded-restart machinery
+(``parallel.sharded._run_shard``) shards independent solves: each dp
+slice owns a contiguous block of tenants and runs the SAME vmapped
+decision kernel over its block, so the two planes are decision-identical
+by construction (the shard body IS ``solver.fleet._fleet_decide`` —
+parity is structural, and test-pinned).
+
+Like ``_run_shard``, the jitted shard_map is cached per mesh so the
+multiplexed controller's per-round dispatch hits the compile cache, and
+instrumented (``fn="fleet_solve_dp"``) under the usual 1-trace
+steady-state invariant.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubernetes_rescheduling_tpu.parallel.compat import shard_map
+from kubernetes_rescheduling_tpu.solver.fleet import _fleet_decide
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
+
+# jitted shard-mapped fleet kernels keyed by mesh — the dp twin of
+# parallel.sharded._RUN_SHARD_CACHE (same reuse rationale: the
+# controller re-dispatches every round and must not retrace a fresh
+# closure each time)
+_FLEET_SHARD_CACHE: dict = {}
+
+
+def _fleet_shard(mesh: Mesh):
+    fn = _FLEET_SHARD_CACHE.get(mesh)
+    if fn is None:
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P("dp"), P("dp"), P(), P(), P("dp"), P("dp")),
+            out_specs=(P("dp"), P("dp")),
+            check_vma=False,
+        )
+        def run_shard(states, graphs, policy_id, threshold, keys, mask):
+            # each shard's tenant block runs the SAME batched kernel the
+            # vmap plane runs over the whole fleet — no collectives: the
+            # tenants are independent clusters
+            return _fleet_decide(
+                states, graphs, policy_id, threshold, keys, mask
+            )
+
+        fn = instrument_jit(run_shard, name="fleet_solve_dp")
+        _FLEET_SHARD_CACHE[mesh] = fn
+    return fn
+
+
+def fleet_solve_dp(
+    states,
+    graphs,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    keys: jax.Array,
+    tenant_mask: jax.Array,
+    *,
+    mesh: Mesh | None = None,
+):
+    """:func:`solver.fleet.fleet_solve` with the tenant axis sharded over
+    the mesh's ``dp`` dimension — one (block of) tenant(s) per device.
+
+    ``states``/``graphs`` are the stacked tenant pytrees
+    (:func:`solver.fleet.stack_tenants`); the tenant count must divide by
+    the mesh's dp extent. With no mesh given one is auto-shaped over the
+    largest dp that divides the tenant count — on a single chip that
+    degenerates to the vmap plane's single-device program, so the same
+    call works from laptop CPU to a pod slice.
+    """
+    t = int(tenant_mask.shape[0])
+    if mesh is None:
+        from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh
+        from kubernetes_rescheduling_tpu.parallel.sharded import (
+            _largest_divisor,
+        )
+
+        dp = _largest_divisor(t, len(jax.devices()))
+        mesh = make_mesh(dp, shape=(dp, 1))
+    dp = mesh.shape["dp"]
+    if t % dp:
+        raise ValueError(f"tenant count {t} must be a multiple of dp={dp}")
+    return _fleet_shard(mesh)(
+        states, graphs, policy_id, threshold, keys, tenant_mask
+    )

@@ -21,6 +21,11 @@ from kubernetes_rescheduling_tpu.solver.sparse_solver import (
     global_assign_sparse,
     sparse_pod_comm_cost,
 )
+from kubernetes_rescheduling_tpu.solver.fleet import (
+    fleet_metrics,
+    fleet_solve,
+    stack_tenants,
+)
 
 __all__ = [
     "RoundTelemetry",
@@ -30,4 +35,7 @@ __all__ = [
     "global_assign",
     "global_assign_sparse",
     "sparse_pod_comm_cost",
+    "fleet_metrics",
+    "fleet_solve",
+    "stack_tenants",
 ]

@@ -1,0 +1,145 @@
+"""Fleet mode, device half: vmap-batched multi-tenant decision solving.
+
+RESULTS.md's round-5 conclusion is that per-solve FIXED cost and op
+dispatch — not kernel launches — dominate at every scale. A scheduling
+*service* (ROADMAP north star) therefore wants a leading ``tenant``
+dimension: N same-shaped clusters solved by ONE device program per
+round, so the fixed cost amortizes across the fleet instead of being
+paid N times by a sequential loop.
+
+This module is that batch axis:
+
+- :func:`stack_tenants` — stack N same-shaped tenant pytrees
+  (``ClusterState`` + ``CommGraph``) along a new leading tenant axis.
+  Tenants must already be padded to a common capacity (``ClusterState.
+  build(node_capacity=..., pod_capacity=...)``); mismatched shapes raise
+  a sizing error, never a silent broadcast.
+- :func:`fleet_solve` — ``vmap`` of the per-round decision kernel
+  (:func:`solver.round_loop.decide`) over the tenant axis, under ONE
+  ``instrument_jit`` (``fn="fleet_solve"``, the usual 1-trace
+  steady-state invariant). Decisions are BIT-EXACT with the solo kernel
+  per tenant under the same keys (test-pinned, including the
+  threefry-partitionable ``random`` policy) — fleet mode changes the
+  dispatch shape, never the answer.
+- :func:`fleet_metrics` — the per-round reporting pair
+  (``communication_cost``, ``load_std``) batched the same way, so the
+  multiplexed controller's round epilogue is one transfer for the whole
+  fleet instead of 2·N scalar pulls.
+
+Padded tenant slots (``tenant_mask`` False — a fleet below its
+configured capacity, or a tenant whose breaker froze the round) never
+emit moves: their ``most``/``victim``/``target`` come back -1 and their
+hazard mask all-False, exactly the per-tenant no-op path of the solo
+loop. The dp-mesh alternative (one tenant per device through the
+sharded-restart machinery) lives in ``parallel.fleet``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.objectives.metrics import (
+    communication_cost,
+    load_std,
+)
+from kubernetes_rescheduling_tpu.solver.round_loop import decide
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
+
+
+def stack_tenants(trees):
+    """Stack N same-shaped tenant pytrees along a new leading tenant axis.
+
+    Static (non-pytree) metadata — name tuples — is taken from tenant 0:
+    it is host-side bookkeeping the device kernels never read, and fleet
+    callers index back into each tenant's OWN names with the per-tenant
+    rows of the batched result. Array shapes must match exactly across
+    tenants; a mismatch raises a sizing error naming the offending
+    tenant (pad every tenant to a common capacity first — the
+    ``node_capacity``/``pod_capacity`` knobs exist for this).
+    """
+    if not trees:
+        raise ValueError("stack_tenants needs at least one tenant")
+    leaves0, treedef = jax.tree_util.tree_flatten(trees[0])
+    cols = [leaves0]
+    for t, tree in enumerate(trees[1:], start=1):
+        leaves = jax.tree_util.tree_leaves(tree)
+        if len(leaves) != len(leaves0):
+            raise ValueError(
+                f"tenant {t} has a different pytree structure than tenant 0"
+            )
+        for a, b in zip(leaves0, leaves):
+            if jnp.shape(a) != jnp.shape(b):
+                raise ValueError(
+                    f"tenant {t} shape {jnp.shape(b)} != tenant 0 shape "
+                    f"{jnp.shape(a)}: fleet tenants must be padded to a "
+                    "common capacity (node_capacity/pod_capacity) before "
+                    "stacking"
+                )
+        cols.append(leaves)
+    stacked = [
+        jnp.stack([col[i] for col in cols]) for i in range(len(leaves0))
+    ]
+    return jax.tree_util.tree_unflatten(treedef, stacked)
+
+
+# rows of the per-tenant decision bundle (axis 1 of the i32[T, 4] the
+# batched kernel returns): the solo kernel's scalar outputs, packed so
+# the whole fleet's decisions come home in ONE counted transfer
+ROW_MOST, ROW_VICTIM, ROW_SERVICE, ROW_TARGET = range(4)
+
+
+def _fleet_decide(
+    states: ClusterState,
+    graphs: CommGraph,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    keys: jax.Array,
+    tenant_mask: jax.Array,
+):
+    """The batched decision: ``decide`` vmapped over the leading tenant
+    axis of ``states``/``graphs``/``keys``, masked so padded slots are
+    no-ops. Returns ``(decisions, hazard_mask)``: ``decisions`` is
+    i32[T, 4] — per tenant ``(most, victim, service, target)``, the solo
+    kernel's scalars packed tenant-leading (see ``ROW_*``) so the host
+    pulls the fleet's round in one transfer — and ``hazard_mask`` is
+    bool[T, N]."""
+    most, hazard_mask, victim, svc, target = jax.vmap(
+        decide, in_axes=(0, 0, None, None, 0)
+    )(states, graphs, policy_id, threshold, keys)
+    neg = jnp.int32(-1)
+    m = tenant_mask
+    decisions = jnp.stack(
+        [
+            jnp.where(m, most, neg),
+            jnp.where(m, victim, neg),
+            jnp.where(m, svc, jnp.int32(0)),
+            jnp.where(m, target, neg),
+        ],
+        axis=1,
+    )
+    return decisions, hazard_mask & m[:, None]
+
+
+# ONE device program for the whole fleet's round: the instrumented jit
+# the multiplexed controller dispatches once per round. Steady state must
+# show jax_traces_total{fn="fleet_solve"} == 1 — a second trace means a
+# tenant axis went shape-polymorphic and every round re-pays the compile
+# the batching exists to amortize (test-pinned, like controller_decide).
+fleet_solve = instrument_jit(_fleet_decide, name="fleet_solve")
+
+
+def _fleet_metrics(states: ClusterState, graphs: CommGraph):
+    """Per-tenant round metrics: f32[T, 2] — ``(communication_cost,
+    load_std)`` per tenant, tenant-leading like the decision bundle."""
+
+    def one(state, graph):
+        return jnp.stack([communication_cost(state, graph), load_std(state)])
+
+    return jax.vmap(one)(states, graphs)
+
+
+# the round epilogue's reporting pair, batched: 2 values × N tenants in
+# one dispatch + one bundled transfer (site="fleet_metrics" at the pull).
+fleet_metrics = instrument_jit(_fleet_metrics, name="fleet_metrics")

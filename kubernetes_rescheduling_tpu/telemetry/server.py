@@ -75,6 +75,11 @@ class HealthState:
         # unhealthiness itself flows through the watchdog's
         # perf_regression rule; this is the human-readable "what & why"
         self.perf: dict | None = None
+        # fleet mode: per-tenant health rows (bench.fleet updates this
+        # each round). A single tenant's open breaker is DEGRADED fleet
+        # service, not a dead plane — it shows here without flipping the
+        # endpoint to 503 (per-tenant isolation extends to the probe).
+        self.fleet: dict[str, dict] | None = None
 
     def mark_round(self) -> None:
         """Stamp 'a round just finished' on both clocks."""
@@ -113,6 +118,7 @@ class HealthState:
                 "uptime_s": time.monotonic() - self._started_mono,
                 "slo": slo,
                 "perf": self.perf,
+                **({"fleet": self.fleet} if self.fleet is not None else {}),
             },
             healthy,
         )

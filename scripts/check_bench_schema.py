@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Static check: the checked-in bench history parses as the ledger expects.
+
+``telemetry.perf_ledger.ingest_bench_file`` turns ``BENCH_r*.json`` /
+``MULTICHIP_r*.json`` driver snapshots into perf-ledger series — but it
+is deliberately lenient (a malformed file yields NO records rather than
+an error), so a drifted record shape would silently drop history from
+the regression detector instead of failing loudly. This checker is the
+loud half: every checked-in snapshot must carry the record keys the
+ledger keys its series by.
+
+Schema enforced per ``BENCH_r*.json``:
+
+- top level: ``n`` (int), ``cmd`` (str), ``rc`` (int), ``tail`` (str),
+  ``parsed`` (dict — the headline record);
+- ``parsed``: ``metric`` (non-empty str), ``value`` (finite number),
+  ``unit`` (str), ``extra`` (dict); ``vs_baseline``, when present, a
+  finite number.
+
+Per ``MULTICHIP_r*.json``: ``n_devices`` (int), ``ok`` (bool), ``rc``
+(int).
+
+Usage:
+    python scripts/check_bench_schema.py [FILE.json ...]
+
+With no arguments it checks every ``BENCH_r*.json`` and
+``MULTICHIP_r*.json`` in the repo root — the self-check its test twin
+(tests/test_bench_schema.py) runs, alongside pinned corruption classes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _is_finite_number(x) -> bool:
+    return (
+        isinstance(x, (int, float))
+        and not isinstance(x, bool)
+        and math.isfinite(x)
+    )
+
+
+def check_parsed(parsed, where: str) -> list[str]:
+    """Violations in one headline record (the ``parsed`` block — also
+    the shape ``bench.py`` prints and ``_ledger_append`` consumes)."""
+    out: list[str] = []
+    if not isinstance(parsed, dict):
+        return [f"{where}: parsed block is {type(parsed).__name__}, not a dict"]
+    metric = parsed.get("metric")
+    if not (isinstance(metric, str) and metric):
+        out.append(f"{where}: parsed.metric must be a non-empty string")
+    if not _is_finite_number(parsed.get("value")):
+        out.append(f"{where}: parsed.value must be a finite number")
+    if not isinstance(parsed.get("unit"), str):
+        out.append(f"{where}: parsed.unit must be a string")
+    if not isinstance(parsed.get("extra"), dict):
+        out.append(f"{where}: parsed.extra must be a dict")
+    if "vs_baseline" in parsed and not _is_finite_number(
+        parsed["vs_baseline"]
+    ):
+        out.append(f"{where}: parsed.vs_baseline must be a finite number")
+    return out
+
+
+def check_file(path: str | Path) -> list[str]:
+    """Violations in one driver snapshot file (empty = clean)."""
+    p = Path(path)
+    try:
+        doc = json.loads(p.read_text())
+    except OSError as e:
+        return [f"{p.name}: unreadable ({e})"]
+    except json.JSONDecodeError as e:
+        return [f"{p.name}: invalid JSON ({e})"]
+    if not isinstance(doc, dict):
+        return [f"{p.name}: top level is {type(doc).__name__}, not a dict"]
+    out: list[str] = []
+    if p.name.startswith("MULTICHIP"):
+        if not isinstance(doc.get("n_devices"), int):
+            out.append(f"{p.name}: n_devices must be an int")
+        if not isinstance(doc.get("ok"), bool):
+            out.append(f"{p.name}: ok must be a bool")
+        if not isinstance(doc.get("rc"), int):
+            out.append(f"{p.name}: rc must be an int")
+        return out
+    for key, typ in (("n", int), ("cmd", str), ("rc", int), ("tail", str)):
+        if not isinstance(doc.get(key), typ):
+            out.append(f"{p.name}: {key} must be {typ.__name__}")
+    if "parsed" not in doc:
+        out.append(
+            f"{p.name}: no parsed headline block — the ledger would "
+            "silently drop this snapshot"
+        )
+    else:
+        out.extend(check_parsed(doc["parsed"], p.name))
+    return out
+
+
+def violations(paths=None) -> list[str]:
+    if paths is None:
+        paths = sorted(ROOT.glob("BENCH_r*.json")) + sorted(
+            ROOT.glob("MULTICHIP_r*.json")
+        )
+        if not paths:
+            return ["no BENCH_r*.json / MULTICHIP_r*.json found in repo root"]
+    out: list[str] = []
+    for p in paths:
+        out.extend(check_file(p))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    bad = violations(argv or None)
+    if bad:
+        sys.stderr.write(
+            "bench history schema drift — ledger ingestion would silently "
+            "lose these records:\n" + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -7,10 +7,12 @@ one raw ``backend.monitor()`` re-introduces the reference's
 crash-on-flaky-cluster behavior the resilience layer exists to remove.
 
 AST-based, like its sibling ``check_no_print.py``: inside
-``bench/controller.py``, a ``.monitor(...)`` or ``.apply_move(...)`` call
-is only legal on a receiver NAMED ``boundary`` (the BoundaryClient the
-loop builds). The boundary module itself is the one place allowed to
-touch ``self.backend.<call>``.
+``bench/controller.py`` and the multiplexed fleet loop
+``bench/fleet.py``, a ``.monitor(...)`` or ``.apply_move(...)`` call is
+only legal on a receiver NAMED ``boundary`` — the bare name the solo
+loop builds, or a ``<tenant>.boundary`` attribute (the fleet loop's
+per-tenant BoundaryClient). The boundary module itself is the one place
+allowed to touch ``self.backend.<call>``.
 
 Run directly (exit 1 on violation) or through its test twin
 (tests/test_boundary_retry.py).
@@ -23,12 +25,24 @@ import sys
 from pathlib import Path
 
 PACKAGE = Path(__file__).resolve().parent.parent / "kubernetes_rescheduling_tpu"
-# the control loop: the one consumer of the Backend protocol that must be
-# resilient. (harness/CLI measurement phases deliberately stay raw — a
-# broken ruler should fail loudly, not retry.)
-CHECKED = PACKAGE / "bench" / "controller.py"
+# the control loops: the consumers of the Backend protocol that must be
+# resilient — the solo loop and the multiplexed fleet loop. (harness/CLI
+# measurement phases deliberately stay raw — a broken ruler should fail
+# loudly, not retry.)
+CHECKED = (
+    PACKAGE / "bench" / "controller.py",
+    PACKAGE / "bench" / "fleet.py",
+)
 BOUNDARY_CALLS = {"monitor", "apply_move"}
 ALLOWED_RECEIVERS = {"boundary"}
+
+
+def _is_boundary_receiver(recv: ast.expr) -> bool:
+    """``boundary.<call>`` (the solo loop's local) or ``<x>.boundary.<call>``
+    (the fleet loop's per-tenant BoundaryClient attribute)."""
+    if isinstance(recv, ast.Name) and recv.id in ALLOWED_RECEIVERS:
+        return True
+    return isinstance(recv, ast.Attribute) and recv.attr in ALLOWED_RECEIVERS
 
 
 def find_raw_boundary_calls(path: Path) -> list[tuple[int, str]]:
@@ -42,9 +56,9 @@ def find_raw_boundary_calls(path: Path) -> list[tuple[int, str]]:
             and node.func.attr in BOUNDARY_CALLS
         ):
             continue
-        recv = node.func.value
-        if isinstance(recv, ast.Name) and recv.id in ALLOWED_RECEIVERS:
+        if _is_boundary_receiver(node.func.value):
             continue
+        recv = node.func.value
         recv_txt = ast.unparse(recv) if hasattr(ast, "unparse") else "<recv>"
         out.append((node.lineno, f"{recv_txt}.{node.func.attr}(...)"))
     return out
@@ -52,8 +66,9 @@ def find_raw_boundary_calls(path: Path) -> list[tuple[int, str]]:
 
 def violations() -> list[str]:
     return [
-        f"{CHECKED.relative_to(PACKAGE.parent)}:{line}: {what}"
-        for line, what in find_raw_boundary_calls(CHECKED)
+        f"{path.relative_to(PACKAGE.parent)}:{line}: {what}"
+        for path in CHECKED
+        for line, what in find_raw_boundary_calls(path)
     ]
 
 

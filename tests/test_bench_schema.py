@@ -1,0 +1,112 @@
+"""CI twin of ``scripts/check_bench_schema.py``: the checked-in driver
+snapshots (``BENCH_r*.json`` / ``MULTICHIP_r*.json``) carry the record
+keys perf-ledger ingestion series on — and the checker actually catches
+each corruption class that would otherwise be dropped silently
+(``ingest_bench_file`` is lenient by design; this is the loud half)."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+
+def _load_checker():
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_bench_schema.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_bench_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_bench_schema", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+GOOD = {
+    "n": 6,
+    "cmd": "python bench.py",
+    "rc": 0,
+    "tail": "{...}",
+    "parsed": {
+        "metric": "device_round_ms_large",
+        "value": 26.776,
+        "unit": "ms",
+        "vs_baseline": 3.735,
+        "extra": {"scenario": "large"},
+    },
+}
+
+
+def test_checked_in_history_is_clean():
+    checker = _load_checker()
+    assert checker.violations() == []
+
+
+def test_good_record_passes(tmp_path):
+    checker = _load_checker()
+    f = tmp_path / "BENCH_r99.json"
+    f.write_text(json.dumps(GOOD))
+    assert checker.check_file(f) == []
+
+
+def test_corruption_classes_are_caught(tmp_path):
+    """Five pinned corruption classes, each of which ingest_bench_file
+    would swallow into zero records (or a broken series) without a word."""
+    checker = _load_checker()
+
+    def corrupt(name, mutate):
+        doc = json.loads(json.dumps(GOOD))
+        mutate(doc)
+        f = tmp_path / name
+        f.write_text(json.dumps(doc))
+        return checker.check_file(f)
+
+    # 1. no parsed block at all — the whole snapshot vanishes from history
+    bad = corrupt("BENCH_r90.json", lambda d: d.pop("parsed"))
+    assert any("no parsed headline" in v for v in bad)
+    # 2. non-finite value — would poison the detector's baseline math
+    bad = corrupt(
+        "BENCH_r91.json", lambda d: d["parsed"].__setitem__("value", "fast")
+    )
+    assert any("finite number" in v for v in bad)
+    # 3. missing metric name — the series key collapses
+    bad = corrupt(
+        "BENCH_r92.json", lambda d: d["parsed"].__setitem__("metric", "")
+    )
+    assert any("metric" in v for v in bad)
+    # 4. extra not a dict — scenario/device attribution is lost
+    bad = corrupt(
+        "BENCH_r93.json", lambda d: d["parsed"].__setitem__("extra", [1])
+    )
+    assert any("extra" in v for v in bad)
+    # 5. invalid JSON — unreadable snapshot
+    f = tmp_path / "BENCH_r94.json"
+    f.write_text("{not json")
+    assert any("invalid JSON" in v for v in checker.check_file(f))
+
+
+def test_multichip_shape(tmp_path):
+    checker = _load_checker()
+    ok = tmp_path / "MULTICHIP_r99.json"
+    ok.write_text(json.dumps({"n_devices": 4, "ok": True, "rc": 0}))
+    assert checker.check_file(ok) == []
+    bad = tmp_path / "MULTICHIP_r98.json"
+    bad.write_text(json.dumps({"n_devices": "four", "ok": 1, "rc": None}))
+    out = checker.check_file(bad)
+    assert len(out) == 3
+
+
+def test_fleet_headline_conforms():
+    """The new fleet cell's result dict (bench.bench_fleet's shape)
+    satisfies the same parsed-record schema the history is held to —
+    schema and producer cannot drift apart silently."""
+    checker = _load_checker()
+    fleet_like = {
+        "metric": "device_round_ms_fleet_per_tenant",
+        "value": 0.42,
+        "unit": "ms",
+        "vs_baseline": 238.0,
+        "extra": {"scenario": "fleet", "tenants": 16, "vs_solo": 8.5},
+    }
+    assert checker.check_parsed(fleet_like, "fleet") == []

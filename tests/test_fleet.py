@@ -1,0 +1,515 @@
+"""Fleet mode: vmap-batched multi-tenant solving + the multiplexed
+controller loop.
+
+The invariants pinned here are the fleet-mode contract:
+
+- the batched kernel's decisions are BIT-EXACT with the solo decision
+  kernel per tenant under shared fold_in seeds (fleet mode changes the
+  dispatch shape, never the answer) — on both device planes (vmap and
+  the dp shard_map);
+- a padded/masked tenant slot never emits a move;
+- the batched kernel runs steady state from exactly ONE trace;
+- the multiplexed loop keeps per-tenant accounting
+  (``max_rounds == records + skipped`` per tenant) and per-tenant
+  failure domains: a seeded chaos soak on one tenant leaves every other
+  tenant's executed-round counts and comm-cost trajectories identical
+  to a no-chaos run;
+- solver caches on the boundary are tenant-aware (no cross-pollination,
+  no per-round rebuild when tenants alternate over one backend).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends.fleet import FleetBackend, make_fleet
+from kubernetes_rescheduling_tpu.bench.boundary import BoundaryClient
+from kubernetes_rescheduling_tpu.bench.controller import run_controller
+from kubernetes_rescheduling_tpu.bench.fleet import run_fleet_controller
+from kubernetes_rescheduling_tpu.config import (
+    ChaosConfig,
+    FleetConfig,
+    RescheduleConfig,
+)
+from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.solver.fleet import (
+    ROW_MOST,
+    ROW_SERVICE,
+    ROW_TARGET,
+    ROW_VICTIM,
+    fleet_metrics,
+    fleet_solve,
+    stack_tenants,
+)
+from kubernetes_rescheduling_tpu.solver.round_loop import decide
+from kubernetes_rescheduling_tpu.telemetry import (
+    MetricsRegistry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.utils.retry import RetryPolicy
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+def _mubench_fleet(n=3, seed=0):
+    fleet = make_fleet("mubench", n, seed=seed)
+    fleet.inject_imbalance()
+    return fleet
+
+
+def _stacked(fleet):
+    states = [b.monitor() for b in fleet.backends]
+    graphs = [b.comm_graph() for b in fleet.backends]
+    return states, graphs, stack_tenants(states), stack_tenants(graphs)
+
+
+def _keys(n, seed=0):
+    return jnp.stack(
+        [jax.random.fold_in(jax.random.PRNGKey(seed), t) for t in range(n)]
+    )
+
+
+# ---------------- batched kernel ----------------
+
+
+@pytest.mark.parametrize("policy", ["communication", "spread", "random"])
+def test_fleet_solve_bit_exact_vs_solo(policy):
+    """vmap-fleet vs N-solo decision parity, bit-exact under shared
+    fold_in seeds — including the PRNG-backed random policy (threefry
+    partitionable makes the batched draw equal the solo draw)."""
+    fleet = _mubench_fleet(3)
+    states, graphs, st, gr = _stacked(fleet)
+    pid = jnp.asarray(POLICY_IDS[policy])
+    thr = jnp.asarray(30.0)
+    keys = _keys(3)
+    mask = jnp.ones((3,), bool)
+    decisions, hazard = jax.block_until_ready(
+        fleet_solve(st, gr, pid, thr, keys, mask)
+    )
+    decisions, hazard = np.asarray(decisions), np.asarray(hazard)
+    for t in range(3):
+        most, hz, victim, svc, target = decide(
+            states[t], graphs[t], pid, thr, keys[t]
+        )
+        assert decisions[t, ROW_MOST] == int(most)
+        assert decisions[t, ROW_VICTIM] == int(victim)
+        assert decisions[t, ROW_SERVICE] == int(svc)
+        assert decisions[t, ROW_TARGET] == int(target)
+        assert np.array_equal(hazard[t], np.asarray(hz))
+
+
+def test_fleet_dp_plane_matches_vmap_plane():
+    """The dp shard_map plane (one tenant per device) returns the vmap
+    plane's outputs bit-exact — the shard body IS the vmap kernel."""
+    from kubernetes_rescheduling_tpu.parallel.fleet import fleet_solve_dp
+
+    fleet = _mubench_fleet(4)
+    _, _, st, gr = _stacked(fleet)
+    pid = jnp.asarray(POLICY_IDS["communication"])
+    thr = jnp.asarray(30.0)
+    keys = _keys(4)
+    mask = jnp.asarray(np.array([True, True, False, True]))
+    d1, h1 = jax.block_until_ready(fleet_solve(st, gr, pid, thr, keys, mask))
+    d2, h2 = jax.block_until_ready(
+        fleet_solve_dp(st, gr, pid, thr, keys, mask)
+    )
+    assert np.array_equal(np.asarray(d1), np.asarray(d2))
+    assert np.array_equal(np.asarray(h1), np.asarray(h2))
+
+
+def test_padded_tenant_slot_never_emits_moves():
+    fleet = _mubench_fleet(3)
+    _, _, st, gr = _stacked(fleet)
+    pid = jnp.asarray(POLICY_IDS["communication"])
+    mask = jnp.asarray(np.array([True, False, True]))
+    for rnd in range(1, 4):
+        decisions, hazard = fleet_solve(
+            st, gr, pid, jnp.asarray(30.0), _keys(3, seed=rnd), mask
+        )
+        row = np.asarray(decisions)[1]
+        # every scalar a no-op, every hazard masked: the padded slot can
+        # never produce a MoveRequest whatever its (filler) state says
+        assert row[ROW_MOST] == -1
+        assert row[ROW_VICTIM] == -1
+        assert row[ROW_TARGET] == -1
+        assert not np.asarray(hazard)[1].any()
+
+
+def test_fleet_solve_steady_state_single_trace(registry):
+    # a FRESH tenant count (5 — no other test in this module stacks 5
+    # mubench tenants) so a jit-cache hit from a sibling test cannot
+    # fake the exactly-one-trace assertion
+    fleet = _mubench_fleet(5)
+    _, _, st, gr = _stacked(fleet)
+    pid = jnp.asarray(POLICY_IDS["communication"])
+    mask = jnp.ones((5,), bool)
+    for rnd in range(5):
+        jax.block_until_ready(
+            fleet_solve(st, gr, pid, jnp.asarray(30.0), _keys(5, rnd), mask)
+        )
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    calls = registry.counter("jax_calls_total", labelnames=("fn",))
+    assert traces.labels(fn="fleet_solve").value == 1
+    assert calls.labels(fn="fleet_solve").value == 5
+
+
+def test_stack_tenants_rejects_mismatched_shapes():
+    fleet = _mubench_fleet(2)
+    states = [b.monitor() for b in fleet.backends]
+    small = states[1].replace(pod_node=states[1].pod_node[:-1])
+    with pytest.raises(ValueError, match="common capacity"):
+        stack_tenants([states[0], small])
+
+
+def test_fleet_metrics_matches_solo_objectives():
+    from kubernetes_rescheduling_tpu.objectives.metrics import (
+        communication_cost,
+        load_std,
+    )
+
+    fleet = _mubench_fleet(3)
+    states, graphs, st, gr = _stacked(fleet)
+    m = np.asarray(fleet_metrics(st, gr))
+    for t in range(3):
+        assert m[t, 0] == pytest.approx(
+            float(communication_cost(states[t], graphs[t])), rel=1e-6
+        )
+        assert m[t, 1] == pytest.approx(
+            float(load_std(states[t])), rel=1e-6
+        )
+
+
+# ---------------- multiplexed controller ----------------
+
+
+def test_fleet_controller_matches_n_solo_controllers():
+    """The multiplexed loop IS N solo loops on one device plane: same
+    per-tenant key derivation, same decisions, same post-round metrics
+    (the loop-level twin of the kernel parity pin above)."""
+    key = jax.random.PRNGKey(3)
+    fleet = _mubench_fleet(3, seed=1)
+    cfg = RescheduleConfig(
+        algorithm="communication",
+        max_rounds=4,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=3),
+    )
+    res = run_fleet_controller(fleet, cfg, key=key)
+    solo_fleet = _mubench_fleet(3, seed=1)
+    solo_cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=4, sleep_after_action_s=0.0
+    )
+    for t, (name, backend) in enumerate(solo_fleet):
+        solo = run_controller(
+            backend, solo_cfg, key=jax.random.fold_in(key, t)
+        )
+        frounds = res.results[name].rounds
+        assert len(solo.rounds) == len(frounds) == 4
+        for a, b in zip(solo.rounds, frounds):
+            assert (a.most_hazard, a.service, a.target, a.moved) == (
+                b.most_hazard, b.service, b.target, b.moved,
+            )
+            assert a.communication_cost == pytest.approx(
+                b.communication_cost, rel=1e-5
+            )
+            assert a.load_std == pytest.approx(b.load_std, rel=1e-5)
+
+
+def test_fleet_round_accounting_and_metrics(registry):
+    fleet = _mubench_fleet(3)
+    cfg = RescheduleConfig(
+        algorithm="communication",
+        max_rounds=3,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=3),
+    )
+    res = run_fleet_controller(
+        fleet, cfg, key=jax.random.PRNGKey(0), registry=registry
+    )
+    assert res.tenants == ("tenant0", "tenant1", "tenant2")
+    assert registry.gauge("fleet_tenants").value == 3
+    rounds_c = registry.counter("fleet_rounds_total", labelnames=("tenant",))
+    for name, r in res.results.items():
+        # per-tenant accounting: every configured round is a record or a
+        # counted skip, and the registry twin agrees
+        assert len(r.rounds) + r.skipped_rounds == 3
+        assert rounds_c.labels(tenant=name).value == len(r.rounds)
+    assert res.batched_solves == 3
+    assert res.device_solve_s > 0
+    assert res.amortized_solve_ms_per_tenant_round > 0
+
+
+def test_fleet_chaos_isolation_acceptance(registry):
+    """The acceptance pin: a seeded chaos soak on tenant 3 leaves the
+    other tenants' executed-round counts AND comm-cost trajectories
+    identical to a no-chaos run, while tenant 3 itself degrades (counted
+    skips, breaker opens) without ever stalling the fleet."""
+    key = jax.random.PRNGKey(0)
+
+    def run(chaos: bool):
+        fleet = _mubench_fleet(4)
+        cfg = RescheduleConfig(
+            algorithm="communication",
+            max_rounds=14,
+            sleep_after_action_s=0.0,
+            retry=RetryPolicy(max_attempts=1, base_delay_s=0.01),
+            max_consecutive_failures=2,
+            breaker_cooldown_rounds=2,
+            chaos=ChaosConfig(
+                profile="soak" if chaos else "none", seed=5
+            ),
+            fleet=FleetConfig(
+                tenants=4, chaos_tenants=(3,) if chaos else ()
+            ),
+        )
+        return run_fleet_controller(fleet, cfg, key=key, registry=registry)
+
+    clean = run(False)
+    chaotic = run(True)
+    for name in ("tenant0", "tenant1", "tenant2"):
+        a, b = clean.results[name], chaotic.results[name]
+        assert len(a.rounds) == len(b.rounds) == 14
+        assert a.skipped_rounds == b.skipped_rounds == 0
+        assert [r.communication_cost for r in a.rounds] == [
+            r.communication_cost for r in b.rounds
+        ]
+        assert [r.moved for r in a.rounds] == [r.moved for r in b.rounds]
+    t3 = chaotic.results["tenant3"]
+    # tenant 3 really was on fire: counted skips (open breaker), breaker
+    # transitions, absorbed failures — and still zero lost rounds
+    assert len(t3.rounds) + t3.skipped_rounds == 14
+    assert t3.skipped_rounds > 0
+    assert any(tr["to"] == "open" for tr in t3.breaker_transitions)
+    assert t3.boundary_failures > 0
+    skips = registry.counter(
+        "fleet_rounds_skipped_total", labelnames=("tenant",)
+    )
+    assert skips.labels(tenant="tenant3").value == t3.skipped_rounds
+
+
+def test_fleet_healthz_block():
+    """/healthz grows a per-tenant fleet block, and one tenant's breaker
+    state shows there without unhealthying the plane."""
+    from kubernetes_rescheduling_tpu.config import ObsConfig
+    from kubernetes_rescheduling_tpu.telemetry.server import OpsPlane
+
+    fleet = _mubench_fleet(2)
+    cfg = RescheduleConfig(
+        algorithm="communication",
+        max_rounds=2,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=2),
+    )
+    ops = OpsPlane.from_config(ObsConfig(serve_port=None)).start()
+    try:
+        run_fleet_controller(fleet, cfg, key=jax.random.PRNGKey(0), ops=ops)
+        payload, healthy = ops.health.snapshot()
+        assert healthy
+        assert set(payload["fleet"]) == {"tenant0", "tenant1"}
+        for row in payload["fleet"].values():
+            assert row["rounds"] == 2
+            assert row["breaker"] == "closed"
+        # the top-level counters see tenant-rounds (ops.observe_round
+        # fires per executed tenant-round, the solo plane contract)
+        assert payload["rounds"] == 4
+        assert payload["last_round_age_s"] is not None
+    finally:
+        ops.close()
+
+
+def test_cli_fleet_reschedule(capsys):
+    from kubernetes_rescheduling_tpu.cli import main as cli_main
+
+    rc = cli_main(
+        [
+            "reschedule", "--fleet", "2", "--rounds", "2", "--imbalance",
+            "--scenario", "mubench", "--seed", "1",
+        ]
+    )
+    assert rc == 0
+    import json
+
+    out = json.loads(capsys.readouterr().out)
+    assert out["fleet"] == {"tenants": 2, "plane": "vmap"}
+    assert set(out["per_tenant"]) == {"tenant0", "tenant1"}
+    for row in out["per_tenant"].values():
+        assert row["rounds"] + row["skipped_rounds"] == 2
+    assert out["batched_solves"] == 2
+
+
+def test_cli_fleet_rejects_k8s():
+    from kubernetes_rescheduling_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="sim backend"):
+        cli_main(["reschedule", "--fleet", "2", "--backend", "k8s"])
+
+
+def test_cli_fleet_rejects_unsupported_flags():
+    """Solver-shaping flags flow into the validated config — --fleet with
+    an incompatible combination exits cleanly instead of silently running
+    something else; --perf-ledger fails loudly rather than being a no-op."""
+    from kubernetes_rescheduling_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="greedy"):
+        cli_main(["reschedule", "--fleet", "2", "--moves-per-round", "3"])
+    with pytest.raises(SystemExit, match="greedy"):
+        cli_main(
+            ["reschedule", "--fleet", "2", "--algorithm", "global"]
+        )
+    with pytest.raises(SystemExit, match="perf-ledger"):
+        cli_main(
+            ["reschedule", "--fleet", "2", "--perf-ledger", "/tmp/x.jsonl"]
+        )
+
+
+@pytest.mark.slow  # heavy fleet variant: the amortization measurement at
+# bench-like scale; kernel/loop correctness stays pinned fast by
+# test_fleet_solve_bit_exact_vs_solo and the controller parity cases above
+def test_fleet_bench_scale_amortization():
+    """A shrunk fleet headline cell (8 tenants × 500 svc × 64 nodes): the
+    batched dispatch runs from ONE trace across rounds and its decisions
+    stay bit-exact with the solo kernel at bench-like scale."""
+    from kubernetes_rescheduling_tpu.bench.harness import make_fleet_problem
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        states, graphs = make_fleet_problem(
+            tenants=8, n_services=500, n_nodes=64
+        )
+        st, gr = stack_tenants(states), stack_tenants(graphs)
+        pid = jnp.asarray(POLICY_IDS["communication"])
+        mask = jnp.ones((8,), bool)
+        for rnd in range(3):
+            decisions, _ = jax.block_until_ready(
+                fleet_solve(
+                    st, gr, pid, jnp.asarray(30.0), _keys(8, rnd), mask
+                )
+            )
+        traces = reg.counter("jax_traces_total", labelnames=("fn",))
+        assert traces.labels(fn="fleet_solve").value == 1
+        decisions = np.asarray(decisions)
+        for t in (0, 5):
+            most, _, victim, svc, target = decide(
+                states[t], graphs[t], pid, jnp.asarray(30.0), _keys(8, 2)[t]
+            )
+            assert decisions[t, ROW_MOST] == int(most)
+            assert decisions[t, ROW_VICTIM] == int(victim)
+            assert decisions[t, ROW_SERVICE] == int(svc)
+            assert decisions[t, ROW_TARGET] == int(target)
+    finally:
+        set_registry(prev)
+
+
+# ---------------- config & backend surfaces ----------------
+
+
+def test_fleet_config_validation():
+    FleetConfig(tenants=4, plane="dp", chaos_tenants=(0, 3)).validate()
+    with pytest.raises(ValueError, match="plane"):
+        FleetConfig(plane="pmap").validate()
+    with pytest.raises(ValueError, match="out of range"):
+        FleetConfig(tenants=2, chaos_tenants=(2,)).validate()
+    # fleet mode batches the greedy kernel — global/pod solos stay solo
+    with pytest.raises(ValueError, match="greedy"):
+        RescheduleConfig(
+            algorithm="global", fleet=FleetConfig(tenants=2)
+        ).validate()
+    with pytest.raises(ValueError, match="greedy"):
+        RescheduleConfig(
+            moves_per_round=2, fleet=FleetConfig(tenants=2)
+        ).validate()
+    # the loop enforces the same gate even with the [fleet] block off
+    # (tenants=0 validates — but the caller handed it a fleet anyway)
+    with pytest.raises(ValueError, match="greedy"):
+        run_fleet_controller(
+            make_fleet("mubench", 2), RescheduleConfig(algorithm="global")
+        )
+
+
+def test_fleet_backend_surface():
+    fleet = make_fleet("mubench", 2, seed=0)
+    assert fleet.num_tenants == 2
+    assert fleet.tenant_names == ["tenant0", "tenant1"]
+    with pytest.raises(ValueError, match="unique"):
+        FleetBackend(backends=fleet.backends, tenant_names=["a", "a"])
+    with pytest.raises(ValueError, match="at least one"):
+        FleetBackend(backends=[])
+    with pytest.raises(ValueError, match=">= 1"):
+        make_fleet("mubench", 0)
+
+
+def test_fleet_config_from_toml(tmp_path):
+    f = tmp_path / "cfg.toml"
+    f.write_text(
+        "algorithm = 'communication'\n"
+        "[fleet]\n"
+        "tenants = 4\n"
+        "plane = 'dp'\n"
+        "chaos_tenants = [1, 3]\n"
+    )
+    cfg = RescheduleConfig.from_toml(f)
+    assert cfg.fleet.tenants == 4
+    assert cfg.fleet.plane == "dp"
+    assert cfg.fleet.chaos_tenants == (1, 3)
+
+
+# ---------------- tenant-aware solver caches ----------------
+
+
+def test_solver_cache_is_tenant_aware():
+    """Regression (fleet satellite): two tenants multiplexed over ONE
+    backend keep separate cache slots — alternating rounds neither
+    cross-pollinate one tenant's graph into the other nor evict (and so
+    rebuild) each other's entries."""
+    fleet = _mubench_fleet(1)
+    backend = fleet.backends[0]
+    ba = BoundaryClient(backend, tenant="a")
+    bb = BoundaryClient(backend, tenant="b")
+    ca = ba.solver_cache("sparse_graph")
+    cb = bb.solver_cache("sparse_graph")
+    assert ca is not cb  # per-tenant slots, same backend
+    ca["graph"], ca["value"] = "ga", "va"
+    cb["graph"], cb["value"] = "gb", "vb"
+    # alternate "rounds": each tenant re-resolves ITS slot, finds its own
+    # entry intact (no rebuild), never the other tenant's (no pollution)
+    for _ in range(3):
+        assert ba.solver_cache("sparse_graph")["value"] == "va"
+        assert bb.solver_cache("sparse_graph")["value"] == "vb"
+    # distinct cache names are independent too
+    assert ba.solver_cache("pod_graph") == {}
+    # the solo controller (tenant=None) keeps its own slot
+    assert BoundaryClient(backend).solver_cache("sparse_graph") == {}
+
+
+def test_sparse_graph_cache_not_rebuilt_per_round(monkeypatch):
+    """The fleet-motivating symptom pinned at the controller level: a
+    multi-round sparse-solver run builds its SparseCommGraph exactly
+    once (the cache survives rounds instead of thrashing)."""
+    from kubernetes_rescheduling_tpu.core import sparsegraph
+
+    calls = {"n": 0}
+    real = sparsegraph.from_comm_graph
+
+    def counting(graph):
+        calls["n"] += 1
+        return real(graph)
+
+    monkeypatch.setattr(sparsegraph, "from_comm_graph", counting)
+    fleet = _mubench_fleet(1)
+    cfg = RescheduleConfig(
+        algorithm="global",
+        max_rounds=2,
+        sleep_after_action_s=0.0,
+        solver_backend="sparse",
+        balance_weight=0.5,
+    )
+    run_controller(fleet.backends[0], cfg, key=jax.random.PRNGKey(0))
+    assert calls["n"] == 1

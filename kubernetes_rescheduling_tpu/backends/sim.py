@@ -21,13 +21,17 @@ whole experiment matrix runs deterministically in-process:
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from kubernetes_rescheduling_tpu.backends.base import MoveRequest
 from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph, UNASSIGNED
-from kubernetes_rescheduling_tpu.core.workmodel import Workmodel, kahn_traversal
+from kubernetes_rescheduling_tpu.core.workmodel import (
+    Workmodel,
+    propagate_entry_rate,
+)
 from kubernetes_rescheduling_tpu.telemetry.accounting import (
     count_reconcile,
     timed_call,
@@ -53,24 +57,18 @@ class LoadModel:
         """Propagate entry rps through the directed call graph: each request
         to a service triggers one request to each of its callees.
 
-        Edges come from the shared cycle-broken traversal
-        (``core.workmodel.kahn_traversal`` — also used by the request-level
-        load generator, so CPU load and latency agree on which edges exist);
-        processing in its topological order means every upstream contribution
-        accumulates before a service's outgoing edges fire.
+        Delegates to the shared :func:`core.workmodel.propagate_entry_rate`
+        (also behind the load generator's autoscaling rate series), whose
+        edges come from the cycle-broken ``kahn_traversal`` — CPU load,
+        latency, and autoscaling all agree on which edges exist and how
+        rate accumulates through them.
         """
-        rps = {name: 0.0 for name in wm.names}
-        if self.entry_service not in rps:
-            return rps
-        rps[self.entry_service] = self.entry_rps
-        order, edges = kahn_traversal(wm.directed_relation(), wm.names)
-        out_edges: dict[str, list[str]] = {}
-        for s, d in edges:
-            out_edges.setdefault(s, []).append(d)
-        for svc in order:
-            for callee in out_edges.get(svc, ()):
-                rps[callee] += rps[svc] * self.fanout_frac
-        return rps
+        return propagate_entry_rate(
+            wm,
+            entry_service=self.entry_service,
+            entry_rps=self.entry_rps,
+            fanout_frac=self.fanout_frac,
+        )
 
 
 @dataclass
@@ -86,14 +84,12 @@ class SimBackend:
     seed: int = 0
     node_capacity: int | None = None
     pod_capacity: int | None = None
+    service_capacity: int | None = None  # comm-graph padding (shape buckets)
     reconcile_delay_s: float = 3.0     # simulated teardown+recreate latency
     pacing_s: float = 15.0             # reference main.py:27
 
     def __post_init__(self) -> None:
         self._rng = np.random.default_rng(self.seed)
-        self._graph = self.workmodel.comm_graph()
-        self._svc_index = {n: i for i, n in enumerate(self.workmodel.names)}
-        self._rps_cache: dict[str, float] | None = None
         self.clock_s = 0.0
         self.events: list[dict] = []
         n = len(self.node_names)
@@ -105,6 +101,25 @@ class SimBackend:
             for r in range(svc.replicas):
                 node = int(self._rng.integers(0, n))
                 self._pods.append([idx, node, f"{svc.name}-{r}"])
+        self._refresh_workload()
+
+    def _refresh_workload(self) -> None:
+        """THE derived-state rebuild: everything computed from the
+        service/node sets funnels through here, so the elastic mutators
+        below can change either set between rounds and every consumer
+        (comm graph, service index, rps cache) follows. The no-churn
+        path calls it exactly once, from ``__post_init__`` — a static
+        run is bit-identical to the pre-elastic simulator
+        (regression-pinned in tests/test_elastic.py)."""
+        cap = self.service_capacity
+        if cap is not None:
+            # never let a mid-step deploy outrun a stale bucket: the
+            # churn engine promotes capacities before applying events,
+            # but the graph build itself must stay safe regardless
+            cap = max(cap, len(self.workmodel.services))
+        self._graph = self.workmodel.comm_graph(capacity=cap)
+        self._svc_index = {n: i for i, n in enumerate(self.workmodel.names)}
+        self._rps_cache: dict[str, float] | None = None
 
     # ---- Backend protocol ----
 
@@ -290,6 +305,159 @@ class SimBackend:
                 restored += 1
         self.events.append({"t": self.clock_s, "event": "restore", "pods": restored})
         return restored
+
+    # ---- elastic topology mutators (elastic/engine.py drives these) ----
+
+    def live_counts(self) -> dict[str, int]:
+        """Live (unpadded) sizes the shape buckets quantize: services,
+        node SLOTS (drained nodes keep their slot, like real Node
+        objects), and pods."""
+        return {
+            "services": len(self.workmodel.services),
+            "nodes": len(self.node_names),
+            "pods": len(self._pods),
+        }
+
+    def alive_node_names(self) -> list[str]:
+        return [
+            n for n, a in zip(self.node_names, self._node_alive) if bool(a)
+        ]
+
+    def set_capacities(
+        self,
+        *,
+        node: int | None = None,
+        pod: int | None = None,
+        service: int | None = None,
+    ) -> None:
+        """Pin snapshot padding to bucket capacities: every ``monitor``
+        builds at these shapes until the churn engine promotes them."""
+        if node is not None:
+            self.node_capacity = node
+        if pod is not None:
+            self.pod_capacity = pod
+        if service is not None and service != self.service_capacity:
+            self.service_capacity = service
+            self._refresh_workload()
+
+    def deploy_service(self, spec) -> None:
+        """A new Deployment lands: the workmodel grows, its replicas are
+        placed by the simulated scheduler (least-allocated CPU — the
+        same model ``_scheduler_choice`` uses for affinityOnly moves)."""
+        if spec.name in self._svc_index:
+            raise ValueError(f"service {spec.name!r} already deployed")
+        self.workmodel = Workmodel(
+            services=self.workmodel.services + (spec,),
+            source=self.workmodel.source,
+        )
+        self._refresh_workload()
+        idx = self._svc_index[spec.name]
+        for r in range(max(1, spec.replicas)):
+            target = self._scheduler_choice()
+            self._pods.append(
+                [idx, target if target is not None else UNASSIGNED,
+                 f"{spec.name}-{r}"]
+            )
+        # NO per-event clock charge: the churn engine advances the clock
+        # once per round's event wave (kubelets reconcile in parallel —
+        # the apply_pod_moves rule; serial charging would jump simulated
+        # time by minutes on a busy autoscale round)
+        self.events.append(
+            {"t": self.clock_s, "event": "deploy", "service": spec.name,
+             "replicas": max(1, spec.replicas)}
+        )
+
+    def teardown_service(self, name: str) -> None:
+        """A Deployment leaves: its pods disappear and every later
+        service index compacts down by one (the comm graph, service
+        index, and pod table stay aligned via the shared rebuild)."""
+        if name not in self._svc_index:
+            raise ValueError(f"service {name!r} not deployed")
+        idx = self._svc_index[name]
+        self.workmodel = type(self.workmodel)(
+            services=tuple(
+                s for s in self.workmodel.services if s.name != name
+            ),
+            source=self.workmodel.source,
+        )
+        self._pods = [
+            [s - 1 if s > idx else s, node, pname]
+            for s, node, pname in self._pods
+            if s != idx
+        ]
+        self._cpu_spike.pop(name, None)
+        self._refresh_workload()
+        # no per-event clock charge (see deploy_service)
+        self.events.append(
+            {"t": self.clock_s, "event": "teardown", "service": name}
+        )
+
+    def scale_replicas(self, name: str, replicas: int) -> None:
+        """Autoscale one service to ``replicas``: scale-up places new
+        pods via the simulated scheduler, scale-down removes the most
+        recently created pods first (a Deployment's newest ReplicaSet
+        pods die first under scale-down)."""
+        if name not in self._svc_index:
+            raise ValueError(f"service {name!r} not deployed")
+        target = max(1, int(replicas))
+        idx = self._svc_index[name]
+        mine = [i for i, p in enumerate(self._pods) if p[0] == idx]
+        cur = len(mine)
+        if target == cur:
+            return
+        if target > cur:
+            suffix = cur
+            for _ in range(target - cur):
+                node = self._scheduler_choice()
+                self._pods.append(
+                    [idx, node if node is not None else UNASSIGNED,
+                     f"{name}-{suffix}"]
+                )
+                suffix += 1
+        else:
+            for i in sorted(mine[target:], reverse=True):
+                del self._pods[i]
+        self.workmodel = type(self.workmodel)(
+            services=tuple(
+                dataclasses.replace(s, replicas=target) if s.name == name else s
+                for s in self.workmodel.services
+            ),
+            source=self.workmodel.source,
+        )
+        # NO _refresh_workload: scaling changes neither the call graph
+        # (comm_graph ignores replicas) nor the name→index map, and the
+        # rps propagation is replica-independent — rebuilding the S×S
+        # adjacency per autoscale event would make a busy diurnal round
+        # O(events · S²) for nothing. No per-event clock charge either
+        # (see deploy_service).
+        self.events.append(
+            {"t": self.clock_s, "event": "scale", "service": name,
+             "from": cur, "to": target}
+        )
+
+    def add_node(self, name: str) -> None:
+        """A node joins the pool: a drained slot of this name revives;
+        a new name grows the cluster (same uniform capacity)."""
+        if name in self.node_names:
+            self.revive_node(name)
+            return
+        self.node_names.append(name)
+        self._node_alive = np.append(self._node_alive, True)
+        self.events.append(
+            {"t": self.clock_s, "event": "node_add", "node": name}
+        )
+
+    def drain_node(self, name: str) -> None:
+        """Cordon+drain: capacity leaves the pool and the node's pods
+        are rescheduled onto the remaining alive nodes (kube-scheduler's
+        job, modeled by ``schedule_pending``). Differs from
+        ``kill_node`` — a crash strands pods pending; a drain re-places
+        them."""
+        self.kill_node(name)
+        self.schedule_pending()
+        self.events.append(
+            {"t": self.clock_s, "event": "node_drain", "node": name}
+        )
 
     # ---- fault injection (SURVEY.md §5.3) ----
 

@@ -30,6 +30,10 @@ from kubernetes_rescheduling_tpu.bench.boundary import (
     CircuitBreaker,
 )
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
+from kubernetes_rescheduling_tpu.elastic.buckets import (
+    device_graph,
+    device_view,
+)
 from kubernetes_rescheduling_tpu.objectives.metrics import (
     communication_cost,
     communication_cost_attribution,
@@ -91,6 +95,10 @@ class RoundRecord:
     # decomposition of communication_cost plus move provenance — None
     # when attribution is off
     attribution: dict | None = None
+    # elastic topologies (elastic/engine.py): the churn applied before
+    # this round — events, live S/N/P counts, the current shape buckets,
+    # and the cumulative promotion count — None on static runs
+    churn: dict | None = None
 
     @property
     def decision_latency_s(self) -> float:
@@ -229,6 +237,7 @@ def run_controller(
     graph=None,
     registry=None,
     ops=None,
+    churn=None,
 ) -> ControllerResult:
     """Run ``config.max_rounds`` rounds against a backend.
 
@@ -274,6 +283,17 @@ def run_controller(
     Decision explainability is on whenever ``config.obs.explain`` and a
     logger or ops plane is attached: rounds carry ``DecisionExplanation``
     dicts (``record.explanations``) and emit ``decision`` events.
+
+    Elastic topologies: ``churn`` (an ``elastic.engine.ChurnEngine``, or
+    built automatically from ``config.elastic``) applies seeded churn
+    events between rounds THROUGH the boundary's backend passthrough —
+    services deploy/tear down, replicas autoscale, nodes drain/join.
+    Snapshots stay padded to quantized shape buckets, device kernels see
+    name-stripped views, and the loop re-reads the comm graph + re-masks
+    via a fresh snapshot only on rounds that actually churned — so steady
+    state stays at exactly 1 trace per kernel across arbitrary churn
+    within a bucket (retrace only on a counted bucket promotion).
+    Churn lands on ``RoundRecord.churn`` → rounds.jsonl.
     """
     config = config.validate()
     registry = registry if registry is not None else get_registry()
@@ -297,6 +317,21 @@ def run_controller(
         logger=logger,
         registry=registry,
     )
+    if churn is None and config.elastic.profile != "none":
+        from kubernetes_rescheduling_tpu.elastic.engine import ChurnEngine
+
+        churn = ChurnEngine(
+            config.elastic.profile,
+            seed=config.elastic.seed,
+            bucket_floor=config.elastic.bucket_floor,
+            registry=registry,
+        )
+    if churn is not None:
+        # the churn feed flows through the boundary's backend passthrough
+        # (like apply_pod_moves): chaos wrappers and the raw simulator see
+        # one stream, and bind() pushes the initial bucket capacities so
+        # even round 1's snapshot is bucket-padded
+        churn.bind(boundary, config.max_rounds, registry=registry)
     if ops is not None:
         ops.bind(breaker=breaker, logger=logger, algorithm=config.algorithm)
         breaker.on_transition = ops.on_breaker_transition
@@ -357,6 +392,23 @@ def run_controller(
         latest = mgr.latest()
         if latest is not None:
             done_round, saved_state, _extra = latest
+            if churn is not None:
+                # fast-forward the churn stream over the already-completed
+                # rounds: the event schedule depends only on (profile,
+                # seed, round, topology) — never on controller moves — so
+                # replaying it on the freshly built backend reconstructs
+                # the checkpoint-time topology AND positions the churn rng
+                # exactly where the uninterrupted run had it. Without
+                # this, a resumed churn run would silently restart from
+                # the initial topology with a rewound event stream.
+                # (Replayed events re-count in churn_events_total when the
+                # resume shares a registry with the original run.)
+                for past in range(1, done_round + 1):
+                    churn.step(past)
+                # the metric graph read above predates the replayed
+                # events — re-read it so resumed rounds report against
+                # the same topology the uninterrupted run saw
+                metric_graph = boundary.comm_graph()
             restore = getattr(backend, "restore_placement", None)
             if restore is not None:
                 restore(saved_state)
@@ -410,11 +462,37 @@ def run_controller(
         # once per run) the per-move cost deltas telescope from
         timeline.bind(state, metric_graph)
     try:
+        # churn bookkeeping that must SURVIVE skipped rounds: a round
+        # whose churn was applied but never re-monitored (breaker open,
+        # dark backend) leaves these set, and the next executed round
+        # settles the debt before deciding — no round ever solves
+        # against a phantom topology, and the provenance model never
+        # silently decodes a stale service set
+        remask_needed = False
+        rebind_timeline = False
+        # events applied during breaker-frozen/dark rounds leave no
+        # RoundRecord of their own — they accumulate here and flush into
+        # the NEXT executed round's churn block, so rounds.jsonl never
+        # shows a live-count jump with no events explaining it
+        pending_churn: list[dict] = []
         for rnd in range(start_round, config.max_rounds + 1):
+            churn_events: list[dict] = []
+            if churn is not None:
+                # the cluster churns whether or not the breaker lets this
+                # round run — events apply first, exactly like real
+                # deploys/autoscaling happening under an ailing controller
+                churn_events = churn.step(rnd)
+                if churn_events:
+                    pending_churn.extend(churn_events)
+                    remask_needed = True
+                    if churn.graph_changed:
+                        metric_graph = boundary.comm_graph()
+                        rebind_timeline = True
             mode = boundary.begin_round(rnd)
             if mode == OPEN:
                 skip_round(rnd, state)
                 continue
+            refreshed = False
             if mode == HALF_OPEN:
                 # one probe before trusting the backend with a full round; a
                 # success closes the breaker AND refreshes the stale snapshot
@@ -423,6 +501,29 @@ def run_controller(
                     skip_round(rnd, state)
                     continue
                 state = probe
+                refreshed = True
+            if remask_needed and not refreshed:
+                # re-mask: the carried snapshot predates some applied
+                # churn — one fresh monitor realigns pod sets and
+                # validity masks with the mutated cluster (shapes stay
+                # in-bucket, so the decision kernels do not retrace); a
+                # dark backend makes this a counted skip and the debt
+                # carries to the next executed round
+                fresh = boundary.monitor()
+                if fresh is None:
+                    skip_round(rnd, state)
+                    continue
+                state = fresh
+                refreshed = True
+            if refreshed:
+                remask_needed = False
+            if rebind_timeline and timeline is not None:
+                # the provenance model is defined over a fixed service
+                # set — re-anchor it at the post-churn snapshot (move
+                # deltas telescope within a churn epoch)
+                timeline = attribution_mod.PlacementTimeline()
+                timeline.bind(state, metric_graph)
+            rebind_timeline = False
             sub = jax.random.fold_in(key, rnd)
             graph = graph_src()  # fresh estimate per round when streaming
 
@@ -449,6 +550,11 @@ def run_controller(
                 state = new_state
             record.breaker_state = breaker.state
             record.boundary_failures = boundary.round_failures
+            if churn is not None:
+                # pending_churn, not churn_events: skipped rounds' events
+                # flush into the first record that can carry them
+                record.churn = churn.round_info(pending_churn)
+                pending_churn = []
             record.communication_cost = float(communication_cost(state, metric_graph))
             record.load_std = float(load_std(state))
             if attr_k > 0:
@@ -456,8 +562,14 @@ def run_controller(
                 # bundled device transfer, same state + metric graph, so
                 # per-edge contributions sum back to it (f32 tolerance —
                 # the attribution_consistent invariant)
+                # name-stripped device views (elastic.buckets): pod/node
+                # churn renames static metadata, which would silently
+                # retrace the kernel — the arrays are identical
                 bundle = pull(
-                    _attribution(state, metric_graph, top_k=attr_k),
+                    _attribution(
+                        device_view(state), device_graph(metric_graph),
+                        top_k=attr_k,
+                    ),
                     site=attribution_mod.ATTRIBUTION_SITE,
                 )
                 attr = attribution_mod.decode_attribution(
@@ -579,11 +691,16 @@ def _greedy_round(
         key, sub = jax.random.split(key)
         t0 = time.perf_counter()
         with span("controller/decide", round=rnd):
+            # name-stripped device views (elastic.buckets): the kernels
+            # never read the static name tuples, and keeping them out of
+            # the jit key is what lets pod/node churn reuse one compiled
+            # program (names stay on the full state for the host side)
+            dev_state, dev_graph = device_view(state), device_graph(graph)
             if explain_k > 0:
                 most, hazard_mask, victim, svc, target, bundle = (
                     jax.block_until_ready(
                         _decide_explain(
-                            state, graph, pid,
+                            dev_state, dev_graph, pid,
                             jnp.asarray(config.hazard_threshold_pct), sub,
                             top_k=explain_k,
                         )
@@ -593,7 +710,7 @@ def _greedy_round(
                 bundle = None
                 most, hazard_mask, victim, svc, target = jax.block_until_ready(
                     _decide(
-                        state, graph, pid,
+                        dev_state, dev_graph, pid,
                         jnp.asarray(config.hazard_threshold_pct), sub,
                     )
                 )
@@ -892,9 +1009,13 @@ def _pod_round(
         cache["graph"], cache["sig"], cache["value"] = graph, sig, value
     pod_graph = cache["value"]
     with span("controller/pod_solve", round=rnd):
+        # name-stripped device views (elastic.buckets): the solver never
+        # reads the static name tuples (the pod graph above is built from
+        # the FULL state), and keeping them out of the jit key lets churn
+        # reuse the compiled program — the greedy path's rule, same here
         new_state, info = jax.block_until_ready(
             global_assign_pods(
-                state, graph, key, cfg,
+                device_view(state), device_graph(graph), key, cfg,
                 pod_graph=pod_graph,
                 n_restarts=config.solver_restarts,
                 tp=config.solver_tp,
@@ -913,7 +1034,9 @@ def _pod_round(
             MoveRequest(
                 service=graph.names[int(svc_arr[i])],
                 pod=state.pod_names[int(i)],
-                target_node=new_state.node_names[int(new_nodes[i])],
+                # index into the FULL state's names — the solver ran on
+                # the name-stripped view (same node axis)
+                target_node=state.node_names[int(new_nodes[i])],
                 mechanism=PlacementMechanism["global"],
             )
         )
@@ -1026,10 +1149,15 @@ def _global_round(
             cache["graph"], cache["value"] = graph, value
         sparse_graph = cache["value"]
     with span("controller/global_solve", round=rnd):
+        # name-stripped device views, like the greedy path: the sparse
+        # graph above is built from the FULL graph; the solver itself
+        # only ever reads arrays, so stripping keeps churned pod/node
+        # names out of the jit key (1 trace + promotions holds for
+        # global rounds too — regression-tested)
         new_state, info = jax.block_until_ready(
             solve_with_restarts(
-                state,
-                graph,
+                device_view(state),
+                device_graph(graph),
                 key,
                 n_restarts=config.solver_restarts,
                 config=cfg,
@@ -1082,7 +1210,8 @@ def _global_round(
         landed = boundary.apply_move(
             MoveRequest(
                 service=graph.names[s],
-                target_node=new_state.node_names[target],
+                # FULL state's node names (the solver ran name-stripped)
+                target_node=state.node_names[target],
                 mechanism=PlacementMechanism["global"],
             )
         )
@@ -1096,7 +1225,7 @@ def _global_round(
         candidates = [
             {
                 "service": graph.names[s],
-                "node": new_state.node_names[t],
+                "node": state.node_names[t],
                 "node_index": int(t),
                 "score": float(gains.get((s, t), 0.0)),
                 "applied": graph.names[s] in moved_names,

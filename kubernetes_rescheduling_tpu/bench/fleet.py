@@ -64,6 +64,11 @@ from kubernetes_rescheduling_tpu.bench.controller import (
     RoundRecord,
 )
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
+from kubernetes_rescheduling_tpu.elastic.buckets import (
+    device_graph,
+    device_view,
+)
+from kubernetes_rescheduling_tpu.elastic.engine import make_fleet_churn
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS
 from kubernetes_rescheduling_tpu.solver.fleet import (
     ROW_MOST,
@@ -130,6 +135,10 @@ class _Tenant:
         self.graph = self.boundary.comm_graph()
         self.key = key
         self.state = None
+        # elastic churn debt: this tenant's carried snapshot predates
+        # applied churn (or a fleet-wide bucket promotion) and must be
+        # re-monitored — behind the breaker gate — before it can run
+        self.remask = False
         self.result = ControllerResult()
 
     def health_row(self) -> dict:
@@ -161,6 +170,7 @@ def run_fleet_controller(
     registry=None,
     ops=None,
     on_round=None,
+    churn=None,
 ) -> FleetResult:
     """Run ``config.max_rounds`` multiplexed rounds over a fleet.
 
@@ -177,6 +187,15 @@ def run_fleet_controller(
     with one row per tenant (breaker state + round counts). A single
     tenant's open breaker reads as degraded service in that block — it
     does not 503 the whole endpoint.
+
+    ``churn`` (``{tenant_index: ChurnEngine}``, or built from
+    ``config.elastic`` via ``elastic.engine.make_fleet_churn``) applies
+    seeded churn to the selected tenants between rounds. All engines
+    share ONE set of shape buckets so the fleet stays stackable: a
+    promotion re-pads every tenant (one counted retrace), while the
+    untouched tenants' decisions stay bit-identical — the vmap rows are
+    independent and padding is masked (test-pinned, like chaos
+    isolation).
     """
     config = config.validate()
     if config.fleet.tenants and config.fleet.tenants != fleet.num_tenants:
@@ -226,6 +245,25 @@ def run_fleet_controller(
         )
     ]
     T = len(tenants)
+    if churn is None and config.elastic.profile != "none":
+        churn = make_fleet_churn(fleet, config.elastic, registry=registry)
+    churn = dict(churn or {})
+    for idx in sorted(churn):
+        if not (0 <= idx < T):
+            raise ValueError(
+                f"churn tenant index {idx} out of range for {T} tenants"
+            )
+        # bind through the tenant's boundary (backend passthrough), so
+        # chaos wrappers see the same stream; bind pushes the shared
+        # bucket capacities into EVERY tenant backend (capacity sinks)
+        churn[idx].bind(
+            tenants[idx].boundary, config.max_rounds, registry=registry
+        )
+    if churn:
+        # binding re-padded the comm graphs (service bucket): re-read
+        # every tenant's graph before the one-time stack below
+        for t in tenants:
+            t.graph = t.boundary.comm_graph()
     registry.gauge(
         "fleet_tenants", "tenants served by the multiplexed fleet loop"
     ).set(T)
@@ -247,7 +285,10 @@ def run_fleet_controller(
     pid = jnp.asarray(POLICY_IDS[config.algorithm])
     thr = jnp.asarray(config.hazard_threshold_pct)
     # graphs and tenant key roots are static per tenant — stacked ONCE
-    stacked_graphs = stack_tenants([t.graph for t in tenants])
+    # (name-stripped device views, elastic.buckets: static name tuples
+    # would put churnable metadata into the jit key); under churn the
+    # stack is rebuilt only on rounds whose events changed a graph
+    stacked_graphs = stack_tenants([device_graph(t.graph) for t in tenants])
     stacked_keys = jnp.stack([t.key for t in tenants])
 
     # startup: the solo loop's bounded probe per tenant, WITHOUT the solo
@@ -290,22 +331,65 @@ def run_fleet_controller(
             ops.observe_skip(rnd, breaker_state=t.breaker.state)
         t.boundary.advance(config.sleep_after_action_s)
 
+    # events applied while a tenant's rounds are skipped accumulate here
+    # and flush into that tenant's next executed record (the solo loop's
+    # pending-churn rule, per tenant)
+    pending_churn: dict[int, list[dict]] = {idx: [] for idx in churn}
+
     def _run_rounds() -> None:
+        nonlocal stacked_graphs
         for rnd in range(1, config.max_rounds + 1):
+            churn_applied: dict[int, list[dict]] = {}
+            if churn:
+                promoted = False
+                graphs_changed = False
+                for idx in sorted(churn):
+                    applied = churn[idx].step(rnd)
+                    if applied:
+                        churn_applied[idx] = applied
+                        pending_churn.setdefault(idx, []).extend(applied)
+                        promoted = promoted or churn[idx].promoted
+                        graphs_changed = graphs_changed or churn[idx].graph_changed
+                        tenants[idx].remask = True
+                if promoted:
+                    # a shared-bucket promotion re-pads EVERY tenant:
+                    # graphs refresh host-side (no boundary traffic) and
+                    # every tenant owes a re-monitor — settled below,
+                    # BEHIND its own breaker gate, so an ailing tenant is
+                    # neither hammered while OPEN nor double-charged
+                    for t in tenants:
+                        t.graph = t.boundary.comm_graph()
+                        t.remask = True
+                    stacked_graphs = stack_tenants(
+                        [device_graph(t.graph) for t in tenants]
+                    )
+                elif graphs_changed:
+                    for idx in churn_applied:
+                        if churn[idx].graph_changed:
+                            tenants[idx].graph = (
+                                tenants[idx].boundary.comm_graph()
+                            )
+                    stacked_graphs = stack_tenants(
+                        [device_graph(t.graph) for t in tenants]
+                    )
             active: list[int] = []
             for i, t in enumerate(tenants):
                 mode = t.boundary.begin_round(rnd)
                 if mode == OPEN:
                     skip_round(t, rnd)
                     continue
-                if mode == HALF_OPEN or t.state is None:
-                    # half-open probe, or a tenant that has never produced a
-                    # snapshot: one monitor decides whether this round runs
+                if mode == HALF_OPEN or t.state is None or t.remask:
+                    # half-open probe, a tenant that has never produced a
+                    # snapshot, or one whose snapshot predates applied
+                    # churn: ONE monitor — behind the gate — decides
+                    # whether this round runs (a dark backend is a single
+                    # counted failure; the re-mask debt carries forward)
                     probe = t.boundary.monitor()
                     if probe is None:
                         skip_round(t, rnd)
                         continue
                     t.state = probe
+                    t.remask = False
                 active.append(i)
             if not active:
                 # the whole fleet skipped — nothing to dispatch this round
@@ -315,10 +399,17 @@ def run_fleet_controller(
 
             # ONE batched solve for every tenant slot: inactive slots carry a
             # placeholder snapshot (shapes must stay static — 1 trace) and
-            # are masked so they can never emit a move
+            # are masked so they can never emit a move. ALWAYS the filler
+            # for inactive slots: a skipped tenant's carried snapshot may
+            # predate a bucket promotion (stale shapes would break the
+            # stack), and masked rows never read their values anyway
             filler = tenants[active[0]].state
+            active_set = set(active)
             stacked_states = stack_tenants(
-                [t.state if t.state is not None else filler for t in tenants]
+                [
+                    device_view(t.state if i in active_set else filler)
+                    for i, t in enumerate(tenants)
+                ]
             )
             mask = np.zeros((T,), dtype=bool)
             mask[active] = True
@@ -392,14 +483,27 @@ def run_fleet_controller(
                     applied_moves=(
                         ((moved_name, landed),) if moved_name else ()
                     ),
+                    # pending, not just this round's: a skipped tenant
+                    # round's events flush into the next executed record
+                    churn=(
+                        churn[i].round_info(pending_churn.pop(i, []))
+                        if i in churn
+                        else None
+                    ),
                 )
 
             # ONE batched metrics dispatch + ONE transfer closes the round's
             # reporting for every active tenant (the solo loop pays 2 scalar
             # pulls per tenant here)
+            # same filler rule as the solve stack: only active tenants'
+            # rows are read, and only active tenants are guaranteed to
+            # hold post-promotion shapes
             filler = tenants[active[0]].state
             stacked_after = stack_tenants(
-                [t.state if t.state is not None else filler for t in tenants]
+                [
+                    device_view(t.state if i in active_set else filler)
+                    for i, t in enumerate(tenants)
+                ]
             )
             metrics = pull(
                 fleet_metrics(stacked_after, stacked_graphs),

@@ -45,6 +45,7 @@ from kubernetes_rescheduling_tpu.bench.sinks import (
 )
 from kubernetes_rescheduling_tpu.config import (
     ChaosConfig,
+    ElasticConfig,
     PerfConfig,
     RescheduleConfig,
 )
@@ -113,6 +114,16 @@ class ExperimentConfig:
     chaos_profile: str = "none"
     chaos_seed: int = 0
     max_consecutive_failures: int = 5
+    # Elastic churn cells: a named elastic/events profile ("none" = the
+    # historical static topology) mutates each cell's cluster between
+    # rounds — service deploy/teardown waves, traffic-driven replica
+    # autoscaling, node drain/add — absorbed by shape buckets so the
+    # decision kernels stay at 1 steady-state trace (+1 per counted
+    # bucket promotion). The load phases keep measuring the cell's
+    # INITIAL topology (services deployed mid-run carry no request
+    # stream of their own yet).
+    churn_profile: str = "none"
+    churn_seed: int = 0
     # Live ops plane: serve /metrics, /healthz, /events on this port for
     # the whole session (0 = ephemeral, None = off). One OpsPlane spans
     # every matrix cell; per-cell loggers re-bind as cells start, so
@@ -151,6 +162,26 @@ class ExperimentConfig:
             regression_frac=self.perf_regression_frac,
             baseline=self.perf_baseline,
         ).validate()
+        # fail an invalid churn cell in milliseconds, not after phase r1:
+        # the profile name must parse, and churn injection is sim-only
+        ElasticConfig(profile=self.churn_profile, seed=self.churn_seed).validate()
+        if self.churn_profile != "none" and self.backend == "k8s":
+            raise ValueError(
+                "churn_profile requires the sim backend: a live cluster "
+                "churns itself"
+            )
+        if self.churn_profile != "none" and self.observe_weights:
+            # the traffic estimator's call plan is frozen at cell start
+            # (LoadGenerator compiles one edge list per workmodel) — under
+            # churn it would silently steer every solve with the stale
+            # pre-churn topology, exactly the phantom-topology class the
+            # elastic plane exists to prevent. Estimating weights over a
+            # churning service set needs a re-planning estimator first.
+            raise ValueError(
+                "churn_profile and observe_weights cannot combine yet: the "
+                "weight estimator's call plan is fixed at cell start and "
+                "cannot observe churned services"
+            )
         if self.placement_unit == "pod" and self.backend == "k8s":
             # K8sBackend.apply_move rejects per-pod moves (the Deployment
             # mechanism cannot pin one replica) — fail here, not mid-run
@@ -524,6 +555,9 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                     # on the raw backend (faults hit the loop, not the ruler)
                     chaos=ChaosConfig(
                         profile=cfg.chaos_profile, seed=cfg.chaos_seed + run_i
+                    ),
+                    elastic=ElasticConfig(
+                        profile=cfg.churn_profile, seed=cfg.churn_seed + run_i
                     ),
                     max_consecutive_failures=cfg.max_consecutive_failures,
                 )

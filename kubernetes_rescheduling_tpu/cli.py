@@ -73,6 +73,19 @@ def _add_resilience_flags(parser: argparse.ArgumentParser) -> None:
              "before the controller opens into safe mode (0 disables the "
              "breaker; retries still apply)",
     )
+    parser.add_argument(
+        "--churn-profile", default="none", metavar="NAME",
+        help="elastic topology churn: mutate the cluster between rounds "
+             "under this named seeded profile (none|steady|"
+             "diurnal-autoscale|deploy-waves|node-flap) — services "
+             "deploy/tear down, replicas autoscale with traffic, nodes "
+             "drain/join; shape buckets keep the device kernels at 1 "
+             "steady-state trace (sim backend only)",
+    )
+    parser.add_argument(
+        "--churn-seed", type=int, default=0,
+        help="seed for the churn event stream (reproducible elasticity)",
+    )
 
 
 def _add_telemetry_flags(parser: argparse.ArgumentParser) -> None:
@@ -469,6 +482,7 @@ def cmd_fleet_reschedule(args, algo: str) -> dict:
     from kubernetes_rescheduling_tpu.bench.fleet import run_fleet_controller
     from kubernetes_rescheduling_tpu.config import (
         ChaosConfig,
+        ElasticConfig,
         FleetConfig,
         RescheduleConfig,
     )
@@ -504,6 +518,9 @@ def cmd_fleet_reschedule(args, algo: str) -> dict:
         solver_tp=args.tp,
         seed=args.seed,
         chaos=ChaosConfig(profile=args.chaos_profile, seed=args.chaos_seed),
+        elastic=ElasticConfig(
+            profile=args.churn_profile, seed=args.churn_seed
+        ),
         max_consecutive_failures=args.max_consecutive_failures,
         fleet=FleetConfig(
             tenants=args.fleet,
@@ -564,6 +581,7 @@ def cmd_reschedule(args) -> dict:
     from kubernetes_rescheduling_tpu.bench.harness import make_backend
     from kubernetes_rescheduling_tpu.config import (
         ChaosConfig,
+        ElasticConfig,
         PerfConfig,
         RescheduleConfig,
     )
@@ -571,6 +589,13 @@ def cmd_reschedule(args) -> dict:
     algo = _norm_algo(args.algorithm)
     if args.fleet:
         return cmd_fleet_reschedule(args, algo)
+    if args.backend == "k8s" and args.churn_profile != "none":
+        # config.validate() raises the same rule; surface it as the
+        # CLI's clean exit instead of a traceback
+        raise SystemExit(
+            "--churn-profile requires the sim backend: a live cluster "
+            "churns itself"
+        )
     if args.backend == "k8s" and args.placement_unit == "pod":
         # fail before any cluster work: K8sBackend rejects per-pod moves
         # (the Deployment mechanism cannot pin one replica), so the run
@@ -612,7 +637,11 @@ def cmd_reschedule(args) -> dict:
         solver_restarts=args.restarts,
         solver_tp=args.tp,
         seed=args.seed,
+        backend=args.backend,
         chaos=ChaosConfig(profile=args.chaos_profile, seed=args.chaos_seed),
+        elastic=ElasticConfig(
+            profile=args.churn_profile, seed=args.churn_seed
+        ),
         max_consecutive_failures=args.max_consecutive_failures,
         perf=PerfConfig(ledger_path=args.perf_ledger),
     )
@@ -675,6 +704,8 @@ def cmd_bench(args) -> dict:
         chaos_profile=args.chaos_profile,
         chaos_seed=args.chaos_seed,
         max_consecutive_failures=args.max_consecutive_failures,
+        churn_profile=args.churn_profile,
+        churn_seed=args.churn_seed,
         serve_port=args.serve,
         bundle_dir=args.bundle_dir,
     )

@@ -34,6 +34,55 @@ POLICIES: tuple[str, ...] = (
 )
 
 
+# the named churn profiles elastic/events.py implements (mirrored here so
+# config validation stays jax/numpy-free — the elastic package asserts the
+# two registries agree)
+ELASTIC_PROFILES: tuple[str, ...] = (
+    "steady",
+    "diurnal-autoscale",
+    "deploy-waves",
+    "node-flap",
+)
+
+
+@dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic-topology block (``[elastic]`` in TOML): which seeded churn
+    profile mutates the cluster between rounds (``"none"`` = static
+    topology, the historical behavior), and how live S×N×P counts are
+    padded into quantized shape buckets so churn within a bucket never
+    retraces a kernel (``elastic.buckets``). jax-free, like the other
+    blocks, so config import stays light.
+
+    ``tenants`` applies only under fleet mode: the tenant indices the
+    churn profile mutates (empty = every tenant) — the per-tenant churn
+    domain the fleet isolation tests pin, mirroring
+    ``FleetConfig.chaos_tenants``."""
+
+    profile: str = "none"
+    seed: int = 0
+    bucket_floor: int = 8
+    tenants: tuple[int, ...] = ()
+
+    def validate(self) -> "ElasticConfig":
+        valid = ("none",) + ELASTIC_PROFILES
+        if self.profile not in valid:
+            raise ValueError(
+                f"unknown churn profile {self.profile!r}; expected one of "
+                f"{sorted(valid)}"
+            )
+        if self.bucket_floor < 1:
+            raise ValueError(
+                f"bucket_floor must be >= 1, got {self.bucket_floor}"
+            )
+        for t in self.tenants:
+            if not (isinstance(t, int) and t >= 0):
+                raise ValueError(
+                    f"elastic tenants must be non-negative ints, got {t!r}"
+                )
+        return self
+
+
 @dataclass(frozen=True)
 class ChaosConfig:
     """Fault-injection block: which named ``backends.chaos`` profile wraps
@@ -243,6 +292,12 @@ class RescheduleConfig:
     # to the tenant indices in fleet.chaos_tenants.
     fleet: FleetConfig = field(default_factory=FleetConfig)
 
+    # Elastic topologies: seeded churn events (service deploy/teardown
+    # waves, replica autoscaling, node drain/add, spot preemption)
+    # applied between rounds, absorbed by shape buckets — see
+    # ElasticConfig.
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
+
     # Observability: the live ops plane (HTTP endpoint, decision
     # explainability, flight recorder, SLO watchdog) — see ObsConfig.
     obs: ObsConfig = field(default_factory=ObsConfig)
@@ -291,6 +346,13 @@ class RescheduleConfig:
                     "better than wave capping, RESULTS.md round 4)"
                 )
         self.retry.validate()
+        self.elastic.validate()
+        if self.elastic.profile != "none" and self.backend == "k8s":
+            raise ValueError(
+                "churn injection requires the hermetic sim backend: a live "
+                "cluster churns itself (watch-driven snapshots are ROADMAP "
+                "item 5)"
+            )
         self.obs.validate()
         self.perf.validate()
         self.fleet.validate()
@@ -334,6 +396,11 @@ class RescheduleConfig:
             if isinstance(fl.get("chaos_tenants"), list):
                 fl["chaos_tenants"] = tuple(fl["chaos_tenants"])
             data["fleet"] = FleetConfig(**fl)
+        if isinstance(data.get("elastic"), dict):
+            el = dict(data["elastic"])
+            if isinstance(el.get("tenants"), list):
+                el["tenants"] = tuple(el["tenants"])
+            data["elastic"] = ElasticConfig(**el)
         if isinstance(data.get("obs"), dict):
             data["obs"] = ObsConfig(**data["obs"])
         if isinstance(data.get("perf"), dict):

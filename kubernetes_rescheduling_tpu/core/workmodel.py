@@ -208,6 +208,38 @@ def kahn_traversal(
     return order, edges
 
 
+def propagate_entry_rate(
+    workmodel: "Workmodel",
+    *,
+    entry_service: str,
+    entry_rps: float,
+    fanout_frac: float = 1.0,
+) -> dict[str, float]:
+    """Propagate an entry request rate through the directed call graph:
+    each request to a service triggers ``fanout_frac`` requests to each
+    callee, accumulated in the cycle-broken topological order of
+    :func:`kahn_traversal`.
+
+    THE single source of truth for per-service offered rates — the
+    simulator's CPU-load model (``backends.sim.LoadModel.service_rps``)
+    and the load generator's autoscaling rate series
+    (``bench.loadgen.service_rate_series``) both call it, so traffic and
+    autoscaling can never disagree on which services are hot.
+    """
+    rps = {name: 0.0 for name in workmodel.names}
+    if entry_service not in rps:
+        return rps
+    rps[entry_service] = float(entry_rps)
+    order, edges = kahn_traversal(workmodel.directed_relation(), workmodel.names)
+    out_edges: dict[str, list[str]] = {}
+    for s, d in edges:
+        out_edges.setdefault(s, []).append(d)
+    for svc in order:
+        for callee in out_edges.get(svc, ()):
+            rps[callee] += rps[svc] * fanout_frac
+    return rps
+
+
 def mubench_workmodel_c() -> Workmodel:
     """The reference's s0–s19 topology, reconstructed from its call graph.
 

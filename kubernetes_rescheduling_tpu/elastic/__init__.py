@@ -1,0 +1,51 @@
+"""Elastic topologies: churn, autoscaling, and node-pool events absorbed
+without retraces.
+
+- :mod:`elastic.events` — typed churn events + seeded named profiles
+  (``steady`` / ``diurnal-autoscale`` / ``deploy-waves`` / ``node-flap``);
+- :mod:`elastic.buckets` — quantized shape buckets + the name-stripped
+  device views that keep the jit cache stable under arbitrary churn
+  within a bucket (retrace only on a counted promotion);
+- :mod:`elastic.engine` — the :class:`ChurnEngine` that applies a
+  profile's events to a backend between controller rounds.
+"""
+
+from kubernetes_rescheduling_tpu.elastic.buckets import (
+    ShapeBuckets,
+    bucket_capacity,
+    device_graph,
+    device_view,
+)
+from kubernetes_rescheduling_tpu.elastic.engine import (
+    ChurnEngine,
+    make_fleet_churn,
+)
+from kubernetes_rescheduling_tpu.elastic.events import (
+    GRAPH_EVENTS,
+    NodeAdd,
+    NodeDrain,
+    ReplicaScale,
+    ServiceDeploy,
+    ServiceTeardown,
+    SpotPreemption,
+    WorkloadView,
+    make_profile,
+)
+
+__all__ = [
+    "ShapeBuckets",
+    "bucket_capacity",
+    "device_graph",
+    "device_view",
+    "ChurnEngine",
+    "make_fleet_churn",
+    "GRAPH_EVENTS",
+    "NodeAdd",
+    "NodeDrain",
+    "ReplicaScale",
+    "ServiceDeploy",
+    "ServiceTeardown",
+    "SpotPreemption",
+    "WorkloadView",
+    "make_profile",
+]

@@ -1,0 +1,116 @@
+"""Shape-bucket plane: absorb churn without retraces.
+
+Every device kernel in this codebase is already mask-native — states and
+graphs carry ``pod_valid``/``node_valid``/``service_valid`` and padded
+slots never emit moves or contribute cost (statically enforced by
+``scripts/check_mask_threading.py``, bit-exactness pinned by the
+mask-twin tests). What churn therefore threatens is not correctness but
+COMPILATION: a jit cache keys on array shapes AND on the pytree's static
+metadata, so a cluster that grows by one pod — or merely renames one —
+would retrace every kernel every round.
+
+Two mechanisms close that hole:
+
+- **Quantized capacity buckets** (:func:`bucket_capacity`,
+  :class:`ShapeBuckets`): live S×N×P counts are padded up to the next
+  power-of-two bucket (with a floor), so arbitrary churn WITHIN a bucket
+  reuses the compiled program; only a bucket **promotion** — live counts
+  outgrowing a capacity — changes shapes, and promotions are counted
+  (``bucket_promotions_total``) and test-pinned: steady state is exactly
+  1 trace per kernel plus one per promotion.
+- **Device views** (:func:`device_view`, :func:`device_graph`): the
+  name tuples on :class:`~core.state.ClusterState` /
+  :class:`~core.state.CommGraph` are static (non-pytree) metadata, so a
+  new pod name would be a new treedef — a silent retrace the shape
+  buckets cannot absorb. The controller hands kernels a view with the
+  name tuples stripped (they are host-side bookkeeping no traced code
+  reads); the full snapshot keeps the live names for everything
+  host-side. Stripping changes the jit key, never a value: the arrays
+  are the same objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+
+
+def bucket_capacity(n: int, *, floor: int = 8) -> int:
+    """The quantized capacity for a live count: the next power of two at
+    or above ``n``, never below ``floor``. Power-of-two growth keeps the
+    number of distinct compiled shapes logarithmic in cluster size."""
+    if n < 0:
+        raise ValueError(f"live count must be >= 0, got {n}")
+    cap = max(int(floor), 1)
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@dataclass
+class ShapeBuckets:
+    """Current capacity bucket per axis, with promotion accounting.
+
+    ``fit`` grows whichever axes a new set of live counts has outgrown
+    and reports whether anything grew — the ONE legal retrace trigger
+    under churn. Buckets never shrink: demotion would trade a retrace
+    for memory the next scale-up immediately re-pays.
+    """
+
+    floor: int = 8
+    services: int = 0
+    nodes: int = 0
+    pods: int = 0
+    # promoting fit() calls (NOT per-axis growths): one fit that grows
+    # two axes produces one new compiled signature, hence counts once
+    promotions: int = 0
+    history: list[dict] = field(default_factory=list)
+
+    def fit(self, *, services: int, nodes: int, pods: int) -> bool:
+        """Grow buckets to cover the live counts; True iff promoted."""
+        new = {
+            "services": max(self.services, bucket_capacity(services, floor=self.floor)),
+            "nodes": max(self.nodes, bucket_capacity(nodes, floor=self.floor)),
+            "pods": max(self.pods, bucket_capacity(pods, floor=self.floor)),
+        }
+        promoted = (
+            new["services"] > self.services
+            or new["nodes"] > self.nodes
+            or new["pods"] > self.pods
+        )
+        first = self.services == 0 and self.nodes == 0 and self.pods == 0
+        self.services, self.nodes, self.pods = (
+            new["services"], new["nodes"], new["pods"],
+        )
+        if first:
+            return False  # initial sizing is a compile, not a promotion
+        if promoted:
+            self.promotions += 1
+            self.history.append(dict(new))
+        return promoted
+
+    def as_dict(self) -> dict:
+        return {
+            "services": self.services,
+            "nodes": self.nodes,
+            "pods": self.pods,
+            "promotions": self.promotions,
+        }
+
+
+def device_view(state: ClusterState) -> ClusterState:
+    """The kernel-facing view of a snapshot: same arrays, name tuples
+    stripped so pod/node churn cannot change the jit treedef."""
+    if not state.node_names and not state.pod_names:
+        return state
+    return state.replace(node_names=(), pod_names=())
+
+
+def device_graph(graph: CommGraph) -> CommGraph:
+    """The kernel-facing view of a comm graph: same adjacency, the
+    static service-name tuple stripped (service deploy/teardown renames
+    slots; the kernels only ever read ``adj``/``service_valid``)."""
+    if not graph.names:
+        return graph
+    return graph.replace(names=())

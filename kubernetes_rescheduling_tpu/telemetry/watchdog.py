@@ -14,7 +14,11 @@ over a bounded window of recent rounds:
   is paying a recompile). Baselines — and the rolling windows — reset on
   :meth:`Watchdog.rebase`, which the ops plane calls when a new run
   binds, so a bench session's later cells compiling fresh shapes are not
-  misread as retraces.
+  misread as retraces. Under elastic churn a counted **bucket
+  promotion** (``RoundRecord.churn["promotions"]``) legitimately
+  recompiles every kernel once — each promotion observed since the
+  baseline raises the per-fn allowance by one, so the rule flags only
+  retraces a promotion does NOT explain.
 
 A fourth rule is fed EXTERNALLY rather than per round: **perf
 regression** (:meth:`Watchdog.observe_perf`) takes the perf ledger's
@@ -110,6 +114,11 @@ class Watchdog:
             maxlen=self.rules.window
         )
         self._trace_base: dict[str, float] = {}
+        # elastic churn: cumulative bucket promotions last seen / the
+        # allowance accrued since (re)base — each promotion excuses one
+        # retrace per fn (the ONLY legal churn recompile)
+        self._promo_seen: int | None = None
+        self._promo_allow: int = 0
         self._perf_active: dict[str, dict[str, Any]] = {}
         self._attr: dict[str, Any] | None = None  # latest round's attribution
         self.active: dict[str, dict[str, Any]] = {}
@@ -127,6 +136,8 @@ class Watchdog:
         self._lat.clear()
         self._cost.clear()
         self._trace_base.clear()
+        self._promo_seen = None
+        self._promo_allow = 0
         self._attr = None
         self.active = (
             {RULE_PERF: self.active[RULE_PERF]}
@@ -145,6 +156,18 @@ class Watchdog:
         attr = getattr(record, "attribution", None)
         if isinstance(attr, dict):
             self._attr = attr
+        churn = getattr(record, "churn", None)
+        if isinstance(churn, dict):
+            p = churn.get("promotions")
+            if isinstance(p, (int, float)):
+                p = int(p)
+                if self._promo_seen is None:
+                    # promotions that pre-date the watch are baselined
+                    # away, exactly like the trace baselines
+                    self._promo_seen = p
+                elif p > self._promo_seen:
+                    self._promo_allow += p - self._promo_seen
+                    self._promo_seen = p
         return self.check()
 
     def observe_perf(self, verdicts: dict[str, dict[str, Any]]) -> list[dict[str, Any]]:
@@ -200,11 +223,16 @@ class Watchdog:
                 fn = rec["labels"].get("fn", "?")
                 v = rec.get("value", 0)
                 base = self._trace_base.setdefault(fn, v)
-                if v - base >= r.max_retraces:
+                # each counted bucket promotion explains one retrace per
+                # fn — only growth BEYOND the promotion allowance is an
+                # SLO signal (the elastic invariant: 1 steady-state
+                # trace plus exactly the counted promotions)
+                if v - base - self._promo_allow >= r.max_retraces:
                     retraced[fn] = v
             if retraced:
                 now[RULE_RETRACE] = {
                     "fns": retraced, "max_retraces": r.max_retraces,
+                    "promotions_allowed": self._promo_allow,
                 }
         if r.attribution_drift_frac > 0 and self._attr is not None:
             # the LATEST round's attribution judges: one edge carrying

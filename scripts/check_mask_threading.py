@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Static check: every solver/attribution kernel threads validity masks.
+
+Elastic topologies work because padded array slots are INERT: every
+device kernel consuming a padded ``ClusterState``/``CommGraph`` must
+read the validity masks (``pod_valid`` / ``node_valid`` /
+``service_valid``, or a batched ``tenant_mask``) — directly or through a
+helper that does — so masked slots never emit moves and never contribute
+cost. A kernel that forgets the masks is bit-exact on unpadded inputs
+and silently wrong the first time a shape bucket pads one, which is
+exactly the failure mode the mask-twin tests (tests/test_elastic.py)
+catch dynamically and this checker catches statically, at the entry
+point, before any test runs.
+
+Mechanics (AST, like its siblings ``check_no_print.py`` /
+``check_boundary_retry.py``): for every function in the package, collect
+(a) mask usage — an attribute read of a mask name, or a ``*mask``
+parameter that the body actually reads — and (b) the bare names it
+calls. Mask usage then propagates transitively over the call graph,
+resolving each call to a SAME-MODULE definition first and falling back
+to the package-wide bare name. Every ENTRY_POINT must be defined in the
+module it is listed under, ACCEPT mask-carrying arguments (a state/
+graph/mask parameter), and REACH mask usage.
+
+Adding a new device kernel? List it in ``ENTRY_POINTS`` — the test twin
+(tests/test_mask_threading.py) will hold it to the same rule.
+
+Run directly (exit 1 on violation); with no arguments it self-checks the
+repo's own package.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "kubernetes_rescheduling_tpu"
+
+# module path (relative to the package) -> kernel entry points that MUST
+# thread the masks. These are the functions the controller/fleet/metric
+# planes hand padded states and graphs to.
+ENTRY_POINTS: dict[str, tuple[str, ...]] = {
+    "solver/round_loop.py": ("decide", "decide_explain", "round_step"),
+    "solver/fleet.py": ("_fleet_decide", "_fleet_metrics"),
+    "parallel/fleet.py": ("fleet_solve_dp",),
+    "objectives/metrics.py": (
+        "communication_cost",
+        "communication_cost_deployment",
+        "load_std",
+        "node_cpu_pct_rounded",
+        "capacity_violation",
+        "node_pair_cost_matrix",
+        "communication_cost_attribution",
+    ),
+    "policies/hazard.py": ("detect_hazard",),
+    "policies/scoring.py": ("node_features", "policy_scores", "choose_node"),
+    "policies/victim.py": ("pick_victim", "deployment_group"),
+    "solver/global_solver.py": ("global_assign",),
+}
+
+MASK_ATTRS = {"pod_valid", "node_valid", "service_valid"}
+MASK_PARAMS = {"tenant_mask", "hazard_mask"}
+# parameters that carry masks inside a pytree — an entry point must take
+# at least one of these (or a bare mask) to be maskable at all
+CARRIER_PARAMS = {
+    "state", "states", "st", "removed", "graph", "graphs",
+} | MASK_PARAMS
+
+
+class _FnInfo(ast.NodeVisitor):
+    """Per-function facts: mask usage + called bare names."""
+
+    def __init__(self) -> None:
+        self.uses_mask = False
+        self.calls: set[str] = set()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in MASK_ATTRS:
+            self.uses_mask = True
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load) and node.id in MASK_PARAMS:
+            self.uses_mask = True
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Name):
+            self.calls.add(f.id)
+        elif isinstance(f, ast.Attribute):
+            self.calls.add(f.attr)
+        self.generic_visit(node)
+
+
+def _functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _param_names(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def analyze(package: Path = PACKAGE):
+    """(facts, params, defs): facts/params keyed by (module, name) —
+    the module being the file's package-relative posix path — plus a
+    per-module set of defined function names. Calls resolve to a
+    same-module definition FIRST and fall back to any package-wide
+    definition by bare name, so a same-named helper in another module
+    cannot vouch for a kernel that stopped reading masks itself."""
+    facts: dict[tuple[str, str], _FnInfo] = {}
+    params: dict[tuple[str, str], set[str]] = {}
+    defs: dict[str, set[str]] = {}
+    by_name: dict[str, list[tuple[str, str]]] = {}
+    for path in sorted(package.rglob("*.py")):
+        mod = path.relative_to(package).as_posix()
+        defs.setdefault(mod, set())
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for fn in _functions(tree):
+            info = _FnInfo()
+            for stmt in fn.body:
+                info.visit(stmt)
+            key = (mod, fn.name)
+            if key in facts:  # re-definition in one module: merge
+                facts[key].uses_mask |= info.uses_mask
+                facts[key].calls |= info.calls
+                params[key] |= _param_names(fn)
+            else:
+                facts[key] = info
+                params[key] = _param_names(fn)
+                by_name.setdefault(fn.name, []).append(key)
+            defs[mod].add(fn.name)
+    # transitive closure: a function that calls a mask-using function
+    # uses masks (fixpoint; same-module resolution wins, then any
+    # package-wide definition of that bare name)
+    changed = True
+    while changed:
+        changed = False
+        for (mod, _name), info in facts.items():
+            if info.uses_mask:
+                continue
+            for c in info.calls:
+                if (mod, c) in facts:
+                    targets = [(mod, c)]
+                else:
+                    targets = by_name.get(c, [])
+                if any(facts[t].uses_mask for t in targets):
+                    info.uses_mask = True
+                    changed = True
+                    break
+    return facts, params, defs
+
+
+def violations(
+    package: Path = PACKAGE,
+    entries: dict[str, tuple[str, ...]] | None = None,
+) -> list[str]:
+    entries = ENTRY_POINTS if entries is None else entries
+    facts, params, defs = analyze(package)
+    out: list[str] = []
+    for mod, fns in sorted(entries.items()):
+        mod_path = package / mod
+        if not mod_path.is_file():
+            out.append(f"{mod}: listed in ENTRY_POINTS but missing")
+            continue
+        for name in fns:
+            # the kernel must be defined IN the module it is listed
+            # under — a same-named function elsewhere cannot stand in
+            if name not in defs.get(mod, ()):
+                out.append(f"{mod}: entry point {name}() not found")
+                continue
+            key = (mod, name)
+            if not (params[key] & CARRIER_PARAMS):
+                out.append(
+                    f"{mod}: {name}() accepts no mask-carrying argument "
+                    f"(expected one of {sorted(CARRIER_PARAMS)})"
+                )
+            if not facts[key].uses_mask:
+                out.append(
+                    f"{mod}: {name}() never reaches a validity mask "
+                    f"({sorted(MASK_ATTRS | MASK_PARAMS)}) — padded slots "
+                    "would not be inert"
+                )
+    return out
+
+
+def main() -> int:
+    bad = violations()
+    if bad:
+        sys.stderr.write(
+            "kernel entry points that do not thread validity masks:\n"
+            + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,908 @@
+"""Elastic topologies: churn events, shape buckets, and the
+no-retrace-under-churn contract.
+
+Pinned invariants:
+
+- **mask twins** — for every kernel taking validity masks, a padded +
+  masked problem is bit-exact with the unpadded problem of the same
+  live size (greedy decide across all five policies, the explain twin,
+  the attribution kernel, objectives, and both fleet planes);
+- **no-churn regression** — the elastic refactor of the simulator left
+  a static run bit-identical to the pre-elastic code (golden-pinned
+  trajectory + final placement digest);
+- **steady-state traces** — churn within a bucket never retraces: every
+  instrumented kernel compiles exactly ``1 + bucket promotions`` times
+  (a promotion landing before a kernel's first compile folds in);
+- **acceptance soak** — a seeded 30-round ``diurnal-autoscale`` run
+  (replicas ×0.5–×2, one node drain/add cycle) completes with pinned
+  traces, sum-consistent attribution every round, and full round
+  accounting;
+- **fleet isolation** — churn on one tenant leaves the other tenants'
+  trajectories bit-identical to a churn-free fleet run.
+"""
+
+import hashlib
+import json
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends.fleet import make_fleet
+from kubernetes_rescheduling_tpu.backends.sim import SimBackend, LoadModel
+from kubernetes_rescheduling_tpu.bench.controller import run_controller
+from kubernetes_rescheduling_tpu.bench.fleet import run_fleet_controller
+from kubernetes_rescheduling_tpu.bench.harness import make_backend
+from kubernetes_rescheduling_tpu.bench.loadgen import service_rate_series
+from kubernetes_rescheduling_tpu.config import (
+    ElasticConfig,
+    FleetConfig,
+    RescheduleConfig,
+)
+from kubernetes_rescheduling_tpu.core.workmodel import (
+    ServiceSpec,
+    Workmodel,
+    mubench_workmodel_c,
+)
+from kubernetes_rescheduling_tpu.elastic import (
+    ChurnEngine,
+    ShapeBuckets,
+    bucket_capacity,
+    device_graph,
+    device_view,
+)
+from kubernetes_rescheduling_tpu.objectives.metrics import (
+    capacity_violation,
+    communication_cost,
+    communication_cost_attribution,
+    load_std,
+    node_pair_cost_matrix,
+)
+from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.solver.round_loop import decide, decide_explain
+from kubernetes_rescheduling_tpu.telemetry import (
+    MetricsRegistry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.telemetry.attribution import (
+    check_attribution,
+    decode_attribution,
+)
+from kubernetes_rescheduling_tpu.telemetry.watchdog import (
+    RULE_RETRACE,
+    SLORules,
+    Watchdog,
+)
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+# ---------------------------------------------------------------- buckets
+
+
+def test_bucket_capacity_quantization():
+    assert bucket_capacity(0) == 8
+    assert bucket_capacity(1) == 8
+    assert bucket_capacity(8) == 8
+    assert bucket_capacity(9) == 16
+    assert bucket_capacity(1000) == 1024
+    assert bucket_capacity(3, floor=4) == 4
+    with pytest.raises(ValueError):
+        bucket_capacity(-1)
+
+
+def test_shape_buckets_promote_once_per_fit_and_never_shrink():
+    b = ShapeBuckets(floor=8)
+    # initial sizing is a compile, not a promotion
+    assert b.fit(services=20, nodes=3, pods=21) is False
+    assert (b.services, b.nodes, b.pods) == (32, 8, 32)
+    assert b.promotions == 0
+    # in-bucket churn: no promotion
+    assert b.fit(services=25, nodes=5, pods=30) is False
+    # two axes outgrow in ONE fit -> one promotion (one new signature)
+    assert b.fit(services=40, nodes=3, pods=40) is True
+    assert b.promotions == 1
+    assert (b.services, b.pods) == (64, 64)
+    # shrink never happens
+    assert b.fit(services=5, nodes=1, pods=5) is False
+    assert (b.services, b.nodes, b.pods) == (64, 8, 64)
+
+
+def test_device_view_strips_names_only():
+    backend = make_backend("mubench", seed=0)
+    state = backend.monitor()
+    dev = device_view(state)
+    assert dev.node_names == () and dev.pod_names == ()
+    assert dev.pod_node is state.pod_node  # same arrays, no copies
+    graph = backend.comm_graph()
+    dg = device_graph(graph)
+    assert dg.names == () and dg.adj is graph.adj
+    # idempotent (already-stripped views return themselves)
+    assert device_view(dev) is dev
+    assert device_graph(dg) is dg
+
+
+# ------------------------------------------------------------- mask twins
+
+
+def _twin_problem(seed=2):
+    """The same live cluster twice: exact shapes vs bucket-padded shapes
+    (node 3→8, pod 21→64, service 20→32). Same seed → identical rng
+    placement stream → identical live arrays."""
+    exact = make_backend("mubench", seed=seed)
+    exact.inject_imbalance(exact.node_names[0])
+    padded = make_backend("mubench", seed=seed)
+    padded.set_capacities(node=8, pod=64, service=32)
+    padded.inject_imbalance(padded.node_names[0])
+    return (
+        exact.monitor(), exact.comm_graph(),
+        padded.monitor(), padded.comm_graph(),
+    )
+
+
+def test_mask_twin_greedy_decide_all_policies():
+    """The greedy decision kernel: padded+masked bit-exact with the
+    unpadded twin for every policy — including the PRNG `random` policy
+    (partitionable threefry makes the padded gumbel draw a prefix
+    extension of the unpadded one)."""
+    st, gr, pst, pgr = _twin_problem()
+    thr = jnp.asarray(30.0)
+    for name, pid in POLICY_IDS.items():
+        key = jax.random.PRNGKey(7)
+        a = decide(st, gr, jnp.asarray(pid), thr, key)
+        b = decide(pst, pgr, jnp.asarray(pid), thr, key)
+        for ai, bi in zip(a[:1] + a[2:], b[:1] + b[2:]):  # scalars
+            assert int(ai) == int(bi), name
+        n = st.num_nodes
+        assert np.array_equal(np.asarray(a[1]), np.asarray(b[1])[:n]), name
+        assert not np.asarray(b[1])[n:].any(), name  # padded nodes never hazard
+
+
+def test_mask_twin_decide_explain_bundle():
+    st, gr, pst, pgr = _twin_problem()
+    thr = jnp.asarray(30.0)
+    key = jax.random.PRNGKey(3)
+    pid = jnp.asarray(POLICY_IDS["communication"])
+    *a, bundle_a = decide_explain(st, gr, pid, thr, key, top_k=3)
+    *b, bundle_b = decide_explain(pst, pgr, pid, thr, key, top_k=3)
+    assert int(a[0]) == int(b[0]) and int(a[4]) == int(b[4])
+    assert int(a[2]) == int(b[2]) and int(a[3]) == int(b[3])
+    # k = min(3, N) = 3 on both sides; every recorded row bit-exact
+    assert np.array_equal(np.asarray(bundle_a), np.asarray(bundle_b))
+
+
+def test_mask_twin_objectives():
+    st, gr, pst, pgr = _twin_problem()
+    assert float(communication_cost(st, gr)) == float(
+        communication_cost(pst, pgr)
+    )
+    assert float(load_std(st)) == float(load_std(pst))
+    assert float(capacity_violation(st)) == float(capacity_violation(pst))
+    m = np.asarray(node_pair_cost_matrix(st, gr))
+    pm = np.asarray(node_pair_cost_matrix(pst, pgr))
+    n = st.num_nodes
+    assert np.array_equal(m, pm[:n, :n])
+    assert not pm[n:, :].any() and not pm[:, n:].any()
+
+
+def test_mask_twin_attribution_kernel():
+    st, gr, pst, pgr = _twin_problem()
+    k = 6
+    a = decode_attribution(
+        np.asarray(communication_cost_attribution(st, gr, top_k=k)),
+        node_names=st.node_names, service_names=gr.names,
+        top_k=k, num_nodes=st.num_nodes, num_services=gr.num_services,
+    )
+    b = decode_attribution(
+        np.asarray(communication_cost_attribution(pst, pgr, top_k=k)),
+        node_names=pst.node_names, service_names=pgr.names,
+        top_k=k, num_nodes=pst.num_nodes, num_services=pgr.num_services,
+    )
+    assert a["total"] == b["total"] and a["tail"] == b["tail"]
+    ea = [(e["src_service"], e["dst_service"], e["cost"]) for e in a["edges"]]
+    eb = [(e["src_service"], e["dst_service"], e["cost"]) for e in b["edges"]]
+    assert ea == eb
+
+
+def test_mask_twin_fleet_planes():
+    """Both fleet device planes over padded tenants reproduce the solo
+    kernel on the unpadded twin, row for row."""
+    from kubernetes_rescheduling_tpu.parallel.fleet import fleet_solve_dp
+    from kubernetes_rescheduling_tpu.solver.fleet import (
+        ROW_MOST, ROW_SERVICE, ROW_TARGET, ROW_VICTIM,
+        fleet_solve, stack_tenants,
+    )
+
+    st, gr, pst, pgr = _twin_problem()
+    _, _, pst2, pgr2 = _twin_problem(seed=5)
+    st2 = make_backend("mubench", seed=5)
+    st2.inject_imbalance(st2.node_names[0])
+    est2, egr2 = st2.monitor(), st2.comm_graph()
+
+    states = stack_tenants([device_view(pst), device_view(pst2)])
+    graphs = stack_tenants([device_graph(pgr), device_graph(pgr2)])
+    pid = jnp.asarray(POLICY_IDS["communication"])
+    thr = jnp.asarray(30.0)
+    keys = jnp.stack([jax.random.PRNGKey(11), jax.random.PRNGKey(12)])
+    mask = jnp.ones((2,), bool)
+
+    for plane in (fleet_solve, fleet_solve_dp):
+        dec, _hz = plane(states, graphs, pid, thr, keys, mask)
+        dec = np.asarray(dec)
+        for row, (est, egr, key) in enumerate(
+            [(st, gr, keys[0]), (est2, egr2, keys[1])]
+        ):
+            most, _m, victim, svc, target = decide(est, egr, pid, thr, key)
+            assert dec[row, ROW_MOST] == int(most)
+            assert dec[row, ROW_VICTIM] == int(victim)
+            assert dec[row, ROW_SERVICE] == int(svc)
+            assert dec[row, ROW_TARGET] == int(target)
+
+
+# ------------------------------------------------- no-churn regression
+
+
+def test_no_churn_run_bit_identical_to_pre_elastic_sim():
+    """Satellite regression: the mutable-node/pod-set refactor of
+    SimBackend left the static path byte-for-byte identical — golden
+    trajectory + placement digest captured from the pre-elastic code."""
+    backend = make_backend("mubench", seed=3)
+    backend.inject_imbalance(backend.node_names[0])
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=5,
+        sleep_after_action_s=0.0, seed=3,
+    )
+    res = run_controller(backend, cfg, key=jax.random.PRNGKey(3))
+    traj = [
+        (r.round, r.moved, r.service, r.target,
+         r.communication_cost, round(r.load_std, 6))
+        for r in res.rounds
+    ]
+    assert traj == [
+        (1, True, "s0", "worker2", 4.0, 37.104767),
+        (2, True, "s1", "worker2", 7.0, 34.235298),
+        (3, True, "s2", "worker2", 6.0, 31.48699),
+        (4, True, "s3", "worker2", 10.0, 28.894444),
+        (5, True, "s4", "worker2", 9.0, 26.503405),
+    ]
+    final = backend.monitor()
+    digest = hashlib.sha1(
+        np.asarray(final.pod_node).tobytes()
+        + np.asarray(final.pod_valid).tobytes()
+    ).hexdigest()
+    assert digest == "704ae98df34a8fcd626b0dfe47ec045957223f24"
+    assert all(r.churn is None for r in res.rounds)
+
+
+# --------------------------------------------------------- sim mutators
+
+
+def _tiny_backend(seed=0, **kw):
+    wm = Workmodel(
+        services=(
+            ServiceSpec(name="a", callees=("b",)),
+            ServiceSpec(name="b", callees=("c",)),
+            ServiceSpec(name="c"),
+        )
+    )
+    return SimBackend(
+        workmodel=wm, node_names=["n0", "n1"], seed=seed,
+        load=LoadModel(entry_service="a"), **kw,
+    )
+
+
+def test_sim_teardown_compacts_indices_and_graph():
+    b = _tiny_backend()
+    b.teardown_service("b")
+    g = b.comm_graph()
+    assert g.names == ("a", "c")
+    st = b.monitor()
+    svc = np.asarray(st.pod_service)[np.asarray(st.pod_valid)]
+    assert sorted(g.names[int(s)] for s in svc) == ["a", "c"]
+    with pytest.raises(ValueError):
+        b.teardown_service("b")
+
+
+def test_sim_scale_and_deploy_track_replicas():
+    b = _tiny_backend()
+    b.scale_replicas("a", 3)
+    assert {s.name: s.replicas for s in b.workmodel.services}["a"] == 3
+    b.scale_replicas("a", 1)
+    assert b.live_counts()["pods"] == 3
+    b.deploy_service(ServiceSpec(name="d", callees=("a",), replicas=2))
+    assert b.live_counts() == {"services": 4, "nodes": 2, "pods": 5}
+    g = b.comm_graph()
+    assert g.adj[g.names.index("d"), g.names.index("a")] > 0
+    with pytest.raises(ValueError):
+        b.deploy_service(ServiceSpec(name="d"))
+
+
+def test_sim_drain_reschedules_add_grows():
+    b = _tiny_backend()
+    b.add_node("n2")
+    assert b.live_counts()["nodes"] == 3
+    b.drain_node("n0")
+    st = b.monitor()
+    nodes = np.asarray(st.pod_node)[np.asarray(st.pod_valid)]
+    alive = {b.node_names.index(n) for n in b.alive_node_names()}
+    assert set(int(x) for x in nodes) <= alive  # drained pods re-placed
+    b.add_node("n0")  # re-adding a drained name revives it
+    assert "n0" in b.alive_node_names()
+
+
+# ------------------------------------------------------------ the engine
+
+
+def test_engine_event_stream_is_seeded_deterministic():
+    logs = []
+    for _ in range(2):
+        backend = _tiny_backend(seed=1)
+        eng = ChurnEngine("deploy-waves", seed=9, registry=MetricsRegistry())
+        eng.bind(backend, 12)
+        for rnd in range(1, 13):
+            eng.step(rnd)
+        logs.append(eng.events_log)
+    assert logs[0] == logs[1]
+    assert any(e["kind"] == "service_deploy" for e in logs[0])
+    assert any(e["kind"] == "service_teardown" for e in logs[0])
+
+
+def test_engine_profiles_produce_their_kinds():
+    kinds_by_profile = {}
+    for profile in ("steady", "diurnal-autoscale", "node-flap"):
+        backend = make_backend("mubench", seed=1)
+        eng = ChurnEngine(profile, seed=3, registry=MetricsRegistry())
+        eng.bind(backend, 20)
+        for rnd in range(1, 21):
+            eng.step(rnd)
+        kinds_by_profile[profile] = {e["kind"] for e in eng.events_log}
+    assert kinds_by_profile["steady"] <= {"replica_scale"}
+    assert "replica_scale" in kinds_by_profile["diurnal-autoscale"]
+    assert "node_drain" in kinds_by_profile["diurnal-autoscale"]
+    assert "node_add" in kinds_by_profile["diurnal-autoscale"]
+    assert "node_drain" in kinds_by_profile["node-flap"]
+
+
+def test_engine_promotion_counts_and_invalidates_solver_caches(registry):
+    backend = _tiny_backend(seed=0)
+    backend._solver_caches = {("sparse_graph", None): {"graph": object()}}
+    eng = ChurnEngine("deploy-waves", seed=0, bucket_floor=4, registry=registry)
+    eng.bind(backend, 30)
+    assert backend.service_capacity == 4  # 3 services -> floor bucket
+    promoted_rounds = []
+    for rnd in range(1, 8):
+        eng.step(rnd)
+        if eng.promoted:
+            promoted_rounds.append(rnd)
+    assert promoted_rounds, "deploy waves past 4 services must promote"
+    assert eng.buckets.promotions == len(promoted_rounds)
+    assert backend._solver_caches == {}  # promotion cleared the slots
+    assert backend.service_capacity >= eng.buckets.services
+    # telemetry: the counter matches the bucket accounting
+    snap = {
+        (r["metric"], tuple(sorted(r["labels"].items()))): r.get("value", 0)
+        for r in registry.snapshot()
+    }
+    assert snap[("bucket_promotions_total", ())] == eng.buckets.promotions
+    assert snap[("bucket_capacity", (("axis", "services"),))] == eng.buckets.services
+
+
+def test_engine_requires_elastic_mutators():
+    class NotASim:
+        pass
+
+    eng = ChurnEngine("steady", registry=MetricsRegistry())
+    with pytest.raises(TypeError, match="elastic mutators"):
+        eng.bind(NotASim(), 10)
+
+
+# ------------------------------------------------------ rate series
+
+
+def test_rate_profile_resamples_not_truncates():
+    wm = mubench_workmodel_c()
+    rp = service_rate_series(wm, amplitude=2.0, steps=8, phase_jitter=0.0)
+    # a 30-round run over the 8-point shape sweeps the WHOLE profile:
+    # the peak (~x2) and the trough (~x0.5) both appear. The truncation
+    # idiom (shape[:rounds] index) would replay only the profile's head.
+    factors = [rp.factors(r, 30)["s0"] for r in range(1, 31)]
+    assert max(factors) > 1.8 and min(factors) < 0.6
+    # resampling is horizon-independent: a 10-round run sweeps it too
+    short = [rp.factors(r, 10)["s0"] for r in range(1, 11)]
+    assert max(short) > 1.7 and min(short) < 0.65
+
+
+def test_rate_profile_per_replica_follows_live_counts():
+    wm = mubench_workmodel_c()
+    rp = service_rate_series(wm, entry_rps=100.0, steps=8, phase_jitter=0.0)
+    total = rp.at(4, 10)["s0"]
+    one = rp.per_replica(4, 10, {"s0": 1})["s0"]
+    four = rp.per_replica(4, 10, {"s0": 4})["s0"]
+    assert one == pytest.approx(total)
+    assert four == pytest.approx(total / 4)  # same offered load, split
+
+
+def test_rate_profile_base_rates_propagate_call_graph():
+    wm = mubench_workmodel_c()
+    rp = service_rate_series(wm, entry_rps=100.0)
+    rates = dict(zip(rp.names, rp.base_rps))
+    assert rates["s0"] == 100.0
+    assert rates["s1"] == 100.0   # s0 -> s1
+    assert rates["s2"] == 100.0   # s1 -> s2
+    assert rates["s18"] == 100.0  # s0->s1->s15->s18
+
+
+# ------------------------------------------------- controller invariants
+
+
+def _churn_run(profile, rounds, *, logger=None, seed=1, registry=None):
+    backend = make_backend("mubench", seed=seed)
+    backend.inject_imbalance(backend.node_names[0])
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=rounds,
+        sleep_after_action_s=0.0, seed=seed,
+        elastic=ElasticConfig(profile=profile, seed=7),
+    )
+    res = run_controller(
+        backend, cfg, key=jax.random.PRNGKey(seed), logger=logger,
+        registry=registry,
+    )
+    return backend, res
+
+
+def _traces(registry, fn):
+    return int(
+        registry.counter("jax_traces_total", labelnames=("fn",))
+        .labels(fn=fn).value
+    )
+
+
+def test_steady_churn_one_trace(registry):
+    """The quiet-cluster invariant: in-bucket churn reuses ONE compiled
+    decision kernel for the whole run."""
+    backend, res = _churn_run("steady", 10, registry=registry)
+    assert len(res.rounds) + res.skipped_rounds == 10
+    promotions = res.rounds[-1].churn["promotions"]
+    assert promotions == 0
+    assert _traces(registry, "controller_decide") == 1
+    assert all(r.churn is not None for r in res.rounds)
+
+
+def test_acceptance_diurnal_autoscale_soak(registry):
+    """THE acceptance soak: 30 seeded rounds under diurnal-autoscale
+    (replicas ×0.5–×2 tracking the rate series, one node drain/add
+    cycle) with explain + attribution live. Every instrumented kernel
+    compiles exactly 1 + (promotions after its first compile) times,
+    attribution stays sum-consistent every round, and every round is
+    accounted."""
+    logger = StructuredLogger(name="elastic-soak")
+    backend, res = _churn_run(
+        "diurnal-autoscale", 30, logger=logger, registry=registry
+    )
+    assert len(res.rounds) + res.skipped_rounds == 30
+    assert res.rounds, "soak produced no executed rounds"
+    # churn really happened: scaling events and the drain/add cycle
+    events = [e for r in res.rounds for e in (r.churn or {}).get("events", ())]
+    kinds = {e["kind"] for e in events}
+    assert "replica_scale" in kinds
+    assert "node_drain" in kinds and "node_add" in kinds
+    # trace accounting: promotions folded into the first compile do not
+    # retrace; every later promotion retraces exactly once
+    first = res.rounds[0].churn["promotions"]
+    final = res.rounds[-1].churn["promotions"]
+    expected = 1 + (final - first)
+    assert _traces(registry, "controller_decide_explain") == expected
+    assert _traces(registry, "controller_attribution") == expected
+    # attribution: sum-consistent EVERY round (the PR-5 invariant holds
+    # under churn, across the bucket promotion)
+    checked, bad = check_attribution([r.as_dict() for r in res.rounds])
+    assert checked == len(res.rounds) and bad == []
+    # the replica swing really spans the x0.5-x2 band at some point
+    pods = [r.churn["live_pods"] for r in res.rounds]
+    assert max(pods) > min(pods)
+    # gauges + counters landed
+    snap = {
+        (r["metric"], tuple(sorted(r["labels"].items()))): r.get("value", 0)
+        for r in registry.snapshot()
+    }
+    assert snap[("live_services", ())] == res.rounds[-1].churn["live_services"]
+    assert ("bucket_capacity", (("axis", "pods"),)) in snap
+    # the counter may exceed the recorded events (skipped rounds churn
+    # too but leave no RoundRecord) — never undercount
+    total_counted = sum(
+        v for (m, _l), v in snap.items() if m == "churn_events_total"
+    )
+    assert total_counted >= len(events) > 0
+
+
+@pytest.mark.slow  # 60-round two-profile soak; the 30-round diurnal pin stays fast in test_acceptance_diurnal_autoscale_soak above
+def test_long_deploy_waves_soak(registry):
+    """Structural churn endurance: 60 rounds of deploy-waves — the comm
+    graph grows and shrinks repeatedly — with the same trace pin."""
+    logger = StructuredLogger(name="elastic-waves")
+    backend, res = _churn_run(
+        "deploy-waves", 60, logger=logger, registry=registry
+    )
+    assert len(res.rounds) + res.skipped_rounds == 60
+    first = res.rounds[0].churn["promotions"]
+    final = res.rounds[-1].churn["promotions"]
+    # <=: an earlier test's run may have compiled these bucket shapes
+    # already (process-wide jit cache) — the pin is NO UNEXPLAINED traces
+    assert _traces(registry, "controller_decide_explain") <= 1 + (final - first)
+    checked, bad = check_attribution([r.as_dict() for r in res.rounds])
+    assert checked == len(res.rounds) and bad == []
+    assert backend.live_counts()["services"] != 20  # waves really landed
+
+
+def test_node_flap_churn_keeps_loop_alive(registry):
+    backend, res = _churn_run("node-flap", 14, registry=registry)
+    assert len(res.rounds) + res.skipped_rounds == 14
+    kinds = {
+        e["kind"]
+        for r in res.rounds
+        for e in (r.churn or {}).get("events", ())
+    }
+    assert "node_drain" in kinds
+    # drained capacity returns: the run ends with every node alive again
+    # or at most the currently-flapped one down
+    assert len(backend.alive_node_names()) >= len(backend.node_names) - 1
+
+
+class _FlakyMonitor:
+    """Backend wrapper failing monitor() on exact call numbers — the
+    deterministic way to hit the churn re-mask path's failure branch."""
+
+    def __init__(self, inner, fail_calls):
+        self.inner = inner
+        self.calls = 0
+        self.fail_calls = set(fail_calls)
+
+    def monitor(self):
+        self.calls += 1
+        if self.calls in self.fail_calls:
+            raise ConnectionError("flaky monitor")
+        return self.inner.monitor()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_remask_debt_survives_a_skipped_churn_round(registry):
+    """A churn round whose re-mask monitor fails becomes a counted skip,
+    and the NEXT executed round still re-masks (and re-anchors the
+    provenance model) before deciding — graph-changing churn can never
+    be silently decided against the pre-churn snapshot."""
+    from kubernetes_rescheduling_tpu.utils.retry import RetryPolicy
+
+    inner = make_backend("mubench", seed=6)
+    inner.inject_imbalance(inner.node_names[0])
+    backend = _FlakyMonitor(inner, fail_calls={2})  # the round-1 re-mask
+    logger = StructuredLogger(name="flaky-churn")
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=4,
+        sleep_after_action_s=0.0, seed=6,
+        retry=RetryPolicy(max_attempts=1, base_delay_s=0.0),
+        elastic=ElasticConfig(profile="deploy-waves", seed=5),
+    )
+    res = run_controller(
+        backend, cfg, key=jax.random.PRNGKey(6), logger=logger,
+        registry=registry,
+    )
+    assert res.skipped_rounds == 1  # round 1: churned, dark, counted
+    assert len(res.rounds) + res.skipped_rounds == 4
+    first = res.rounds[0]
+    # the first EXECUTED round already sees the deployed wave (the
+    # re-mask debt was settled before deciding) AND carries the skipped
+    # round's events (pending-churn flush: rounds.jsonl never shows a
+    # live-count jump with no events explaining it)
+    assert first.churn["live_services"] > 20
+    assert any(e["round"] == 1 for e in first.churn["events"])
+    checked, bad = check_attribution([r.as_dict() for r in res.rounds])
+    assert checked == len(res.rounds) and bad == []
+
+
+def test_global_rounds_under_churn_stay_trace_stable(registry):
+    """The global solver path threads the same name-stripped device
+    views as the greedy path: churn that renames pods/services must not
+    retrace `global_assign` beyond the counted bucket promotions (the
+    code-review repro: 4 traces in 6 rounds before the fix)."""
+    backend = make_backend("mubench", seed=8)
+    backend.inject_imbalance(backend.node_names[0])
+    cfg = RescheduleConfig(
+        algorithm="global", max_rounds=6,
+        sleep_after_action_s=0.0, seed=8, balance_weight=0.5,
+        elastic=ElasticConfig(profile="diurnal-autoscale", seed=2),
+    )
+    res = run_controller(
+        backend, cfg, key=jax.random.PRNGKey(8), registry=registry
+    )
+    assert len(res.rounds) + res.skipped_rounds == 6
+    promos = max((r.churn["promotions"] for r in res.rounds if r.churn), default=0)
+    assert _traces(registry, "global_assign") <= 1 + promos
+
+
+def test_resume_fast_forwards_the_churn_stream(tmp_path):
+    """Checkpoint resume under churn: the engine replays the completed
+    rounds' events on the rebuilt backend, so the resumed run's topology
+    and event stream are bit-identical to the uninterrupted run's."""
+
+    def build():
+        b = make_backend("mubench", seed=4)
+        b.inject_imbalance(b.node_names[0])
+        return b
+
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=6,
+        sleep_after_action_s=0.0, seed=4,
+        elastic=ElasticConfig(profile="deploy-waves", seed=5),
+    )
+    full_backend = build()
+    full = run_controller(
+        full_backend, cfg, key=jax.random.PRNGKey(4),
+        checkpoint_dir=str(tmp_path / "full"),
+    )
+
+    class Boom(Exception):
+        pass
+
+    def crash_at_3(rec, _state):
+        if rec.round == 3:
+            raise Boom()
+
+    crash_dir = str(tmp_path / "crash")
+    with pytest.raises(Boom):
+        run_controller(
+            build(), cfg, key=jax.random.PRNGKey(4),
+            checkpoint_dir=crash_dir, on_round=crash_at_3,
+        )
+    resumed_backend = build()
+    resumed = run_controller(
+        resumed_backend, cfg, key=jax.random.PRNGKey(4),
+        checkpoint_dir=crash_dir,
+    )
+    assert resumed.resumed_from_round == 3  # round 3 replays
+
+    def traj(rounds):
+        return [
+            (r.round, r.moved, r.service, r.target,
+             r.communication_cost, r.churn)
+            for r in rounds
+        ]
+
+    assert traj(resumed.rounds) == traj(full.rounds[2:])
+    assert resumed_backend.live_counts() == full_backend.live_counts()
+
+
+# ------------------------------------------------------------ fleet churn
+
+
+def _fleet_traj(result, name):
+    return [
+        (r.round, r.moved, r.service, r.target,
+         r.communication_cost, r.load_std)
+        for r in result.results[name].rounds
+    ]
+
+
+def test_fleet_churn_isolated_to_its_tenant():
+    """Acceptance: churn on tenant 1 (deploy-waves — graph-changing,
+    bucket-padding) leaves tenants 0 and 2 bit-identical with a
+    churn-free fleet run, across the padded/unpadded representation
+    change (the mask twins make it exact)."""
+
+    def run(profile):
+        fleet = make_fleet("mubench", 3, seed=5)
+        fleet.inject_imbalance()
+        cfg = RescheduleConfig(
+            algorithm="communication", max_rounds=8,
+            sleep_after_action_s=0.0, seed=5,
+            fleet=FleetConfig(tenants=3),
+            elastic=ElasticConfig(profile=profile, seed=11, tenants=(1,)),
+        )
+        return run_fleet_controller(fleet, cfg, key=jax.random.PRNGKey(5))
+
+    base = run("none")
+    churned = run("deploy-waves")
+    for name in ("tenant0", "tenant2"):
+        assert _fleet_traj(base, name) == _fleet_traj(churned, name)
+        assert all(r.churn is None for r in churned.results[name].rounds)
+    t1 = churned.results["tenant1"].rounds
+    assert any(r.churn and r.churn["events"] for r in t1)
+    # accounting holds per tenant under churn
+    for name, r in churned.results.items():
+        assert len(r.rounds) + r.skipped_rounds == 8
+
+
+def test_fleet_shared_buckets_keep_tenants_stackable(registry):
+    """A promotion on the churned tenant re-pads the WHOLE fleet (one
+    shared bucket set) — the loop keeps stacking and the batched kernel
+    retraces at most once per promotion."""
+    fleet = make_fleet("mubench", 2, seed=2)
+    fleet.inject_imbalance()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=10,
+        sleep_after_action_s=0.0, seed=2,
+        fleet=FleetConfig(tenants=2),
+        # diurnal autoscaling doubles replicas -> pods outgrow the first
+        # bucket mid-run on the churned tenant
+        elastic=ElasticConfig(
+            profile="diurnal-autoscale", seed=3, tenants=(0,), bucket_floor=8
+        ),
+    )
+    result = run_fleet_controller(
+        fleet, cfg, key=jax.random.PRNGKey(2), registry=registry,
+    )
+    for name, r in result.results.items():
+        assert len(r.rounds) + r.skipped_rounds == 10
+    t0 = result.results["tenant0"].rounds
+    promos = max((r.churn["promotions"] for r in t0 if r.churn), default=0)
+    traces = _traces(registry, "fleet_solve")
+    assert 1 <= traces <= 1 + promos
+
+
+# ------------------------------------------------------- watchdog rule
+
+
+def _round_rec(cost=1.0, lat=0.01, promotions=None):
+    churn = None if promotions is None else {"promotions": promotions}
+    return types.SimpleNamespace(
+        decision_latency_s=lat, communication_cost=cost,
+        attribution=None, churn=churn,
+    )
+
+
+def test_watchdog_retrace_rule_allows_promotions(registry):
+    wd = Watchdog(SLORules(max_retraces=1), registry=registry)
+    tr = registry.counter(
+        "jax_traces_total", "t", labelnames=("fn",)
+    ).labels(fn="k")
+    tr.inc()  # first compile
+    assert wd.observe_round(_round_rec(promotions=0)) == []
+    # a bucket promotion retraces the kernel: allowed, not a violation
+    tr.inc()
+    assert wd.observe_round(_round_rec(promotions=1)) == []
+    assert RULE_RETRACE not in wd.active
+    # a retrace with NO promotion to explain it: violation
+    tr.inc()
+    raised = wd.observe_round(_round_rec(promotions=1))
+    assert [v["rule"] for v in raised] == [RULE_RETRACE]
+    assert wd.active[RULE_RETRACE]["promotions_allowed"] == 1
+
+
+def test_watchdog_rebase_clears_promotion_allowance(registry):
+    wd = Watchdog(SLORules(max_retraces=1), registry=registry)
+    assert wd.observe_round(_round_rec(promotions=5)) == []  # baselined
+    wd.rebase()
+    assert wd._promo_allow == 0 and wd._promo_seen is None
+
+
+# ------------------------------------------------------- config + CLI
+
+
+def test_elastic_config_validation():
+    with pytest.raises(ValueError, match="churn profile"):
+        ElasticConfig(profile="tsunami").validate()
+    with pytest.raises(ValueError, match="bucket_floor"):
+        ElasticConfig(bucket_floor=0).validate()
+    with pytest.raises(ValueError, match="tenants"):
+        ElasticConfig(tenants=(-1,)).validate()
+    ElasticConfig(profile="steady", tenants=(0, 2)).validate()
+    with pytest.raises(ValueError, match="sim backend"):
+        RescheduleConfig(
+            backend="k8s", elastic=ElasticConfig(profile="steady")
+        ).validate()
+
+
+def test_elastic_config_from_toml(tmp_path):
+    f = tmp_path / "cfg.toml"
+    f.write_text(
+        'algorithm = "communication"\n'
+        "[elastic]\n"
+        'profile = "node-flap"\n'
+        "seed = 4\n"
+        "bucket_floor = 16\n"
+        "tenants = [1, 3]\n"
+    )
+    cfg = RescheduleConfig.from_toml(f)
+    assert cfg.elastic == ElasticConfig(
+        profile="node-flap", seed=4, bucket_floor=16, tenants=(1, 3)
+    )
+
+
+def test_experiment_config_rejects_bad_churn():
+    from kubernetes_rescheduling_tpu.bench.harness import ExperimentConfig
+
+    with pytest.raises(ValueError, match="churn profile"):
+        ExperimentConfig(churn_profile="tsunami")
+    with pytest.raises(ValueError, match="sim backend"):
+        ExperimentConfig(backend="k8s", churn_profile="steady")
+    # the weight estimator's call plan is frozen at cell start — under
+    # churn it would silently steer solves with the stale topology
+    with pytest.raises(ValueError, match="observe_weights"):
+        ExperimentConfig(churn_profile="steady", observe_weights=True)
+
+
+def test_churn_wave_advances_clock_once():
+    """A busy churn round reconciles as ONE wave (the apply_pod_moves
+    rule): simulated time advances by reconcile_delay_s per churny
+    round, never events × delay — else the harness's clock-driven load
+    segments would inflate ~100x under diurnal autoscaling."""
+    backend = make_backend("mubench", seed=1)
+    eng = ChurnEngine(
+        "diurnal-autoscale", seed=3, registry=MetricsRegistry()
+    )
+    eng.bind(backend, 10)
+    before = backend.clock_s
+    applied = eng.step(2)  # mid-sinusoid: many services rescale at once
+    assert len(applied) > 1
+    assert backend.clock_s - before == pytest.approx(backend.reconcile_delay_s)
+    # a quiet round costs nothing
+    before = backend.clock_s
+    if not eng.step(3):
+        assert backend.clock_s == before
+
+
+def test_cli_churn_flags_smoke(capsys):
+    from kubernetes_rescheduling_tpu import cli
+
+    rc = cli.main(
+        [
+            "reschedule", "--scenario", "mubench", "--rounds", "3",
+            "--imbalance", "--churn-profile", "steady",
+            "--churn-seed", "2",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert len(out["rounds"]) + out["skipped_rounds"] == 3
+    assert out["rounds"][0]["churn"] is not None
+
+
+def test_cli_rejects_churn_on_k8s():
+    from kubernetes_rescheduling_tpu import cli
+
+    with pytest.raises(SystemExit, match="sim backend"):
+        cli.main(
+            [
+                "reschedule", "--backend", "k8s",
+                "--churn-profile", "steady",
+            ]
+        )
+
+
+# --------------------------------------------------------- harness cell
+
+
+@pytest.mark.slow  # full harness cell with load phases; the controller-level churn pins stay fast in test_steady_churn_one_trace / the acceptance soak above
+def test_harness_churn_cell_records_rounds(tmp_path):
+    from kubernetes_rescheduling_tpu.bench.harness import (
+        ExperimentConfig,
+        run_experiment,
+    )
+    from kubernetes_rescheduling_tpu.bench.loadgen import LoadGenConfig
+
+    cfg = ExperimentConfig(
+        algorithms=("communication",), repeats=1, rounds=3,
+        scenario="mubench", out_dir=str(tmp_path),
+        churn_profile="steady", churn_seed=1,
+        load=LoadGenConfig(requests_per_phase=256, chunk=256),
+    )
+    summary = run_experiment(cfg)
+    assert len(summary["runs"]) == 1
+    run_dir = next((tmp_path).glob("session_*/communication/run_1"))
+    rounds = [
+        json.loads(line)
+        for line in (run_dir / "rounds.jsonl").read_text().splitlines()
+        if line.strip() and not line.startswith("#")
+    ]
+    recs = [r for r in rounds if "churn" in r]
+    assert recs and all(r["churn"] is not None for r in recs)

@@ -1,0 +1,91 @@
+"""CI twin of ``scripts/check_mask_threading.py``: every solver/
+attribution kernel entry point accepts and (transitively) reads the
+validity masks, so padded bucket slots are provably inert."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+
+def _load_checker():
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_mask_threading.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_mask_threading", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_mask_threading", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_kernels_all_thread_masks():
+    """The no-args self-check: the checked-in package satisfies the rule
+    the checker documents."""
+    checker = _load_checker()
+    assert checker.violations() == []
+
+
+def test_checker_catches_unmasked_kernel(tmp_path):
+    """A kernel that never consults a mask — directly or via a helper —
+    is flagged; one that reaches a mask through a call chain is not."""
+    checker = _load_checker()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "kernels.py").write_text(
+        "def _masked_sum(state):\n"
+        "    return (state.x * state.pod_valid).sum()\n"
+        "def good_kernel(state, graph):\n"
+        "    return _masked_sum(state)\n"
+        "def bad_kernel(state, graph):\n"
+        "    return state.x.sum()\n"           # ignores every mask
+        "def armless_kernel(key):\n"
+        "    return key\n"                      # no mask-carrying arg
+    )
+    bad = checker.violations(
+        package=pkg,
+        entries={"kernels.py": ("good_kernel", "bad_kernel", "armless_kernel")},
+    )
+    assert any("bad_kernel" in v and "mask" in v for v in bad)
+    assert any("armless_kernel" in v and "no mask-carrying" in v for v in bad)
+    assert not any("good_kernel" in v for v in bad)
+
+
+def test_checker_scopes_entry_points_to_their_module(tmp_path):
+    """A same-named masked function in ANOTHER module cannot vouch for a
+    listed kernel: the entry point must be defined — and masked — in the
+    module it is listed under."""
+    checker = _load_checker()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "real.py").write_text(
+        "def decide(state):\n"
+        "    return state.x.sum()\n"            # the listed kernel: unmasked
+    )
+    (pkg / "other.py").write_text(
+        "def decide(state):\n"
+        "    return state.pod_valid.sum()\n"    # impostor with the same name
+    )
+    bad = checker.violations(package=pkg, entries={"real.py": ("decide",)})
+    assert any("decide" in v and "mask" in v for v in bad)
+    # listing a module that never defines the name is 'not found', even
+    # though another module does define it
+    bad2 = checker.violations(package=pkg, entries={"real.py": ("helper",)})
+    assert any("not found" in v for v in bad2)
+
+
+def test_checker_flags_missing_entry_point(tmp_path):
+    """A listed kernel that does not exist (renamed, deleted) is loud —
+    the list cannot silently rot."""
+    checker = _load_checker()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("def real(state):\n    return state.node_valid\n")
+    bad = checker.violations(
+        package=pkg, entries={"m.py": ("real", "vanished")}
+    )
+    assert any("vanished" in v and "not found" in v for v in bad)
+    assert not any("real(" in v for v in bad)
+    bad2 = checker.violations(package=pkg, entries={"gone.py": ("x",)})
+    assert any("missing" in v for v in bad2)

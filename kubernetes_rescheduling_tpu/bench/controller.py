@@ -40,6 +40,7 @@ from kubernetes_rescheduling_tpu.objectives.metrics import (
     load_std,
 )
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.policies.proactive import scoring_policy
 from kubernetes_rescheduling_tpu.telemetry import (
     get_registry,
     instrument_jit,
@@ -60,7 +61,12 @@ from kubernetes_rescheduling_tpu.solver.global_solver import (
     GlobalSolverConfig,
     pct_balance_terms,
 )
-from kubernetes_rescheduling_tpu.solver.round_loop import decide, decide_explain
+from kubernetes_rescheduling_tpu.solver.round_loop import (
+    decide,
+    decide_explain,
+    decide_explain_with_forecast,
+    decide_with_forecast,
+)
 
 
 @dataclass
@@ -99,6 +105,10 @@ class RoundRecord:
     # this round — events, live S/N/P counts, the current shape buckets,
     # and the cumulative promotion count — None on static runs
     churn: dict | None = None
+    # forecast plane (forecast/): the proactive round's model state —
+    # skill vs the persistence baseline, running MAEs, and which path
+    # the round took (cold/predictive/degraded) — None on reactive runs
+    forecast: dict | None = None
 
     @property
     def decision_latency_s(self) -> float:
@@ -176,6 +186,19 @@ _decide_explain = instrument_jit(
 # (shape, top_k) signature — jax_traces_total{fn="controller_attribution"}.
 _attribution = instrument_jit(
     communication_cost_attribution, name="controller_attribution",
+    static_argnames=("top_k",),
+)
+
+# the proactive decision kernels: the SAME decide/decide_explain
+# machinery run against the predicted next-window state (the forecast
+# delta folded into node_base_cpu inside the trace). Own fn labels, same
+# steady-state invariant: jax_traces_total == 1 + counted bucket
+# promotions per (shape, top_k) signature.
+_decide_proactive = instrument_jit(
+    decide_with_forecast, name="controller_decide_proactive"
+)
+_decide_proactive_explain = instrument_jit(
+    decide_explain_with_forecast, name="controller_decide_proactive_explain",
     static_argnames=("top_k",),
 )
 
@@ -326,6 +349,14 @@ def run_controller(
             bucket_floor=config.elastic.bucket_floor,
             registry=registry,
         )
+    forecast_plane = None
+    if config.algorithm == "proactive":
+        # the forecast plane: one online forecaster per run, one kernel
+        # dispatch + one counted diag transfer per round. Lazy import —
+        # reactive runs never touch the forecast package.
+        from kubernetes_rescheduling_tpu.forecast.plane import ForecastPlane
+
+        forecast_plane = ForecastPlane(config.forecast, registry=registry)
     if churn is not None:
         # the churn feed flows through the boundary's backend passthrough
         # (like apply_pod_moves): chaos wrappers and the raw simulator see
@@ -381,6 +412,12 @@ def run_controller(
             )
         else:
             roofline_fns = ("global_assign", "sharded_restarts_dense")
+    elif forecast_plane is not None:
+        roofline_fns = (
+            ("controller_decide_proactive_explain",)
+            if explain_k > 0
+            else ("controller_decide_proactive",)
+        )
     elif explain_k > 0:
         roofline_fns = ("controller_decide_explain",)
     else:
@@ -534,10 +571,33 @@ def run_controller(
                         logger=logger, explain=explain_k > 0,
                     )
                 else:
+                    forecast_delta = None
+                    if forecast_plane is not None:
+                        # fold this round's observed loads into the
+                        # online model and predict the next window —
+                        # one instrumented dispatch, name-stripped view
+                        # (same jit-key rule as the decision kernels)
+                        t_fc = time.perf_counter()
+                        with span("controller/forecast", round=rnd):
+                            forecast_delta = forecast_plane.observe_and_predict(
+                                device_view(state)
+                            )
+                        forecast_latency = time.perf_counter() - t_fc
                     record = _greedy_round(
                         boundary, state, graph, config, sub, rnd,
                         logger=logger, explain_k=explain_k,
+                        forecast_delta=forecast_delta,
                     )
+                    if forecast_plane is not None:
+                        # the forecast dispatch is decision work: count
+                        # it in the round's device latency budget so
+                        # decisions/sec and the bench cells price the
+                        # proactive path honestly
+                        record.decision_latencies_s = (
+                            forecast_latency,
+                        ) + record.decision_latencies_s
+                        record.forecast = forecast_plane.round_info()
+                        forecast_plane.publish(registry)
                 boundary.advance(config.sleep_after_action_s)
                 with span("backend/monitor"):
                     new_state = boundary.monitor()
@@ -656,6 +716,7 @@ def run_controller(
 
 def _greedy_round(
     boundary, state, graph, config, key, rnd, *, logger=None, explain_k=0,
+    forecast_delta=None,
 ) -> RoundRecord:
     """Up to ``config.moves_per_round`` greedy moves: after each move the
     working snapshot is edited in place (the moved service's pods re-homed —
@@ -667,8 +728,15 @@ def _greedy_round(
     decision kernel (bit-identical choice) and records a
     ``DecisionExplanation`` — top-k hazard nodes, top-k candidate targets
     with score margins, chosen target and why — pulled device→host as ONE
-    counted transfer and emitted as a ``decision`` event."""
-    pid = jnp.asarray(POLICY_IDS[config.algorithm])
+    counted transfer and emitted as a ``decision`` event.
+
+    ``forecast_delta`` (proactive rounds) routes every decide through the
+    forecast-aware kernels: the same scoring policy (the forecast
+    config's base policy — reactive CAR by default) evaluated against
+    the PREDICTED next-window state. A zero delta reproduces the
+    reactive decisions bit-for-bit."""
+    scoring = scoring_policy(config.algorithm, config.forecast)
+    pid = jnp.asarray(POLICY_IDS[scoring])
     k_moves = config.moves_per_round
     first_hazard: str | None = None
     moved_names: list[str] = []
@@ -696,23 +764,30 @@ def _greedy_round(
             # the jit key is what lets pod/node churn reuse one compiled
             # program (names stay on the full state for the host side)
             dev_state, dev_graph = device_view(state), device_graph(graph)
+            thr = jnp.asarray(config.hazard_threshold_pct)
             if explain_k > 0:
-                most, hazard_mask, victim, svc, target, bundle = (
-                    jax.block_until_ready(
-                        _decide_explain(
-                            dev_state, dev_graph, pid,
-                            jnp.asarray(config.hazard_threshold_pct), sub,
-                            top_k=explain_k,
-                        )
+                if forecast_delta is not None:
+                    out = _decide_proactive_explain(
+                        dev_state, dev_graph, pid, thr, sub, forecast_delta,
+                        top_k=explain_k,
                     )
+                else:
+                    out = _decide_explain(
+                        dev_state, dev_graph, pid, thr, sub, top_k=explain_k,
+                    )
+                most, hazard_mask, victim, svc, target, bundle = (
+                    jax.block_until_ready(out)
                 )
             else:
                 bundle = None
-                most, hazard_mask, victim, svc, target = jax.block_until_ready(
-                    _decide(
-                        dev_state, dev_graph, pid,
-                        jnp.asarray(config.hazard_threshold_pct), sub,
+                if forecast_delta is not None:
+                    out = _decide_proactive(
+                        dev_state, dev_graph, pid, thr, sub, forecast_delta
                     )
+                else:
+                    out = _decide(dev_state, dev_graph, pid, thr, sub)
+                most, hazard_mask, victim, svc, target = jax.block_until_ready(
+                    out
                 )
         latencies.append(time.perf_counter() - t0)
 
@@ -752,7 +827,9 @@ def _greedy_round(
                 service=service_name,
                 target_node=target_name,
                 hazard_nodes=hazard_names,
-                mechanism=PlacementMechanism[config.algorithm],
+                # proactive resolves to its base policy's mechanism (the
+                # forecast changes the state scored, not how the move pins)
+                mechanism=PlacementMechanism[scoring],
             )
         )
         if expl is not None:

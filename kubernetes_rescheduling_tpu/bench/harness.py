@@ -46,6 +46,7 @@ from kubernetes_rescheduling_tpu.bench.sinks import (
 from kubernetes_rescheduling_tpu.config import (
     ChaosConfig,
     ElasticConfig,
+    ForecastConfig,
     PerfConfig,
     RescheduleConfig,
 )
@@ -124,6 +125,10 @@ class ExperimentConfig:
     # stream of their own yet).
     churn_profile: str = "none"
     churn_seed: int = 0
+    # Forecast plane: the online forecaster behind `proactive` cells
+    # (algorithms may include "proactive" — the head-to-head against
+    # reactive CAR under churn is run_forecast_headtohead's matrix).
+    forecast: ForecastConfig = field(default_factory=ForecastConfig)
     # Live ops plane: serve /metrics, /healthz, /events on this port for
     # the whole session (0 = ephemeral, None = off). One OpsPlane spans
     # every matrix cell; per-cell loggers re-bind as cells start, so
@@ -165,6 +170,7 @@ class ExperimentConfig:
         # fail an invalid churn cell in milliseconds, not after phase r1:
         # the profile name must parse, and churn injection is sim-only
         ElasticConfig(profile=self.churn_profile, seed=self.churn_seed).validate()
+        self.forecast.validate()
         if self.churn_profile != "none" and self.backend == "k8s":
             raise ValueError(
                 "churn_profile requires the sim backend: a live cluster "
@@ -559,6 +565,7 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                     elastic=ElasticConfig(
                         profile=cfg.churn_profile, seed=cfg.churn_seed + run_i
                     ),
+                    forecast=cfg.forecast,
                     max_consecutive_failures=cfg.max_consecutive_failures,
                 )
                 # solve_graph (above) closes over this accumulator; bound here,
@@ -781,6 +788,106 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
         if ops is not None:
             ops.close()
     return summary
+
+
+def run_forecast_headtohead(
+    profiles: tuple[str, ...] = ("diurnal-autoscale", "deploy-waves"),
+    rounds: int = 40,
+    *,
+    scenario: str = "dense",
+    seed: int = 1,
+    churn_seed: int = 7,
+    load_noise_frac: float = 0.05,
+    forecast: ForecastConfig | None = None,
+    logger_factory=None,
+    registry=None,
+) -> dict:
+    """The forecast-plane matrix cell: ``proactive`` vs reactive CAR on
+    IDENTICALLY seeded churned clusters, one pair per churn profile.
+
+    Both arms see the same backend construction, the same imbalance
+    injection, the same churn event stream (profile + seed), the same
+    metrics-reading noise stream, and the same controller key — the ONLY
+    difference is the algorithm, so the comparison isolates what
+    predicting the next window buys. Returns per-profile mean/final
+    communication cost for both arms, the proactive arm's final forecast
+    block (skill vs persistence), and round accounting — the acceptance
+    test pins ``proactive mean ≤ reactive mean`` and ``forecast_skill >
+    0`` on this cell.
+
+    ``load_noise_frac`` injects per-pod gaussian reading noise into the
+    sim's monitor (real metrics servers are noisy): under observation
+    noise the differenced ridge model has a PROVABLE edge over
+    persistence (deltas of a noisy level series are negatively
+    autocorrelated — the model learns the mean-reversion persistence
+    cannot express), which is exactly the regime the skill metric must
+    separate the two predictors in.
+    """
+    out: dict = {"rounds": rounds, "scenario": scenario, "profiles": {}}
+    for profile in profiles:
+        arms: dict[str, dict] = {}
+        for algo in ("proactive", "communication"):
+            backend = make_backend(scenario, seed)
+            if load_noise_frac:
+                backend.load = dataclasses.replace(
+                    backend.load, noise_frac=load_noise_frac
+                )
+            backend.inject_imbalance(backend.node_names[0])
+            from kubernetes_rescheduling_tpu.config import ObsConfig
+
+            rcfg = RescheduleConfig(
+                algorithm=algo,
+                max_rounds=rounds,
+                sleep_after_action_s=0.0,
+                seed=seed,
+                elastic=ElasticConfig(profile=profile, seed=churn_seed),
+                forecast=forecast if forecast is not None else ForecastConfig(),
+                # attribution is not under test here and would double the
+                # per-round device work of both arms, so it is OFF;
+                # explain follows the controller's usual gate — active
+                # only when the caller supplies a logger_factory (the
+                # acceptance test does, and pins bundle re-derivation)
+                obs=ObsConfig(attribution=False),
+            )
+            logger = logger_factory() if logger_factory is not None else None
+            with span("bench/forecast_headtohead", profile=profile, algorithm=algo):
+                result = run_controller(
+                    backend, rcfg, key=jax.random.PRNGKey(seed),
+                    logger=logger, registry=registry,
+                )
+            costs = [r.communication_cost for r in result.rounds]
+            arms[algo] = {
+                "mean_communication_cost": float(np.mean(costs)) if costs else 0.0,
+                "final_communication_cost": costs[-1] if costs else None,
+                "mean_load_std": float(
+                    np.mean([r.load_std for r in result.rounds])
+                ) if result.rounds else 0.0,
+                "rounds": len(result.rounds),
+                "skipped_rounds": result.skipped_rounds,
+                "moves": result.moves,
+                "forecast": next(
+                    (
+                        r.forecast
+                        for r in reversed(result.rounds)
+                        if r.forecast is not None
+                    ),
+                    None,
+                ),
+                "records": result.rounds,
+            }
+        pro, rea = arms["proactive"], arms["communication"]
+        out["profiles"][profile] = {
+            **{k: {kk: vv for kk, vv in v.items() if kk != "records"}
+               for k, v in arms.items()},
+            "proactive_vs_reactive_cost": (
+                pro["mean_communication_cost"]
+                / rea["mean_communication_cost"]
+                if rea["mean_communication_cost"] > 0
+                else 1.0
+            ),
+            "_records": {k: v["records"] for k, v in arms.items()},
+        }
+    return out
 
 
 def run_chaos_soak(

@@ -88,6 +88,56 @@ def _add_resilience_flags(parser: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_forecast_flags(parser: argparse.ArgumentParser) -> None:
+    """The forecast plane behind --algorithm proactive (reschedule/bench).
+
+    Defaults come FROM the ``ForecastConfig`` dataclass, so a bare CLI
+    proactive run and a programmatic/TOML/bench-cell run can never drift
+    onto different forecasters (config import stays jax-free)."""
+    from kubernetes_rescheduling_tpu.config import ForecastConfig
+
+    d = ForecastConfig()
+    parser.add_argument(
+        "--forecast-lags", type=int, default=d.lags,
+        help="lag-feature window of the online per-node ridge forecaster "
+             "(proactive algorithm)",
+    )
+    parser.add_argument(
+        "--forecast-decay", type=float, default=d.decay,
+        help="exponential weight of the rolling skill window per scored "
+             "round (~1/(1-decay) rounds dominate; 1.0 = cumulative)",
+    )
+    parser.add_argument(
+        "--forecast-ridge", type=float, default=d.ridge,
+        help="L2 regularization of the per-node ridge fits (keeps cold "
+             "solves well-posed)",
+    )
+    parser.add_argument(
+        "--forecast-min-history", type=int, default=d.min_history,
+        help="observations a node needs before its model prediction is "
+             "trusted; until then proactive rounds are bit-identical to "
+             "reactive CAR (persistence prediction)",
+    )
+    parser.add_argument(
+        "--forecast-min-skill", type=float, default=d.min_skill,
+        help="degrade gate: when forecast_skill (1 - mae_model/"
+             "mae_persistence) drops below this, proactive rounds fall "
+             "back to reactive CAR while the shadow model keeps scoring",
+    )
+
+
+def _forecast_config(args):
+    from kubernetes_rescheduling_tpu.config import ForecastConfig
+
+    return ForecastConfig(
+        lags=args.forecast_lags,
+        ridge=args.forecast_ridge,
+        min_history=args.forecast_min_history,
+        min_skill=args.forecast_min_skill,
+        decay=args.forecast_decay,
+    )
+
+
 def _add_telemetry_flags(parser: argparse.ArgumentParser) -> None:
     """The unified observability outputs, shared by every run command."""
     parser.add_argument(
@@ -135,7 +185,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     r = sub.add_parser("reschedule", help="run the rescheduling control loop")
     r.add_argument("--algorithm", default="communication",
-                   help="spread|binpack|random|kubescheduling|communication|car|global")
+                   help="spread|binpack|random|kubescheduling|communication|"
+                        "car|global|proactive (proactive = CAR against the "
+                        "forecast-predicted next-window state; --forecast-*)")
     r.add_argument("--backend", default="sim", choices=["sim", "k8s"])
     r.add_argument("--scenario", default="mubench",
                    choices=["mubench", "dense", "powerlaw", "large", "xlarge"])
@@ -192,6 +244,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "ops plane's perf_regression rule when --serve is "
                         "active (render trends with `telemetry perf PATH`)")
     _add_resilience_flags(r)
+    _add_forecast_flags(r)
     _add_telemetry_flags(r)
     _add_serve_flags(r)
 
@@ -242,6 +295,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "algorithm, sim backend)")
     b.add_argument("--seed", type=int, default=0)
     _add_resilience_flags(b)
+    _add_forecast_flags(b)
     _add_telemetry_flags(b)
     _add_serve_flags(b)
 
@@ -313,7 +367,9 @@ def build_parser() -> argparse.ArgumentParser:
              "summarizes a flight-recorder bundle (incl. the "
              "explain-consistency verdict), 'telemetry topo <files>' "
              "renders cost attribution, the node-pair heatmap, and move "
-             "provenance",
+             "provenance, 'telemetry dataset <rounds.jsonl...>' extracts "
+             "forecast training windows from recorded soaks and scores "
+             "the oracle ridge fit against the persistence baseline",
     )
     m.add_argument("paths", nargs="+",
                    help="artifact files (kind detected from record shape); "
@@ -327,7 +383,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "rounds.jsonl files or flight-recorder bundles and "
                         "renders the cost-attribution table, node-pair "
                         "heatmap, and move-provenance trail with the "
-                        "sum-consistency verdict")
+                        "sum-consistency verdict; 'dataset' takes "
+                        "rounds.jsonl files (or flight-recorder bundles) "
+                        "and reports the extracted per-node load / "
+                        "per-edge traffic training windows with the "
+                        "oracle fit's skill vs persistence")
     m.add_argument("--perf-window", type=int, default=5,
                    help="perf mode: prior readings each series is judged "
                         "against")
@@ -338,6 +398,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["median", "best"],
                    help="perf mode: judge against the window's median or "
                         "its best reading")
+    m.add_argument("--dataset-lags", type=int, default=4,
+                   help="dataset mode: lag-feature window length of the "
+                        "extracted training windows")
+    m.add_argument("--dataset-ridge", type=float, default=1e-3,
+                   help="dataset mode: L2 term of the offline oracle fit "
+                        "scored against the persistence baseline")
     return p
 
 
@@ -380,10 +446,21 @@ def cmd_telemetry(args) -> str:
     )
 
     mode, paths = "report", list(args.paths)
-    if paths and paths[0] in ("report", "explain", "bundle", "perf", "topo"):
+    if paths and paths[0] in (
+        "report", "explain", "bundle", "perf", "topo", "dataset"
+    ):
         mode, paths = paths[0], paths[1:]
     if not paths:
         raise SystemExit(f"telemetry {mode}: no artifact paths given")
+    if mode == "dataset":
+        # forecast training windows from recorded soaks — the numpy-only
+        # dataset module + oracle fitter (the forecast package resolves
+        # its jax halves lazily, so this mode never imports them)
+        from kubernetes_rescheduling_tpu.forecast.dataset import report_dataset
+
+        return report_dataset(
+            paths, lags=args.dataset_lags, ridge=args.dataset_ridge
+        )
     if mode == "explain":
         return report_explain(paths)
     if mode == "bundle":
@@ -643,6 +720,7 @@ def cmd_reschedule(args) -> dict:
             profile=args.churn_profile, seed=args.churn_seed
         ),
         max_consecutive_failures=args.max_consecutive_failures,
+        forecast=_forecast_config(args),
         perf=PerfConfig(ledger_path=args.perf_ledger),
     )
     ops, logger = _build_ops_plane(args, cfg)
@@ -706,6 +784,7 @@ def cmd_bench(args) -> dict:
         max_consecutive_failures=args.max_consecutive_failures,
         churn_profile=args.churn_profile,
         churn_seed=args.churn_seed,
+        forecast=_forecast_config(args),
         serve_port=args.serve,
         bundle_dir=args.bundle_dir,
     )

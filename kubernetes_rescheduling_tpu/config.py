@@ -84,6 +84,74 @@ class ElasticConfig:
 
 
 @dataclass(frozen=True)
+class ForecastConfig:
+    """Forecast-plane block (``[forecast]`` in TOML): the online
+    lag-feature ridge forecaster behind the ``proactive`` algorithm
+    (``forecast/``). jax-free, like the other blocks, so config import
+    stays light.
+
+    ``lags`` is the feature window length; ``ridge`` the L2 term that
+    keeps every per-node solve well-posed; ``min_history`` how many
+    observations a node needs before its model prediction is trusted
+    (until then the prediction IS persistence — proactive rounds are
+    bit-identical to reactive ones); ``min_skill`` the device-side
+    degrade gate: when ``forecast_skill = 1 − mae_model/mae_persistence``
+    drops below it, the applied delta zeroes and the round falls back to
+    reactive CAR (the shadow model keeps scoring so it can recover).
+    ``decay`` is the exponential weight of the skill window (per scored
+    round): ~1/(1−decay) recent rounds dominate, so a model that starts
+    badly and then learns re-earns the gate instead of dragging its
+    cold-start errors forever (1.0 = cumulative, never forgets).
+    ``fit_decay`` is the separate recursive-least-squares forgetting of
+    the ridge statistics — deliberately LONGER than the skill window
+    (the noise mean-reversion the model exploits is stationary and
+    rewards memory; the skill verdict must react fast).
+    ``base_policy`` is the greedy policy the proactive rounds score
+    with — the forecast moves the STATE the policy sees, not the policy
+    itself."""
+
+    lags: int = 2
+    ridge: float = 1e-3
+    min_history: int = 12
+    min_skill: float = 0.0
+    decay: float = 0.85
+    fit_decay: float = 0.97
+    base_policy: str = "communication"
+
+    def validate(self) -> "ForecastConfig":
+        if self.lags < 1:
+            raise ValueError(f"forecast lags must be >= 1, got {self.lags}")
+        if self.ridge <= 0:
+            raise ValueError(
+                f"forecast ridge must be > 0 (it keeps cold solves "
+                f"well-posed), got {self.ridge}"
+            )
+        if self.min_history < self.lags + 2:
+            raise ValueError(
+                f"forecast min_history must be >= lags + 2 (a node needs "
+                f"a full feature window plus targets before its fit "
+                f"means anything), got {self.min_history} with lags="
+                f"{self.lags}"
+            )
+        if not (0.0 < self.decay <= 1.0):
+            raise ValueError(
+                f"forecast decay must be in (0, 1] (1 = cumulative skill "
+                f"window), got {self.decay}"
+            )
+        if not (0.0 < self.fit_decay <= 1.0):
+            raise ValueError(
+                f"forecast fit_decay must be in (0, 1] (1 = infinite "
+                f"fit memory), got {self.fit_decay}"
+            )
+        if self.base_policy not in POLICIES:
+            raise ValueError(
+                f"forecast base_policy must be a greedy policy "
+                f"{sorted(POLICIES)}, got {self.base_policy!r}"
+            )
+        return self
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Fault-injection block: which named ``backends.chaos`` profile wraps
     the loop's backend (``"none"`` = no wrapper), under which fault seed.
@@ -158,6 +226,11 @@ class ObsConfig:
     slo_latency_p95_s: float = 0.0       # 0 disables the latency rule
     slo_cost_regression_frac: float = 0.0  # 0 disables the cost rule
     slo_max_retraces: int = 1            # 0 disables the retrace rule
+    slo_forecast_min_skill: float = 0.0  # forecast_skill SLO rule: a trained
+                                         # forecaster whose skill drops below
+                                         # this is in violation (only judges
+                                         # rounds that carry forecast data,
+                                         # so reactive runs never trip it)
 
     def validate(self) -> "ObsConfig":
         if self.serve_port is not None and not (0 <= self.serve_port <= 65535):
@@ -180,6 +253,11 @@ class ObsConfig:
             raise ValueError("SLO thresholds must be >= 0")
         if self.slo_max_retraces < 0:
             raise ValueError("slo_max_retraces must be >= 0")
+        if self.slo_forecast_min_skill > 1.0:
+            raise ValueError(
+                "slo_forecast_min_skill must be <= 1.0 (skill is bounded "
+                "above by 1, so a larger threshold would always violate)"
+            )
         return self
 
 
@@ -298,6 +376,11 @@ class RescheduleConfig:
     # ElasticConfig.
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
 
+    # Forecast plane: the online forecaster behind the `proactive`
+    # algorithm (lag window, ridge term, warm-up, skill degrade gate) —
+    # see ForecastConfig.
+    forecast: ForecastConfig = field(default_factory=ForecastConfig)
+
     # Observability: the live ops plane (HTTP endpoint, decision
     # explainability, flight recorder, SLO watchdog) — see ObsConfig.
     obs: ObsConfig = field(default_factory=ObsConfig)
@@ -306,7 +389,7 @@ class RescheduleConfig:
     perf: PerfConfig = field(default_factory=PerfConfig)
 
     def validate(self) -> "RescheduleConfig":
-        valid = set(POLICIES) | {"global"}
+        valid = set(POLICIES) | {"global", "proactive"}
         if self.algorithm not in valid:
             raise ValueError(
                 f"unknown algorithm {self.algorithm!r}; expected one of {sorted(valid)}"
@@ -346,6 +429,24 @@ class RescheduleConfig:
                     "better than wave capping, RESULTS.md round 4)"
                 )
         self.retry.validate()
+        self.forecast.validate()
+        if self.algorithm == "proactive":
+            # proactive is the greedy machinery against the predicted
+            # state — the global/pod solvers never consume the forecast
+            # delta, so routing a proactive round through them would
+            # silently decide reactively under a predictive label
+            if self.moves_per_round == "all":
+                raise ValueError(
+                    "algorithm='proactive' requires integer "
+                    "moves_per_round: 'all' routes the round through the "
+                    "global solver, which does not consume the forecast"
+                )
+            if self.placement_unit != "service":
+                raise ValueError(
+                    "algorithm='proactive' requires placement_unit="
+                    "'service' (the forecast-aware kernels are the greedy "
+                    "deployment movers)"
+                )
         self.elastic.validate()
         if self.elastic.profile != "none" and self.backend == "k8s":
             raise ValueError(
@@ -370,6 +471,12 @@ class RescheduleConfig:
             if self.placement_unit != "service":
                 raise ValueError(
                     "fleet mode requires placement_unit='service'"
+                )
+            if self.algorithm == "proactive":
+                raise ValueError(
+                    "fleet mode does not support algorithm='proactive' "
+                    "yet: the batched fleet kernel has no per-tenant "
+                    "forecast state"
                 )
         if self.max_consecutive_failures < 0:
             raise ValueError("max_consecutive_failures must be >= 0")
@@ -401,6 +508,8 @@ class RescheduleConfig:
             if isinstance(el.get("tenants"), list):
                 el["tenants"] = tuple(el["tenants"])
             data["elastic"] = ElasticConfig(**el)
+        if isinstance(data.get("forecast"), dict):
+            data["forecast"] = ForecastConfig(**data["forecast"])
         if isinstance(data.get("obs"), dict):
             data["obs"] = ObsConfig(**data["obs"])
         if isinstance(data.get("perf"), dict):

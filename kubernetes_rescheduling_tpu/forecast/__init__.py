@@ -1,0 +1,59 @@
+"""Forecast plane: predictive scheduling trained on our own telemetry.
+
+- :mod:`model` — the pure-JAX online lag-feature ridge forecaster
+  (per-node, batched, mask-aware) with its persistence baseline and
+  device-side skill gate;
+- :mod:`plane` — the controller-facing :class:`ForecastPlane` (one
+  instrumented kernel dispatch + one counted transfer per round,
+  forecast metric families);
+- :mod:`dataset` — numpy-only extraction of per-node load / per-edge
+  traffic training windows from recorded ``rounds.jsonl`` soaks (the
+  ``telemetry dataset`` CLI mode).
+
+The numpy twin lives in :mod:`oracle.forecast` (the ``oracle/optimum``
+precedent); the ``proactive`` algorithm consuming the predictions lives
+in :mod:`policies.proactive` + ``bench/controller.py``.
+
+``model``/``plane`` import jax + flax at module load, so their names
+resolve lazily (PEP 562, the ``utils/__init__`` precedent): importing
+``forecast.dataset`` — the numpy-only half the ``telemetry dataset``
+CLI mode uses — does not pay the jax/flax import through this package.
+(Module-level hygiene only: the top-level package ``__init__`` imports
+jax anyway.)
+"""
+
+_LAZY = {
+    "ForecastState": "model",
+    "fit_ridge": "model",
+    "forecast_step": "model",
+    "init_forecast_state": "model",
+    "node_loads": "model",
+    "repad_forecast_state": "model",
+    "ridge_predict": "model",
+    "ForecastPlane": "plane",
+    "FORECAST_SITE": "plane",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(
+            f"kubernetes_rescheduling_tpu.forecast.{_LAZY[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "ForecastState",
+    "fit_ridge",
+    "forecast_step",
+    "init_forecast_state",
+    "node_loads",
+    "repad_forecast_state",
+    "ridge_predict",
+    "ForecastPlane",
+    "FORECAST_SITE",
+]

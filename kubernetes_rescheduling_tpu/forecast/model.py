@@ -1,0 +1,379 @@
+"""The device-side forecaster: lag-feature ridge regression per series.
+
+The reactive controller reschedules against the *last observed* snapshot,
+so under the bursty/diurnal churn profiles it is always one window behind
+the load it is placing against. This module closes that gap with the
+smallest learned model that can: one lag-feature linear (ridge) predictor
+PER NODE, batched over the node axis, trained ONLINE from the loop's own
+snapshots — no external training system, no stored datasets, everything
+inside one jitted kernel per round.
+
+Model. For a series ``y`` (a node's CPU load fraction, or — in the
+offline path — a service edge's traffic share), the one-step prediction
+is persistence plus a learned trend over the last L DIFFERENCES:
+
+    ŷ_{t+1} = y_t + w · [Δy_{t-L+1}, …, Δy_t, 1],   Δy_t = y_t − y_{t-1}
+
+with ``w`` the ridge solution ``(XᵀX + λI)⁻¹ XᵀΔy`` over every observed
+difference window. Differencing is the robustness choice, not a detail:
+ridge shrinkage pulls ``w`` toward ZERO, and a zero trend model IS the
+persistence baseline — so a series with no learnable structure (or a
+freshly trained model) degrades toward skill ≈ 0 instead of extrapolating
+raw levels badly, and a trending/diurnal series is where the model earns
+positive skill. Online, the kernel keeps the sufficient statistics
+``A ← A + x xᵀ`` / ``b ← b + x Δy`` per node and re-solves the tiny
+(L+1)² system each round — O(N·(L+1)²) work, batched over nodes in one
+``jnp.linalg.solve``.
+
+Mask-awareness (the elastic contract). Padded bucket slots and churned
+nodes must never poison the fit: every accumulation is weighted by
+``state.node_valid``, a slot whose validity FLIPS ON (a drained slot
+re-used, a node added) restarts its series from zero, and invalid slots
+always predict persistence with a zero applied delta — so a padded +
+masked problem is bit-exact with its unpadded twin (the mask-twin tests
+pin it).
+
+Persistence baseline & skill. The model must BEAT the free predictor
+``ŷ_{t+1} = y_t`` to earn the right to steer placement:
+``forecast_skill = 1 − MAE(model)/MAE(persistence)`` over every round
+where a trained model prediction existed. The kernel gates the applied
+delta on ``skill ≥ min_skill`` DEVICE-SIDE, so a forecaster that loses
+to persistence degrades the proactive policy to reactive CAR (delta 0 →
+bit-identical decisions) without a host round trip — and keeps scoring
+its shadow predictions so it can re-earn the gate back.
+
+Cold start. Until ``min_history`` observations per node the prediction
+IS persistence: the applied delta is exactly 0.0, so a proactive round
+with an untrained forecaster is bit-identical to a plain reactive round
+(test-pinned) — never NaN, never a crash (the ridge term keeps every
+solve well-posed even for all-zero slots).
+
+The numpy twin in ``oracle/forecast.py`` re-implements the fit and the
+baseline for test-pinning (the ``oracle/optimum.py`` precedent).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+
+# diagnostic vector layout (one device→host pull per round, site="forecast")
+DIAG_SKILL = 0          # 1 - mae_model/mae_persistence (0 until scored)
+DIAG_MAE_MODEL = 1      # running mean |model pred - observed|, in load
+                        # FRACTION of node capacity (the model's units)
+DIAG_MAE_PERSIST = 2    # running mean |persistence pred - observed|
+DIAG_ROUNDS = 3         # decayed weight of rounds contributing to the
+                        # error window (~min(rounds, 1/(1-decay)))
+DIAG_FRAC_MODEL = 4     # fraction of valid nodes on the model path
+DIAG_TRAINED = 5        # 1.0 once any node has min_history observations
+DIAG_SIZE = 6
+
+
+@struct.dataclass
+class ForecastState:
+    """Online per-node forecaster state (all arrays carry the node axis).
+
+    ``history`` is a rolling window, row 0 oldest, row L-1 the most
+    recent observation. ``A``/``b`` are the ridge normal-equation
+    sufficient statistics per node. ``prev_model_pred`` is last round's
+    SHADOW model prediction (kept even while the skill gate degrades the
+    applied delta to zero, so a bad model keeps being scored and can
+    recover). Scalars accumulate the masked per-round mean absolute
+    errors for the skill metric.
+    """
+
+    history: jax.Array          # f32[L+1, N] — L+1 levels yield L differences
+    count: jax.Array            # f32[N] observations since the slot was (re)validated
+    A: jax.Array                # f32[N, F, F], F = L+1
+    b: jax.Array                # f32[N, F]
+    prev_model_pred: jax.Array  # f32[N]
+    prev_model_valid: jax.Array  # bool[N] — shadow prediction existed last round
+    prev_valid: jax.Array       # bool[N] — node validity last round
+    err_model_sum: jax.Array    # f32[] masked-mean |model - obs| summed over rounds
+    err_persist_sum: jax.Array  # f32[]
+    err_rounds: jax.Array       # f32[]
+    steps: jax.Array            # i32[] rounds observed
+
+    @property
+    def lags(self) -> int:
+        return int(self.history.shape[0]) - 1
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.history.shape[1])
+
+
+def init_forecast_state(lags: int, num_nodes: int) -> ForecastState:
+    """A fresh (all-cold) forecaster over ``num_nodes`` series."""
+    if lags < 1:
+        raise ValueError(f"lags must be >= 1, got {lags}")
+    f = lags + 1
+    z = jnp.zeros
+    return ForecastState(
+        history=z((lags + 1, num_nodes), jnp.float32),
+        count=z((num_nodes,), jnp.float32),
+        A=z((num_nodes, f, f), jnp.float32),
+        b=z((num_nodes, f), jnp.float32),
+        prev_model_pred=z((num_nodes,), jnp.float32),
+        prev_model_valid=z((num_nodes,), bool),
+        prev_valid=z((num_nodes,), bool),
+        err_model_sum=jnp.float32(0.0),
+        err_persist_sum=jnp.float32(0.0),
+        err_rounds=jnp.float32(0.0),
+        steps=jnp.int32(0),
+    )
+
+
+def repad_forecast_state(fstate: ForecastState, num_nodes: int) -> ForecastState:
+    """Grow the node axis to a promoted bucket capacity.
+
+    New slots arrive cold (zero stats, invalid) — exactly the state a
+    freshly validated node would be reset to by the kernel's slot
+    hygiene, so a bucket promotion costs one retrace (new shapes) and
+    nothing else. Shrinking is rejected: buckets never demote.
+    """
+    n_old = fstate.num_nodes
+    if num_nodes < n_old:
+        raise ValueError(
+            f"forecast state cannot shrink ({n_old} -> {num_nodes}); "
+            "shape buckets never demote"
+        )
+    if num_nodes == n_old:
+        return fstate
+    pad = num_nodes - n_old
+
+    def pad_last(x):
+        width = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+        return jnp.pad(x, width)
+
+    return fstate.replace(
+        history=pad_last(fstate.history),
+        count=pad_last(fstate.count),
+        A=jnp.pad(fstate.A, ((0, pad), (0, 0), (0, 0))),
+        b=jnp.pad(fstate.b, ((0, pad), (0, 0))),
+        prev_model_pred=pad_last(fstate.prev_model_pred),
+        prev_model_valid=pad_last(fstate.prev_model_valid),
+        prev_valid=pad_last(fstate.prev_valid),
+    )
+
+
+def fit_ridge(
+    X: jax.Array, y: jax.Array, mask: jax.Array, ridge: float | jax.Array
+) -> jax.Array:
+    """Batched masked ridge fit — the OFFLINE form of the same math the
+    online kernel accumulates incrementally.
+
+    ``X``: f32[B, T, F] lag-feature windows per series, ``y``: f32[B, T]
+    targets, ``mask``: [B, T] sample validity (0-weighted samples
+    contribute nothing — churned slots never poison the fit). Returns
+    the per-series weights ``W``: f32[B, F]. The ridge term keeps every
+    solve well-posed even for all-masked series (W = 0 there).
+    """
+    X = jnp.asarray(X, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    w = jnp.asarray(mask, jnp.float32)
+    A = jnp.einsum("btf,btg,bt->bfg", X, X, w)
+    b = jnp.einsum("btf,bt,bt->bf", X, y, w)
+    eye = jnp.eye(X.shape[-1], dtype=jnp.float32)
+    ridge_a = A + jnp.asarray(ridge, jnp.float32) * eye
+    return jnp.linalg.solve(ridge_a, b[..., None])[..., 0]
+
+
+def ridge_predict(W: jax.Array, X: jax.Array) -> jax.Array:
+    """Apply per-series weights over window arrays: f32[B, F] ×
+    f32[B, T, F] → f32[B, T] (T may be absent)."""
+    X = jnp.asarray(X, jnp.float32)
+    if X.ndim == W.ndim:
+        return jnp.einsum("bf,bf->b", X, W)
+    return jnp.einsum("btf,bf->bt", X, W)
+
+
+def node_loads(state: ClusterState) -> jax.Array:
+    """The observed per-node series the online forecaster trains on:
+    CPU load as a FRACTION of node capacity, masked to valid nodes.
+
+    Fractions, not millicores: the normal equations accumulate x·xᵀ, so
+    raw millicore magnitudes (~2e4 on the reference cluster) square into
+    ~4e8 f32 entries where the ridge term vanishes and the solve goes
+    ill-conditioned. Capacity-normalized series keep features O(1), the
+    ridge meaningful, and the fit scale-free across scenarios.
+    """
+    cap = jnp.where(state.node_cpu_cap > 0, state.node_cpu_cap, 1.0)
+    return jnp.where(state.node_valid, state.node_cpu_used() / cap, 0.0)
+
+
+def forecast_step(
+    state: ClusterState,
+    fstate: ForecastState,
+    ridge: jax.Array,
+    min_skill: jax.Array,
+    min_history: jax.Array,
+    decay: jax.Array,
+    fit_decay: jax.Array,
+) -> tuple[ForecastState, jax.Array, jax.Array]:
+    """One online round: score last round's predictions, fold the new
+    observation into the ridge statistics, and predict the next window.
+
+    The series deliberately includes the controller's OWN move-induced
+    jumps, as observations, features, and training targets alike: a
+    landed deployment's load spike tends to mean-revert (CAR drains it
+    again, autoscaling rebalances), which is exactly the kind of
+    structure the difference model can learn — and the persistence
+    baseline faces the same jumps, so the skill comparison stays fair.
+    (An earlier design excluded "intervention-contaminated" samples; it
+    measurably LOWERED skill by deleting the most learnable deltas.)
+
+    Returns ``(fstate', delta, diag)``:
+
+    - ``delta``: f32[N] — the load adjustment the proactive policy adds
+      to ``node_base_cpu`` so hazard detection and ``policy_scores`` run
+      against the PREDICTED next-window state. Exactly 0.0 wherever the
+      model is cold, gated off by skill, or the slot is invalid — the
+      reactive-equivalence contract.
+    - ``diag``: f32[DIAG_SIZE] — skill / MAEs / accounting for the one
+      per-round host pull.
+
+    Fully traced and mask-aware; see the module docstring for the
+    contract each piece honors.
+    """
+    loads = node_loads(state)                        # f32[N]
+    valid = state.node_valid
+    lags = fstate.history.shape[0] - 1
+    feat = lags + 1
+
+    # slot hygiene: a slot whose validity flips ON this round is a NEW
+    # series (drained slot re-used, node added) — its history, counts,
+    # and normal-equation stats restart from zero so the old tenant's
+    # series can never leak into the new one's fit
+    fresh = valid & ~fstate.prev_valid & (fstate.steps > 0)
+    keep = (~fresh).astype(jnp.float32)
+    history = fstate.history * keep[None, :]
+    count = fstate.count * keep
+    A = fstate.A * keep[:, None, None]
+    b = fstate.b * keep[:, None]
+
+    # score LAST round's predictions against today's observation — the
+    # shadow model prediction vs the free persistence predictor (last
+    # observed value). Only nodes that had a trained prediction AND kept
+    # their identity contribute, so the two MAEs are computed over the
+    # same sample set and the skill ratio is apples-to-apples.
+    prev_obs = history[-1]
+    acct_mask = valid & fstate.prev_model_valid & (~fresh)
+    acct = acct_mask.astype(jnp.float32)
+    n_acct = jnp.sum(acct)
+    # where(), not multiply-by-mask: a non-finite shadow prediction on a
+    # masked slot would turn inf·0 into NaN and poison the scalar sums
+    em = jnp.sum(
+        jnp.where(acct_mask, jnp.abs(fstate.prev_model_pred - loads), 0.0)
+    )
+    ep = jnp.sum(jnp.where(acct_mask, jnp.abs(prev_obs - loads), 0.0))
+    has = n_acct > 0
+    denom = jnp.maximum(n_acct, 1.0)
+    # exponentially-decayed error window (per SCORED round): recent
+    # rounds dominate with effective length ~1/(1-decay), so a model
+    # that learns re-earns the skill gate instead of dragging its
+    # cold-start misses forever. decay == 1 degenerates to cumulative.
+    err_model_sum = jnp.where(
+        has, decay * fstate.err_model_sum + em / denom, fstate.err_model_sum
+    )
+    err_persist_sum = jnp.where(
+        has, decay * fstate.err_persist_sum + ep / denom,
+        fstate.err_persist_sum,
+    )
+    err_rounds = jnp.where(
+        has, decay * fstate.err_rounds + 1.0, fstate.err_rounds
+    )
+
+    # ridge accumulation: a node with a full DIFFERENCE window
+    # contributes one (features, target) sample — features are the L
+    # differences of the window BEFORE today's observation (+ bias), the
+    # target is today's observed delta. Regressing deltas on deltas is
+    # what makes ridge shrinkage degrade to persistence, not to zero.
+    ones_row = jnp.ones((1, history.shape[1]), jnp.float32)
+
+    def features(hist):
+        return jnp.concatenate([hist[1:] - hist[:-1], ones_row])
+
+    x_feat = features(history)
+    target_delta = loads - history[-1]
+    # recursive-least-squares forgetting: contributing nodes decay their
+    # statistics so the fit tracks the CURRENT regime instead of
+    # averaging over every regime the series ever visited — with a
+    # LONGER memory than the skill window (fit_decay vs decay): the
+    # noise mean-reversion the model exploits is stationary and rewards
+    # accumulated samples, while the skill verdict must react fast
+    upd = (valid & (count >= lags + 1)).astype(jnp.float32)
+    rho = 1.0 - upd * (1.0 - fit_decay)              # decay where updating
+    A = rho[:, None, None] * A + upd[:, None, None] * jnp.einsum(
+        "fn,gn->nfg", x_feat, x_feat
+    )
+    b = rho[:, None] * b + upd[:, None] * (x_feat * target_delta[None, :]).T
+
+    # push today's observation into the rolling window
+    history = jnp.concatenate(
+        [history[1:], jnp.where(valid, loads, 0.0)[None, :]]
+    )
+    count = count + valid.astype(jnp.float32)
+
+    # solve the per-node ridge systems and predict the NEXT window from
+    # the post-push difference features; negative load predictions clip
+    # to zero
+    eye = jnp.eye(feat, dtype=jnp.float32)
+    W = jnp.linalg.solve(A + ridge * eye, b[..., None])[..., 0]  # f32[N, F]
+    x_next = features(history)
+    model_pred = jnp.maximum(
+        loads + jnp.einsum("nf,fn->n", W, x_next), 0.0
+    )
+    # the never-NaN contract: a pathological slot (ill-conditioned f32
+    # solve despite the ridge) falls back to persistence for THAT node
+    # instead of poisoning the round
+    model_pred = jnp.where(jnp.isfinite(model_pred), model_pred, loads)
+
+    node_trained = valid & (count >= min_history)
+    scored = err_rounds > 0
+    skill = jnp.where(
+        err_persist_sum > 1e-9,
+        1.0 - err_model_sum / jnp.where(err_persist_sum > 1e-9, err_persist_sum, 1.0),
+        # no persistence error at all: a perfectly flat (or unscored)
+        # series — the model is at worst even, never "winning"
+        jnp.where(err_model_sum > 1e-9, -1.0, 0.0),
+    )
+    skill = jnp.where(scored, skill, 0.0)
+    use_model = node_trained & (skill >= min_skill)
+    pred = jnp.where(use_model, model_pred, loads)
+    # the model works in capacity fractions; the applied delta converts
+    # back to millicores so it folds into node_base_cpu. A persistence
+    # prediction gives (loads - loads) * cap = exactly 0.0 — the
+    # reactive-equivalence contract.
+    cap = jnp.where(state.node_cpu_cap > 0, state.node_cpu_cap, 1.0)
+    delta = jnp.where(valid, (pred - loads) * cap, 0.0)
+
+    trained_any = jnp.any(node_trained)
+    n_valid = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    diag = jnp.stack(
+        [
+            skill,
+            err_model_sum / jnp.maximum(err_rounds, 1.0),
+            err_persist_sum / jnp.maximum(err_rounds, 1.0),
+            err_rounds,
+            jnp.sum(use_model.astype(jnp.float32)) / n_valid,
+            trained_any.astype(jnp.float32),
+        ]
+    )
+    new_fstate = fstate.replace(
+        history=history,
+        count=count,
+        A=A,
+        b=b,
+        prev_model_pred=model_pred,
+        prev_model_valid=node_trained,
+        prev_valid=valid,
+        err_model_sum=err_model_sum,
+        err_persist_sum=err_persist_sum,
+        err_rounds=err_rounds,
+        steps=fstate.steps + 1,
+    )
+    return new_fstate, delta, diag

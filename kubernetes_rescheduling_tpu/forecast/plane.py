@@ -1,0 +1,130 @@
+"""Host-side forecast plane: the controller's handle on the online model.
+
+Owns the :class:`~forecast.model.ForecastState` pytree across rounds,
+dispatches the ONE jitted forecast kernel per round (instrumented as
+``controller_forecast`` — the 1-steady-state-trace invariant applies,
+retracing only on a counted bucket promotion, which this plane absorbs
+by re-padding its node axis), pulls the diagnostic vector as ONE counted
+transfer (``site="forecast"``), and publishes the forecast-error metric
+families:
+
+- ``forecast_mae{target}`` / ``forecast_skill{target}`` gauges — running
+  model vs persistence error and the skill ratio;
+- ``forecast_rounds_total{mode}`` — rounds by path: ``cold`` (still
+  warming up, persistence applied), ``predictive`` (trained model
+  steering the decision), ``degraded`` (trained but losing to
+  persistence — the skill gate zeroed the applied delta, so the round
+  is reactive CAR again).
+
+The per-round record (:meth:`round_info`) rides
+``RoundRecord.forecast`` → rounds.jsonl, where the watchdog's
+``forecast_skill`` rule reads it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_rescheduling_tpu.forecast.model import (
+    DIAG_FRAC_MODEL,
+    DIAG_MAE_MODEL,
+    DIAG_MAE_PERSIST,
+    DIAG_ROUNDS,
+    DIAG_SKILL,
+    DIAG_TRAINED,
+    forecast_step,
+    init_forecast_state,
+    repad_forecast_state,
+)
+from kubernetes_rescheduling_tpu.telemetry import instrument_jit, pull
+
+FORECAST_SITE = "forecast"
+
+# the online update+solve+predict kernel: one dispatch per proactive
+# round. Same steady-state contract as the decision kernels —
+# jax_traces_total{fn="controller_forecast"} == 1 + bucket promotions
+# (the node axis re-pads on promotion; nothing else changes shape).
+_forecast_step = instrument_jit(forecast_step, name="controller_forecast")
+
+
+class ForecastPlane:
+    """One per proactive run; never shared across tenants."""
+
+    def __init__(self, config, *, registry=None) -> None:
+        self.config = config
+        self.registry = registry
+        self._fstate = None
+        self._last: dict | None = None
+        # traced scalars (not Python floats) so every configuration of
+        # the plane reuses the one compiled kernel signature
+        self._ridge = jnp.float32(config.ridge)
+        self._min_skill = jnp.float32(config.min_skill)
+        self._min_history = jnp.float32(config.min_history)
+        self._decay = jnp.float32(config.decay)
+        self._fit_decay = jnp.float32(config.fit_decay)
+
+    def observe_and_predict(self, state) -> jax.Array:
+        """Fold ``state``'s observed node loads into the model and
+        return the predicted-load ``delta`` (f32[N], device-resident)
+        for this round's proactive decision. Handles bucket promotions
+        by re-padding the forecaster's node axis (one legal retrace)."""
+        n = state.num_nodes
+        if self._fstate is None:
+            self._fstate = init_forecast_state(self.config.lags, n)
+        elif self._fstate.num_nodes != n:
+            self._fstate = repad_forecast_state(self._fstate, n)
+        self._fstate, delta, diag = _forecast_step(
+            state, self._fstate, self._ridge, self._min_skill,
+            self._min_history, self._decay, self._fit_decay,
+        )
+        d = pull(diag, site=FORECAST_SITE, registry=self.registry)
+        trained = bool(d[DIAG_TRAINED] > 0)
+        frac = float(d[DIAG_FRAC_MODEL])
+        skill = float(d[DIAG_SKILL])
+        if not trained:
+            mode = "cold"
+        elif frac > 0:
+            mode = "predictive"
+        else:
+            mode = "degraded"
+        self._last = {
+            "skill": skill,
+            "mae_model": float(d[DIAG_MAE_MODEL]),
+            "mae_persistence": float(d[DIAG_MAE_PERSIST]),
+            "scored_weight": float(d[DIAG_ROUNDS]),
+            "model_node_frac": frac,
+            "trained": trained,
+            "mode": mode,
+            "target": "node_load",
+        }
+        return delta
+
+    def round_info(self) -> dict | None:
+        """The latest round's forecast block (RoundRecord.forecast)."""
+        return dict(self._last) if self._last is not None else None
+
+    def publish(self, registry) -> None:
+        """One metric sample set per proactive round."""
+        if self._last is None:
+            return
+        lab = {"target": "node_load"}
+        registry.gauge(
+            "forecast_mae",
+            "running mean absolute one-step forecast error (model vs "
+            "observed), by target family",
+            labelnames=("target",),
+        ).labels(**lab).set(self._last["mae_model"])
+        registry.gauge(
+            "forecast_skill",
+            "1 - mae_model/mae_persistence: >0 means the learned "
+            "forecaster beats the persistence baseline",
+            labelnames=("target",),
+        ).labels(**lab).set(self._last["skill"])
+        registry.counter(
+            "forecast_rounds_total",
+            "proactive rounds by forecast path (cold = warming up, "
+            "predictive = model steering, degraded = skill gate fell "
+            "back to reactive)",
+            labelnames=("mode",),
+        ).labels(mode=self._last["mode"]).inc()

@@ -1,0 +1,58 @@
+"""The ``proactive`` algorithm: reactive CAR's kernels, one window ahead.
+
+The reference's five strategies — and our ``global`` solver — all score
+the *last observed* snapshot, so under bursty/diurnal load they place
+against a cluster that no longer exists by the time the move lands.
+``proactive`` keeps the exact greedy machinery (hazard detection →
+victim → ``policies.scoring.policy_scores`` → masked lex argmax) but
+runs it against the PREDICTED next-window state: the online forecaster
+(``forecast/``) supplies a per-node load delta, and the decision kernels
+(``solver.round_loop.decide_with_forecast`` /
+``decide_explain_with_forecast``) fold it into ``node_base_cpu`` before
+scoring — one compiled program, same explain bundle, same audit
+invariants.
+
+This module is the host-side glue: the algorithm name, the scoring
+policy it delegates to (the forecast only moves the STATE the policy
+sees, not the policy itself — by default reactive CAR's
+``communication``), and :func:`predicted_state`, the one shared
+definition of how a load delta becomes a state (also used by the mask
+twins and the oracle tests, so the device and test views can never
+disagree on what "predicted state" means).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState
+from kubernetes_rescheduling_tpu.policies.scoring import POLICY_IDS
+
+PROACTIVE = "proactive"
+
+
+def scoring_policy(algorithm: str, forecast_cfg) -> str:
+    """The greedy policy whose key table a round actually scores with:
+    ``proactive`` delegates to the forecast config's base policy
+    (reactive CAR by default); every other algorithm scores as itself."""
+    if algorithm == PROACTIVE:
+        return forecast_cfg.base_policy
+    return algorithm
+
+
+def scoring_policy_id(algorithm: str, forecast_cfg) -> int:
+    return POLICY_IDS[scoring_policy(algorithm, forecast_cfg)]
+
+
+def predicted_state(state: ClusterState, delta: jax.Array) -> ClusterState:
+    """The next-window state the proactive policy decides against:
+    observed state with the forecast per-node load delta folded into
+    ``node_base_cpu`` (so ``node_cpu_used``/``node_cpu_pct`` — hazard
+    detection AND every load-derived scoring feature — see predicted
+    loads). A zero delta (cold start, skill-gated degrade, invalid
+    slots) reproduces the reactive state bit-for-bit: adding 0.0 changes
+    no value, so the decision kernels emit identical moves.
+    """
+    delta = jnp.asarray(delta, jnp.float32)
+    return state.replace(node_base_cpu=state.node_base_cpu + delta)

@@ -148,6 +148,54 @@ def decide_explain(
     return most, hazard_mask, victim, svc, target, bundle
 
 
+def decide_with_forecast(
+    state: ClusterState,
+    graph: CommGraph,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    key: jax.Array,
+    delta: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The ``proactive`` decision kernel: :func:`decide` run against the
+    PREDICTED next-window state — the observed snapshot with the
+    forecaster's per-node load ``delta`` folded into ``node_base_cpu``
+    (``policies.proactive.predicted_state``, the one shared definition).
+
+    Hazard detection and ``policy_scores`` therefore see next-window
+    loads while the pod/topology arrays stay observed — masked slots
+    carry a zero delta by the forecast kernel's contract, so padding
+    stays inert. A zero ``delta`` (cold start, skill-gated degrade)
+    makes this bit-identical to :func:`decide` on the raw state — the
+    reactive-equivalence invariant the cold-start tests pin.
+    """
+    from kubernetes_rescheduling_tpu.policies.proactive import predicted_state
+
+    return decide(predicted_state(state, delta), graph, policy_id, threshold, key)
+
+
+def decide_explain_with_forecast(
+    state: ClusterState,
+    graph: CommGraph,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    key: jax.Array,
+    delta: jax.Array,
+    *,
+    top_k: int = 3,
+) -> tuple[jax.Array, ...]:
+    """:func:`decide_explain` against the predicted state — the explain
+    twin of :func:`decide_with_forecast`. The recorded bundle carries
+    the PREDICTED scores the decision was actually made from, so the
+    explain-consistency invariant (chosen == argmax of recorded rows)
+    holds for proactive rounds for free."""
+    from kubernetes_rescheduling_tpu.policies.proactive import predicted_state
+
+    return decide_explain(
+        predicted_state(state, delta), graph, policy_id, threshold, key,
+        top_k=top_k,
+    )
+
+
 def round_step(
     state: ClusterState,
     graph: CommGraph,

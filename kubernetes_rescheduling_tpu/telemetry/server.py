@@ -287,6 +287,9 @@ class OpsPlane:
                 attribution_drift_frac=getattr(
                     obs, "attribution_drift_frac", 0.0
                 ),
+                forecast_min_skill=getattr(
+                    obs, "slo_forecast_min_skill", 0.0
+                ),
             ),
             registry=registry,
             logger=logger,

@@ -55,6 +55,7 @@ RULE_COST = "comm_cost_regression"
 RULE_RETRACE = "retrace"
 RULE_PERF = "perf_regression"
 RULE_ATTRIBUTION = "attribution_drift"
+RULE_FORECAST = "forecast_skill"
 
 
 @dataclass(frozen=True)
@@ -72,6 +73,15 @@ class SLORules:
     # has collapsed onto a single hot pair (0 disables; needs per-round
     # attribution records — see telemetry.attribution)
     attribution_drift_frac: float = 0.0
+    # forecast skill: a TRAINED forecaster whose running skill (1 −
+    # mae_model/mae_persistence) sits below this threshold is losing to
+    # the free persistence baseline — the proactive policy is paying
+    # model risk for nothing (the controller's device-side gate has
+    # already degraded those rounds to reactive CAR; this rule makes the
+    # condition a visible SLO). Only rounds carrying forecast data are
+    # judged, so reactive runs can never trip it. The natural threshold
+    # is 0.0 — "at least tie persistence".
+    forecast_min_skill: float = 0.0
 
     def validate(self) -> "SLORules":
         if self.window < 2:
@@ -85,6 +95,11 @@ class SLORules:
             raise ValueError("max_retraces must be >= 0")
         if not (0.0 <= self.attribution_drift_frac <= 1.0):
             raise ValueError("attribution_drift_frac must be in [0, 1]")
+        if self.forecast_min_skill > 1.0:
+            raise ValueError(
+                "forecast_min_skill must be <= 1.0 (skill is bounded "
+                "above by 1)"
+            )
         return self
 
 
@@ -121,6 +136,7 @@ class Watchdog:
         self._promo_allow: int = 0
         self._perf_active: dict[str, dict[str, Any]] = {}
         self._attr: dict[str, Any] | None = None  # latest round's attribution
+        self._forecast: dict[str, Any] | None = None  # latest round's forecast
         self.active: dict[str, dict[str, Any]] = {}
         self.violations_seen = 0
 
@@ -139,6 +155,7 @@ class Watchdog:
         self._promo_seen = None
         self._promo_allow = 0
         self._attr = None
+        self._forecast = None
         self.active = (
             {RULE_PERF: self.active[RULE_PERF]}
             if RULE_PERF in self.active
@@ -156,6 +173,9 @@ class Watchdog:
         attr = getattr(record, "attribution", None)
         if isinstance(attr, dict):
             self._attr = attr
+        forecast = getattr(record, "forecast", None)
+        if isinstance(forecast, dict):
+            self._forecast = forecast
         churn = getattr(record, "churn", None)
         if isinstance(churn, dict):
             p = churn.get("promotions")
@@ -250,6 +270,20 @@ class Watchdog:
                         "threshold_frac": r.attribution_drift_frac,
                         "total": total,
                     }
+        if self._forecast is not None and self._forecast.get("trained"):
+            # the LATEST round's forecast block judges: a trained model
+            # below the skill floor is losing to the free persistence
+            # baseline (the controller's device gate has already
+            # degraded the delta — this surfaces it on /healthz)
+            skill = float(self._forecast.get("skill", 0.0))
+            if skill < r.forecast_min_skill:
+                now[RULE_FORECAST] = {
+                    "skill": skill,
+                    "threshold": r.forecast_min_skill,
+                    "mae_model": self._forecast.get("mae_model"),
+                    "mae_persistence": self._forecast.get("mae_persistence"),
+                    "mode": self._forecast.get("mode"),
+                }
         if self._perf_active:
             now[RULE_PERF] = {
                 "metrics": {

@@ -42,7 +42,14 @@ PACKAGE = ROOT / "kubernetes_rescheduling_tpu"
 # thread the masks. These are the functions the controller/fleet/metric
 # planes hand padded states and graphs to.
 ENTRY_POINTS: dict[str, tuple[str, ...]] = {
-    "solver/round_loop.py": ("decide", "decide_explain", "round_step"),
+    "solver/round_loop.py": (
+        "decide",
+        "decide_explain",
+        "round_step",
+        "decide_with_forecast",
+        "decide_explain_with_forecast",
+    ),
+    "forecast/model.py": ("forecast_step", "node_loads"),
     "solver/fleet.py": ("_fleet_decide", "_fleet_metrics"),
     "parallel/fleet.py": ("fleet_solve_dp",),
     "objectives/metrics.py": (

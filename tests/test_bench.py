@@ -595,6 +595,10 @@ def test_observe_weights_streams_per_round(monkeypatch, tmp_path):
     assert calls["n"] >= 3
 
 
+@pytest.mark.slow  # the sparse ROUTE + per-backend graph cache stay pinned
+# fast by test_fleet.py::test_sparse_graph_cache_not_rebuilt_per_round (a
+# 2-round sparse controller run counting graph builds), and the improving
+# behavior by test_sparse_solver.py::test_sparse_solver_never_worse_and_improves
 def test_controller_sparse_backend_routes_and_improves():
     """solver_backend='sparse' drives global rounds through the block-local
     solver (graph cached per backend) with the same improving behavior."""

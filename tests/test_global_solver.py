@@ -122,6 +122,10 @@ def test_capacity_frac_breaks_up_dense_pile():
     assert float(pct[0]) < float(start_pct[0])
 
 
+@pytest.mark.slow  # λ's load-balance term stays exercised fast by
+# test_capacity_frac_breaks_up_dense_pile below (balance_weight=0.5 in both
+# solves) and the tp-parity cases in test_parallel.py; this is the heavy
+# two-compile λ=0-vs-50 monotonicity variant
 def test_balance_weight_tradeoff():
     wm = mubench_workmodel_c()
     state = state_from_workmodel(wm, seed=3, node_cpu_cap_m=4000.0)
@@ -253,6 +257,10 @@ def test_move_cost_blocks_unprofitable_moves():
     assert float(priced_info["move_penalty"]) == 0.0
 
 
+@pytest.mark.slow  # the accept direction of the move-cost gate (profitable
+# moves clear the restart bill, penalty reported) stays pinned fast by
+# test_sharded_sparse.py::test_move_cost_parity_and_gate; the blocking
+# direction keeps its own fast pin above
 def test_move_cost_accepts_profitable_moves_and_reports_penalty():
     """A modest move price still lets high-value moves through; the
     adopted improvement exceeds the restart bill, and fewer pods restart

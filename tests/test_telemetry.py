@@ -383,6 +383,11 @@ def test_run_controller_one_round_event_and_one_compile(registry, tracer):
     assert calls.labels(fn="controller_decide").value == rounds
 
 
+@pytest.mark.slow  # the solver before/after objective surfacing stays
+# pinned fast by test_observability.py::
+# test_global_round_explanation_scores_match_wave_selection (the same
+# _pull_solver_objectives fields on the explanation record of a global
+# controller round); this is the heavy gauge/transfer-count variant
 def test_run_controller_global_objectives_surface(registry):
     rounds = 2
     logger = StructuredLogger(name="t")

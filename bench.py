@@ -37,6 +37,12 @@ Environment knobs:
                   traffic, one node drain/add cycle — emitting the
                   median device ms/round with the decision kernel's
                   trace count pinned at 1 + counted bucket promotions) |
+                  pipeline (async pipelined control loop: the live greedy
+                  loop at 10k×1k run sequential vs software-pipelined —
+                  single-bundle round-end transfers, background monitor —
+                  emitting the pipelined wall-clock ms/round with the
+                  decisions pinned bit-identical, the RTT attribution,
+                  and the overlap ratio; ledger series wall_round_ms) |
                   forecast (predictive scheduling: BENCH_ROUNDS proactive
                   rounds of the powerlaw scenario under diurnal-autoscale
                   churn — the online per-node ridge forecaster + the
@@ -357,6 +363,101 @@ def _sparse50k_problem():
     return _sparse_problem(50_000, 2_000)
 
 
+def bench_pipeline(baseline_ms: float, rounds: int) -> dict:
+    """Pipelined control loop: the SAME live greedy loop run twice on
+    identically-seeded 10k-pod × 1k-node clusters — sequential schedule
+    vs the software-pipelined one (``[controller] pipeline``). The
+    headline is the pipelined wall-clock ms/round; the structural claims
+    ride in ``extra``: decisions bit-identical (service/target streams
+    compared), wall ≤ target vs the device ms/round, the explicit RTT
+    attribution, and the measured overlap ratio. Appends to the perf
+    ledger as the ``wall_round_ms`` series (BENCH_LEDGER).
+
+    NOTE on CPU smoke runs: the overlap win is RTT hiding, and rtt_ms
+    on a local CPU backend is ~0.1 ms while the sim monitor is
+    GIL-bound Python the background thread cannot overlap with host
+    work — expect speedup_vs_sequential ≈ 1 ± ambient noise there. The
+    single-bundle round-end transfer (the other half of this arc)
+    benefits BOTH schedules and is already in the sequential baseline.
+    The ≤ 2× wall-vs-device acceptance is the tunneled-rig (BENCH)
+    reading."""
+    import jax
+
+    from kubernetes_rescheduling_tpu.bench.controller import run_controller
+    from kubernetes_rescheduling_tpu.bench.harness import make_backend
+    from kubernetes_rescheduling_tpu.config import (
+        ControllerConfig,
+        RescheduleConfig,
+    )
+
+    rtt_ms = measure_rtt_ms()
+
+    def run(pipeline: bool):
+        backend = make_backend("large", seed=0)
+        backend.inject_imbalance(backend.node_names[0])
+        cfg = RescheduleConfig(
+            algorithm="communication",
+            max_rounds=rounds,
+            sleep_after_action_s=0.0,
+            seed=0,
+            controller=ControllerConfig(pipeline=pipeline),
+        )
+        t0 = time.perf_counter()
+        result = run_controller(backend, cfg, key=jax.random.PRNGKey(0))
+        return result, time.perf_counter() - t0
+
+    seq, seq_wall = run(False)
+    pl, pl_wall = run(True)
+
+    def med(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2] if xs else 0.0
+
+    # drop round 1 (compile) from the medians, like the other live cells
+    seq_wall_ms = med([r.wall_s * 1e3 for r in seq.rounds[1:]])
+    pl_wall_ms = med([r.wall_s * 1e3 for r in pl.rounds[1:]])
+    device_ms = med([r.decision_latency_s * 1e3 for r in seq.rounds[1:]])
+    ratios = [
+        r.pipeline["overlap_ratio"] for r in pl.rounds if r.pipeline
+    ]
+    bit_identical = [
+        (r.services_moved, r.target, round(r.communication_cost, 6))
+        for r in seq.rounds
+    ] == [
+        (r.services_moved, r.target, round(r.communication_cost, 6))
+        for r in pl.rounds
+    ]
+    return {
+        "metric": "wall_round_ms",
+        "value": round(pl_wall_ms, 4),
+        "unit": "ms",
+        "vs_baseline": round(baseline_ms / max(pl_wall_ms, 1e-9), 3),
+        "extra": {
+            "scenario": "pipeline",
+            "rounds": rounds,
+            "sequential_wall_round_ms": round(seq_wall_ms, 4),
+            "device_ms_per_round": round(device_ms, 4),
+            # the acceptance gate: pipelined wall-clock round vs device
+            # compute (target <= 2x on the tunneled rig)
+            "wall_vs_device": round(pl_wall_ms / max(device_ms, 1e-9), 3),
+            "speedup_vs_sequential": round(
+                seq_wall_ms / max(pl_wall_ms, 1e-9), 3
+            ),
+            "rtt_ms": round(rtt_ms, 3),
+            "overlap_ratio_mean": round(
+                sum(ratios) / len(ratios), 4
+            ) if ratios else 0.0,
+            "pipelined_rounds": len(ratios),
+            "bit_identical": bit_identical,
+            "total_wall_s": {
+                "sequential": round(seq_wall, 3),
+                "pipelined": round(pl_wall, 3),
+            },
+            "devices": [str(d.platform) for d in jax.devices()],
+        },
+    }
+
+
 def bench_elastic(baseline_ms: float, rounds: int) -> dict:
     """Elastic topologies: the full controller loop under sustained
     seeded churn (diurnal-autoscale: every service's replica target
@@ -531,6 +632,12 @@ def main() -> int:
 
     if scenario == "fleet":
         result = bench_fleet(reps, baseline_ms, _env_int("BENCH_TENANTS", 16))
+        _ledger_append(result)
+        print(json.dumps(result))
+        return 0
+
+    if scenario == "pipeline":
+        result = bench_pipeline(baseline_ms, _env_int("BENCH_ROUNDS", 12))
         _ledger_append(result)
         print(json.dumps(result))
         return 0

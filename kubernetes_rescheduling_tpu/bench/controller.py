@@ -14,6 +14,7 @@ moved (SURVEY.md §7 '--moves-per-round all' mode).
 from __future__ import annotations
 
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import jax
@@ -29,22 +30,24 @@ from kubernetes_rescheduling_tpu.bench.boundary import (
     BoundaryClient,
     CircuitBreaker,
 )
+from kubernetes_rescheduling_tpu.bench.round_end import (
+    METRIC_COST,
+    METRIC_HEAD,
+    METRIC_LOAD_STD,
+    RoundCloser,
+    dispatch_round_end,
+    fence,
+)
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
 from kubernetes_rescheduling_tpu.elastic.buckets import (
     device_graph,
     device_view,
-)
-from kubernetes_rescheduling_tpu.objectives.metrics import (
-    communication_cost,
-    communication_cost_attribution,
-    load_std,
 )
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS
 from kubernetes_rescheduling_tpu.policies.proactive import scoring_policy
 from kubernetes_rescheduling_tpu.telemetry import (
     get_registry,
     instrument_jit,
-    pull,
     span,
 )
 from kubernetes_rescheduling_tpu.telemetry import attribution as attribution_mod
@@ -109,6 +112,15 @@ class RoundRecord:
     # skill vs the persistence baseline, running MAEs, and which path
     # the round took (cold/predictive/degraded) — None on reactive runs
     forecast: dict | None = None
+    # wall-clock lifecycle of the round (timing field — excluded from
+    # the pipelined-vs-sequential bit-identity comparison): execute
+    # start to record finalize
+    wall_s: float = 0.0
+    # pipelined-schedule telemetry (timing field): depth, the fraction of
+    # background boundary time hidden behind foreground work, and the
+    # raw background/blocked seconds — None on sequentially-scheduled
+    # rounds (including drained rounds of a pipelined run)
+    pipeline: dict | None = None
 
     @property
     def decision_latency_s(self) -> float:
@@ -179,21 +191,26 @@ _decide_explain = instrument_jit(
     static_argnames=("top_k",),
 )
 
-# the cost-decomposition kernel (objectives.metrics): per-node-pair
-# matrix collapse + top-k edge attribution, produced alongside the scalar
-# objective and pulled as ONE bundled transfer (site="attribution").
-# Same steady-state invariant as the decision kernels: 1 trace per
-# (shape, top_k) signature — jax_traces_total{fn="controller_attribution"}.
-_attribution = instrument_jit(
-    communication_cost_attribution, name="controller_attribution",
-    static_argnames=("top_k",),
-)
+# NOTE: the per-round cost/attribution kernels now live in
+# bench/round_end.py (``controller_round_end``): one compiled program
+# computes the comm-cost/load-std pair AND the flat attribution bundle,
+# and the host pulls it — together with every other diagnostic the round
+# deferred (explain bundles, forecast diag, solver objectives) — as ONE
+# counted ``round_end`` transfer per executed round.
 
 # the proactive decision kernels: the SAME decide/decide_explain
 # machinery run against the predicted next-window state (the forecast
 # delta folded into node_base_cpu inside the trace). Own fn labels, same
 # steady-state invariant: jax_traces_total == 1 + counted bucket
 # promotions per (shape, top_k) signature.
+#
+# None of the decide kernels donate their snapshot argument
+# (donate_argnums): their outputs — index scalars and a bool hazard
+# mask — can alias none of the f32/i32 snapshot buffers, so XLA would
+# warn per compile and reuse nothing. The donated carries live where
+# aliasing is total: the global solver's placement carry
+# (solver.global_solver.global_assign_donated) and the forecast plane's
+# RLS state (forecast.plane).
 _decide_proactive = instrument_jit(
     decide_with_forecast, name="controller_decide_proactive"
 )
@@ -249,6 +266,721 @@ def _emit_round_metrics(registry, algorithm: str, record: "RoundRecord") -> None
         ).labels(**lab).set(record.objective_after)
 
 
+# wall-clock round-latency buckets (milliseconds): the live plane's
+# rounds span sub-ms sim rounds to multi-second paced live rounds
+_WALL_MS_BUCKETS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+
+def observe_wall_round(registry, mode: str, wall_s: float) -> None:
+    """THE `wall_round_ms` declaration (one definition — the solo loop's
+    schedules and the fleet loop share name/help/buckets through here,
+    so the series can never fork)."""
+    registry.histogram(
+        "wall_round_ms",
+        "wall-clock lifecycle of one executed controller round "
+        "(execute start to record finalize), by schedule",
+        labelnames=("mode",),
+        buckets=_WALL_MS_BUCKETS,
+    ).labels(mode=mode).observe(wall_s * 1e3)
+
+
+def pipeline_depth_gauge(registry):
+    """THE `pipeline_depth` declaration (set only by pipelined runs)."""
+    return registry.gauge(
+        "pipeline_depth",
+        "configured software-pipeline depth of the control loop "
+        "(0/absent = sequential)",
+    )
+
+
+def pipeline_overlap_gauge(registry):
+    """THE `pipeline_overlap_ratio` declaration (set only by pipelined
+    runs — a sequential run must not export a stray zero series)."""
+    return registry.gauge(
+        "pipeline_overlap_ratio",
+        "fraction of the background boundary (advance+monitor) time "
+        "hidden behind foreground work, most recent pipelined round",
+    )
+
+
+class _Runtime:
+    """The control loop's shared machinery: boundary, breaker, churn,
+    forecast plane, explain/attribution gates, the round-end bundle
+    protocol, and the per-round helpers both schedules compose.
+
+    The SEQUENTIAL schedule (``sequential_round``) is the historical
+    loop re-expressed over the single-bundle round-end protocol; the
+    PIPELINED schedule (``_pipelined_loop``) interleaves the same helper
+    calls so the previous round's flush + host tail overlap the current
+    round's device compute and the post-move monitor runs in a
+    background thread — with the backend seeing the exact sequential
+    call order, which is what makes the two schedules bit-identical on
+    the sim backend.
+    """
+
+    def __init__(
+        self,
+        backend,
+        config,
+        *,
+        key,
+        on_round,
+        checkpoint_dir,
+        logger,
+        graph,
+        registry,
+        ops,
+        churn,
+    ):
+        self.config = config
+        self.registry = registry
+        self.key = key
+        self.on_round = on_round
+        self.logger = logger
+        self.ops = ops
+
+        if config.chaos.profile != "none":
+            backend = with_chaos(
+                backend, config.chaos.profile, seed=config.chaos.seed,
+                registry=registry,
+            )
+        self.breaker = CircuitBreaker(
+            max_consecutive_failures=config.max_consecutive_failures,
+            cooldown_rounds=config.breaker_cooldown_rounds,
+            logger=logger,
+            registry=registry,
+        )
+        self.boundary = BoundaryClient(
+            backend,
+            policy=config.retry,
+            breaker=self.breaker,
+            failure_budget_per_round=config.failure_budget_per_round,
+            logger=logger,
+            registry=registry,
+        )
+        if churn is None and config.elastic.profile != "none":
+            from kubernetes_rescheduling_tpu.elastic.engine import ChurnEngine
+
+            churn = ChurnEngine(
+                config.elastic.profile,
+                seed=config.elastic.seed,
+                bucket_floor=config.elastic.bucket_floor,
+                registry=registry,
+            )
+        self.churn = churn
+        self.forecast_plane = None
+        if config.algorithm == "proactive":
+            # the forecast plane: one online forecaster per run, one kernel
+            # dispatch per round whose diag rides the round-end bundle.
+            # Lazy import — reactive runs never touch the forecast package.
+            from kubernetes_rescheduling_tpu.forecast.plane import ForecastPlane
+
+            self.forecast_plane = ForecastPlane(config.forecast, registry=registry)
+        if churn is not None:
+            # the churn feed flows through the boundary's backend passthrough
+            # (like apply_pod_moves): chaos wrappers and the raw simulator see
+            # one stream, and bind() pushes the initial bucket capacities so
+            # even round 1's snapshot is bucket-padded
+            churn.bind(self.boundary, config.max_rounds, registry=registry)
+        if ops is not None:
+            ops.bind(breaker=self.breaker, logger=logger, algorithm=config.algorithm)
+            self.breaker.on_transition = ops.on_breaker_transition
+        # decision explainability: on when configured AND someone is listening
+        # (a structured logger or the ops plane) — the bare loop stays exactly
+        # the historical decision kernel
+        self.explain_k = (
+            config.obs.explain_top_k
+            if config.obs.explain and (ops is not None or logger is not None)
+            else 0
+        )
+        # cost attribution rides the same gate; when on, the attribution
+        # bundle rides the round-end transfer the loop pays anyway
+        self.attr_k = (
+            config.obs.attribution_top_k
+            if config.obs.attribution and (ops is not None or logger is not None)
+            else 0
+        )
+        self.timeline = attribution_mod.PlacementTimeline() if self.attr_k > 0 else None
+        # decisions may run on an estimated graph; TELEMETRY always reports on
+        # the backend's declared graph so round costs stay comparable across
+        # configurations (and with the harness's before/after metrics)
+        self.metric_graph = self.boundary.comm_graph()
+        self.graph_static = graph is None or not callable(graph)
+        if graph is None:
+            self.graph_src = lambda: self.metric_graph
+        elif callable(graph):
+            self.graph_src = graph
+        else:
+            self.graph_src = lambda: graph
+        self.result = ControllerResult()
+
+        # per-round device observability: which instrumented kernel this run's
+        # rounds dispatch (preference order — the roofline publishes for the
+        # first label with a captured cost snapshot)
+        if config.algorithm == "global" or config.moves_per_round == "all":
+            # prefer THIS run's solver family: the cost book is process-global,
+            # so a dense-first list would publish the dense kernel's static
+            # cost against a sparse round's latency in a mixed bench session.
+            # The dense labels stay as FALLBACK on the sparse path because
+            # global_assign_sparse genuinely routes small graphs through the
+            # dense kernel — there the dense attribution is the true one.
+            if config.solver_backend == "sparse":
+                self.roofline_fns = (
+                    "global_assign_sparse", "sharded_restarts_sparse",
+                    "global_assign", "sharded_restarts_dense",
+                )
+            else:
+                self.roofline_fns = ("global_assign", "sharded_restarts_dense")
+        elif self.forecast_plane is not None:
+            self.roofline_fns = (
+                ("controller_decide_proactive_explain",)
+                if self.explain_k > 0
+                else ("controller_decide_proactive",)
+            )
+        elif self.explain_k > 0:
+            self.roofline_fns = ("controller_decide_explain",)
+        else:
+            self.roofline_fns = ("controller_decide",)
+
+        self.mgr = CheckpointManager(checkpoint_dir) if checkpoint_dir else None
+        # carry donation (config.controller.donate_carry): the global
+        # solver's donated state carry is only legal when NOTHING outside
+        # this loop can touch the pre-solve snapshot's device buffers
+        # afterwards — a checkpoint manager re-serializes the carried
+        # snapshot on degraded/skipped rounds, on_round hands it to
+        # arbitrary sinks, and the ops plane digests it per round, so any
+        # of them forces the defensive-copy path instead
+        self.donate_ok = (
+            config.controller.donate_carry
+            and self.mgr is None
+            and on_round is None
+            and ops is None
+        )
+        self.start_round = 1
+        if self.mgr is not None:
+            latest = self.mgr.latest()
+            if latest is not None:
+                done_round, saved_state, _extra = latest
+                if churn is not None:
+                    # fast-forward the churn stream over the already-completed
+                    # rounds: the event schedule depends only on (profile,
+                    # seed, round, topology) — never on controller moves — so
+                    # replaying it on the freshly built backend reconstructs
+                    # the checkpoint-time topology AND positions the churn rng
+                    # exactly where the uninterrupted run had it. Without
+                    # this, a resumed churn run would silently restart from
+                    # the initial topology with a rewound event stream.
+                    # (Replayed events re-count in churn_events_total when the
+                    # resume shares a registry with the original run.)
+                    for past in range(1, done_round + 1):
+                        churn.step(past)
+                    # the metric graph read above predates the replayed
+                    # events — re-read it so resumed rounds report against
+                    # the same topology the uninterrupted run saw
+                    self.metric_graph = self.boundary.comm_graph()
+                restore = getattr(backend, "restore_placement", None)
+                if restore is not None:
+                    restore(saved_state)
+                self.start_round = done_round + 1
+                self.result.resumed_from_round = self.start_round
+                if logger is not None:
+                    logger.info(
+                        "resume", round=self.start_round, checkpoint=done_round
+                    )
+
+        # churn bookkeeping that must SURVIVE skipped rounds (see the
+        # sequential loop's historical comments): a round whose churn was
+        # applied but never re-monitored leaves these set, and the next
+        # executed round settles the debt before deciding
+        self.remask_needed = False
+        self.rebind_timeline = False
+        self.pending_churn: list[dict] = []
+
+        # one snapshot per round: the post-move snapshot provides this round's
+        # metrics AND the next round's state. Startup has no last-good
+        # snapshot to degrade to, so the initial monitor gets its own bounded
+        # probe loop on top of the per-call retries; only a backend that
+        # stays dark through all of it raises.
+        self.state = None
+        self._pending_end: dict | None = None
+        for _ in range(max(3, config.max_consecutive_failures + 1)):
+            probe = self.boundary.monitor()
+            if probe is not None:
+                self.note_fresh_snapshot(probe)
+                break
+        if self.state is None:
+            raise ConnectionError(
+                "backend unavailable: initial monitor() failed after retries "
+                "(no last good snapshot to degrade to)"
+            )
+        if self.timeline is not None:
+            # provenance model: the initial residency collapse (host-side,
+            # once per run) the per-move cost deltas telescope from
+            self.timeline.bind(self.state, self.metric_graph)
+
+    # ---- round-end bundle protocol ----
+
+    def note_fresh_snapshot(self, state) -> None:
+        """Adopt a fresh monitor snapshot and dispatch its round-end
+        bundle (async, never pulled unless it closes a record): the
+        post-move snapshot's bundle closes its own round; a startup/
+        probe/remask snapshot's bundle is the degraded-close fallback —
+        exactly the state the historical loop measured on, at the same
+        transfer cost, without re-running kernels on a carried state."""
+        self.state = state
+        ctx = {
+            "node_names": state.node_names,
+            "svc_names": self.metric_graph.names,
+            "num_nodes": state.num_nodes,
+            "num_services": self.metric_graph.num_services,
+        }
+        dev = dispatch_round_end(
+            device_view(state), device_graph(self.metric_graph),
+            top_k=self.attr_k,
+        )
+        self._pending_end = {"dev": dev, "ctx": ctx}
+
+    def _attach_metrics(self, rnd: int, record: RoundRecord, closer: RoundCloser) -> None:
+        """Register the record's closing metrics (cost/load-std +
+        attribution) on the closer: the pending snapshot bundle when it
+        is still device-resident, the cached host values otherwise (a
+        degraded round closing on an already-pulled snapshot costs no
+        transfer — the historical loop re-pulled bit-equal values)."""
+        pend = self._pending_end
+        ctx = pend["ctx"]
+
+        def apply_vals(cost: float, lstd: float, attr_flat) -> None:
+            record.communication_cost = cost
+            record.load_std = lstd
+            if self.attr_k > 0:
+                attr = attribution_mod.decode_attribution(
+                    attr_flat,
+                    node_names=ctx["node_names"],
+                    service_names=ctx["svc_names"],
+                    top_k=self.attr_k,
+                    num_nodes=ctx["num_nodes"],
+                    num_services=ctx["num_services"],
+                )
+                attr["round"] = rnd
+                attr["algorithm"] = self.config.algorithm
+                attr.update(
+                    self.timeline.observe_round(
+                        rnd,
+                        record.applied_moves,
+                        pod_level=self.config.placement_unit == "pod",
+                    )
+                )
+                record.attribution = attr
+                attribution_mod.publish_attribution(
+                    self.registry, attr, top_k=self.attr_k
+                )
+                attribution_mod.get_attribution_book().update(
+                    self.config.algorithm, rnd, attr
+                )
+
+        if "host" in pend:
+            h = pend["host"]
+            closer.defer_host(
+                lambda: apply_vals(h["cost"], h["lstd"], h["attr"])
+            )
+            return
+
+        dev = pend.pop("dev")
+
+        def decode(flat) -> None:
+            cost = float(flat[METRIC_COST])
+            lstd = float(flat[METRIC_LOAD_STD])
+            attr_flat = flat[METRIC_HEAD:] if self.attr_k > 0 else None
+            # cache for a following degraded round (bit-equal to re-running
+            # the kernels on the same snapshot, which is what the
+            # historical loop did)
+            pend["host"] = {"cost": cost, "lstd": lstd, "attr": attr_flat}
+            apply_vals(cost, lstd, attr_flat)
+
+        closer.defer(dev, decode)
+
+    def begin_close(self, rnd: int, record: RoundRecord, closer: RoundCloser, new_state) -> None:
+        """Round-close bookkeeping that must precede the NEXT round's
+        ``begin_round`` (it reads the breaker/failure counters) and the
+        flush: adopt or degrade the snapshot, attach the metrics piece."""
+        record.breaker_state = self.breaker.state
+        record.boundary_failures = self.boundary.round_failures
+        if self.churn is not None:
+            # pending_churn, not this round's events only: skipped rounds'
+            # events flush into the first record that can carry them
+            record.churn = self.churn.round_info(self.pending_churn)
+            self.pending_churn = []
+        if new_state is None:
+            # post-move snapshot failed: finish the round DEGRADED on the
+            # last good snapshot instead of crashing (metrics below are
+            # stale but labeled as such via record.degraded)
+            record.degraded = True
+        else:
+            self.note_fresh_snapshot(new_state)
+        self._attach_metrics(rnd, record, closer)
+
+    # ---- per-round helpers ----
+
+    def skip_round(self, rnd: int) -> None:
+        """Safe mode: the open breaker froze this round — count it, pace,
+        checkpoint the carried-over snapshot so resume semantics hold."""
+        self.result.skipped_rounds += 1
+        self.registry.counter(
+            "rounds_skipped_total",
+            "rounds frozen by the open circuit breaker",
+            labelnames=("algorithm",),
+        ).labels(algorithm=self.config.algorithm).inc()
+        if self.logger is not None:
+            self.logger.info(
+                "round_skipped",
+                round=rnd,
+                breaker=self.breaker.state,
+                consecutive_failures=self.breaker.consecutive_failures,
+            )
+        if self.ops is not None:
+            self.ops.observe_skip(rnd, breaker_state=self.breaker.state)
+        self.boundary.advance(self.config.sleep_after_action_s)
+        if self.mgr is not None:
+            self.mgr.save(
+                rnd, self.state,
+                extra={"algorithm": self.config.algorithm, "skipped": True},
+            )
+
+    def preamble(self, rnd: int) -> bool:
+        """Everything before a round may decide: churn events, the
+        breaker gate, the half-open probe, the churn re-mask. Returns
+        False when the round was a counted skip."""
+        if self.churn is not None:
+            # the cluster churns whether or not the breaker lets this
+            # round run — events apply first, exactly like real
+            # deploys/autoscaling happening under an ailing controller
+            events = self.churn.step(rnd)
+            if events:
+                self.pending_churn.extend(events)
+                self.remask_needed = True
+                if self.churn.graph_changed:
+                    self.metric_graph = self.boundary.comm_graph()
+                    self.rebind_timeline = True
+        mode = self.boundary.begin_round(rnd)
+        if mode == OPEN:
+            self.skip_round(rnd)
+            return False
+        refreshed = False
+        if mode == HALF_OPEN:
+            # one probe before trusting the backend with a full round; a
+            # success closes the breaker AND refreshes the stale snapshot
+            probe = self.boundary.monitor()
+            if probe is None:
+                self.skip_round(rnd)
+                return False
+            self.note_fresh_snapshot(probe)
+            refreshed = True
+        if self.remask_needed and not refreshed:
+            # re-mask: the carried snapshot predates some applied churn —
+            # one fresh monitor realigns pod sets and validity masks with
+            # the mutated cluster (shapes stay in-bucket, so the decision
+            # kernels do not retrace); a dark backend makes this a counted
+            # skip and the debt carries to the next executed round
+            fresh = self.boundary.monitor()
+            if fresh is None:
+                self.skip_round(rnd)
+                return False
+            self.note_fresh_snapshot(fresh)
+            refreshed = True
+        if refreshed:
+            self.remask_needed = False
+        if self.rebind_timeline and self.timeline is not None:
+            # the provenance model is defined over a fixed service set —
+            # re-anchor it at the post-churn snapshot (move deltas
+            # telescope within a churn epoch)
+            self.timeline = attribution_mod.PlacementTimeline()
+            self.timeline.bind(self.state, self.metric_graph)
+        self.rebind_timeline = False
+        return True
+
+    def execute_round(self, rnd: int, closer: RoundCloser, pre_fence_hook=None) -> RoundRecord:
+        """Dispatch and apply one round's decisions (no advance/monitor —
+        the schedules own those). ``pre_fence_hook`` runs after the first
+        async kernel dispatch, before the apply-boundary fence — the
+        pipelined schedule's overlap window."""
+        sub = jax.random.fold_in(self.key, rnd)
+        graph = self.graph_src()  # fresh estimate per round when streaming
+        config = self.config
+        if config.algorithm == "global" or config.moves_per_round == "all":
+            carry: dict = {}
+            record = _global_round(
+                self.boundary, self.state, graph, config, sub, rnd,
+                logger=self.logger, explain=self.explain_k > 0,
+                closer=closer, pre_fence_hook=pre_fence_hook,
+                donate=self.donate_ok, carry=carry,
+            )
+            if carry.get("state") is not None:
+                # the donated solve consumed the snapshot's buffers; adopt
+                # the bit-equal resurrected copy so a failed post-move
+                # monitor (or a breaker skip) can still carry it forward
+                self.state = carry["state"]
+            return record
+        forecast_delta = None
+        forecast_latency = 0.0
+        if self.forecast_plane is not None:
+            # fold this round's observed loads into the online model and
+            # predict the next window — one instrumented dispatch,
+            # name-stripped view (same jit-key rule as the decision
+            # kernels); the diag vector rides the round-end bundle
+            t_fc = time.perf_counter()
+            with span("controller/forecast", round=rnd):
+                forecast_delta = self.forecast_plane.observe_and_predict(
+                    device_view(self.state), closer=closer
+                )
+            forecast_latency = time.perf_counter() - t_fc
+        record = _greedy_round(
+            self.boundary, self.state, graph, config, sub, rnd,
+            logger=self.logger, explain_k=self.explain_k,
+            forecast_delta=forecast_delta,
+            closer=closer, pre_fence_hook=pre_fence_hook,
+        )
+        if self.forecast_plane is not None:
+            # the forecast dispatch is decision work: count it in the
+            # round's device latency budget so decisions/sec and the
+            # bench cells price the proactive path honestly
+            record.decision_latencies_s = (
+                forecast_latency,
+            ) + record.decision_latencies_s
+            plane, registry = self.forecast_plane, self.registry
+
+            def _finish_forecast() -> None:
+                record.forecast = plane.round_info()
+                plane.publish(registry)
+
+            closer.defer_host(_finish_forecast)
+        return record
+
+    def emit(self, rnd: int, record: RoundRecord, mode: str = "sequential") -> None:
+        """The record's host tail: result stream, metrics, roofline,
+        logger, ops plane, on_round. Runs strictly after the flush."""
+        config, registry = self.config, self.registry
+        self.result.rounds.append(record)
+        _emit_round_metrics(registry, config.algorithm, record)
+        observe_wall_round(registry, mode, record.wall_s)
+        # device-side observability: live memory_stats gauges plus the
+        # round's achieved-FLOP/s / bytes/s roofline against the
+        # decision kernel's captured static cost
+        costmodel.observe_round_device(
+            registry,
+            fn_labels=self.roofline_fns,
+            seconds=record.decision_latency_s,
+        )
+        if record.degraded:
+            registry.counter(
+                "degraded_rounds_total",
+                "rounds completed on a stale snapshot after boundary failure",
+                labelnames=("algorithm",),
+            ).labels(algorithm=config.algorithm).inc()
+        round_event = dict(
+            round=rnd,
+            moved=record.moved,
+            services=list(record.services_moved),
+            most_hazard=record.most_hazard,
+            communication_cost=record.communication_cost,
+            load_std=record.load_std,
+            decision_latency_s=record.decision_latency_s,
+            objective_before=record.objective_before,
+            objective_after=record.objective_after,
+            breaker=record.breaker_state,
+            degraded=record.degraded,
+            boundary_failures=record.boundary_failures,
+        )
+        if self.logger is not None:
+            self.logger.info("round", **round_event)
+        if self.ops is not None:
+            self.ops.observe_round(
+                record,
+                self.state,
+                events=[
+                    {"event": "decision", **e} for e in record.explanations
+                ] + [{"event": "round", **round_event}],
+            )
+        if self.on_round is not None:
+            self.on_round(record, self.state)
+
+    def sequential_round(self, rnd: int) -> None:
+        """One full round on the historical schedule (also the pipelined
+        loop's drained path): preamble, execute, advance+monitor, close,
+        flush, emit, checkpoint — in exactly the historical order."""
+        if not self.preamble(rnd):
+            return
+        t0 = time.perf_counter()
+        closer = RoundCloser(self.registry)
+        with span("controller/round", round=rnd, algorithm=self.config.algorithm):
+            record = self.execute_round(rnd, closer)
+            self.boundary.advance(self.config.sleep_after_action_s)
+            with span("backend/monitor"):
+                new_state = self.boundary.monitor()
+        self.begin_close(rnd, record, closer, new_state)
+        closer.flush()
+        record.wall_s = time.perf_counter() - t0
+        self.emit(rnd, record)
+        # checkpoint LAST: a crash inside on_round (sinks, load segment)
+        # replays this round on resume instead of leaving a hole in its
+        # outputs; replaying a move is idempotent (same pin, same target)
+        if self.mgr is not None:
+            self.mgr.save(rnd, self.state, extra={"algorithm": self.config.algorithm})
+
+    def _advance_and_monitor(self):
+        """The background half of a pipelined round: pace, then the
+        post-move monitor — the same boundary calls in the same order the
+        sequential loop issues, just off the main thread. Returns the
+        snapshot (or None) plus the wall time the pair took."""
+        t0 = time.perf_counter()
+        self.boundary.advance(self.config.sleep_after_action_s)
+        out = self.boundary.monitor()
+        return out, time.perf_counter() - t0
+
+
+def _sequential_loop(rt: _Runtime) -> None:
+    for rnd in range(rt.start_round, rt.config.max_rounds + 1):
+        rt.sequential_round(rnd)
+
+
+def _pipelined_loop(rt: _Runtime) -> None:
+    """The software-pipelined schedule (``--pipeline``): per steady-state
+    round the previous round's single-bundle flush + record finalize +
+    ``on_round`` overlap this round's decision kernel executing on
+    device, and the post-move ``advance`` + ``monitor`` run in a
+    background thread overlapping the checkpoint write and the next
+    iteration's bookkeeping. The backend observes the EXACT sequential
+    call order — apply(r), advance, monitor(r), [load mutations from
+    on_round(r)], apply(r+1), ... — which is why the schedules are
+    bit-identical on the sim backend (test-pinned).
+
+    Rounds that cannot pipeline — churn pending (the sequential loop
+    re-masks before deciding), a streaming callable decision graph (the
+    estimator updates in ``on_round`` must precede the graph read), or a
+    breaker that is not CLOSED — drain the pipeline (the pending round
+    finishes fully) and run the sequential path, so skip/degraded/remask
+    accounting stays exact: ``max_rounds == records + skipped``.
+    """
+    cfg = rt.config
+    depth = cfg.controller.depth
+    pipeline_depth_gauge(rt.registry).set(depth)
+    overlap_gauge = pipeline_overlap_gauge(rt.registry)
+    ex = ThreadPoolExecutor(max_workers=1, thread_name_prefix="krt-boundary")
+    pend: dict | None = None  # the one in-flight round (depth-2 pipeline)
+    mon_future = None
+
+    def finish(p: dict, end_t: float | None = None) -> None:
+        if p["closed"]:
+            return
+        p["closed"] = True
+        rec = p["record"]
+        bg, blocked = p["bg_s"], p["blocked_s"]
+        hidden = max(bg - blocked, 0.0)
+        ratio = hidden / bg if bg > 1e-9 else 0.0
+        rec.pipeline = {
+            "depth": depth,
+            "overlap_ratio": ratio,
+            "background_s": bg,
+            "blocked_s": blocked,
+        }
+        overlap_gauge.set(ratio)
+        p["closer"].flush()
+        # wall = round start to the NEXT round's start when pipelined
+        # (end_t — the steady-state throughput quantity: per-round walls
+        # sum to the loop's total instead of double-counting the overlap
+        # windows shared with adjacent rounds); drain/tail closes fall
+        # back to "now"
+        rec.wall_s = (
+            end_t if end_t is not None else time.perf_counter()
+        ) - p["t0"]
+        rt.emit(p["rnd"], rec, mode="pipelined")
+
+    def checkpoint(p: dict) -> None:
+        if rt.mgr is not None:
+            rt.mgr.save(
+                p["rnd"], p["state"], extra={"algorithm": cfg.algorithm}
+            )
+
+    def settle(p: dict, future) -> None:
+        """Join the pending round's in-flight advance+monitor and run its
+        close bookkeeping — ONE definition for the loop-top and tail
+        sites, so the final round can never close differently from
+        steady-state rounds."""
+        t_w = time.perf_counter()
+        new_state, bg_s = future.result()
+        p["blocked_s"] = time.perf_counter() - t_w
+        p["bg_s"] = bg_s
+        rt.begin_close(p["rnd"], p["record"], p["closer"], new_state)
+        p["state"] = rt.state
+
+    try:
+        for rnd in range(rt.start_round, cfg.max_rounds + 1):
+            if mon_future is not None:
+                # settle the in-flight monitor of the pending round BEFORE
+                # this round's begin_round resets the failure counters
+                settle(pend, mon_future)
+                mon_future = None
+            can_pipeline = (
+                rt.churn is None
+                and rt.graph_static
+                and rt.breaker.state == "closed"
+            )
+            if pend is not None and not can_pipeline:
+                # drain: an open/half-open breaker (or any condition the
+                # overlapped schedule cannot honor) finishes the pending
+                # round completely and falls back to the sequential path
+                finish(pend)
+                checkpoint(pend)
+                pend = None
+            if not can_pipeline:
+                rt.sequential_round(rnd)
+                continue
+            rt.boundary.begin_round(rnd)  # CLOSED stays CLOSED
+            t0 = time.perf_counter()
+            closer = RoundCloser(rt.registry)
+            hook = None
+            if pend is not None:
+                prev = pend
+
+                def hook(prev=prev, end_t=t0):
+                    finish(prev, end_t)
+
+            with span("controller/round", round=rnd, algorithm=cfg.algorithm):
+                record = rt.execute_round(rnd, closer, pre_fence_hook=hook)
+            if pend is not None:
+                # a round body that never reached its fence (e.g. zero
+                # decides) still owes the previous round its close
+                finish(pend)
+            prev_pend = pend
+            mon_future = ex.submit(rt._advance_and_monitor)
+            if prev_pend is not None:
+                # the checkpoint write overlaps the background
+                # advance+monitor (host IO only — resume replays at most
+                # one extra round, and per-round keys make that replay
+                # bit-deterministic)
+                checkpoint(prev_pend)
+            pend = {
+                "rnd": rnd,
+                "record": record,
+                "closer": closer,
+                "t0": t0,
+                "closed": False,
+                "bg_s": 0.0,
+                "blocked_s": 0.0,
+                "state": rt.state,
+            }
+        # drain the tail: the final round's monitor + close
+        if mon_future is not None:
+            settle(pend, mon_future)
+        if pend is not None:
+            finish(pend)
+            checkpoint(pend)
+    finally:
+        ex.shutdown(wait=True)
+
+
 def run_controller(
     backend: Backend,
     config: RescheduleConfig,
@@ -288,8 +1020,9 @@ def run_controller(
 
     ``registry`` (default: the process registry) receives one metric
     sample set per round — counters ``rounds_total``/
-    ``services_moved_total``, the ``decision_seconds`` histogram, and
-    cost/objective gauges — alongside the spans the loop emits.
+    ``services_moved_total``, the ``decision_seconds`` histogram, the
+    ``wall_round_ms`` lifecycle histogram, and cost/objective gauges —
+    alongside the spans the loop emits.
 
     Resilience: ``config.chaos`` optionally wraps the backend in the
     fault-injecting ``ChaosBackend``; either way every boundary call goes
@@ -317,406 +1050,57 @@ def run_controller(
     state stays at exactly 1 trace per kernel across arbitrary churn
     within a bucket (retrace only on a counted bucket promotion).
     Churn lands on ``RoundRecord.churn`` → rounds.jsonl.
+
+    Round-end transfers: every executed round closes its reporting —
+    comm cost, load std, the attribution bundle, explain bundles, the
+    forecast diag, solver objectives — through ONE counted device→host
+    transfer (``device_transfers_total{site="round_end"}``;
+    ``bench/round_end.py``). A degraded round closing on an
+    already-measured snapshot reuses the cached values and costs at most
+    the transfer for its fresh per-round diagnostics.
+
+    ``config.controller.pipeline`` selects the software-pipelined
+    schedule: the same helper calls interleaved so the previous round's
+    flush + host tail overlap this round's device compute, with the
+    post-move monitor in a background thread. Decisions, records, and
+    all accounting are bit-identical to the sequential schedule on the
+    sim backend (test-pinned); rounds the pipeline cannot honor (open
+    breaker, pending churn, streaming graph) drain and run sequentially.
     """
     config = config.validate()
     registry = registry if registry is not None else get_registry()
     key = key if key is not None else jax.random.PRNGKey(config.seed)
-    if config.chaos.profile != "none":
-        backend = with_chaos(
-            backend, config.chaos.profile, seed=config.chaos.seed,
-            registry=registry,
-        )
-    breaker = CircuitBreaker(
-        max_consecutive_failures=config.max_consecutive_failures,
-        cooldown_rounds=config.breaker_cooldown_rounds,
-        logger=logger,
-        registry=registry,
-    )
-    boundary = BoundaryClient(
+    rt = _Runtime(
         backend,
-        policy=config.retry,
-        breaker=breaker,
-        failure_budget_per_round=config.failure_budget_per_round,
+        config,
+        key=key,
+        on_round=on_round,
+        checkpoint_dir=checkpoint_dir,
         logger=logger,
+        graph=graph,
         registry=registry,
+        ops=ops,
+        churn=churn,
     )
-    if churn is None and config.elastic.profile != "none":
-        from kubernetes_rescheduling_tpu.elastic.engine import ChurnEngine
-
-        churn = ChurnEngine(
-            config.elastic.profile,
-            seed=config.elastic.seed,
-            bucket_floor=config.elastic.bucket_floor,
-            registry=registry,
-        )
-    forecast_plane = None
-    if config.algorithm == "proactive":
-        # the forecast plane: one online forecaster per run, one kernel
-        # dispatch + one counted diag transfer per round. Lazy import —
-        # reactive runs never touch the forecast package.
-        from kubernetes_rescheduling_tpu.forecast.plane import ForecastPlane
-
-        forecast_plane = ForecastPlane(config.forecast, registry=registry)
-    if churn is not None:
-        # the churn feed flows through the boundary's backend passthrough
-        # (like apply_pod_moves): chaos wrappers and the raw simulator see
-        # one stream, and bind() pushes the initial bucket capacities so
-        # even round 1's snapshot is bucket-padded
-        churn.bind(boundary, config.max_rounds, registry=registry)
-    if ops is not None:
-        ops.bind(breaker=breaker, logger=logger, algorithm=config.algorithm)
-        breaker.on_transition = ops.on_breaker_transition
-    # decision explainability: on when configured AND someone is listening
-    # (a structured logger or the ops plane) — the bare loop stays exactly
-    # the historical decision kernel
-    explain_k = (
-        config.obs.explain_top_k
-        if config.obs.explain and (ops is not None or logger is not None)
-        else 0
-    )
-    # cost attribution rides the same gate: on when configured AND someone
-    # is listening — the bare loop pays no extra kernel and no extra
-    # transfer (the per-round transfer budget stays the historical one)
-    attr_k = (
-        config.obs.attribution_top_k
-        if config.obs.attribution and (ops is not None or logger is not None)
-        else 0
-    )
-    timeline = attribution_mod.PlacementTimeline() if attr_k > 0 else None
-    # decisions may run on an estimated graph; TELEMETRY always reports on
-    # the backend's declared graph so round costs stay comparable across
-    # configurations (and with the harness's before/after metrics)
-    metric_graph = boundary.comm_graph()
-    if graph is None:
-        graph_src = lambda: metric_graph  # noqa: E731
-    elif callable(graph):
-        graph_src = graph
-    else:
-        graph_src = lambda: graph  # noqa: E731
-    result = ControllerResult()
-
-    # per-round device observability: which instrumented kernel this run's
-    # rounds dispatch (preference order — the roofline publishes for the
-    # first label with a captured cost snapshot)
-    if config.algorithm == "global" or config.moves_per_round == "all":
-        # prefer THIS run's solver family: the cost book is process-global,
-        # so a dense-first list would publish the dense kernel's static
-        # cost against a sparse round's latency in a mixed bench session.
-        # The dense labels stay as FALLBACK on the sparse path because
-        # global_assign_sparse genuinely routes small graphs through the
-        # dense kernel — there the dense attribution is the true one.
-        if config.solver_backend == "sparse":
-            roofline_fns = (
-                "global_assign_sparse", "sharded_restarts_sparse",
-                "global_assign", "sharded_restarts_dense",
-            )
-        else:
-            roofline_fns = ("global_assign", "sharded_restarts_dense")
-    elif forecast_plane is not None:
-        roofline_fns = (
-            ("controller_decide_proactive_explain",)
-            if explain_k > 0
-            else ("controller_decide_proactive",)
-        )
-    elif explain_k > 0:
-        roofline_fns = ("controller_decide_explain",)
-    else:
-        roofline_fns = ("controller_decide",)
-
-    mgr = CheckpointManager(checkpoint_dir) if checkpoint_dir else None
-    start_round = 1
-    if mgr is not None:
-        latest = mgr.latest()
-        if latest is not None:
-            done_round, saved_state, _extra = latest
-            if churn is not None:
-                # fast-forward the churn stream over the already-completed
-                # rounds: the event schedule depends only on (profile,
-                # seed, round, topology) — never on controller moves — so
-                # replaying it on the freshly built backend reconstructs
-                # the checkpoint-time topology AND positions the churn rng
-                # exactly where the uninterrupted run had it. Without
-                # this, a resumed churn run would silently restart from
-                # the initial topology with a rewound event stream.
-                # (Replayed events re-count in churn_events_total when the
-                # resume shares a registry with the original run.)
-                for past in range(1, done_round + 1):
-                    churn.step(past)
-                # the metric graph read above predates the replayed
-                # events — re-read it so resumed rounds report against
-                # the same topology the uninterrupted run saw
-                metric_graph = boundary.comm_graph()
-            restore = getattr(backend, "restore_placement", None)
-            if restore is not None:
-                restore(saved_state)
-            start_round = done_round + 1
-            result.resumed_from_round = start_round
-            if logger is not None:
-                logger.info("resume", round=start_round, checkpoint=done_round)
-
-    def skip_round(rnd: int, state) -> None:
-        """Safe mode: the open breaker froze this round — count it, pace,
-        checkpoint the carried-over snapshot so resume semantics hold."""
-        result.skipped_rounds += 1
-        registry.counter(
-            "rounds_skipped_total",
-            "rounds frozen by the open circuit breaker",
-            labelnames=("algorithm",),
-        ).labels(algorithm=config.algorithm).inc()
-        if logger is not None:
-            logger.info(
-                "round_skipped",
-                round=rnd,
-                breaker=breaker.state,
-                consecutive_failures=breaker.consecutive_failures,
-            )
-        if ops is not None:
-            ops.observe_skip(rnd, breaker_state=breaker.state)
-        boundary.advance(config.sleep_after_action_s)
-        if mgr is not None:
-            mgr.save(
-                rnd, state, extra={"algorithm": config.algorithm, "skipped": True}
-            )
-
-    # one snapshot per round: the post-move snapshot provides this round's
-    # metrics AND the next round's state (a live monitor() is 4 cluster-wide
-    # API calls — doubling it per round doubles API-server load).
-    # Startup has no last-good snapshot to degrade to, so the initial
-    # monitor gets its own bounded probe loop on top of the per-call
-    # retries; only a backend that stays dark through all of it raises.
-    state = None
-    for _ in range(max(3, config.max_consecutive_failures + 1)):
-        state = boundary.monitor()
-        if state is not None:
-            break
-    if state is None:
-        raise ConnectionError(
-            "backend unavailable: initial monitor() failed after retries "
-            "(no last good snapshot to degrade to)"
-        )
-    if timeline is not None:
-        # provenance model: the initial residency collapse (host-side,
-        # once per run) the per-move cost deltas telescope from
-        timeline.bind(state, metric_graph)
     try:
-        # churn bookkeeping that must SURVIVE skipped rounds: a round
-        # whose churn was applied but never re-monitored (breaker open,
-        # dark backend) leaves these set, and the next executed round
-        # settles the debt before deciding — no round ever solves
-        # against a phantom topology, and the provenance model never
-        # silently decodes a stale service set
-        remask_needed = False
-        rebind_timeline = False
-        # events applied during breaker-frozen/dark rounds leave no
-        # RoundRecord of their own — they accumulate here and flush into
-        # the NEXT executed round's churn block, so rounds.jsonl never
-        # shows a live-count jump with no events explaining it
-        pending_churn: list[dict] = []
-        for rnd in range(start_round, config.max_rounds + 1):
-            churn_events: list[dict] = []
-            if churn is not None:
-                # the cluster churns whether or not the breaker lets this
-                # round run — events apply first, exactly like real
-                # deploys/autoscaling happening under an ailing controller
-                churn_events = churn.step(rnd)
-                if churn_events:
-                    pending_churn.extend(churn_events)
-                    remask_needed = True
-                    if churn.graph_changed:
-                        metric_graph = boundary.comm_graph()
-                        rebind_timeline = True
-            mode = boundary.begin_round(rnd)
-            if mode == OPEN:
-                skip_round(rnd, state)
-                continue
-            refreshed = False
-            if mode == HALF_OPEN:
-                # one probe before trusting the backend with a full round; a
-                # success closes the breaker AND refreshes the stale snapshot
-                probe = boundary.monitor()
-                if probe is None:
-                    skip_round(rnd, state)
-                    continue
-                state = probe
-                refreshed = True
-            if remask_needed and not refreshed:
-                # re-mask: the carried snapshot predates some applied
-                # churn — one fresh monitor realigns pod sets and
-                # validity masks with the mutated cluster (shapes stay
-                # in-bucket, so the decision kernels do not retrace); a
-                # dark backend makes this a counted skip and the debt
-                # carries to the next executed round
-                fresh = boundary.monitor()
-                if fresh is None:
-                    skip_round(rnd, state)
-                    continue
-                state = fresh
-                refreshed = True
-            if refreshed:
-                remask_needed = False
-            if rebind_timeline and timeline is not None:
-                # the provenance model is defined over a fixed service
-                # set — re-anchor it at the post-churn snapshot (move
-                # deltas telescope within a churn epoch)
-                timeline = attribution_mod.PlacementTimeline()
-                timeline.bind(state, metric_graph)
-            rebind_timeline = False
-            sub = jax.random.fold_in(key, rnd)
-            graph = graph_src()  # fresh estimate per round when streaming
-
-            with span("controller/round", round=rnd, algorithm=config.algorithm):
-                if config.algorithm == "global" or config.moves_per_round == "all":
-                    record = _global_round(
-                        boundary, state, graph, config, sub, rnd,
-                        logger=logger, explain=explain_k > 0,
-                    )
-                else:
-                    forecast_delta = None
-                    if forecast_plane is not None:
-                        # fold this round's observed loads into the
-                        # online model and predict the next window —
-                        # one instrumented dispatch, name-stripped view
-                        # (same jit-key rule as the decision kernels)
-                        t_fc = time.perf_counter()
-                        with span("controller/forecast", round=rnd):
-                            forecast_delta = forecast_plane.observe_and_predict(
-                                device_view(state)
-                            )
-                        forecast_latency = time.perf_counter() - t_fc
-                    record = _greedy_round(
-                        boundary, state, graph, config, sub, rnd,
-                        logger=logger, explain_k=explain_k,
-                        forecast_delta=forecast_delta,
-                    )
-                    if forecast_plane is not None:
-                        # the forecast dispatch is decision work: count
-                        # it in the round's device latency budget so
-                        # decisions/sec and the bench cells price the
-                        # proactive path honestly
-                        record.decision_latencies_s = (
-                            forecast_latency,
-                        ) + record.decision_latencies_s
-                        record.forecast = forecast_plane.round_info()
-                        forecast_plane.publish(registry)
-                boundary.advance(config.sleep_after_action_s)
-                with span("backend/monitor"):
-                    new_state = boundary.monitor()
-            if new_state is None:
-                # post-move snapshot failed: finish the round DEGRADED on the
-                # last good snapshot instead of crashing (metrics below are
-                # stale but labeled as such via record.degraded)
-                record.degraded = True
-            else:
-                state = new_state
-            record.breaker_state = breaker.state
-            record.boundary_failures = boundary.round_failures
-            if churn is not None:
-                # pending_churn, not churn_events: skipped rounds' events
-                # flush into the first record that can carry them
-                record.churn = churn.round_info(pending_churn)
-                pending_churn = []
-            record.communication_cost = float(communication_cost(state, metric_graph))
-            record.load_std = float(load_std(state))
-            if attr_k > 0:
-                # the decomposition of the scalar just recorded: one
-                # bundled device transfer, same state + metric graph, so
-                # per-edge contributions sum back to it (f32 tolerance —
-                # the attribution_consistent invariant)
-                # name-stripped device views (elastic.buckets): pod/node
-                # churn renames static metadata, which would silently
-                # retrace the kernel — the arrays are identical
-                bundle = pull(
-                    _attribution(
-                        device_view(state), device_graph(metric_graph),
-                        top_k=attr_k,
-                    ),
-                    site=attribution_mod.ATTRIBUTION_SITE,
-                )
-                attr = attribution_mod.decode_attribution(
-                    bundle,
-                    node_names=state.node_names,
-                    service_names=metric_graph.names,
-                    top_k=attr_k,
-                    num_nodes=state.num_nodes,
-                    num_services=metric_graph.num_services,
-                )
-                attr["round"] = rnd
-                attr["algorithm"] = config.algorithm
-                attr.update(
-                    timeline.observe_round(
-                        rnd,
-                        record.applied_moves,
-                        pod_level=config.placement_unit == "pod",
-                    )
-                )
-                record.attribution = attr
-                attribution_mod.publish_attribution(
-                    registry, attr, top_k=attr_k
-                )
-                attribution_mod.get_attribution_book().update(
-                    config.algorithm, rnd, attr
-                )
-            result.rounds.append(record)
-            _emit_round_metrics(registry, config.algorithm, record)
-            # device-side observability: live memory_stats gauges plus the
-            # round's achieved-FLOP/s / bytes/s roofline against the
-            # decision kernel's captured static cost
-            costmodel.observe_round_device(
-                registry,
-                fn_labels=roofline_fns,
-                seconds=record.decision_latency_s,
-            )
-            if record.degraded:
-                registry.counter(
-                    "degraded_rounds_total",
-                    "rounds completed on a stale snapshot after boundary failure",
-                    labelnames=("algorithm",),
-                ).labels(algorithm=config.algorithm).inc()
-            round_event = dict(
-                round=rnd,
-                moved=record.moved,
-                services=list(record.services_moved),
-                most_hazard=record.most_hazard,
-                communication_cost=record.communication_cost,
-                load_std=record.load_std,
-                decision_latency_s=record.decision_latency_s,
-                objective_before=record.objective_before,
-                objective_after=record.objective_after,
-                breaker=record.breaker_state,
-                degraded=record.degraded,
-                boundary_failures=record.boundary_failures,
-            )
-            if logger is not None:
-                logger.info("round", **round_event)
-            if ops is not None:
-                ops.observe_round(
-                    record,
-                    state,
-                    events=[
-                        {"event": "decision", **e} for e in record.explanations
-                    ] + [{"event": "round", **round_event}],
-                )
-            if on_round is not None:
-                on_round(record, state)
-            # checkpoint LAST: a crash inside on_round (sinks, load segment)
-            # replays this round on resume instead of leaving a hole in its
-            # outputs; replaying a move is idempotent (same pin, same target)
-            if mgr is not None:
-                mgr.save(rnd, state, extra={"algorithm": config.algorithm})
+        if config.controller.pipeline:
+            _pipelined_loop(rt)
+        else:
+            _sequential_loop(rt)
     except BaseException as e:
         # the always-on crash-dump path: whatever escapes the loop leaves
         # a flight-recorder bundle behind before propagating
         if ops is not None:
             ops.on_crash(e)
         raise
-    result.breaker_transitions = list(breaker.transitions)
-    result.boundary_failures = boundary.total_failures
-    return result
+    rt.result.breaker_transitions = list(rt.breaker.transitions)
+    rt.result.boundary_failures = rt.boundary.total_failures
+    return rt.result
 
 
 def _greedy_round(
     boundary, state, graph, config, key, rnd, *, logger=None, explain_k=0,
-    forecast_delta=None,
+    forecast_delta=None, closer=None, pre_fence_hook=None,
 ) -> RoundRecord:
     """Up to ``config.moves_per_round`` greedy moves: after each move the
     working snapshot is edited in place (the moved service's pods re-homed —
@@ -727,14 +1111,21 @@ def _greedy_round(
     With ``explain_k > 0`` each decide runs the explain twin of the
     decision kernel (bit-identical choice) and records a
     ``DecisionExplanation`` — top-k hazard nodes, top-k candidate targets
-    with score margins, chosen target and why — pulled device→host as ONE
-    counted transfer and emitted as a ``decision`` event.
+    with score margins, chosen target and why. The bundle stays
+    device-resident on ``closer`` and rides the round's single
+    ``round_end`` transfer; the decode (and the ``decision`` event) runs
+    at flush, in decide order, before the round event.
 
     ``forecast_delta`` (proactive rounds) routes every decide through the
     forecast-aware kernels: the same scoring policy (the forecast
     config's base policy — reactive CAR by default) evaluated against
     the PREDICTED next-window state. A zero delta reproduces the
-    reactive decisions bit-for-bit."""
+    reactive decisions bit-for-bit.
+
+    ``pre_fence_hook`` (the pipelined schedule) runs once, after the
+    first decide has been dispatched and before its apply-boundary
+    fence — the window where the previous round's flush and host tail
+    hide behind this round's device compute."""
     scoring = scoring_policy(config.algorithm, config.forecast)
     pid = jnp.asarray(POLICY_IDS[scoring])
     k_moves = config.moves_per_round
@@ -745,15 +1136,35 @@ def _greedy_round(
     latencies: list[float] = []
     explanations: list[dict] = []
 
-    def emit(expl, stop=None):
-        if expl is None:
-            return
-        if stop is not None:
-            expl["stop"] = stop
-            expl["why"] += f" ({stop})"
-        explanations.append(expl)
-        if logger is not None:
-            logger.info("decision", **expl)
+    def defer_explanation(bundle, meta):
+        """Register the explain bundle's decode on the round closer: the
+        DecisionExplanation is built host-side at flush time from the
+        pulled rows plus the apply outcome recorded in ``meta`` during
+        the round (landed/stop patches — the historical emit())."""
+
+        def decode(flat):
+            expl = greedy_explanation(
+                flat,
+                meta["node_names"],
+                round=meta["round"],
+                seq=meta["seq"],
+                policy=meta["policy"],
+                service=meta["service"],
+                hazard_node=meta["hazard_node"],
+                chosen=meta["chosen"],
+            )
+            if meta.get("applied_known"):
+                expl["landed"] = meta["landed"]
+                expl["applied"] = meta["landed"] is not None
+            stop = meta.get("stop")
+            if stop is not None:
+                expl["stop"] = stop
+                expl["why"] += f" ({stop})"
+            explanations.append(expl)
+            if logger is not None:
+                logger.info("decision", **expl)
+
+        closer.defer(bundle, decode)
 
     for i in range(k_moves):
         key, sub = jax.random.split(key)
@@ -775,47 +1186,51 @@ def _greedy_round(
                     out = _decide_explain(
                         dev_state, dev_graph, pid, thr, sub, top_k=explain_k,
                     )
-                most, hazard_mask, victim, svc, target, bundle = (
-                    jax.block_until_ready(out)
-                )
+                decision_dev, bundle = out[:5], out[5]
             else:
                 bundle = None
                 if forecast_delta is not None:
-                    out = _decide_proactive(
+                    decision_dev = _decide_proactive(
                         dev_state, dev_graph, pid, thr, sub, forecast_delta
                     )
                 else:
-                    out = _decide(dev_state, dev_graph, pid, thr, sub)
-                most, hazard_mask, victim, svc, target = jax.block_until_ready(
-                    out
-                )
+                    decision_dev = _decide(dev_state, dev_graph, pid, thr, sub)
+            if pre_fence_hook is not None:
+                # the pipelined overlap window: the previous round's
+                # single-bundle pull + host tail run while this decide
+                # executes on device
+                pre_fence_hook()
+                pre_fence_hook = None
+            # the apply boundary: ONE batched host read of the decision
+            # tuple (never per-element int()/bool() syncs)
+            most, hazard_mask, victim, svc, target = fence(decision_dev)
         latencies.append(time.perf_counter() - t0)
 
         most_i, victim_i, target_i = int(most), int(victim), int(target)
         service_name = graph.names[int(svc)] if victim_i >= 0 else None
         target_name = state.node_names[target_i] if target_i >= 0 else None
-        expl = None
+        meta = None
         if bundle is not None:
-            expl = greedy_explanation(
-                pull(bundle, site="decision_explain"),
-                state.node_names,
-                round=rnd,
-                seq=i,
-                policy=config.algorithm,
-                service=service_name,
-                hazard_node=state.node_names[most_i] if most_i >= 0 else None,
-                chosen=target_name if victim_i >= 0 else None,
-            )
+            meta = {
+                "node_names": state.node_names,
+                "round": rnd,
+                "seq": i,
+                "policy": config.algorithm,
+                "service": service_name,
+                "hazard_node": state.node_names[most_i] if most_i >= 0 else None,
+                "chosen": target_name if victim_i >= 0 else None,
+            }
+            defer_explanation(bundle, meta)
         if first_hazard is None and most_i >= 0:
             first_hazard = state.node_names[most_i]
         if most_i < 0 or victim_i < 0 or target_i < 0:
-            emit(expl)
             break  # no hazard left (or nowhere to go): the round is done
         if service_name in moved_names:
             # the drain has started ping-ponging (the move made the target
             # the new hazard node and elected the same service back) —
             # further moves this round are churn, not progress
-            emit(expl, stop="ping-pong stop: service already moved this round")
+            if meta is not None:
+                meta["stop"] = "ping-pong stop: service already moved this round"
             break
         hazard_names = tuple(
             state.node_names[j]
@@ -832,10 +1247,11 @@ def _greedy_round(
                 mechanism=PlacementMechanism[scoring],
             )
         )
-        if expl is not None:
-            expl["landed"] = landed
-            expl["applied"] = landed is not None
-        emit(expl, stop=None if landed is not None else "boundary move failed")
+        if meta is not None:
+            meta["applied_known"] = True
+            meta["landed"] = landed
+            if landed is None:
+                meta["stop"] = "boundary move failed"
         if landed is None:
             break
         moved_names.append(service_name)
@@ -856,19 +1272,25 @@ def _greedy_round(
                 pod_node=jnp.where(svc_pods, landed_i, state.pod_node)
             )
 
-    return RoundRecord(
+    record = RoundRecord(
         round=rnd,
         moved=bool(moved_names),
         most_hazard=first_hazard,
         service=moved_names[0] if moved_names else None,
         target=first_target,
-        communication_cost=0.0,  # filled by run_controller from the post-move snapshot
+        communication_cost=0.0,  # filled at the round-end flush
         load_std=0.0,
         services_moved=tuple(moved_names),
         decision_latencies_s=tuple(latencies),
-        explanations=tuple(explanations),
         applied_moves=tuple(applied_moves),
     )
+    if explain_k > 0:
+        # the deferred decodes above fill `explanations` at flush time —
+        # materialize them onto the record after the last decode runs
+        closer.defer_host(
+            lambda: setattr(record, "explanations", tuple(explanations))
+        )
+    return record
 
 
 def _move_scoring_env(state, graph, solver_cfg):
@@ -951,12 +1373,16 @@ def _move_gain(env, work_node, loads, mem_loads, bal_now, s, t):
 
 
 def _individual_move_gains(
-    changed: list[tuple[int, int]], state, graph, solver_cfg
+    changed: list[tuple[int, int]], state=None, graph=None, solver_cfg=None,
+    *, env=None,
 ) -> list[tuple[int, int, float]]:
     """Each candidate move's INDIVIDUAL gain at the round-start state
     (every other service held in place) — what the uncapped global
-    round's explanation records as candidate scores."""
-    env = _move_scoring_env(state, graph, solver_cfg)
+    round's explanation records as candidate scores. ``env`` (a prebuilt
+    ``_move_scoring_env``) lets the donated-carry global round collapse
+    the snapshot host-side BEFORE the solver consumes its buffers."""
+    if env is None:
+        env = _move_scoring_env(state, graph, solver_cfg)
     work_node = env.svc_node.copy()
     loads = env.used.copy()
     mem_loads = env.mem_used.copy()
@@ -968,7 +1394,8 @@ def _individual_move_gains(
 
 
 def _top_gain_moves(
-    changed: list[tuple[int, int]], state, graph, solver_cfg, k: int
+    changed: list[tuple[int, int]], state=None, graph=None, solver_cfg=None,
+    k: int = 0, *, env=None,
 ) -> list[tuple[int, int, float]]:
     """≤``k`` strictly-improving moves selected GREEDILY AND SEQUENTIALLY,
     using the SOLVER's own accounting (``solver_cfg`` is the round's
@@ -996,8 +1423,11 @@ def _top_gain_moves(
 
     Returns ``(service, target, gain)`` triples — the gain at each move's
     EVALUATION state, which the ``global`` DecisionExplanation records as
-    the candidate score."""
-    env = _move_scoring_env(state, graph, solver_cfg)
+    the candidate score. ``env`` (a prebuilt ``_move_scoring_env``) lets
+    the donated-carry global round collapse the snapshot host-side
+    BEFORE the solver consumes its buffers."""
+    if env is None:
+        env = _move_scoring_env(state, graph, solver_cfg)
     work_node = env.svc_node.copy()
     loads = env.used.copy()
     mem_loads = env.mem_used.copy()
@@ -1032,32 +1462,36 @@ def _top_gain_moves(
     return [(*changed[i], gains[i]) for i in sorted(picked)]
 
 
-def _pull_solver_objectives(info):
-    """Host-pull the solver's before/after accounting from its info dict,
-    as ONE counted transfer (the values arrive together). Some restart
-    paths omit ``objective_before``/``improved`` — absent keys come back
-    as None rather than forcing every solver to grow them."""
+def _defer_solver_objectives(closer, info, apply_cb) -> None:
+    """Defer the solver's before/after accounting onto the round closer:
+    the values ride the round's single ``round_end`` transfer instead of
+    their own counted pull. Some restart paths omit
+    ``objective_before``/``improved`` — absent keys decode to None rather
+    than forcing every solver to grow them. ``apply_cb(before, after,
+    improved)`` runs at flush, before the record is emitted."""
     keys = [
         k for k in ("objective_before", "objective_after", "improved")
         if k in info
     ]
     if not keys:
-        return None, None, None
-    pulled = pull(
-        jnp.stack([jnp.asarray(info[k], jnp.float32) for k in keys]),
-        site="solver_objectives",
-    )
-    d = dict(zip(keys, pulled))
-    return (
-        float(d["objective_before"]) if "objective_before" in d else None,
-        float(d["objective_after"]) if "objective_after" in d else None,
-        bool(d["improved"]) if "improved" in d else None,
-    )
+        closer.defer_host(lambda: apply_cb(None, None, None))
+        return
+    piece = jnp.stack([jnp.asarray(info[k], jnp.float32) for k in keys])
+
+    def decode(flat) -> None:
+        d = dict(zip(keys, flat))
+        apply_cb(
+            float(d["objective_before"]) if "objective_before" in d else None,
+            float(d["objective_after"]) if "objective_after" in d else None,
+            bool(d["improved"]) if "improved" in d else None,
+        )
+
+    closer.defer(piece, decode)
 
 
 def _pod_round(
     boundary, state, graph, config, cfg, key, rnd, *, logger=None,
-    explain=False,
+    explain=False, closer=None, pre_fence_hook=None,
 ) -> RoundRecord:
     """Per-replica global round: solve on the expanded pod graph, apply
     per-pod moves (MoveRequest.pod). The pod graph is cached per
@@ -1069,10 +1503,13 @@ def _pod_round(
     )
 
     t0 = time.perf_counter()
-    sig = (
-        np.asarray(state.pod_service).tobytes(),
-        np.asarray(state.pod_valid).tobytes(),
-    )
+    # host-side copies of the incoming placement BEFORE the solve (the
+    # donated-carry discipline of the dense global path, kept symmetric
+    # here even though the pod solver does not donate yet)
+    old_nodes = np.asarray(state.pod_node)
+    valid = np.asarray(state.pod_valid)
+    svc_arr = np.asarray(state.pod_service)
+    sig = (svc_arr.tobytes(), valid.tobytes())
     # tenant-aware slot on the RAW backend (boundary.solver_cache): keyed
     # past this run's wrappers so repeated runs keep the reuse, and past
     # the tenant so fleet multiplexing neither cross-pollinates nor
@@ -1090,21 +1527,20 @@ def _pod_round(
         # reads the static name tuples (the pod graph above is built from
         # the FULL state), and keeping them out of the jit key lets churn
         # reuse the compiled program — the greedy path's rule, same here
-        new_state, info = jax.block_until_ready(
-            global_assign_pods(
-                device_view(state), device_graph(graph), key, cfg,
-                pod_graph=pod_graph,
-                n_restarts=config.solver_restarts,
-                tp=config.solver_tp,
-            )
+        new_state, info = global_assign_pods(
+            device_view(state), device_graph(graph), key, cfg,
+            pod_graph=pod_graph,
+            n_restarts=config.solver_restarts,
+            tp=config.solver_tp,
         )
+        if pre_fence_hook is not None:
+            # the pipelined overlap window: the previous round's flush +
+            # host tail run while the solve executes on device
+            pre_fence_hook()
+        # the apply boundary: ONE batched host read of the new placement
+        new_nodes = fence(new_state.pod_node)
     latency = time.perf_counter() - t0
-    obj_before, obj_after, improved = _pull_solver_objectives(info)
 
-    old_nodes = np.asarray(state.pod_node)
-    new_nodes = np.asarray(new_state.pod_node)
-    valid = np.asarray(state.pod_valid)
-    svc_arr = np.asarray(state.pod_service)
     moves: list[MoveRequest] = []
     for i in np.flatnonzero(valid & (old_nodes != new_nodes)):
         moves.append(
@@ -1143,8 +1579,32 @@ def _pod_round(
     moved_services = {mv.service for mv in landed_moves}
     moved_any = bool(moved_services)
 
-    explanations: tuple[dict, ...] = ()
-    if explain:
+    # services_moved carries the SERVICE names of moves that LANDED: its
+    # consumers — the harness's teardown-outage injection and restart
+    # accounting — are service-granular, and a pod name (or a move a dead
+    # node rejected) would charge disruption that never happened
+    record = RoundRecord(
+        round=rnd,
+        moved=moved_any,
+        most_hazard=None,
+        service=None,
+        target=None,
+        communication_cost=0.0,  # filled at the round-end flush
+        load_std=0.0,
+        services_moved=tuple(sorted(moved_services)) if moved_any else (),
+        decision_latencies_s=(latency,),
+        # pod-level provenance: each landed REPLICA hop (a service may
+        # appear once per pod) — the timeline records residency without
+        # service-collapsed cost deltas for these
+        applied_moves=tuple(applied_moves),
+    )
+
+    def _apply_objectives(obj_before, obj_after, improved) -> None:
+        record.objective_before = obj_before
+        record.objective_after = obj_after
+        record.solver_improved = improved
+        if not explain:
+            return
         # per-service candidates scored by replicas relocated — the pod
         # round's unit of disruption; chosen = the most-relocated service
         per_svc: dict[str, dict] = {}
@@ -1167,34 +1627,16 @@ def _pod_round(
         )
         if logger is not None:
             logger.info("decision", **expl)
-        explanations = (expl,)
-    # services_moved carries the SERVICE names of moves that LANDED: its
-    # consumers — the harness's teardown-outage injection and restart
-    # accounting — are service-granular, and a pod name (or a move a dead
-    # node rejected) would charge disruption that never happened
-    return RoundRecord(
-        round=rnd,
-        moved=moved_any,
-        most_hazard=None,
-        service=None,
-        target=None,
-        communication_cost=0.0,  # filled by run_controller post-move
-        load_std=0.0,
-        services_moved=tuple(sorted(moved_services)) if moved_any else (),
-        decision_latencies_s=(latency,),
-        objective_before=obj_before,
-        objective_after=obj_after,
-        solver_improved=improved,
-        explanations=explanations,
-        # pod-level provenance: each landed REPLICA hop (a service may
-        # appear once per pod) — the timeline records residency without
-        # service-collapsed cost deltas for these
-        applied_moves=tuple(applied_moves),
-    )
+        record.explanations = (expl,)
+
+    # the solver's before/after accounting rides the round-end bundle
+    _defer_solver_objectives(closer, info, _apply_objectives)
+    return record
 
 
 def _global_round(
     boundary, state, graph, config, key, rnd, *, logger=None, explain=False,
+    closer=None, pre_fence_hook=None, donate=False, carry=None,
 ) -> RoundRecord:
     cfg = GlobalSolverConfig(
         sweeps=config.global_solver_iters,
@@ -1207,6 +1649,7 @@ def _global_round(
         return _pod_round(
             boundary, state, graph, config, cfg, key, rnd,
             logger=logger, explain=explain,
+            closer=closer, pre_fence_hook=pre_fence_hook,
         )
     t0 = time.perf_counter()
     sparse_graph = None
@@ -1225,30 +1668,65 @@ def _global_round(
             value = sparsegraph.from_comm_graph(graph)
             cache["graph"], cache["value"] = graph, value
         sparse_graph = cache["value"]
+    # EVERYTHING the host needs from the incoming placement is read
+    # BEFORE the solve: with ``donate`` the dense solver consumes the
+    # snapshot's device buffers (the output placement aliases them), so
+    # post-solve host reads of the input state would touch freed memory.
+    # The move-scoring env (an O(P) host collapse) pre-builds only on
+    # the donated path for the same reason — undonated rounds keep the
+    # historical lazy build inside the gain helpers (an explain round
+    # with zero proposed moves never pays it)
+    old_nodes = np.asarray(state.pod_node)
+    valid = np.asarray(state.pod_valid)
+    svc_arr = np.asarray(state.pod_service)
+    cap = config.global_moves_cap
+    env = (
+        _move_scoring_env(state, graph, cfg)
+        if donate and (isinstance(cap, int) or explain)
+        else None
+    )
     with span("controller/global_solve", round=rnd):
         # name-stripped device views, like the greedy path: the sparse
         # graph above is built from the FULL graph; the solver itself
         # only ever reads arrays, so stripping keeps churned pod/node
         # names out of the jit key (1 trace + promotions holds for
         # global rounds too — regression-tested)
-        new_state, info = jax.block_until_ready(
-            solve_with_restarts(
-                device_view(state),
-                device_graph(graph),
-                key,
-                n_restarts=config.solver_restarts,
-                config=cfg,
-                tp=config.solver_tp,
-                sparse_graph=sparse_graph,
-            )
+        new_state, info = solve_with_restarts(
+            device_view(state),
+            device_graph(graph),
+            key,
+            n_restarts=config.solver_restarts,
+            config=cfg,
+            tp=config.solver_tp,
+            sparse_graph=sparse_graph,
+            donate=donate,
         )
+        if pre_fence_hook is not None:
+            # the pipelined overlap window: the previous round's flush +
+            # host tail run while the solve executes on device
+            pre_fence_hook()
+        # the apply boundary: ONE batched host read of the new placement
+        new_nodes = fence(new_state.pod_node)
     latency = time.perf_counter() - t0
-    obj_before, obj_after, improved = _pull_solver_objectives(info)
 
-    old_nodes = np.asarray(state.pod_node)
-    new_nodes = np.asarray(new_state.pod_node)
-    valid = np.asarray(state.pod_valid)
-    svc_arr = np.asarray(state.pod_service)
+    if info.pop("donated", False) and carry is not None:
+        # the solver consumed the snapshot's device buffers — but the
+        # loop's degraded/skip paths may still need the PRE-solve
+        # snapshot (a failed post-move monitor carries it into the next
+        # round's decide). Resurrect it bit-exactly: every non-pod_node
+        # leaf of the output is a pass-through alias of the input, and
+        # the old placement was host-read above — one small i32[P]
+        # re-upload, off the critical path
+        import dataclasses as _dc
+
+        updates = {
+            f.name: getattr(new_state, f.name)
+            for f in _dc.fields(new_state)
+            if f.name not in ("node_names", "pod_names")
+        }
+        updates["pod_node"] = jnp.asarray(old_nodes)
+        carry["state"] = state.replace(**updates)
+
     changed: list[tuple[int, int]] = []  # (service, target node)
     seen: set[int] = set()
     for i in np.flatnonzero(valid & (old_nodes != new_nodes)):
@@ -1258,7 +1736,6 @@ def _global_round(
         seen.add(s)
         changed.append((s, int(new_nodes[i])))
 
-    cap = config.global_moves_cap
     proposed = len(changed)
     gains: dict[tuple[int, int], float] = {}
     if isinstance(cap, int):
@@ -1269,7 +1746,7 @@ def _global_round(
         # is still pursued k Deployments at a time, and once no single
         # move helps on its own the loop is converged instead of churning
         # (the full solution may keep shifting under annealing noise)
-        scored = _top_gain_moves(changed, state, graph, cfg, cap)
+        scored = _top_gain_moves(changed, state, graph, cfg, cap, env=env)
         changed = [(s, t) for s, t, _ in scored]
         gains = {(s, t): g for s, t, g in scored}
     elif explain and changed:
@@ -1277,7 +1754,9 @@ def _global_round(
         # once at the start state so the explanation still carries why
         gains = {
             (s, t): g
-            for s, t, g in _individual_move_gains(changed, state, graph, cfg)
+            for s, t, g in _individual_move_gains(
+                changed, state, graph, cfg, env=env
+            )
         }
 
     moved_any = False
@@ -1297,18 +1776,36 @@ def _global_round(
             moved_names.append(graph.names[s])
             applied_moves.append((graph.names[s], landed))
 
-    explanations: tuple[dict, ...] = ()
-    if explain:
-        candidates = [
-            {
-                "service": graph.names[s],
-                "node": state.node_names[t],
-                "node_index": int(t),
-                "score": float(gains.get((s, t), 0.0)),
-                "applied": graph.names[s] in moved_names,
-            }
-            for s, t in changed
-        ]
+    record = RoundRecord(
+        round=rnd,
+        moved=moved_any,
+        most_hazard=None,
+        service=None,
+        target=None,
+        communication_cost=0.0,  # filled at the round-end flush
+        load_std=0.0,
+        services_moved=tuple(moved_names),
+        decision_latencies_s=(latency,),
+        applied_moves=tuple(applied_moves),
+    )
+
+    candidates = [
+        {
+            "service": graph.names[s],
+            "node": state.node_names[t],
+            "node_index": int(t),
+            "score": float(gains.get((s, t), 0.0)),
+            "applied": graph.names[s] in moved_names,
+        }
+        for s, t in changed
+    ]
+
+    def _apply_objectives(obj_before, obj_after, improved) -> None:
+        record.objective_before = obj_before
+        record.objective_after = obj_after
+        record.solver_improved = improved
+        if not explain:
+            return
         expl = solver_explanation(
             kind="global",
             round=rnd,
@@ -1321,20 +1818,8 @@ def _global_round(
         )
         if logger is not None:
             logger.info("decision", **expl)
-        explanations = (expl,)
-    return RoundRecord(
-        round=rnd,
-        moved=moved_any,
-        most_hazard=None,
-        service=None,
-        target=None,
-        communication_cost=0.0,  # filled by run_controller from the post-move snapshot
-        load_std=0.0,
-        services_moved=tuple(moved_names),
-        decision_latencies_s=(latency,),
-        objective_before=obj_before,
-        objective_after=obj_after,
-        solver_improved=improved,
-        explanations=explanations,
-        applied_moves=tuple(applied_moves),
-    )
+        record.explanations = (expl,)
+
+    # the solver's before/after accounting rides the round-end bundle
+    _defer_solver_objectives(closer, info, _apply_objectives)
+    return record

@@ -45,6 +45,7 @@ from kubernetes_rescheduling_tpu.bench.sinks import (
 )
 from kubernetes_rescheduling_tpu.config import (
     ChaosConfig,
+    ControllerConfig,
     ElasticConfig,
     ForecastConfig,
     PerfConfig,
@@ -129,6 +130,12 @@ class ExperimentConfig:
     # (algorithms may include "proactive" — the head-to-head against
     # reactive CAR under churn is run_forecast_headtohead's matrix).
     forecast: ForecastConfig = field(default_factory=ForecastConfig)
+    # Software-pipelined control loop ([controller] pipeline): the r2
+    # control-loop phase runs the overlapped schedule — decisions are
+    # bit-identical to the sequential loop (test-pinned), only wall
+    # clock and transfer timing change.
+    pipeline: bool = False
+    pipeline_depth: int = 2
     # Live ops plane: serve /metrics, /healthz, /events on this port for
     # the whole session (0 = ephemeral, None = off). One OpsPlane spans
     # every matrix cell; per-cell loggers re-bind as cells start, so
@@ -567,6 +574,9 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                     ),
                     forecast=cfg.forecast,
                     max_consecutive_failures=cfg.max_consecutive_failures,
+                    controller=ControllerConfig(
+                        pipeline=cfg.pipeline, depth=cfg.pipeline_depth
+                    ),
                 )
                 # solve_graph (above) closes over this accumulator; bound here,
                 # before the controller ever calls the estimator

@@ -1,0 +1,171 @@
+"""Single-bundle round-end transfers for the live control loop.
+
+BENCH_r04/r05 put the live plane's wall-clock round at 4-5x device time,
+and most of the gap is device<->host round trips: before this module the
+controller paid up to SIX per executed round — two uncounted scalar
+reads (``communication_cost`` / ``load_std``), plus one counted ``pull``
+each for ``decision_explain``, ``attribution``, the forecast diag, and
+``solver_objectives``. This module folds all of them into ONE round-end
+bundle:
+
+- :func:`round_end_metrics` — the device half: one compiled program
+  (``controller_round_end``, instrumented — the 1-steady-state-trace
+  invariant applies) producing ``[communication_cost, load_std]`` and,
+  when attribution is on, the flat attribution bundle, in a single flat
+  f32 vector.
+- :class:`RoundCloser` — the host half: a per-round accumulator of
+  device-resident diagnostic pieces (the metrics vector, explain
+  bundles, the forecast diag, solver objectives). :meth:`RoundCloser.flush`
+  concatenates the pieces on device, pulls them in ONE counted transfer
+  (``site="round_end"``), slices them back out host-side, and runs each
+  piece's decode callback in registration order.
+
+Degraded rounds (a failed post-move monitor) historically re-ran the
+metric kernels on the carried snapshot and re-pulled values bit-equal to
+the previous round's — now they reuse the cached host values (or the
+still-unpulled device bundle of a mid-round probe/remask snapshot), so a
+degraded round costs at most one transfer and often zero.
+
+:func:`fence` is the apply boundary: the ONE place the round functions
+materialize decision outputs on the host (``jax.device_get`` of the
+whole tuple — one batched host read instead of per-element ``int()`` /
+``bool()`` syncs). ``scripts/check_apply_boundary.py`` statically pins
+``block_until_ready``/``pull``/``device_get`` in the controller modules
+to this module's designated sites.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_rescheduling_tpu.objectives.metrics import (
+    communication_cost,
+    communication_cost_attribution,
+    load_std,
+)
+from kubernetes_rescheduling_tpu.telemetry import instrument_jit, pull
+from kubernetes_rescheduling_tpu.telemetry.registry import MetricsRegistry
+
+ROUND_END_SITE = "round_end"
+
+# layout of the metrics head inside the round-end vector
+METRIC_COST = 0
+METRIC_LOAD_STD = 1
+METRIC_HEAD = 2
+
+
+def round_end_metrics(state, graph, *, top_k: int = 0) -> jax.Array:
+    """Everything the host needs to close a round's reporting, in one
+    compiled program: ``[communication_cost, load_std]`` followed — when
+    ``top_k > 0`` — by the flat attribution bundle
+    (``objectives.metrics.communication_cost_attribution``; per-edge
+    contributions sum back to the scalar recorded two slots earlier, so
+    the ``attribution_consistent`` invariant holds by construction)."""
+    head = jnp.stack(
+        [
+            communication_cost(state, graph).astype(jnp.float32),
+            load_std(state).astype(jnp.float32),
+        ]
+    )
+    if top_k > 0:
+        return jnp.concatenate(
+            [head, communication_cost_attribution(state, graph, top_k=top_k)]
+        )
+    return head
+
+
+# one dispatch per fresh snapshot; same steady-state contract as the
+# decision kernels — jax_traces_total{fn="controller_round_end"} == 1 per
+# (shape, top_k) signature plus counted bucket promotions
+_round_end = instrument_jit(
+    round_end_metrics, name="controller_round_end", static_argnames=("top_k",)
+)
+
+
+def dispatch_round_end(state, graph, *, top_k: int = 0) -> jax.Array:
+    """Async dispatch of the round-end kernel (no host sync)."""
+    return _round_end(state, graph, top_k=top_k)
+
+
+def fence(tree):
+    """The apply boundary: materialize device outputs on the host as ONE
+    batched read (``jax.device_get`` fences and transfers the whole
+    pytree together — never per-element ``int()``/``bool()`` syncs)."""
+    return jax.device_get(tree)
+
+
+def block(tree):
+    """Completion fence WITHOUT a host transfer
+    (``jax.block_until_ready``) — the timing boundary for fenced device
+    measurements (the fleet loop's batched solve). Like :func:`fence`,
+    this is a designated apply-boundary site for
+    ``scripts/check_apply_boundary.py``."""
+    return jax.block_until_ready(tree)
+
+
+class RoundCloser:
+    """One per round: device-resident diagnostics in, ONE transfer out.
+
+    ``defer(arr, decode)`` registers a device array (any shape/dtype —
+    flattened to f32 on device) plus a host callback receiving the
+    decoded ``np.ndarray`` reshaped to the original shape. ``flush()``
+    pulls every pending piece as a single counted ``round_end`` transfer
+    and runs the decodes in registration order; pure-host callbacks
+    registered via ``defer_host`` interleave at their registered
+    position (a degraded round's cached metric values ride this path,
+    costing no transfer)."""
+
+    def __init__(self, registry: MetricsRegistry | None = None) -> None:
+        self.registry = registry
+        # (dev_flat | None, shape, dtype, decode) in registration order;
+        # dev_flat None = host-only callback (no transfer contribution)
+        self._pieces: list[tuple[Any, tuple, Any, Callable]] = []
+        self.flushed = False
+
+    def defer(self, arr: jax.Array, decode: Callable[[np.ndarray], None]) -> None:
+        if self.flushed:
+            raise RuntimeError("RoundCloser already flushed")
+        shape = tuple(arr.shape)
+        self._pieces.append(
+            (jnp.ravel(arr).astype(jnp.float32), shape, arr.dtype, decode)
+        )
+
+    def defer_host(self, decode: Callable[[], None]) -> None:
+        """A host-side finalize step with no device payload."""
+        if self.flushed:
+            raise RuntimeError("RoundCloser already flushed")
+        self._pieces.append((None, (), None, decode))
+
+    @property
+    def has_device_pieces(self) -> bool:
+        return any(dev is not None for dev, *_ in self._pieces)
+
+    def flush(self) -> None:
+        """Close the round: ONE pull for every device piece, then the
+        decode callbacks in order. A round with no device pieces (a
+        degraded round closing on cached values) pulls nothing and
+        counts nothing — the transfer counter reports what actually
+        crossed."""
+        if self.flushed:
+            raise RuntimeError("RoundCloser already flushed")
+        self.flushed = True
+        dev = [p[0] for p in self._pieces if p[0] is not None]
+        flat = None
+        if dev:
+            bundle = dev[0] if len(dev) == 1 else jnp.concatenate(dev)
+            flat = pull(bundle, site=ROUND_END_SITE, registry=self.registry)
+        off = 0
+        for dev_flat, shape, dtype, decode in self._pieces:
+            if dev_flat is None:
+                decode()
+                continue
+            n = int(dev_flat.shape[0])
+            piece = np.asarray(flat[off : off + n])
+            off += n
+            if dtype is not None and np.dtype("float32") != np.dtype(dtype):
+                piece = piece.astype(dtype)
+            decode(piece.reshape(shape))

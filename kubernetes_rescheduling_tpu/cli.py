@@ -138,6 +138,36 @@ def _forecast_config(args):
     )
 
 
+def _add_pipeline_flags(parser: argparse.ArgumentParser) -> None:
+    """The software-pipelined control loop (reschedule/bench)."""
+    parser.add_argument(
+        "--pipeline", action="store_true",
+        help="run the software-pipelined control loop: the previous "
+             "round's single-bundle round-end transfer + record tail "
+             "overlap this round's device compute, and the post-move "
+             "monitor runs in a background thread — decisions are "
+             "bit-identical to the sequential loop (the backend sees "
+             "the same call order); only wall-clock changes. Rounds the "
+             "pipeline cannot honor (open breaker, pending churn, "
+             "streaming graph) drain and run sequentially",
+    )
+    parser.add_argument(
+        "--pipeline-depth", type=int, default=2,
+        help="snapshot double-buffer depth of the pipelined loop; only "
+             "2 (one round closing while the next decides) is "
+             "implemented — other values are rejected so telemetry "
+             "never reports a schedule that did not run",
+    )
+
+
+def _pipeline_config(args):
+    from kubernetes_rescheduling_tpu.config import ControllerConfig
+
+    return ControllerConfig(
+        pipeline=args.pipeline, depth=args.pipeline_depth
+    )
+
+
 def _add_telemetry_flags(parser: argparse.ArgumentParser) -> None:
     """The unified observability outputs, shared by every run command."""
     parser.add_argument(
@@ -245,6 +275,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "active (render trends with `telemetry perf PATH`)")
     _add_resilience_flags(r)
     _add_forecast_flags(r)
+    _add_pipeline_flags(r)
     _add_telemetry_flags(r)
     _add_serve_flags(r)
 
@@ -296,6 +327,7 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--seed", type=int, default=0)
     _add_resilience_flags(b)
     _add_forecast_flags(b)
+    _add_pipeline_flags(b)
     _add_telemetry_flags(b)
     _add_serve_flags(b)
 
@@ -599,6 +631,7 @@ def cmd_fleet_reschedule(args, algo: str) -> dict:
             profile=args.churn_profile, seed=args.churn_seed
         ),
         max_consecutive_failures=args.max_consecutive_failures,
+        controller=_pipeline_config(args),
         fleet=FleetConfig(
             tenants=args.fleet,
             plane=args.fleet_plane,
@@ -721,6 +754,7 @@ def cmd_reschedule(args) -> dict:
         ),
         max_consecutive_failures=args.max_consecutive_failures,
         forecast=_forecast_config(args),
+        controller=_pipeline_config(args),
         perf=PerfConfig(ledger_path=args.perf_ledger),
     )
     ops, logger = _build_ops_plane(args, cfg)
@@ -785,6 +819,8 @@ def cmd_bench(args) -> dict:
         churn_profile=args.churn_profile,
         churn_seed=args.churn_seed,
         forecast=_forecast_config(args),
+        pipeline=args.pipeline,
+        pipeline_depth=args.pipeline_depth,
         serve_port=args.serve,
         bundle_dir=args.bundle_dir,
     )

@@ -152,6 +152,59 @@ class ForecastConfig:
 
 
 @dataclass(frozen=True)
+class ControllerConfig:
+    """Control-loop execution block (``[controller]`` in TOML): how the
+    live round loop schedules its work. jax-free, like the other blocks,
+    so config import stays light.
+
+    ``pipeline`` turns on the software-pipelined round loop: the
+    post-move ``monitor`` is issued asynchronously through the boundary
+    (retry/breaker/degraded semantics unchanged — the call ORDER the
+    backend sees is exactly the sequential loop's, so decisions are
+    bit-identical on the sim backend), decision kernels dispatch
+    asynchronously, the host fences device work only at the apply
+    boundary, and the previous round's single round-end bundle pull +
+    record finalization overlap the current round's device compute.
+    Rounds that cannot pipeline — an open/half-open breaker, pending
+    churn, a streaming (callable) decision graph — drain the pipeline
+    and run the sequential path for that round.
+
+    ``depth`` is the snapshot double-buffer depth: how many rounds may
+    be in flight at once. Only 2 — one round closing while the next
+    decides — is implemented (the monitor→decide data dependency admits
+    no more without speculation), and validation REJECTS anything else
+    so the ``pipeline_depth`` gauge and ``RoundRecord.pipeline`` can
+    never report a schedule that did not run; the knob reserves the
+    config surface for speculative deeper variants.
+
+    ``donate_carry`` gates donation of the GLOBAL SOLVER's snapshot
+    carry (``global_assign_donated`` — the output placement aliases the
+    input instead of holding both; visible in the ``jax_hbm_*``
+    cost-model gauges), applied only when nothing outside the loop can
+    touch the pre-solve snapshot (no checkpoint manager, ``on_round``,
+    or ops plane). It does NOT govern the forecast plane's
+    recursive-least-squares carry: that state is private to the plane
+    and consumed every round by construction, so it is ALWAYS donated
+    (``forecast/plane.py``). The greedy decide kernels are deliberately
+    never donated: none of their outputs (index scalars, a bool hazard
+    mask) can alias the f32/i32 snapshot buffers, so XLA would warn and
+    reuse nothing."""
+
+    pipeline: bool = False
+    depth: int = 2
+    donate_carry: bool = True
+
+    def validate(self) -> "ControllerConfig":
+        if self.depth != 2:
+            raise ValueError(
+                f"controller pipeline depth must be 2 (the only "
+                f"implemented schedule: one round closing while the next "
+                f"decides), got {self.depth}"
+            )
+        return self
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Fault-injection block: which named ``backends.chaos`` profile wraps
     the loop's backend (``"none"`` = no wrapper), under which fault seed.
@@ -231,6 +284,14 @@ class ObsConfig:
                                          # this is in violation (only judges
                                          # rounds that carry forecast data,
                                          # so reactive runs never trip it)
+    slo_pipeline_min_overlap: float = 0.0  # pipeline_overlap SLO rule: the
+                                           # rolling mean overlap_ratio of
+                                           # pipelined rounds collapsing
+                                           # below this means the pipeline
+                                           # has degenerated to sequential
+                                           # round-trips (0 = off; only
+                                           # judges rounds that carry
+                                           # pipeline telemetry)
 
     def validate(self) -> "ObsConfig":
         if self.serve_port is not None and not (0 <= self.serve_port <= 65535):
@@ -257,6 +318,11 @@ class ObsConfig:
             raise ValueError(
                 "slo_forecast_min_skill must be <= 1.0 (skill is bounded "
                 "above by 1, so a larger threshold would always violate)"
+            )
+        if not (0.0 <= self.slo_pipeline_min_overlap <= 1.0):
+            raise ValueError(
+                "slo_pipeline_min_overlap must be in [0, 1] (overlap_ratio "
+                "is a fraction of background boundary time hidden)"
             )
         return self
 
@@ -381,6 +447,10 @@ class RescheduleConfig:
     # see ForecastConfig.
     forecast: ForecastConfig = field(default_factory=ForecastConfig)
 
+    # Control-loop execution: the software-pipelined round loop and
+    # device-carry donation — see ControllerConfig.
+    controller: ControllerConfig = field(default_factory=ControllerConfig)
+
     # Observability: the live ops plane (HTTP endpoint, decision
     # explainability, flight recorder, SLO watchdog) — see ObsConfig.
     obs: ObsConfig = field(default_factory=ObsConfig)
@@ -454,6 +524,7 @@ class RescheduleConfig:
                 "cluster churns itself (watch-driven snapshots are ROADMAP "
                 "item 5)"
             )
+        self.controller.validate()
         self.obs.validate()
         self.perf.validate()
         self.fleet.validate()
@@ -510,6 +581,8 @@ class RescheduleConfig:
             data["elastic"] = ElasticConfig(**el)
         if isinstance(data.get("forecast"), dict):
             data["forecast"] = ForecastConfig(**data["forecast"])
+        if isinstance(data.get("controller"), dict):
+            data["controller"] = ControllerConfig(**data["controller"])
         if isinstance(data.get("obs"), dict):
             data["obs"] = ObsConfig(**data["obs"])
         if isinstance(data.get("perf"), dict):

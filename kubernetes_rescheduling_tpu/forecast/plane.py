@@ -45,7 +45,18 @@ FORECAST_SITE = "forecast"
 # round. Same steady-state contract as the decision kernels —
 # jax_traces_total{fn="controller_forecast"} == 1 + bucket promotions
 # (the node axis re-pads on promotion; nothing else changes shape).
-_forecast_step = instrument_jit(forecast_step, name="controller_forecast")
+#
+# The forecast state is a DONATED carry (donate_argnums=1): every leaf
+# of the output ForecastState has exactly the input's shape, the plane
+# replaces its handle with the output every round, and the old state is
+# never read again — so XLA aliases the recursive-least-squares
+# statistics (the per-node normal-equation matrices, the largest
+# resident piece of the plane) in place instead of holding both
+# generations. Visible in the jax_hbm_* gauges captured at first
+# compile; test-pinned in tests/test_pipeline.py.
+_forecast_step = instrument_jit(
+    forecast_step, name="controller_forecast", donate_argnums=(1,)
+)
 
 
 class ForecastPlane:
@@ -64,11 +75,19 @@ class ForecastPlane:
         self._decay = jnp.float32(config.decay)
         self._fit_decay = jnp.float32(config.fit_decay)
 
-    def observe_and_predict(self, state) -> jax.Array:
+    def observe_and_predict(self, state, *, closer=None) -> jax.Array:
         """Fold ``state``'s observed node loads into the model and
         return the predicted-load ``delta`` (f32[N], device-resident)
         for this round's proactive decision. Handles bucket promotions
-        by re-padding the forecaster's node axis (one legal retrace)."""
+        by re-padding the forecaster's node axis (one legal retrace).
+
+        With ``closer`` (the controller's per-round
+        :class:`~bench.round_end.RoundCloser`) the diag vector stays
+        device-resident and rides the round's single ``round_end``
+        transfer — the decode lands on ``self._last`` at flush, before
+        ``round_info`` is read. Without it (direct callers, tests) the
+        diag is pulled immediately as its own counted ``forecast``
+        transfer, the historical behavior."""
         n = state.num_nodes
         if self._fstate is None:
             self._fstate = init_forecast_state(self.config.lags, n)
@@ -78,7 +97,15 @@ class ForecastPlane:
             state, self._fstate, self._ridge, self._min_skill,
             self._min_history, self._decay, self._fit_decay,
         )
-        d = pull(diag, site=FORECAST_SITE, registry=self.registry)
+        if closer is not None:
+            closer.defer(diag, self._decode_diag)
+        else:
+            self._decode_diag(
+                pull(diag, site=FORECAST_SITE, registry=self.registry)
+            )
+        return delta
+
+    def _decode_diag(self, d) -> None:
         trained = bool(d[DIAG_TRAINED] > 0)
         frac = float(d[DIAG_FRAC_MODEL])
         skill = float(d[DIAG_SKILL])
@@ -98,7 +125,6 @@ class ForecastPlane:
             "mode": mode,
             "target": "node_load",
         }
-        return delta
 
     def round_info(self) -> dict | None:
         """The latest round's forecast block (RoundRecord.forecast)."""

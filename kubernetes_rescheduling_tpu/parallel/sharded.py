@@ -146,6 +146,7 @@ def solve_with_restarts(
     mesh: Mesh | None = None,
     tp: int = 1,
     sparse_graph=None,
+    donate: bool = False,
 ) -> tuple[ClusterState, dict[str, jax.Array]]:
     """Production best-of-N global solve — the mesh-parallel path with
     graceful degradation.
@@ -171,6 +172,14 @@ def solve_with_restarts(
     same call works from laptop CPU to a pod slice. ``info["restarts"]``
     records N for benchmark provenance; ``info["tp"]`` is present when the
     node axis was sharded.
+
+    ``donate=True`` (the controller's donated-carry dispatch) hands the
+    state's device buffers to the solver on the ONE path with a
+    top-level donatable jit — the single-restart, unsharded dense solve
+    (``global_assign_donated``: output placement aliases the input). The
+    sharded/scan/sparse paths trace the solver inline, where a nested
+    donation would be dropped anyway, so they ignore the flag. The
+    caller must treat ``state`` as consumed when it sets this.
     """
     if mesh is not None:
         mesh_tp = mesh.shape.get("tp", 1)
@@ -228,9 +237,23 @@ def solve_with_restarts(
     else:
         solver, solve_graph, tag = global_assign, graph, "dense"
     if n_restarts <= 1:
+        donated = donate and tag == "dense"
+        if donated:
+            from kubernetes_rescheduling_tpu.solver.global_solver import (
+                global_assign_donated,
+            )
+
+            solver = global_assign_donated
         new_state, info = solver(state, solve_graph, key, config)
         info = dict(info)
         info["restarts"] = jnp.asarray(1)
+        if donated:
+            # host flag (never a jax array): tells the caller its input
+            # buffers were actually consumed on THIS path — the
+            # sharded/scan/sparse paths above never donate, so a caller
+            # that must rebuild its carry keys off this, not off the
+            # flag it passed
+            info["donated"] = True
         return new_state, info
     if mesh is None:
         from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh

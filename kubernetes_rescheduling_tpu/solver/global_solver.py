@@ -942,3 +942,25 @@ def global_assign(
         "inline_mass": jnp.asarray(inline_mass),
     }
     return new_state, info
+
+
+# The DONATED twin of the solver jit (same traced body, same
+# ``global_assign`` fn label so trace/cost accounting stays one series):
+# the state carry is surrendered to XLA (``donate_argnums``), so the
+# output placement — every leaf of which has exactly the input's shape —
+# aliases the input buffers instead of holding both resident. This is
+# the controller's steady-state dispatch under
+# ``[controller] donate_carry``: the loop consumes a snapshot per round
+# and replaces it with the post-move monitor, so the input is genuinely
+# dead after the call. Callers MUST host-read anything they need from
+# the input snapshot BEFORE dispatching (``bench.controller._global_round``
+# does), and must never pass a snapshot that outlives the round — the
+# un-donated ``global_assign`` stays the default for every other caller
+# (tests, harness one-shots, nested sparse/trace/restart uses, where the
+# inner jit would drop the donation anyway).
+global_assign_donated = instrument_jit(
+    global_assign.__wrapped__,
+    name="global_assign",
+    static_argnames=("config",),
+    donate_argnums=(0,),
+)

@@ -290,6 +290,9 @@ class OpsPlane:
                 forecast_min_skill=getattr(
                     obs, "slo_forecast_min_skill", 0.0
                 ),
+                pipeline_min_overlap=getattr(
+                    obs, "slo_pipeline_min_overlap", 0.0
+                ),
             ),
             registry=registry,
             logger=logger,

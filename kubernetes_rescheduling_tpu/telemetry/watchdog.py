@@ -56,6 +56,7 @@ RULE_RETRACE = "retrace"
 RULE_PERF = "perf_regression"
 RULE_ATTRIBUTION = "attribution_drift"
 RULE_FORECAST = "forecast_skill"
+RULE_PIPELINE = "pipeline_overlap"
 
 
 @dataclass(frozen=True)
@@ -82,6 +83,14 @@ class SLORules:
     # judged, so reactive runs can never trip it. The natural threshold
     # is 0.0 — "at least tie persistence".
     forecast_min_skill: float = 0.0
+    # pipeline overlap collapse: the rolling mean overlap_ratio of
+    # pipelined rounds (RoundRecord.pipeline — the fraction of background
+    # boundary time hidden behind foreground work) sitting below this
+    # means the pipelined loop has degenerated to sequential round-trips
+    # — the wall-clock win the perf ledger's wall_round_ms series gates
+    # is silently gone (0 disables; only rounds carrying pipeline
+    # telemetry are judged, so sequential runs can never trip it).
+    pipeline_min_overlap: float = 0.0
 
     def validate(self) -> "SLORules":
         if self.window < 2:
@@ -99,6 +108,11 @@ class SLORules:
             raise ValueError(
                 "forecast_min_skill must be <= 1.0 (skill is bounded "
                 "above by 1)"
+            )
+        if not (0.0 <= self.pipeline_min_overlap <= 1.0):
+            raise ValueError(
+                "pipeline_min_overlap must be in [0, 1] (overlap_ratio "
+                "is a fraction)"
             )
         return self
 
@@ -137,6 +151,10 @@ class Watchdog:
         self._perf_active: dict[str, dict[str, Any]] = {}
         self._attr: dict[str, Any] | None = None  # latest round's attribution
         self._forecast: dict[str, Any] | None = None  # latest round's forecast
+        # pipelined rounds' overlap ratios (rolling window)
+        self._overlap: collections.deque[float] = collections.deque(
+            maxlen=self.rules.window
+        )
         self.active: dict[str, dict[str, Any]] = {}
         self.violations_seen = 0
 
@@ -156,6 +174,7 @@ class Watchdog:
         self._promo_allow = 0
         self._attr = None
         self._forecast = None
+        self._overlap.clear()
         self.active = (
             {RULE_PERF: self.active[RULE_PERF]}
             if RULE_PERF in self.active
@@ -176,6 +195,9 @@ class Watchdog:
         forecast = getattr(record, "forecast", None)
         if isinstance(forecast, dict):
             self._forecast = forecast
+        pipeline = getattr(record, "pipeline", None)
+        if isinstance(pipeline, dict) and "overlap_ratio" in pipeline:
+            self._overlap.append(float(pipeline["overlap_ratio"]))
         churn = getattr(record, "churn", None)
         if isinstance(churn, dict):
             p = churn.get("promotions")
@@ -283,6 +305,17 @@ class Watchdog:
                     "mae_model": self._forecast.get("mae_model"),
                     "mae_persistence": self._forecast.get("mae_persistence"),
                     "mode": self._forecast.get("mode"),
+                }
+        if r.pipeline_min_overlap > 0 and len(self._overlap) >= r.min_samples:
+            # overlap collapse: the rolling MEAN of pipelined rounds'
+            # hidden-background fraction — one slow flush is noise, a
+            # window of them means the pipeline is sequential again
+            mean = sum(self._overlap) / len(self._overlap)
+            if mean < r.pipeline_min_overlap:
+                now[RULE_PIPELINE] = {
+                    "overlap_ratio_mean": mean,
+                    "threshold": r.pipeline_min_overlap,
+                    "window": len(self._overlap),
                 }
         if self._perf_active:
             now[RULE_PERF] = {

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Static check: the controller fences and pulls only at designated sites.
+
+The wall-clock round arc (ISSUE 9 / ROADMAP item 3) holds only while
+every device→host synchronization in the control loops happens at the
+two designated boundaries:
+
+- the APPLY boundary — ``bench.round_end.fence`` (one batched
+  ``device_get`` of the decision outputs) / ``bench.round_end.block``
+  (a completion fence without a transfer, for fenced timings);
+- the ROUND-END boundary — ``bench.round_end.RoundCloser.flush`` (ONE
+  counted ``round_end`` pull per executed round) and the fleet loop's
+  ``_pull_round_bundle`` (its packed decision and metrics bundles).
+
+One stray ``jax.block_until_ready`` / ``jax.device_get`` /
+``telemetry.pull`` inside a round helper silently re-introduces the
+per-round RTTs the single-bundle protocol removed — the exact failure
+mode BENCH_r04/r05 measured as a 4-5× wall-over-device gap. AST-based,
+like its sibling ``check_boundary_retry.py``: inside
+``bench/controller.py`` and ``bench/fleet.py``, a call named
+``block_until_ready``, ``device_get``, or ``pull`` is only legal inside
+the functions named in ``ALLOWED_FUNCS`` (the fleet loop's designated
+bundle-pull helper). ``bench/round_end.py`` is the designated home of
+the real sync primitives and is deliberately not checked.
+
+Run directly (exit 1 on violation) or through its test twin
+(tests/test_apply_boundary.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parent.parent / "kubernetes_rescheduling_tpu"
+# the control loops whose round helpers must stay sync-free outside the
+# designated boundaries (round_end.py itself is the designated module)
+CHECKED = (
+    PACKAGE / "bench" / "controller.py",
+    PACKAGE / "bench" / "fleet.py",
+)
+BANNED_CALLS = {"block_until_ready", "device_get", "pull"}
+# functions allowed to contain a banned call: the fleet loop's designated
+# round-end transfer site
+ALLOWED_FUNCS = {"_pull_round_bundle"}
+
+
+def _call_name(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def find_raw_syncs(path: Path) -> list[tuple[int, str]]:
+    """(line, description) pairs for banned sync calls outside the
+    designated functions."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out: list[tuple[int, str]] = []
+
+    def walk(node: ast.AST, func: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_func = func
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_func = child.name
+            if isinstance(child, ast.Call):
+                name = _call_name(child)
+                if name in BANNED_CALLS and func not in ALLOWED_FUNCS:
+                    out.append(
+                        (child.lineno, f"{name}(...) in {func or '<module>'}")
+                    )
+            walk(child, child_func)
+
+    walk(tree, None)
+    return out
+
+
+def violations() -> list[str]:
+    return [
+        f"{path.relative_to(PACKAGE.parent)}:{line}: {what}"
+        for path in CHECKED
+        for line, what in find_raw_syncs(path)
+    ]
+
+
+def main() -> int:
+    bad = violations()
+    if bad:
+        sys.stderr.write(
+            "raw device sync in a controller round helper — route host\n"
+            "reads through the apply boundary (bench.round_end.fence/"
+            "block)\nor the round-end bundle (RoundCloser.flush / "
+            "_pull_round_bundle):\n"
+            + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

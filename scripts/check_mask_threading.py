@@ -61,6 +61,7 @@ ENTRY_POINTS: dict[str, tuple[str, ...]] = {
         "node_pair_cost_matrix",
         "communication_cost_attribution",
     ),
+    "bench/round_end.py": ("round_end_metrics",),
     "policies/hazard.py": ("detect_hazard",),
     "policies/scoring.py": ("node_features", "policy_scores", "choose_node"),
     "policies/victim.py": ("pick_victim", "deployment_group"),

@@ -1,0 +1,53 @@
+"""CI twin of ``scripts/check_apply_boundary.py``: the control loops
+fence device work and pull diagnostics only at the designated apply-
+boundary / round-end sites (``bench.round_end``) — a stray
+``block_until_ready``/``device_get``/``pull`` in a round helper would
+silently re-introduce the per-round RTTs the single-bundle round-end
+protocol removed."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+
+def _load_checker():
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_apply_boundary.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_apply_boundary", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_apply_boundary", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_controller_has_no_raw_device_syncs():
+    checker = _load_checker()
+    assert checker.violations() == []
+
+
+def test_checker_catches_raw_syncs(tmp_path):
+    checker = _load_checker()
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import jax\n"
+        "def round_helper(out, closer):\n"
+        "    jax.block_until_ready(out)\n"        # raw fence: flagged
+        "    x = jax.device_get(out)\n"           # raw transfer: flagged
+        "    y = pull(out, site='x')\n"           # raw counted pull: flagged
+        "    closer.flush()\n"                    # designated site: allowed
+        "    return fence(out)\n"                 # designated wrapper: allowed
+        "def _pull_round_bundle(arr, site):\n"
+        "    return pull(arr, site=site)\n"       # the allowlisted home
+    )
+    lines = sorted(line for line, _ in checker.find_raw_syncs(f))
+    assert lines == [3, 4, 5]
+
+
+def test_checker_flags_module_level_calls(tmp_path):
+    checker = _load_checker()
+    f = tmp_path / "mod.py"
+    f.write_text("import jax\nx = jax.device_get(1)\n")
+    assert [line for line, _ in checker.find_raw_syncs(f)] == [2]

@@ -369,14 +369,19 @@ def test_controller_attribution_soak_acceptance(registry, tmp_path):
     checked, bad = check_attribution([r.as_dict() for r in result.rounds])
     assert checked == rounds and bad == []
 
-    # exactly one extra transfer per round, pinned by site
+    # exactly ONE round-end transfer per executed round: the attribution
+    # bundle rides the same pull as the cost/load-std pair and the
+    # explain bundles (bench/round_end.py) — no separate attribution pull
     fam = registry.counter("device_transfers_total", labelnames=("site",))
-    assert fam.labels(site="attribution").value == rounds
-    # exactly one steady-state trace of the attribution kernel
+    assert fam.labels(site="round_end").value == rounds
+    assert fam.labels(site="attribution").value == 0
+    # exactly one steady-state trace of the round-end kernel; it
+    # dispatches once per fresh snapshot (the startup snapshot's bundle
+    # is the degraded-close fallback and is never pulled)
     traces = registry.counter("jax_traces_total", labelnames=("fn",))
-    assert traces.labels(fn="controller_attribution").value == 1
+    assert traces.labels(fn="controller_round_end").value == 1
     calls = registry.counter("jax_calls_total", labelnames=("fn",))
-    assert calls.labels(fn="controller_attribution").value == rounds
+    assert calls.labels(fn="controller_round_end").value == rounds + 1
 
     # cardinality budget: unordered node pairs <= N(N-1)/2, per-node
     # <= N, ranks == k
@@ -476,8 +481,12 @@ def test_chaos_soak_attribution_stays_consistent(registry):
         logger=logger, registry=registry,
     )
     assert report["records"] + report["skipped_rounds"] == 20
+    # one round-end bundle per EXECUTED round (skipped rounds pull
+    # nothing; degraded rounds reuse cached metrics but still flush
+    # their fresh explain bundle — one transfer either way)
     fam = registry.counter("device_transfers_total", labelnames=("site",))
-    assert fam.labels(site="attribution").value == report["records"]
+    assert fam.labels(site="round_end").value == report["records"]
+    assert fam.labels(site="attribution").value == 0
 
 
 def test_flight_recorder_bundle_carries_attribution(registry, tmp_path):

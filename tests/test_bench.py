@@ -346,7 +346,9 @@ def test_cli_workmodel_file_reproduces_builtin(tmp_path, capsys):
     assert cli_main(args + ["--workmodel", str(path)]) == 0
     external = json.loads(capsys.readouterr().out)
 
-    timing_fields = {"decision_latency_s", "decision_latencies_s"}
+    timing_fields = {
+        "decision_latency_s", "decision_latencies_s", "wall_s", "pipeline",
+    }
 
     def decisions(out):  # strip wall-clock timing, keep every decision
         return [

@@ -110,3 +110,28 @@ def test_fleet_headline_conforms():
         "extra": {"scenario": "fleet", "tenants": 16, "vs_solo": 8.5},
     }
     assert checker.check_parsed(fleet_like, "fleet") == []
+
+
+def test_pipeline_headline_conforms():
+    """The pipeline cell's result dict (bench.bench_pipeline's shape —
+    the wall_round_ms perf-ledger series) satisfies the same
+    parsed-record schema the history is held to."""
+    checker = _load_checker()
+    pipeline_like = {
+        "metric": "wall_round_ms",
+        "value": 41.2,
+        "unit": "ms",
+        "vs_baseline": 2.43,
+        "extra": {
+            "scenario": "pipeline",
+            "rounds": 12,
+            "sequential_wall_round_ms": 139.0,
+            "device_ms_per_round": 26.8,
+            "wall_vs_device": 1.54,
+            "speedup_vs_sequential": 3.37,
+            "rtt_ms": 25.0,
+            "overlap_ratio_mean": 0.82,
+            "bit_identical": True,
+        },
+    }
+    assert checker.check_parsed(pipeline_like, "pipeline") == []

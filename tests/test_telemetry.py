@@ -408,9 +408,11 @@ def test_run_controller_global_objectives_surface(registry):
     assert round_events[0]["objective_after"] == pytest.approx(
         rec.objective_after
     )
-    # the solver pull is counted as a device transfer
+    # the solver objectives ride the round's SINGLE round-end bundle
+    # transfer (bench/round_end.py) — no separate counted pull remains
     fam = registry.counter("device_transfers_total", labelnames=("site",))
-    assert fam.labels(site="solver_objectives").value == rounds
+    assert fam.labels(site="round_end").value == rounds
+    assert fam.labels(site="solver_objectives").value == 0
 
 
 # ---------------- logger ring buffer ----------------

@@ -32,7 +32,13 @@ class MoveRequest:
 
 
 class Backend(Protocol):
-    """What a cluster must provide to the controller."""
+    """What a cluster must provide to the controller.
+
+    Backends that cannot express per-pod moves advertise it with a
+    ``supports_pod_moves = False`` class attribute (absent means True);
+    the reconcile plane then scopes corrective moves to the whole
+    Deployment instead of tripping the per-pod rejection above.
+    """
 
     def monitor(self) -> ClusterState:
         """Fresh padded snapshot of the cluster."""

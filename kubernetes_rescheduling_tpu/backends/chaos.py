@@ -66,6 +66,13 @@ class ChaosProfile:
     partial_drop_frac: float = 0.2     # pod fraction dropped by a partial snapshot
     node_flap_period: int = 0          # kill a worker every N monitor calls (0 = off)
     node_flap_down_calls: int = 2      # monitors the worker stays dead
+    # reconciliation-plane faults (drawn from a DEDICATED seeded stream —
+    # see ChaosBackend._rng_aux — so enabling them never shifts the
+    # pre-existing kinds' seeded fault sequence):
+    monitor_corrupt_rate: float = 0.0  # NaN/Inf/negative/over-capacity loads
+    external_drift_rate: float = 0.0   # a pod moves behind the controller's back
+    move_lost_rate: float = 0.0        # apply_move reports success, moves nothing
+    corrupt_max_pods: int = 3          # entries poisoned per corrupt snapshot
 
     def validate(self) -> "ChaosProfile":
         for f in dataclasses.fields(self):
@@ -75,6 +82,8 @@ class ChaosProfile:
                     raise ValueError(f"{f.name} must be in [0, 1], got {v}")
         if self.node_flap_period < 0 or self.node_flap_down_calls < 1:
             raise ValueError("node flap schedule must be non-negative / >= 1")
+        if self.corrupt_max_pods < 1:
+            raise ValueError("corrupt_max_pods must be >= 1")
         return self
 
 
@@ -111,6 +120,25 @@ PROFILES: dict[str, ChaosProfile] = {
         move_wrong_node_rate=0.10,
         node_flap_period=7,
         node_flap_down_calls=2,
+        # reconciliation-plane faults at low rates (dedicated rng stream:
+        # the pre-existing kinds' seeded sequence above is unchanged)
+        monitor_corrupt_rate=0.08,
+        external_drift_rate=0.08,
+        move_lost_rate=0.05,
+    ),
+    # the reconciliation plane's own soak: corrupt metrics + external
+    # drift + lost/wrong-node moves + node flap, hot enough that a
+    # 30-round run exercises every divergence kind while the boundary
+    # stays healthy enough to keep executing rounds (monitor transport
+    # faults stay off so every round's snapshot is reconciled)
+    "reconcile": ChaosProfile(
+        name="reconcile",
+        monitor_corrupt_rate=0.30,
+        external_drift_rate=0.35,
+        move_lost_rate=0.30,
+        move_wrong_node_rate=0.30,
+        node_flap_period=9,
+        node_flap_down_calls=2,
     ),
 }
 
@@ -130,6 +158,11 @@ class ChaosBackend:
         self.seed = seed
         self.registry = registry  # None = the process default, per call
         self._rng = random.Random(seed)
+        # the reconciliation-plane kinds (corrupt/drift/lost) draw from
+        # their OWN seeded stream: seeded soaks pinned before those kinds
+        # existed must keep their exact fault sequence when a profile
+        # turns the new rates on (test-pinned stream stability)
+        self._rng_aux = random.Random((seed << 1) ^ 0x5EED)
         self._last_state: ClusterState | None = None
         self._monitor_calls = 0
         self._flapped_node: str | None = None
@@ -149,6 +182,10 @@ class ChaosBackend:
 
     def _hit(self, rate: float) -> bool:
         return rate > 0 and self._rng.random() < rate
+
+    def _hit_aux(self, rate: float) -> bool:
+        """The new kinds' dedicated stream (see ``_rng_aux``)."""
+        return rate > 0 and self._rng_aux.random() < rate
 
     # ---- Backend protocol ----
 
@@ -198,10 +235,24 @@ class ChaosBackend:
         if self._hit(p.monitor_stale_rate) and self._last_state is not None:
             self._count("monitor_stale")
             return self._last_state
+        if self._hit_aux(p.external_drift_rate):
+            # another actor moves a pod BEFORE the snapshot is taken, so
+            # the drift is visible in what this call returns — the
+            # reconciliation plane's detect-at-next-snapshot contract
+            drift = getattr(self.inner, "external_move_random", None)
+            if drift is not None and drift(self._rng_aux) is not None:
+                self._count("external_drift")
         state = self.inner.monitor()
-        if self._hit(p.monitor_partial_rate):
+        partial = self._hit(p.monitor_partial_rate)
+        if partial:
             self._count("monitor_partial")
             state = self._partial(state)
+        if self._hit_aux(p.monitor_corrupt_rate):
+            self._count("monitor_corrupt")
+            # a lying Metrics API: poisoned readings, NOT cached as last
+            # good (the admission guard's quarantine reuses last good)
+            return self._corrupt(state)
+        if partial:
             return state  # deliberately NOT cached as last good
         self._last_state = state
         return state
@@ -219,6 +270,60 @@ class ChaosBackend:
         import jax.numpy as jnp
 
         return state.replace(pod_valid=jnp.asarray(valid))
+
+    # the metrics-corruption menu: each poisoned entry draws one of these
+    # (the admission guard must classify every class — quarantine for the
+    # first three, clamp-and-count for the impossibly-large reading)
+    _CORRUPT_MODES = ("nan", "inf", "negative", "huge")
+
+    def _corrupt(self, state: ClusterState) -> ClusterState:
+        """Poison 1..corrupt_max_pods valid pod USAGE readings — cpu or
+        memory, the two fields the Metrics API actually reports (node
+        capacities come from the API server's Node objects, not the
+        metrics pipeline, so they stay honest here) — with NaN/Inf/
+        negative/over-capacity values. Shapes are untouched; only
+        values go bad."""
+        idx = np.flatnonzero(np.asarray(state.pod_valid))
+        if idx.size == 0:
+            return state
+        arrays = {
+            "pod_cpu": np.asarray(state.pod_cpu).copy(),
+            "pod_mem": np.asarray(state.pod_mem).copy(),
+        }
+        caps = {
+            "pod_cpu": float(
+                np.max(np.asarray(state.node_cpu_cap), initial=0.0)
+            ),
+            "pod_mem": float(
+                np.max(np.asarray(state.node_mem_cap), initial=0.0)
+            ),
+        }
+        n = self._rng_aux.randint(
+            1, min(self.profile.corrupt_max_pods, int(idx.size))
+        )
+        touched: set[str] = set()
+        for i in self._rng_aux.sample(list(idx), n):
+            field = (
+                "pod_cpu" if self._rng_aux.random() < 0.7 else "pod_mem"
+            )
+            arr, cap = arrays[field], caps[field]
+            mode = self._CORRUPT_MODES[
+                self._rng_aux.randrange(len(self._CORRUPT_MODES))
+            ]
+            if mode == "nan":
+                arr[i] = np.nan
+            elif mode == "inf":
+                arr[i] = np.inf
+            elif mode == "negative":
+                arr[i] = -abs(arr[i]) - 1.0
+            else:  # impossibly above any node's capacity
+                arr[i] = (cap if cap > 0 else 1.0) * 50.0
+            touched.add(field)
+        import jax.numpy as jnp
+
+        return state.replace(
+            **{f: jnp.asarray(arrays[f]) for f in touched}
+        )
 
     def apply_move(self, move: MoveRequest) -> str | None:
         p = self.profile
@@ -248,7 +353,57 @@ class ChaosBackend:
                 return self.inner.apply_move(
                     dataclasses.replace(move, target_node=wrong)
                 )
+        if self._hit_aux(p.move_lost_rate):
+            # the classic lost write: the API acknowledged the move and
+            # the controller records it as landed, but nothing in the
+            # cluster actually changed — only the reconciliation plane's
+            # intent-vs-observed diff can see this one
+            self._count("move_lost")
+            return move.target_node
         return self.inner.apply_move(move)
+
+    def apply_pod_moves(self, moves):
+        """The per-replica batch wave gets the LANDING fault menu, per
+        move: a wrong-node redirect stays in the wave aimed elsewhere,
+        an acknowledged-but-lost move is reported landed while nothing
+        is sent. Transport faults (error/timeout/None) stay on
+        :meth:`apply_move` — the wave is a sim-only extension outside
+        the boundary's retry protection, so raising here would crash
+        the loop rather than exercise degradation. Survivors land as
+        ONE inner wave (the single clock-advance contract)."""
+        p = self.profile
+        send, lost = [], []
+        names_all = list(getattr(self.inner, "node_names", []))
+        for mv in moves:
+            if self._hit(p.move_wrong_node_rate):
+                names = [n for n in names_all if n != mv.target_node]
+                if names:
+                    self._count("move_wrong_node")
+                    send.append(
+                        dataclasses.replace(
+                            mv,
+                            target_node=names[
+                                self._rng.randrange(len(names))
+                            ],
+                        )
+                    )
+                    continue
+            if self._hit_aux(p.move_lost_rate):
+                self._count("move_lost")
+                if mv.pod is not None:
+                    lost.append((mv.pod, mv.target_node))
+                continue
+            send.append(mv)
+        # the inner wave ALWAYS runs — even all-lost, the API call was
+        # acknowledged, so the wave's single clock advance must be paid
+        # (time passes; only the placement is a lie)
+        landed = dict(self.inner.apply_pod_moves(send))
+        for pod, target in lost:
+            # acknowledged at the requested target: the controller
+            # records it as landed there, and only the reconcile plane's
+            # intent-vs-observed diff sees the truth
+            landed.setdefault(pod, target)
+        return landed
 
     def advance(self, seconds: float) -> None:
         self.inner.advance(seconds)

@@ -249,6 +249,11 @@ def extract_redeployable_spec(dep: dict) -> dict:
 class K8sBackend:
     """Adapter over a live cluster (or a fake implementing the same calls)."""
 
+    # the Deployment mechanism cannot pin ONE replica (_apply_move raises
+    # for pod-granular moves); the reconcile plane reads this and issues
+    # Deployment-scoped repairs instead of crashing on a ValueError
+    supports_pod_moves = False
+
     workmodel: Workmodel
     core_api: Any = None
     apps_api: Any = None

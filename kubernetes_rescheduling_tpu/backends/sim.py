@@ -131,6 +131,19 @@ class SimBackend:
         with timed_call("sim", "monitor"):
             return self._monitor()
 
+    def _stable_names(self, attr: str, names: list) -> tuple:
+        """Content-memoized name tuple: successive monitors hand out the
+        SAME tuple object while the names are unchanged, so identity-keyed
+        memos downstream (the admission guard's duplicate scan and
+        name→index maps) hit instead of rebuilding O(P) state per round.
+        Content-compared, so no mutation path needs an invalidation hook."""
+        t = tuple(names)
+        cached = getattr(self, attr, None)
+        if cached is not None and cached == t:
+            return cached
+        setattr(self, attr, t)
+        return t
+
     def _monitor(self) -> ClusterState:
         rps = self.load.service_rps(self.workmodel)
         replicas = {s.name: max(1, s.replicas) for s in self.workmodel.services}
@@ -153,7 +166,9 @@ class SimBackend:
             mems.append(float(spec.mem_request_bytes))
             names.append(name)
         return ClusterState.build(
-            node_names=self.node_names,
+            # stable tuples: tuple() of a tuple is the same object, so
+            # the built state carries THE memoized tuple across rounds
+            node_names=self._stable_names("_node_names_memo", self.node_names),
             node_cpu_cap=[
                 self.node_cpu_cap_m if a else 0.0 for a in self._node_alive
             ],
@@ -163,7 +178,7 @@ class SimBackend:
             pod_nodes=nodes,
             pod_cpu=cpus,
             pod_mem=mems,
-            pod_names=names,
+            pod_names=self._stable_names("_pod_names_memo", names),
             node_capacity=self.node_capacity,
             pod_capacity=self.pod_capacity,
         )
@@ -256,26 +271,27 @@ class SimBackend:
                 best, best_used = i, float(used[i])
         return best
 
-    def apply_pod_moves(self, moves) -> int:
+    def apply_pod_moves(self, moves) -> dict[str, str]:
         """Apply a batch of per-pod moves as ONE reconcile wave: a single
         indexed pass over the pod table and one clock advance. Per-replica
         placement moves many pods per round; issuing them as individual
         ``apply_move`` calls would both cost O(moves × pods) host time and
         charge one reconcile delay per replica — a clock model no real
-        cluster has (kubelets reconcile in parallel). Returns the number
-        of pods moved."""
+        cluster has (kubelets reconcile in parallel). Returns the moved
+        pods as ``{pod name: landed node name}`` (``set()`` of it gives
+        the landed names, so set-consumers keep working)."""
         node_idx = {n: i for i, n in enumerate(self.node_names)}
         target_of: dict[str, int] = {}
         for mv in moves:
             t = node_idx.get(mv.target_node)
             if t is not None and self._node_alive[t] and mv.pod is not None:
                 target_of[mv.pod] = t
-        landed: list[str] = []
+        landed: dict[str, str] = {}
         for pod in self._pods:
             t = target_of.get(pod[2])
             if t is not None:
                 pod[1] = t
-                landed.append(pod[2])
+                landed[pod[2]] = self.node_names[t]
         self.clock_s += self.reconcile_delay_s
         if landed:
             count_reconcile("sim", len(landed))
@@ -288,6 +304,59 @@ class SimBackend:
             }
         )
         return landed
+
+    def external_move(self, pod_name: str, node: str) -> bool:
+        """Move ONE named pod to ``node`` behind the controller's back —
+        another actor's write (a second scheduler, a human `kubectl`, a
+        descheduler). Deliberately NOT ``apply_move``: no reconcile
+        count, no clock charge on the controller's simulated time — the
+        controller never sees this happen except through its next
+        snapshot, which is exactly what the reconciliation plane exists
+        to detect. Returns whether the pod existed and the node is
+        alive."""
+        if node not in self.node_names:
+            return False
+        target = self.node_names.index(node)
+        if not self._node_alive[target]:
+            return False
+        for pod in self._pods:
+            if pod[2] == pod_name:
+                pod[1] = target
+                self.events.append(
+                    {
+                        "t": self.clock_s,
+                        "event": "external_move",
+                        "pod": pod_name,
+                        "node": node,
+                    }
+                )
+                return True
+        return False
+
+    def external_move_random(self, rng) -> dict | None:
+        """Drift one seeded-random placed pod to a random OTHER alive
+        node via :meth:`external_move` (the chaos backend's
+        ``external_drift_rate`` hook; ``rng`` is the caller's seeded
+        ``random.Random`` so drift streams stay reproducible)."""
+        placed = [
+            p for p in self._pods
+            if p[1] >= 0 and self._node_alive[p[1]]
+        ]
+        if not placed:
+            return None
+        pod = placed[rng.randrange(len(placed))]
+        others = [
+            n
+            for i, n in enumerate(self.node_names)
+            if self._node_alive[i] and i != pod[1]
+        ]
+        if not others:
+            return None
+        src = self.node_names[pod[1]]
+        dst = others[rng.randrange(len(others))]
+        if not self.external_move(pod[2], dst):
+            return None
+        return {"pod": pod[2], "from": src, "to": dst}
 
     def restore_placement(self, state: ClusterState) -> int:
         """Pin pods back to the placement recorded in a checkpoint snapshot

@@ -252,6 +252,21 @@ class BoundaryClient:
     def monitor(self):
         return self._call("monitor", self.backend.monitor)
 
+    def admission_reject(self, reason: str) -> None:
+        """An admission-guard rejection (``bench/admission.py``): the
+        monitor call SUCCEEDED at the transport level but its payload was
+        unusable — duplicate pods, unknown node references, a
+        mostly-garbage metrics wave. Charged through ``_failed`` so the
+        PR-2 machinery takes over: the round's failure budget burns, the
+        failure is logged/counted, and the caller treats the snapshot as
+        the protocol's existing ``None`` signal (degraded round on the
+        last good snapshot). Note the transport success that delivered
+        the garbage already reset the breaker's consecutive count — a
+        backend that is reachable but lying reads as degraded service
+        (counted degraded rounds), not as dead (open breaker), which is
+        the honest verdict."""
+        self._failed(f"admission:{reason}", None)
+
     def apply_move(self, move: MoveRequest) -> str | None:
         if self.moves_frozen:
             return None  # safe mode: the round's remaining moves are dropped

@@ -24,11 +24,19 @@ import numpy as np
 from kubernetes_rescheduling_tpu.backends.base import Backend, MoveRequest
 from kubernetes_rescheduling_tpu.backends.chaos import with_chaos
 from kubernetes_rescheduling_tpu.backends.k8s import PlacementMechanism
+from kubernetes_rescheduling_tpu.bench.admission import AdmissionGuard
 from kubernetes_rescheduling_tpu.bench.boundary import (
     HALF_OPEN,
     OPEN,
     BoundaryClient,
     CircuitBreaker,
+)
+from kubernetes_rescheduling_tpu.bench.reconcile import (
+    KIND_UNKNOWN_LANDING,
+    IntentLedger,
+    count_divergence,
+    move_intent,
+    reconcile_round_block,
 )
 from kubernetes_rescheduling_tpu.bench.round_end import (
     METRIC_COST,
@@ -112,6 +120,13 @@ class RoundRecord:
     # skill vs the persistence baseline, running MAEs, and which path
     # the round took (cold/predictive/degraded) — None on reactive runs
     forecast: dict | None = None
+    # reconciliation & admission (bench/admission.py + bench/reconcile.py):
+    # the round's admission quarantine/reject counts, classified
+    # intent-vs-observed divergences, issued corrective moves, and the
+    # pods still diverged after repairs — None when the round was clean
+    # (so a fault-free run's records stay identical to a run with the
+    # plane disabled, the golden-pin contract)
+    reconcile: dict | None = None
     # wall-clock lifecycle of the round (timing field — excluded from
     # the pipelined-vs-sequential bit-identity comparison): execute
     # start to record finalize
@@ -361,6 +376,25 @@ class _Runtime:
             logger=logger,
             registry=registry,
         )
+        # the reconciliation & admission plane (config.reconcile): every
+        # monitor() result passes the admission guard before it can touch
+        # device state (monitor_admitted — statically enforced), and the
+        # intent ledger closes the loop on this controller's own moves
+        self.guard = (
+            AdmissionGuard(
+                config.reconcile,
+                registry=registry,
+                logger=logger,
+                on_reject=self.boundary.admission_reject,
+            )
+            if config.reconcile.admission
+            else None
+        )
+        self.ledger = (
+            IntentLedger(config.reconcile, registry=registry, logger=logger)
+            if config.reconcile.enabled
+            else None
+        )
         if churn is None and config.elastic.profile != "none":
             from kubernetes_rescheduling_tpu.elastic.engine import ChurnEngine
 
@@ -460,6 +494,7 @@ class _Runtime:
             and ops is None
         )
         self.start_round = 1
+        resumed_pending_churn: list[dict] = []
         if self.mgr is not None:
             latest = self.mgr.latest()
             if latest is not None:
@@ -484,6 +519,22 @@ class _Runtime:
                 restore = getattr(backend, "restore_placement", None)
                 if restore is not None:
                     restore(saved_state)
+                if self.ledger is not None:
+                    # adopt the checkpointed intent: the first admitted
+                    # snapshot below is RECONCILED against it instead of
+                    # trusted blindly — whatever moved while the
+                    # controller was down becomes a counted, repairable
+                    # divergence rather than silently becoming truth
+                    self.ledger.restore(_extra.get("reconcile"))
+                # a checkpoint written by a SKIPPED round carries churn
+                # events applied in its preamble that no record has
+                # flushed yet — restore the debt, or the first executed
+                # round's record (and the intent ledger's diff) would
+                # never see them and legitimate churn would read as
+                # phantom/missing divergences
+                resumed_pending_churn = [
+                    dict(e) for e in _extra.get("pending_churn") or []
+                ]
                 self.start_round = done_round + 1
                 self.result.resumed_from_round = self.start_round
                 if logger is not None:
@@ -497,7 +548,15 @@ class _Runtime:
         # executed round settles the debt before deciding
         self.remask_needed = False
         self.rebind_timeline = False
-        self.pending_churn: list[dict] = []
+        # starts with any debt a skip-round checkpoint persisted (the
+        # resume's initial monitor below already sees the post-churn
+        # topology, so the events owe only a flush and a ledger consume,
+        # never a re-mask)
+        self.pending_churn: list[dict] = resumed_pending_churn
+        # the previous round's unrepaired-drift count: a convergence round
+        # must still carry one explicit drift_pods=0 block (see
+        # _reconcile_round) so the watchdog rule can clear
+        self._last_drift = 0
 
         # one snapshot per round: the post-move snapshot provides this round's
         # metrics AND the next round's state. Startup has no last-good
@@ -507,7 +566,7 @@ class _Runtime:
         self.state = None
         self._pending_end: dict | None = None
         for _ in range(max(3, config.max_consecutive_failures + 1)):
-            probe = self.boundary.monitor()
+            probe = self.monitor_admitted()
             if probe is not None:
                 self.note_fresh_snapshot(probe)
                 break
@@ -520,6 +579,44 @@ class _Runtime:
             # provenance model: the initial residency collapse (host-side,
             # once per run) the per-move cost deltas telescope from
             self.timeline.bind(self.state, self.metric_graph)
+        if self.ledger is not None:
+            if not self.ledger.intent:
+                # startup baseline: intent := the first admitted snapshot
+                # (a checkpoint-restored intent instead reconciles at the
+                # first observe — see the resume path above)
+                self.ledger.rebase(
+                    self.state, service_names=self.metric_graph.names
+                )
+            self._ledger_snap = self.ledger.snapshot()
+
+    # ---- snapshot admission ----
+
+    def monitor_admitted(self):
+        """THE monitor wrapper both schedules use: every snapshot passes
+        the admission guard before it can touch device state (statically
+        enforced by ``scripts/check_snapshot_admission.py`` — this is the
+        solo loop's only legal ``.monitor()`` call site). A rejection
+        returns ``None``, the protocol's existing failure signal, after
+        charging the boundary (``admission_reject``)."""
+        out = self.boundary.monitor()
+        if self.guard is not None:
+            out = self.guard.admit(out)
+        return out
+
+    def ckpt_extra(self, **extra) -> dict:
+        """Checkpoint sidecar payload: the algorithm tag (and any
+        caller fields) plus the intent ledger as of the LAST CLOSED round
+        — resume reconciles against it instead of trusting the first
+        snapshot blindly. Churn events no record has flushed yet (a
+        skip-round save — executed rounds always flush first) ride along
+        so resume owes the same record flush + ledger consume the
+        uninterrupted run would have performed."""
+        extra["algorithm"] = self.config.algorithm
+        if self.ledger is not None:
+            extra["reconcile"] = self._ledger_snap
+        if self.pending_churn:
+            extra["pending_churn"] = [dict(e) for e in self.pending_churn]
+        return extra
 
     # ---- round-end bundle protocol ----
 
@@ -606,8 +703,6 @@ class _Runtime:
         """Round-close bookkeeping that must precede the NEXT round's
         ``begin_round`` (it reads the breaker/failure counters) and the
         flush: adopt or degrade the snapshot, attach the metrics piece."""
-        record.breaker_state = self.breaker.state
-        record.boundary_failures = self.boundary.round_failures
         if self.churn is not None:
             # pending_churn, not this round's events only: skipped rounds'
             # events flush into the first record that can carry them
@@ -620,7 +715,35 @@ class _Runtime:
             record.degraded = True
         else:
             self.note_fresh_snapshot(new_state)
+        self._reconcile_round(record, fresh=new_state is not None)
+        # snapshot the counters AFTER the reconcile repairs: a corrective
+        # move is a boundary move like any other, so a failed one must
+        # show in this round's record, not vanish into the next reset
+        record.breaker_state = self.breaker.state
+        record.boundary_failures = self.boundary.round_failures
         self._attach_metrics(rnd, record, closer)
+
+    def _reconcile_round(self, record: RoundRecord, *, fresh: bool) -> None:
+        """The reconciliation plane's per-round step — delegates to the
+        shared :func:`reconcile_round_block` (one implementation for the
+        solo and fleet loops). A degraded round (``fresh=False``) has no
+        admitted snapshot to diff — it carries only the admission counts
+        (the rejection that degraded it) and the standing drift debt,
+        while its churn events wait in the ledger for the next fresh
+        diff."""
+        record.reconcile, self._last_drift = reconcile_round_block(
+            self.guard,
+            self.ledger,
+            state=self.state,
+            service_names=self.metric_graph.names,
+            churn_events=(record.churn or {}).get("events") or (),
+            fresh=fresh,
+            last_drift=self._last_drift,
+            boundary=self.boundary,
+            repair_budget=self.config.reconcile.repair_budget_per_round,
+        )
+        if self.ledger is not None:
+            self._ledger_snap = self.ledger.snapshot()
 
     # ---- per-round helpers ----
 
@@ -633,21 +756,23 @@ class _Runtime:
             "rounds frozen by the open circuit breaker",
             labelnames=("algorithm",),
         ).labels(algorithm=self.config.algorithm).inc()
+        # a rejection during THIS round's preamble (probe/re-mask) belongs
+        # to this skip, not to the next executed round's record — drain it
+        # onto the skip event (the registry counters are the durable half)
+        adm = self.guard.take_info() if self.guard is not None else {}
         if self.logger is not None:
             self.logger.info(
                 "round_skipped",
                 round=rnd,
                 breaker=self.breaker.state,
                 consecutive_failures=self.breaker.consecutive_failures,
+                **({"admission": adm} if adm else {}),
             )
         if self.ops is not None:
             self.ops.observe_skip(rnd, breaker_state=self.breaker.state)
         self.boundary.advance(self.config.sleep_after_action_s)
         if self.mgr is not None:
-            self.mgr.save(
-                rnd, self.state,
-                extra={"algorithm": self.config.algorithm, "skipped": True},
-            )
+            self.mgr.save(rnd, self.state, extra=self.ckpt_extra(skipped=True))
 
     def preamble(self, rnd: int) -> bool:
         """Everything before a round may decide: churn events, the
@@ -672,7 +797,7 @@ class _Runtime:
         if mode == HALF_OPEN:
             # one probe before trusting the backend with a full round; a
             # success closes the breaker AND refreshes the stale snapshot
-            probe = self.boundary.monitor()
+            probe = self.monitor_admitted()
             if probe is None:
                 self.skip_round(rnd)
                 return False
@@ -684,7 +809,7 @@ class _Runtime:
             # the mutated cluster (shapes stay in-bucket, so the decision
             # kernels do not retrace); a dark backend makes this a counted
             # skip and the debt carries to the next executed round
-            fresh = self.boundary.monitor()
+            fresh = self.monitor_admitted()
             if fresh is None:
                 self.skip_round(rnd)
                 return False
@@ -709,19 +834,26 @@ class _Runtime:
         sub = jax.random.fold_in(self.key, rnd)
         graph = self.graph_src()  # fresh estimate per round when streaming
         config = self.config
+        # intent capture: every boundary move this round as (service,
+        # pod, requested, landed) — recorded on the ledger AT APPLY TIME,
+        # so the next admitted snapshot's observe() diffs against what
+        # this round actually asked for
+        intents: list | None = [] if self.ledger is not None else None
         if config.algorithm == "global" or config.moves_per_round == "all":
             carry: dict = {}
             record = _global_round(
                 self.boundary, self.state, graph, config, sub, rnd,
                 logger=self.logger, explain=self.explain_k > 0,
                 closer=closer, pre_fence_hook=pre_fence_hook,
-                donate=self.donate_ok, carry=carry,
+                donate=self.donate_ok, carry=carry, intents=intents,
             )
             if carry.get("state") is not None:
                 # the donated solve consumed the snapshot's buffers; adopt
                 # the bit-equal resurrected copy so a failed post-move
                 # monitor (or a breaker skip) can still carry it forward
                 self.state = carry["state"]
+            if intents:
+                self.ledger.record_moves(intents)
             return record
         forecast_delta = None
         forecast_latency = 0.0
@@ -741,7 +873,10 @@ class _Runtime:
             logger=self.logger, explain_k=self.explain_k,
             forecast_delta=forecast_delta,
             closer=closer, pre_fence_hook=pre_fence_hook,
+            registry=self.registry, intents=intents,
         )
+        if intents:
+            self.ledger.record_moves(intents)
         if self.forecast_plane is not None:
             # the forecast dispatch is decision work: count it in the
             # round's device latency budget so decisions/sec and the
@@ -818,7 +953,7 @@ class _Runtime:
             record = self.execute_round(rnd, closer)
             self.boundary.advance(self.config.sleep_after_action_s)
             with span("backend/monitor"):
-                new_state = self.boundary.monitor()
+                new_state = self.monitor_admitted()
         self.begin_close(rnd, record, closer, new_state)
         closer.flush()
         record.wall_s = time.perf_counter() - t0
@@ -827,7 +962,7 @@ class _Runtime:
         # replays this round on resume instead of leaving a hole in its
         # outputs; replaying a move is idempotent (same pin, same target)
         if self.mgr is not None:
-            self.mgr.save(rnd, self.state, extra={"algorithm": self.config.algorithm})
+            self.mgr.save(rnd, self.state, extra=self.ckpt_extra())
 
     def _advance_and_monitor(self):
         """The background half of a pipelined round: pace, then the
@@ -836,7 +971,7 @@ class _Runtime:
         snapshot (or None) plus the wall time the pair took."""
         t0 = time.perf_counter()
         self.boundary.advance(self.config.sleep_after_action_s)
-        out = self.boundary.monitor()
+        out = self.monitor_admitted()
         return out, time.perf_counter() - t0
 
 
@@ -899,9 +1034,7 @@ def _pipelined_loop(rt: _Runtime) -> None:
 
     def checkpoint(p: dict) -> None:
         if rt.mgr is not None:
-            rt.mgr.save(
-                p["rnd"], p["state"], extra={"algorithm": cfg.algorithm}
-            )
+            rt.mgr.save(p["rnd"], p["state"], extra=rt.ckpt_extra())
 
     def settle(p: dict, future) -> None:
         """Join the pending round's in-flight advance+monitor and run its
@@ -1059,6 +1192,20 @@ def run_controller(
     already-measured snapshot reuses the cached values and costs at most
     the transfer for its fresh per-round diagnostics.
 
+    Reconciliation & admission (``config.reconcile``): every monitor
+    snapshot passes the admission guard (``bench/admission.py``) before
+    touching device state — poisoned readings are quarantined to
+    last-good values, structurally broken snapshots degrade the round —
+    and the intent ledger (``bench/reconcile.py``) diffs each admitted
+    snapshot against the controller's recorded intent, classifying and
+    counting divergences (lost moves, wrong-node landings, external
+    drift) and issuing up to ``reconcile.repair_budget_per_round``
+    corrective moves per round until observed state converges back to
+    intent. Rounds with any such activity carry a ``reconcile`` block;
+    a clean run is bit-identical to the plane-off controller
+    (golden-pinned). The ledger persists through checkpoints, so resume
+    reconciles instead of trusting the first snapshot blindly.
+
     ``config.controller.pipeline`` selects the software-pipelined
     schedule: the same helper calls interleaved so the previous round's
     flush + host tail overlap this round's device compute, with the
@@ -1101,6 +1248,7 @@ def run_controller(
 def _greedy_round(
     boundary, state, graph, config, key, rnd, *, logger=None, explain_k=0,
     forecast_delta=None, closer=None, pre_fence_hook=None,
+    registry=None, intents=None,
 ) -> RoundRecord:
     """Up to ``config.moves_per_round`` greedy moves: after each move the
     working snapshot is edited in place (the moved service's pods re-homed —
@@ -1135,6 +1283,7 @@ def _greedy_round(
     first_target: str | None = None
     latencies: list[float] = []
     explanations: list[dict] = []
+    unknown_landing = False
 
     def defer_explanation(bundle, meta):
         """Register the explain bundle's decode on the round closer: the
@@ -1247,6 +1396,17 @@ def _greedy_round(
                 mechanism=PlacementMechanism[scoring],
             )
         )
+        if intents is not None:
+            # the advisory/pinning intent rule lives in move_intent —
+            # ONE definition shared with the fleet loop
+            intents.append(
+                move_intent(
+                    PlacementMechanism[scoring],
+                    service_name,
+                    target_name,
+                    landed,
+                )
+            )
         if meta is not None:
             meta["applied_known"] = True
             meta["landed"] = landed
@@ -1258,15 +1418,30 @@ def _greedy_round(
         applied_moves.append((service_name, landed))
         if first_target is None:
             first_target = landed
+        if landed not in state.node_names:
+            # the move landed on a node the working snapshot does not even
+            # KNOW (drained mid-flight under churn + a wrong-node landing):
+            # patching pod_node with the stale target index would lie to
+            # every following decide this round. Count the divergence,
+            # stop the round, and finish it DEGRADED — the next monitor
+            # realigns the truth, and the reconcile plane repairs the pod
+            count_divergence(registry, KIND_UNKNOWN_LANDING)
+            unknown_landing = True
+            if meta is not None:
+                meta["stop"] = "landed on a node unknown to the snapshot"
+            if logger is not None:
+                logger.warn(
+                    "unknown_landing",
+                    round=rnd,
+                    service=service_name,
+                    landed=landed,
+                )
+            break
         if i + 1 < k_moves:
             # re-home the moved service in the working snapshot — to where
             # it actually LANDED (the scheduler may have overridden the
             # advisory target under the affinityOnly mechanism)
-            landed_i = (
-                state.node_names.index(landed)
-                if landed in state.node_names
-                else target_i
-            )
+            landed_i = state.node_names.index(landed)
             svc_pods = (state.pod_service == int(svc)) & state.pod_valid
             state = state.replace(
                 pod_node=jnp.where(svc_pods, landed_i, state.pod_node)
@@ -1283,6 +1458,10 @@ def _greedy_round(
         services_moved=tuple(moved_names),
         decision_latencies_s=tuple(latencies),
         applied_moves=tuple(applied_moves),
+        # an unknown landing means the working snapshot could not follow
+        # the cluster mid-round: the round closes on honest-but-stale
+        # bookkeeping, labeled exactly like a failed post-move monitor
+        degraded=unknown_landing,
     )
     if explain_k > 0:
         # the deferred decodes above fill `explanations` at flush time —
@@ -1491,7 +1670,7 @@ def _defer_solver_objectives(closer, info, apply_cb) -> None:
 
 def _pod_round(
     boundary, state, graph, config, cfg, key, rnd, *, logger=None,
-    explain=False, closer=None, pre_fence_hook=None,
+    explain=False, closer=None, pre_fence_hook=None, intents=None,
 ) -> RoundRecord:
     """Per-replica global round: solve on the expanded pod graph, apply
     per-pod moves (MoveRequest.pod). The pod graph is cached per
@@ -1563,14 +1742,39 @@ def _pod_round(
     landed_moves: list[MoveRequest] = []
     applied_moves: list[tuple[str, str]] = []  # (service, LANDED node)
     if batch is not None:
-        landed = set(batch(moves)) if moves else set()
-        landed_moves = [mv for mv in moves if mv.pod in landed]
-        # the sim's batch wave places exactly at the requested node — the
-        # target IS the landed node on this path
-        applied_moves = [(mv.service, mv.target_node) for mv in landed_moves]
+        # the wave reports where each pod actually LANDED (pod -> node):
+        # a chaos wrong-node redirect overrides the requested target on
+        # this path too, and the intent ledger needs the true claim to
+        # classify it wrong_node rather than external_drift
+        landed_of = dict(batch(moves)) if moves else {}
+        landed_moves = [mv for mv in moves if mv.pod in landed_of]
+        applied_moves = [
+            (mv.service, landed_of[mv.pod]) for mv in landed_moves
+        ]
+        if intents is not None:
+            intents.extend(
+                move_intent(
+                    mv.mechanism,
+                    mv.service,
+                    mv.target_node,
+                    landed_of.get(mv.pod),
+                    pod=mv.pod,
+                )
+                for mv in moves
+            )
     else:
         for mv in moves:
             landed_node = boundary.apply_move(mv)
+            if intents is not None:
+                intents.append(
+                    move_intent(
+                        mv.mechanism,
+                        mv.service,
+                        mv.target_node,
+                        landed_node,
+                        pod=mv.pod,
+                    )
+                )
             if landed_node is not None:
                 landed_moves.append(mv)
                 # record where the move actually LANDED (a scheduler —
@@ -1636,7 +1840,7 @@ def _pod_round(
 
 def _global_round(
     boundary, state, graph, config, key, rnd, *, logger=None, explain=False,
-    closer=None, pre_fence_hook=None, donate=False, carry=None,
+    closer=None, pre_fence_hook=None, donate=False, carry=None, intents=None,
 ) -> RoundRecord:
     cfg = GlobalSolverConfig(
         sweeps=config.global_solver_iters,
@@ -1649,7 +1853,7 @@ def _global_round(
         return _pod_round(
             boundary, state, graph, config, cfg, key, rnd,
             logger=logger, explain=explain,
-            closer=closer, pre_fence_hook=pre_fence_hook,
+            closer=closer, pre_fence_hook=pre_fence_hook, intents=intents,
         )
     t0 = time.perf_counter()
     sparse_graph = None
@@ -1771,6 +1975,15 @@ def _global_round(
                 mechanism=PlacementMechanism["global"],
             )
         )
+        if intents is not None:
+            intents.append(
+                move_intent(
+                    PlacementMechanism["global"],
+                    graph.names[s],
+                    state.node_names[target],
+                    landed,
+                )
+            )
         moved_any = moved_any or landed is not None
         if landed is not None:
             moved_names.append(graph.names[s])

@@ -54,6 +54,7 @@ from kubernetes_rescheduling_tpu.backends.base import MoveRequest
 from kubernetes_rescheduling_tpu.backends.chaos import with_chaos
 from kubernetes_rescheduling_tpu.backends.fleet import FleetBackend
 from kubernetes_rescheduling_tpu.backends.k8s import PlacementMechanism
+from kubernetes_rescheduling_tpu.bench.admission import AdmissionGuard
 from kubernetes_rescheduling_tpu.bench.boundary import (
     HALF_OPEN,
     OPEN,
@@ -66,6 +67,11 @@ from kubernetes_rescheduling_tpu.bench.controller import (
     observe_wall_round,
     pipeline_depth_gauge,
     pipeline_overlap_gauge,
+)
+from kubernetes_rescheduling_tpu.bench.reconcile import (
+    IntentLedger,
+    move_intent,
+    reconcile_round_block,
 )
 from kubernetes_rescheduling_tpu.bench.round_end import block
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
@@ -137,6 +143,30 @@ class _Tenant:
             registry=registry,
             tenant=name,
         )
+        # the reconciliation & admission plane, PER TENANT: each tenant's
+        # snapshots pass its own guard (last-good caches must never
+        # cross-pollinate between clusters) and each tenant's moves land
+        # in its own intent ledger (the drift gauge goes tenant-labeled)
+        self.guard = (
+            AdmissionGuard(
+                config.reconcile,
+                registry=registry,
+                logger=logger,
+                on_reject=self.boundary.admission_reject,
+            )
+            if config.reconcile.admission
+            else None
+        )
+        self.ledger = (
+            IntentLedger(
+                config.reconcile,
+                registry=registry,
+                logger=logger,
+                tenant=name,
+            )
+            if config.reconcile.enabled
+            else None
+        )
         self.graph = self.boundary.comm_graph()
         self.key = key
         self.state = None
@@ -144,6 +174,9 @@ class _Tenant:
         # applied churn (or a fleet-wide bucket promotion) and must be
         # re-monitored — behind the breaker gate — before it can run
         self.remask = False
+        # previous round's unrepaired drift (the solo loop's _last_drift
+        # rule: a convergence round carries an explicit drift_pods=0)
+        self.last_drift = 0
         self.result = ControllerResult()
 
     def health_row(self) -> dict:
@@ -153,6 +186,18 @@ class _Tenant:
             "skipped_rounds": self.result.skipped_rounds,
             "degraded_rounds": self.result.degraded_rounds,
         }
+
+
+def _admitted_monitor(t: _Tenant):
+    """THE fleet loop's monitor wrapper: one tenant's snapshot passes
+    that tenant's admission guard before it can touch device state
+    (statically enforced by ``scripts/check_snapshot_admission.py`` —
+    this is the fleet loop's only legal ``.monitor()`` call site). A
+    rejection returns ``None``, charging that tenant's boundary."""
+    out = t.boundary.monitor()
+    if t.guard is not None:
+        out = t.guard.admit(out)
+    return out
 
 
 def _pull_round_bundle(arr, site: str):
@@ -326,9 +371,14 @@ def run_fleet_controller(
     # only a fleet where EVERY tenant is dark is an error
     for t in tenants:
         for _ in range(max(3, config.max_consecutive_failures + 1)):
-            t.state = t.boundary.monitor()
+            t.state = _admitted_monitor(t)
             if t.state is not None:
                 break
+        if t.state is not None and t.ledger is not None:
+            # startup baseline, per tenant: intent := the first admitted
+            # snapshot (a tenant that starts dark rebases at its first
+            # successful probe instead — observe() primes lazily)
+            t.ledger.rebase(t.state, service_names=t.graph.names)
     if all(t.state is None for t in tenants):
         raise ConnectionError(
             "fleet unavailable: every tenant's initial monitor() failed "
@@ -345,6 +395,9 @@ def run_fleet_controller(
             "dark backend) — counted, never silently lost",
             labelnames=("tenant",),
         ).labels(tenant=t.name).inc()
+        # the solo loop's rule: a rejection in this round's gate belongs
+        # to this skip, never to the tenant's next executed record
+        adm = t.guard.take_info() if t.guard is not None else {}
         if logger is not None:
             logger.info(
                 "fleet_round_skipped",
@@ -352,6 +405,7 @@ def run_fleet_controller(
                 round=rnd,
                 breaker=t.breaker.state,
                 consecutive_failures=t.breaker.consecutive_failures,
+                **({"admission": adm} if adm else {}),
             )
         if ops is not None:
             # counted on the plane too: /healthz skip totals move, and
@@ -413,7 +467,7 @@ def run_fleet_controller(
                     # churn: ONE monitor — behind the gate — decides
                     # whether this round runs (a dark backend is a single
                     # counted failure; the re-mask debt carries forward)
-                    probe = t.boundary.monitor()
+                    probe = _admitted_monitor(t)
                     if probe is None:
                         skip_round(t, rnd)
                         continue
@@ -507,13 +561,48 @@ def run_fleet_controller(
                             mechanism=PlacementMechanism[config.algorithm],
                         )
                     )
+                    if t.ledger is not None and landed is not None:
+                        # intent recorded at apply time: the ledger diffs
+                        # it against the next admitted snapshot. The
+                        # advisory/pinning rule lives in move_intent —
+                        # ONE definition shared with the solo loop
+                        t.ledger.record_moves(
+                            [
+                                move_intent(
+                                    PlacementMechanism[config.algorithm],
+                                    service_name,
+                                    state.node_names[target_i],
+                                    landed,
+                                )
+                            ]
+                        )
                     if landed is not None:
                         moved_name = service_name
                 t.boundary.advance(config.sleep_after_action_s)
-                new_state = t.boundary.monitor()
+                new_state = _admitted_monitor(t)
                 degraded = new_state is None
                 if not degraded:
                     t.state = new_state
+                # elastic events consumed BEFORE the reconcile diff so
+                # legitimate churn never reads as drift (pending, not just
+                # this round's: a skipped tenant round's events flush into
+                # the next executed record)
+                churn_info = (
+                    churn[i].round_info(pending_churn.pop(i, []))
+                    if i in churn
+                    else None
+                )
+                reconcile_block, t.last_drift = reconcile_round_block(
+                    t.guard,
+                    t.ledger,
+                    state=t.state,
+                    service_names=t.graph.names,
+                    churn_events=(churn_info or {}).get("events") or (),
+                    fresh=not degraded,
+                    last_drift=t.last_drift,
+                    boundary=t.boundary,
+                    repair_budget=config.reconcile.repair_budget_per_round,
+                )
                 rec = RoundRecord(
                     round=rnd,
                     moved=moved_name is not None,
@@ -530,13 +619,8 @@ def run_fleet_controller(
                     applied_moves=(
                         ((moved_name, landed),) if moved_name else ()
                     ),
-                    # pending, not just this round's: a skipped tenant
-                    # round's events flush into the next executed record
-                    churn=(
-                        churn[i].round_info(pending_churn.pop(i, []))
-                        if i in churn
-                        else None
-                    ),
+                    churn=churn_info,
+                    reconcile=reconcile_block,
                 )
                 return rec, time.perf_counter() - t_bg
 
@@ -643,6 +727,10 @@ def run_fleet_controller(
                         rec,
                         t.state,
                         events=[{"event": "fleet_round", **round_event}],
+                        # per-source watchdog state (the reconcile rule)
+                        # keys on the tenant so interleaved tenant rounds
+                        # never mask each other's drift
+                        tenant=t.name,
                     )
                 if on_round is not None:
                     on_round(t.name, rec, t.state)

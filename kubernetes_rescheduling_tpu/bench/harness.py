@@ -49,6 +49,7 @@ from kubernetes_rescheduling_tpu.config import (
     ElasticConfig,
     ForecastConfig,
     PerfConfig,
+    ReconcileConfig,
     RescheduleConfig,
 )
 from kubernetes_rescheduling_tpu.core.topology import _random_workmodel
@@ -136,6 +137,10 @@ class ExperimentConfig:
     # clock and transfer timing change.
     pipeline: bool = False
     pipeline_depth: int = 2
+    # Reconciliation & admission plane ([reconcile]): on by default —
+    # every cell's r2 loop admits its snapshots and reconciles its own
+    # moves; chaos cells therefore self-heal injected drift.
+    reconcile: ReconcileConfig = field(default_factory=ReconcileConfig)
     # Live ops plane: serve /metrics, /healthz, /events on this port for
     # the whole session (0 = ephemeral, None = off). One OpsPlane spans
     # every matrix cell; per-cell loggers re-bind as cells start, so
@@ -577,6 +582,7 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                     controller=ControllerConfig(
                         pipeline=cfg.pipeline, depth=cfg.pipeline_depth
                     ),
+                    reconcile=cfg.reconcile,
                 )
                 # solve_graph (above) closes over this accumulator; bound here,
                 # before the controller ever calls the estimator

@@ -60,7 +60,7 @@ def _add_resilience_flags(parser: argparse.ArgumentParser) -> None:
         "--chaos-profile", default="none", metavar="NAME",
         help="wrap the loop's backend in the fault-injecting ChaosBackend "
              "under this named profile (none|flaky-monitor|flaky-moves|"
-             "node-flap|soak); faults are seeded and counted as "
+             "node-flap|soak|reconcile); faults are seeded and counted as "
              "chaos_faults_total{kind}",
     )
     parser.add_argument(
@@ -85,6 +85,32 @@ def _add_resilience_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--churn-seed", type=int, default=0,
         help="seed for the churn event stream (reproducible elasticity)",
+    )
+    # the reconciliation & admission plane ([reconcile] TOML block):
+    # defaults come FROM ReconcileConfig so CLI and programmatic runs
+    # can never drift onto different trust boundaries
+    from kubernetes_rescheduling_tpu.config import ReconcileConfig
+
+    d = ReconcileConfig()
+    parser.add_argument(
+        "--no-admission", action="store_true",
+        help="disable the snapshot admission guard (bench/admission.py): "
+             "monitor() results reach device state UNCLASSIFIED — "
+             "NaN/Inf/negative/over-capacity loads, duplicate pods, and "
+             "unknown node references go unquarantined (debug only)",
+    )
+    parser.add_argument(
+        "--no-reconcile", action="store_true",
+        help="disable the intent ledger (bench/reconcile.py): divergences "
+             "between intended and observed placement — lost moves, "
+             "wrong-node landings, external drift — go undetected and "
+             "unrepaired (debug only)",
+    )
+    parser.add_argument(
+        "--repair-budget", type=int, default=d.repair_budget_per_round,
+        help="corrective moves the reconciliation plane may issue per "
+             "round to converge observed placement back to intent "
+             "(0 = detect and count only, never repair)",
     )
 
 
@@ -165,6 +191,16 @@ def _pipeline_config(args):
 
     return ControllerConfig(
         pipeline=args.pipeline, depth=args.pipeline_depth
+    )
+
+
+def _reconcile_config(args):
+    from kubernetes_rescheduling_tpu.config import ReconcileConfig
+
+    return ReconcileConfig(
+        admission=not args.no_admission,
+        enabled=not args.no_reconcile,
+        repair_budget_per_round=args.repair_budget,
     )
 
 
@@ -632,6 +668,7 @@ def cmd_fleet_reschedule(args, algo: str) -> dict:
         ),
         max_consecutive_failures=args.max_consecutive_failures,
         controller=_pipeline_config(args),
+        reconcile=_reconcile_config(args),
         fleet=FleetConfig(
             tenants=args.fleet,
             plane=args.fleet_plane,
@@ -755,6 +792,7 @@ def cmd_reschedule(args) -> dict:
         max_consecutive_failures=args.max_consecutive_failures,
         forecast=_forecast_config(args),
         controller=_pipeline_config(args),
+        reconcile=_reconcile_config(args),
         perf=PerfConfig(ledger_path=args.perf_ledger),
     )
     ops, logger = _build_ops_plane(args, cfg)
@@ -821,6 +859,7 @@ def cmd_bench(args) -> dict:
         forecast=_forecast_config(args),
         pipeline=args.pipeline,
         pipeline_depth=args.pipeline_depth,
+        reconcile=_reconcile_config(args),
         serve_port=args.serve,
         bundle_dir=args.bundle_dir,
     )

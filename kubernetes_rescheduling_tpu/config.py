@@ -205,6 +205,57 @@ class ControllerConfig:
 
 
 @dataclass(frozen=True)
+class ReconcileConfig:
+    """Reconciliation & admission block (``[reconcile]`` in TOML): the
+    controller's trust boundary on its own INPUTS and ACTIONS. jax-free,
+    like the other blocks, so config import stays light.
+
+    ``admission`` gates the snapshot admission guard
+    (``bench/admission.py``): every ``boundary.monitor()`` result is
+    classified before it can touch device state — non-finite/negative
+    loads are quarantined per entry (last-good value reused, counted
+    ``admission_quarantined_total{field,reason}``), impossibly-large
+    loads are clamped to capacity, and structurally-broken snapshots
+    (duplicate pods, unknown node references, a mostly-garbage metrics
+    wave) are REJECTED, which charges the boundary like any other
+    failure (the PR-2 degraded-round/breaker machinery).
+
+    ``enabled`` gates the intent ledger (``bench/reconcile.py``): after
+    each round's applies the controller records where everything SHOULD
+    be; each admitted snapshot is diffed against that intent, divergences
+    are classified (``wrong_node``/``lost_move``/``external_drift``/
+    ``phantom_pod``/``missing_pod`` — churn events are consumed first so
+    legitimate topology changes never read as drift) and counted
+    (``reconcile_divergences_total{kind}``), and up to
+    ``repair_budget_per_round`` corrective moves per round are issued
+    through the normal boundary/breaker budget until observed state
+    converges back to intent (0 = detect and count only, never repair).
+
+    ``max_quarantine_frac``: a snapshot needing more than this fraction
+    of its valid pods quarantined is rejected outright — repairing a
+    mostly-fabricated metrics wave entry-by-entry would launder garbage
+    into 'last good'."""
+
+    admission: bool = True
+    enabled: bool = True
+    repair_budget_per_round: int = 2
+    max_quarantine_frac: float = 0.5
+
+    def validate(self) -> "ReconcileConfig":
+        if self.repair_budget_per_round < 0:
+            raise ValueError(
+                f"reconcile repair_budget_per_round must be >= 0 "
+                f"(0 = detect only), got {self.repair_budget_per_round}"
+            )
+        if not (0.0 < self.max_quarantine_frac <= 1.0):
+            raise ValueError(
+                f"reconcile max_quarantine_frac must be in (0, 1], got "
+                f"{self.max_quarantine_frac}"
+            )
+        return self
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Fault-injection block: which named ``backends.chaos`` profile wraps
     the loop's backend (``"none"`` = no wrapper), under which fault seed.
@@ -292,6 +343,15 @@ class ObsConfig:
                                            # round-trips (0 = off; only
                                            # judges rounds that carry
                                            # pipeline telemetry)
+    slo_reconcile_drift_pods: int = 0      # reconcile_divergence SLO rule:
+                                           # a round whose reconcile block
+                                           # reports at least this many
+                                           # pods still diverged from the
+                                           # controller's intent is in
+                                           # violation (0 = off; 1 = any
+                                           # persistent drift; only rounds
+                                           # carrying reconcile data are
+                                           # judged)
 
     def validate(self) -> "ObsConfig":
         if self.serve_port is not None and not (0 <= self.serve_port <= 65535):
@@ -323,6 +383,11 @@ class ObsConfig:
             raise ValueError(
                 "slo_pipeline_min_overlap must be in [0, 1] (overlap_ratio "
                 "is a fraction of background boundary time hidden)"
+            )
+        if self.slo_reconcile_drift_pods < 0:
+            raise ValueError(
+                "slo_reconcile_drift_pods must be >= 0 (0 disables the "
+                "reconcile_divergence rule)"
             )
         return self
 
@@ -431,6 +496,10 @@ class RescheduleConfig:
     breaker_cooldown_rounds: int = 2
     failure_budget_per_round: int = 0
 
+    # Reconciliation & admission: snapshot admission guard + intent
+    # ledger with rate-limited corrective moves — see ReconcileConfig.
+    reconcile: ReconcileConfig = field(default_factory=ReconcileConfig)
+
     # Fleet mode: N tenants multiplexed over one device plane — see
     # FleetConfig. With tenants > 0 the `chaos` block above applies only
     # to the tenant indices in fleet.chaos_tenants.
@@ -527,6 +596,7 @@ class RescheduleConfig:
         self.controller.validate()
         self.obs.validate()
         self.perf.validate()
+        self.reconcile.validate()
         self.fleet.validate()
         if self.fleet.tenants > 0:
             # the batched fleet kernel is the GREEDY decision vmapped over
@@ -569,6 +639,8 @@ class RescheduleConfig:
             data["retry"] = RetryPolicy(**data["retry"])
         if isinstance(data.get("chaos"), dict):
             data["chaos"] = ChaosConfig(**data["chaos"])
+        if isinstance(data.get("reconcile"), dict):
+            data["reconcile"] = ReconcileConfig(**data["reconcile"])
         if isinstance(data.get("fleet"), dict):
             fl = dict(data["fleet"])
             if isinstance(fl.get("chaos_tenants"), list):

@@ -59,6 +59,36 @@ class RoundTelemetry:
     load_std: jax.Array            # f32, after the round
 
 
+def finite_guard(state: ClusterState) -> ClusterState:
+    """Device-side finite guard on the solver's load inputs — the
+    decision kernels' mirror of the forecast plane's never-NaN
+    discipline. The HOST admission guard (``bench/admission.py``) is the
+    real trust boundary; this is the last-resort in-trace guard for
+    callers that bypass it (bare loops, tests, the scanned replay):
+    a non-finite or negative pod load collapses to 0 instead of
+    poisoning every score, argmax, and objective downstream (NaN
+    compares false everywhere — a poisoned round silently freezes).
+
+    Bit-identity contract: on clean inputs every ``where`` selects the
+    original value, so guarded kernels are bit-identical to the
+    historical ones (golden-pinned). ``node_base_cpu`` is only guarded
+    for finiteness, NOT non-negativity — the proactive path folds a
+    (legitimately negative) forecast delta into it before this guard
+    runs (``decide_with_forecast``)."""
+    def nn(x):
+        return jnp.where(jnp.isfinite(x) & (x >= 0.0), x, 0.0)
+
+    def fin(x):
+        return jnp.where(jnp.isfinite(x), x, 0.0)
+
+    return state.replace(
+        pod_cpu=nn(state.pod_cpu),
+        pod_mem=nn(state.pod_mem),
+        node_base_cpu=fin(state.node_base_cpu),
+        node_base_mem=fin(state.node_base_mem),
+    )
+
+
 def decide(
     state: ClusterState,
     graph: CommGraph,
@@ -75,6 +105,7 @@ def decide(
     cascade delete completes before placement runs, reference
     delete_replaced_pod.py:173-177).
     """
+    state = finite_guard(state)
     most, hazard_mask = detect_hazard(state, threshold)
     victim = jnp.where(most >= 0, pick_victim(state, most), -1)
     group = deployment_group(state, victim)
@@ -115,6 +146,7 @@ def decide_explain(
     must reproduce the decision — the explain-consistency invariant the
     flight-recorder bundle check pins.
     """
+    state = finite_guard(state)
     most, hazard_mask = detect_hazard(state, threshold)
     victim = jnp.where(most >= 0, pick_victim(state, most), -1)
     group = deployment_group(state, victim)
@@ -193,7 +225,7 @@ def decide_explain_with_forecast(
     return decide_explain(
         predicted_state(state, delta), graph, policy_id, threshold, key,
         top_k=top_k,
-    )
+    )  # decide_explain applies the same finite_guard as decide
 
 
 def round_step(

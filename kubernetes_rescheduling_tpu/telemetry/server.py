@@ -293,6 +293,9 @@ class OpsPlane:
                 pipeline_min_overlap=getattr(
                     obs, "slo_pipeline_min_overlap", 0.0
                 ),
+                reconcile_max_drift_pods=getattr(
+                    obs, "slo_reconcile_drift_pods", 0
+                ),
             ),
             registry=registry,
             logger=logger,
@@ -386,13 +389,13 @@ class OpsPlane:
             # read as an SLO violation
             self.watchdog.rebase()
 
-    def observe_round(self, record, state=None, events=()) -> None:
+    def observe_round(self, record, state=None, events=(), tenant=None) -> None:
         self.health.rounds += 1
         self.health.mark_round()
         if record.degraded:
             self.health.degraded_rounds += 1
         if self.watchdog is not None:
-            self.watchdog.observe_round(record)
+            self.watchdog.observe_round(record, tenant=tenant)
         if self.recorder is not None:
             spans = [
                 {

@@ -57,6 +57,7 @@ RULE_PERF = "perf_regression"
 RULE_ATTRIBUTION = "attribution_drift"
 RULE_FORECAST = "forecast_skill"
 RULE_PIPELINE = "pipeline_overlap"
+RULE_RECONCILE = "reconcile_divergence"
 
 
 @dataclass(frozen=True)
@@ -91,6 +92,14 @@ class SLORules:
     # is silently gone (0 disables; only rounds carrying pipeline
     # telemetry are judged, so sequential runs can never trip it).
     pipeline_min_overlap: float = 0.0
+    # reconcile divergence: the latest round's reconcile block
+    # (RoundRecord.reconcile — the intent ledger's accounting) reports at
+    # least this many pods STILL diverged from the controller's intent
+    # after the round's corrective moves — drift is outrunning the repair
+    # budget, or repairs cannot land (0 disables; 1 = any persistent
+    # drift; only rounds carrying reconcile data are judged, so runs with
+    # the plane off can never trip it).
+    reconcile_max_drift_pods: int = 0
 
     def validate(self) -> "SLORules":
         if self.window < 2:
@@ -113,6 +122,11 @@ class SLORules:
             raise ValueError(
                 "pipeline_min_overlap must be in [0, 1] (overlap_ratio "
                 "is a fraction)"
+            )
+        if self.reconcile_max_drift_pods < 0:
+            raise ValueError(
+                "reconcile_max_drift_pods must be >= 0 (0 disables the "
+                "reconcile_divergence rule)"
             )
         return self
 
@@ -151,6 +165,10 @@ class Watchdog:
         self._perf_active: dict[str, dict[str, Any]] = {}
         self._attr: dict[str, Any] | None = None  # latest round's attribution
         self._forecast: dict[str, Any] | None = None  # latest round's forecast
+        # latest reconcile block PER SOURCE (solo runs key None; fleet
+        # tenants key their name): the rule judges the worst source, so
+        # one tenant's convergence can never mask another's drift
+        self._reconcile: dict[str | None, dict[str, Any]] = {}
         # pipelined rounds' overlap ratios (rolling window)
         self._overlap: collections.deque[float] = collections.deque(
             maxlen=self.rules.window
@@ -174,6 +192,7 @@ class Watchdog:
         self._promo_allow = 0
         self._attr = None
         self._forecast = None
+        self._reconcile = {}
         self._overlap.clear()
         self.active = (
             {RULE_PERF: self.active[RULE_PERF]}
@@ -184,9 +203,12 @@ class Watchdog:
     def _reg(self) -> MetricsRegistry:
         return self.registry if self.registry is not None else get_registry()
 
-    def observe_round(self, record) -> list[dict[str, Any]]:
+    def observe_round(self, record, tenant=None) -> list[dict[str, Any]]:
         """Record one executed round and re-evaluate every rule. Returns
-        the NEWLY raised violations (already counted and logged)."""
+        the NEWLY raised violations (already counted and logged).
+        ``tenant`` names the fleet tenant the round belongs to (None for
+        solo runs) — per-source state like the reconcile block keys on
+        it so interleaved tenant rounds never mask each other."""
         self._lat.append(float(record.decision_latency_s))
         self._cost.append(float(record.communication_cost))
         attr = getattr(record, "attribution", None)
@@ -195,6 +217,9 @@ class Watchdog:
         forecast = getattr(record, "forecast", None)
         if isinstance(forecast, dict):
             self._forecast = forecast
+        reconcile = getattr(record, "reconcile", None)
+        if isinstance(reconcile, dict):
+            self._reconcile[tenant] = reconcile
         pipeline = getattr(record, "pipeline", None)
         if isinstance(pipeline, dict) and "overlap_ratio" in pipeline:
             self._overlap.append(float(pipeline["overlap_ratio"]))
@@ -316,6 +341,27 @@ class Watchdog:
                     "overlap_ratio_mean": mean,
                     "threshold": r.pipeline_min_overlap,
                     "window": len(self._overlap),
+                }
+        if r.reconcile_max_drift_pods > 0 and self._reconcile:
+            # each source's LATEST round carrying reconcile data judges,
+            # and the WORST source decides: pods still diverged from
+            # intent after that round's corrective moves means drift is
+            # outrunning the repair budget (or repairs cannot land — a
+            # dead target, a frozen boundary). In fleet mode sources are
+            # tenants, so one tenant converging (drift_pods=0) can never
+            # mask another tenant's persistent drift
+            tenant, worst = max(
+                self._reconcile.items(),
+                key=lambda kv: int(kv[1].get("drift_pods") or 0),
+            )
+            drift = int(worst.get("drift_pods") or 0)
+            if drift >= r.reconcile_max_drift_pods:
+                now[RULE_RECONCILE] = {
+                    "drift_pods": drift,
+                    "threshold": r.reconcile_max_drift_pods,
+                    "divergences": len(worst.get("divergences") or ()),
+                    "repairs_issued": len(worst.get("repairs") or ()),
+                    **({"tenant": tenant} if tenant is not None else {}),
                 }
         if self._perf_active:
             now[RULE_PERF] = {

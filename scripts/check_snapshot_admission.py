@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Static check: every monitor snapshot passes the admission guard.
+
+PR 2 made the boundary resilient (``check_boundary_retry.py``); this is
+the sibling check for the DATA: a ``boundary.monitor()`` result that
+reaches device state without passing ``AdmissionGuard.admit``
+(``bench/admission.py``) re-opens the poisoned-metrics hole — one
+NaN/Inf/negative load silently corrupts the solver score, the forecast
+RLS state, the attribution sums, and the perf ledger.
+
+AST-based, like its siblings: inside ``bench/controller.py`` and
+``bench/fleet.py``, a ``.monitor(...)`` call is only legal inside the
+designated admitted-monitor wrappers — ``_Runtime.monitor_admitted``
+(the solo loop) and ``_admitted_monitor`` (the fleet loop) — and each
+wrapper must itself contain an ``.admit(...)`` call, so the wrapper
+cannot quietly stop guarding. Every other control-loop code path gets
+its snapshots from a wrapper and therefore admitted.
+
+Run directly (exit 1 on violation) or through its test twin
+(tests/test_snapshot_admission.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parent.parent / "kubernetes_rescheduling_tpu"
+# the control loops: the consumers whose snapshots touch device state.
+# (bench/boundary.py is the transport layer below the guard; harness/CLI
+# measurement phases read the raw backend on purpose — a broken ruler
+# should fail loudly, not be repaired.)
+CHECKED = (
+    PACKAGE / "bench" / "controller.py",
+    PACKAGE / "bench" / "fleet.py",
+)
+# the designated wrappers: the ONLY functions allowed to call .monitor()
+WRAPPERS = {"monitor_admitted", "_admitted_monitor"}
+
+
+def _functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _calls(tree: ast.AST, attr: str):
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == attr
+        ):
+            yield node
+
+
+def find_violations(path: Path) -> list[tuple[int, str]]:
+    """(line, message) pairs: monitor calls outside the wrappers, plus
+    wrappers that lost their admit call."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out: list[tuple[int, str]] = []
+
+    # map every node to its innermost enclosing function. ast.walk
+    # yields outer functions before the defs nested inside them, so
+    # plain assignment lets the inner function win — a monitor() call
+    # inside a closure nested in a wrapper is attributed to the
+    # closure (a violation), not laundered through the wrapper's name.
+    enclosing: dict[ast.AST, ast.AST] = {}
+    for fn in _functions(tree):
+        for node in ast.walk(fn):
+            if node is not fn:
+                enclosing[node] = fn
+
+    wrappers_seen: set[str] = set()
+    for call in _calls(tree, "monitor"):
+        fn = enclosing.get(call)
+        name = getattr(fn, "name", None)
+        if name in WRAPPERS:
+            wrappers_seen.add(name)
+            continue
+        recv = (
+            ast.unparse(call.func.value)
+            if hasattr(ast, "unparse")
+            else "<recv>"
+        )
+        out.append(
+            (
+                call.lineno,
+                f"{recv}.monitor(...) outside the admitted-monitor "
+                f"wrappers {sorted(WRAPPERS)}",
+            )
+        )
+
+    for fn in _functions(tree):
+        if fn.name not in wrappers_seen:
+            continue
+        if not any(True for _ in _calls(fn, "admit")):
+            out.append(
+                (
+                    fn.lineno,
+                    f"wrapper {fn.name} never calls .admit(...) — the "
+                    "admission guard has been bypassed",
+                )
+            )
+    return out
+
+
+def violations() -> list[str]:
+    return [
+        f"{path.relative_to(PACKAGE.parent)}:{line}: {what}"
+        for path in CHECKED
+        for line, what in find_violations(path)
+    ]
+
+
+def main() -> int:
+    bad = violations()
+    if bad:
+        sys.stderr.write(
+            "unadmitted monitor snapshot in the control loop — route "
+            "monitor() results through the admission guard "
+            "(bench/admission.py):\n" + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -240,7 +240,16 @@ class TestChaosBackend:
         with pytest.raises(ValueError):
             ChaosProfile(monitor_error_rate=1.5).validate()
         with pytest.raises(ValueError):
+            ChaosProfile(monitor_corrupt_rate=-0.1).validate()
+        with pytest.raises(ValueError):
+            ChaosProfile(corrupt_max_pods=0).validate()
+        with pytest.raises(ValueError):
             with_chaos(_sim(), "no-such-profile")
+        # the soak profile exercises the reconcile-plane kinds at low
+        # rates; the reconcile profile runs them hot
+        assert PROFILES["soak"].monitor_corrupt_rate > 0
+        assert PROFILES["soak"].external_drift_rate > 0
+        assert PROFILES["reconcile"].move_lost_rate > 0
 
     def test_none_profile_is_passthrough(self):
         b = _sim()
@@ -283,6 +292,151 @@ class TestChaosBackend:
         fam = registry.counter("chaos_faults_total", labelnames=("kind",))
         for kind, n in chaos.fault_counts.items():
             assert fam.labels(kind=kind).value == n
+
+    def test_monitor_corrupt_poisons_readings_not_shapes(self, registry):
+        from kubernetes_rescheduling_tpu.backends.chaos import ChaosBackend
+
+        prof = ChaosProfile(monitor_corrupt_rate=1.0, corrupt_max_pods=3)
+        chaos = ChaosBackend(_sim(), prof, seed=0)
+        clean = chaos.inner.monitor()
+        state = chaos.monitor()
+        valid = np.asarray(state.pod_valid)
+        bad = np.zeros_like(valid)
+        # corruption spans BOTH Metrics-API usage fields (cpu and mem)
+        for field, cap_field in (
+            ("pod_cpu", "node_cpu_cap"),
+            ("pod_mem", "node_mem_cap"),
+        ):
+            arr = np.asarray(getattr(state, field))
+            cap = float(np.max(np.asarray(getattr(state, cap_field))))
+            bad |= valid & (~np.isfinite(arr) | (arr < 0.0) | (arr > cap))
+            assert arr.shape == np.asarray(getattr(clean, field)).shape
+        assert 1 <= int(bad.sum()) <= 3  # 1..corrupt_max_pods entries
+        assert chaos.fault_counts["monitor_corrupt"] == 1
+
+    def test_pod_move_wave_gets_landing_faults(self, registry):
+        """Regression: ``apply_pod_moves`` used to pass through
+        ``__getattr__`` untouched, so pod-granular batch waves never saw
+        lost/wrong-node faults — the reconcile profile's own soak never
+        exercised the ledger on the pod path."""
+        from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+
+        backend = _sim()
+        chaos = ChaosBackend(backend, PROFILES["reconcile"], seed=5)
+        state = backend.monitor()
+        valid = np.flatnonzero(np.asarray(state.pod_valid))
+        svcs = np.asarray(state.pod_service)
+        graph = backend.comm_graph()
+        moves = [
+            MoveRequest(
+                service=graph.names[int(svcs[i])],
+                pod=state.pod_names[int(i)],
+                target_node="worker2",
+            )
+            for i in valid[:6]
+        ]
+        for _ in range(12):
+            landed = chaos.apply_pod_moves(moves)
+            if chaos.fault_counts.get(
+                "move_lost", 0
+            ) and chaos.fault_counts.get("move_wrong_node", 0):
+                break
+        assert chaos.fault_counts.get("move_lost", 0) >= 1
+        assert chaos.fault_counts.get("move_wrong_node", 0) >= 1
+        # the wave reports TRUE landings (pod -> node): a wrong-node
+        # redirect shows where the pod really went, and an acknowledged-
+        # but-lost move claims the requested target while the cluster
+        # kept the pod — only the reconcile diff can see that lie
+        assert isinstance(landed, dict)
+        fam = registry.counter("chaos_faults_total", labelnames=("kind",))
+        for kind, n in chaos.fault_counts.items():
+            assert fam.labels(kind=kind).value == n
+
+    def test_external_drift_moves_a_pod_behind_the_controller(self, registry):
+        from kubernetes_rescheduling_tpu.backends.chaos import ChaosBackend
+
+        prof = ChaosProfile(external_drift_rate=1.0)
+        sim = _sim()
+        chaos = ChaosBackend(sim, prof, seed=0)
+        before = sim.monitor()
+        after = chaos.monitor()  # drift applies BEFORE the snapshot
+        moved = (
+            np.asarray(before.pod_node) != np.asarray(after.pod_node)
+        ) & np.asarray(after.pod_valid)
+        assert int(moved.sum()) == 1  # exactly one pod drifted
+        assert chaos.fault_counts["external_drift"] == 1
+
+    def test_move_lost_acknowledges_without_moving(self, registry):
+        from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+        from kubernetes_rescheduling_tpu.backends.chaos import ChaosBackend
+
+        prof = ChaosProfile(move_lost_rate=1.0)
+        sim = _sim()
+        chaos = ChaosBackend(sim, prof, seed=0)
+        before = sim.monitor()
+        landed = chaos.apply_move(
+            MoveRequest(service="s0", target_node="worker2")
+        )
+        assert landed == "worker2"  # the API said yes...
+        after = sim.monitor()
+        assert np.array_equal(  # ...and nothing in the cluster changed
+            np.asarray(before.pod_node), np.asarray(after.pod_node)
+        )
+        assert chaos.fault_counts["move_lost"] == 1
+
+    def test_reconcile_profile_fault_counts_match_registry(self, registry):
+        """The fault-count==registry acceptance invariant, extended to
+        the reconcile-plane kinds (corrupt/drift/lost + wrong-node +
+        node flap, all active in the `reconcile` profile)."""
+        from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+        from kubernetes_rescheduling_tpu.backends.chaos import ChaosBackend
+
+        chaos = ChaosBackend(_sim(), PROFILES["reconcile"], seed=0)
+        for _ in range(30):
+            chaos.monitor()
+            chaos.apply_move(
+                MoveRequest(service="s0", target_node="worker2")
+            )
+        for kind in ("monitor_corrupt", "external_drift", "move_lost"):
+            assert chaos.fault_counts.get(kind, 0) >= 1, kind
+        fam = registry.counter("chaos_faults_total", labelnames=("kind",))
+        for kind, n in chaos.fault_counts.items():
+            assert fam.labels(kind=kind).value == n
+
+    def test_aux_stream_leaves_legacy_fault_sequence_unchanged(self, registry):
+        """The reconcile-plane kinds draw from a DEDICATED seeded stream
+        (ChaosBackend._rng_aux): enabling them must not shift the
+        pre-existing kinds' seeded fault sequence — soaks pinned before
+        the reconciliation plane existed keep their exact faults."""
+        from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+        from kubernetes_rescheduling_tpu.backends.chaos import ChaosBackend
+
+        legacy = dataclasses.replace(
+            PROFILES["soak"],
+            monitor_corrupt_rate=0.0,
+            external_drift_rate=0.0,
+            move_lost_rate=0.0,
+        )
+
+        def run(prof):
+            chaos = ChaosBackend(_sim(), prof, seed=5)
+            for _ in range(40):
+                try:
+                    chaos.monitor()
+                except ChaosError:
+                    pass
+                try:
+                    chaos.apply_move(
+                        MoveRequest(service="s0", target_node="worker2")
+                    )
+                except (ChaosError, TimeoutError):
+                    pass
+            return chaos.fault_counts
+
+        with_new, without = run(PROFILES["soak"]), run(legacy)
+        new_kinds = {"monitor_corrupt", "external_drift", "move_lost"}
+        for kind in (set(with_new) | set(without)) - new_kinds:
+            assert with_new.get(kind, 0) == without.get(kind, 0), kind
 
     def test_stale_snapshot_is_previous_state(self, registry):
         prof = ChaosProfile(monitor_stale_rate=1.0)

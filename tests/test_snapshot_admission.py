@@ -1,0 +1,55 @@
+"""CI twin of ``scripts/check_snapshot_admission.py``: every
+``boundary.monitor()`` result the control loops consume passes the
+admission guard (``bench/admission.py``) before it can touch device
+state — the data sibling of the ``check_boundary_retry`` transport
+check."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+
+def _load_checker():
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_snapshot_admission.py"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "check_snapshot_admission", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_snapshot_admission", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_control_loops_admit_every_snapshot():
+    checker = _load_checker()
+    assert checker.violations() == []
+
+
+def test_checker_catches_an_unadmitted_monitor(tmp_path):
+    checker = _load_checker()
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "def monitor_admitted(self):\n"
+        "    out = self.boundary.monitor()\n"   # inside the wrapper: legal
+        "    return self.guard.admit(out)\n"
+        "def preamble(self):\n"
+        "    probe = self.boundary.monitor()\n"  # outside: flagged
+        "    return probe\n"
+    )
+    lines = [line for line, _ in checker.find_violations(f)]
+    assert lines == [5]
+
+
+def test_checker_catches_a_wrapper_that_stops_admitting(tmp_path):
+    checker = _load_checker()
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "def monitor_admitted(self):\n"
+        "    return self.boundary.monitor()\n"  # wrapper lost its admit
+    )
+    bad = checker.find_violations(f)
+    assert len(bad) == 1 and "admit" in bad[0][1]

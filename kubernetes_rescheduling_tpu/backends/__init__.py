@@ -14,6 +14,10 @@
 - ``FleetBackend`` — N per-tenant backends behind one handle for the
   multiplexed fleet controller (each tenant keeps its own failure
   domain; chaos composes per tenant).
+- ``ReplayBackend`` — a recorded real-cluster trace (``traces/``)
+  served through the same surface; ``apply_move`` records
+  recommendations instead of mutating anything (shadow mode's
+  transport).
 """
 
 from kubernetes_rescheduling_tpu.backends.base import Backend, MoveRequest
@@ -28,6 +32,7 @@ from kubernetes_rescheduling_tpu.backends.chaos import (
     with_chaos,
 )
 from kubernetes_rescheduling_tpu.backends.fleet import FleetBackend, make_fleet
+from kubernetes_rescheduling_tpu.backends.replay import ReplayBackend
 
 __all__ = [
     "Backend",
@@ -44,4 +49,5 @@ __all__ = [
     "with_chaos",
     "FleetBackend",
     "make_fleet",
+    "ReplayBackend",
 ]

@@ -316,6 +316,24 @@ class K8sBackend:
             self.custom_api = self.custom_api or client.CustomObjectsApi()
         self._graph = self.workmodel.comm_graph()
         self._svc_index = {n: i for i, n in enumerate(self.workmodel.names)}
+        # monitor short-circuit memo (first concrete step toward the
+        # watch-driven snapshot path): the parsed cluster STRUCTURE —
+        # node table + capacities + the pod→Deployment owner mapping —
+        # keyed by the (node list, pod list) resourceVersion pair. While
+        # neither list object changed between polls, the per-pod
+        # owner-chain walks (one ReplicaSet read per pod) are skipped
+        # and only usage metrics are re-fetched; clients that expose no
+        # resourceVersion (older fakes) never engage it.
+        self._struct_memo: tuple[tuple[str, str], dict] | None = None
+        # per-pod owner memo: a pod name's owner chain is immutable for
+        # that pod's lifetime (a re-created pod gets a new hash-suffixed
+        # name), so the ReplicaSet walk is cached by pod name even when
+        # the LIST resourceVersions churn — on a busy apiserver the list
+        # RV advances with the cluster-global storage revision (Lease
+        # heartbeats, events), so without this the struct memo alone
+        # would ~never save the walks in production. Pruned to the
+        # current listing each rebuild, so deleted pods don't accumulate.
+        self._owner_memo: dict[str, str | None] = {}
 
     def comm_graph(self) -> CommGraph:
         return self._graph
@@ -353,16 +371,75 @@ class K8sBackend:
         with timed_call("k8s", "monitor"):
             return self._monitor()
 
+    @staticmethod
+    def _list_rv(obj) -> str | None:
+        rv = _get(obj, "metadata", "resource_version") or _get(
+            obj, "metadata", "resourceVersion"
+        )
+        return str(rv) if rv else None
+
     def _monitor(self) -> ClusterState:
         nodes = self._api("list_node", lambda: self.core_api.list_node(watch=False))
-        node_names = self._worker_names(nodes)
-        cap_cpu: dict[str, float] = {}
-        cap_mem: dict[str, float] = {}
-        for n in _get(nodes, "items", default=[]):
-            name = _get(n, "metadata", "name")
-            capacity = _get(n, "status", "capacity", default={}) or {}
-            cap_cpu[name] = float(cpu_to_millicores(str(capacity.get("cpu", "0"))))
-            cap_mem[name] = float(mem_to_bytes(str(capacity.get("memory", "0"))))
+        pods_items, pods_rv = self._list_namespace_pods_rv()
+        nodes_rv = self._list_rv(nodes)
+        struct = None
+        if (
+            nodes_rv is not None
+            and pods_rv is not None
+            and self._struct_memo is not None
+            and self._struct_memo[0] == (nodes_rv, pods_rv)
+        ):
+            # nothing changed between polls: reuse the parsed structure,
+            # skip the owner-chain walks, fetch only fresh usage metrics
+            struct = self._struct_memo[1]
+            get_registry().counter(
+                "backend_monitor_short_circuits_total",
+                "monitor polls that reused the previous poll's parsed "
+                "cluster structure because both list resourceVersions "
+                "were unchanged (per-pod owner-chain walks skipped; "
+                "usage metrics stay fresh)",
+                labelnames=("backend",),
+            ).labels(backend="k8s").inc()
+        if struct is None:
+            node_names = self._worker_names(nodes)
+            cap_cpu: dict[str, float] = {}
+            cap_mem: dict[str, float] = {}
+            for n in _get(nodes, "items", default=[]):
+                name = _get(n, "metadata", "name")
+                capacity = _get(n, "status", "capacity", default={}) or {}
+                cap_cpu[name] = float(
+                    cpu_to_millicores(str(capacity.get("cpu", "0")))
+                )
+                cap_mem[name] = float(
+                    mem_to_bytes(str(capacity.get("memory", "0")))
+                )
+            entries: list[tuple[str, int, str | None]] = []
+            owner_memo: dict[str, str | None] = {}
+            for p in pods_items:
+                name = _get(p, "metadata", "name")
+                if name in self._owner_memo:
+                    dep = self._owner_memo[name]
+                else:
+                    dep = self._deployment_for_pod(p)
+                owner_memo[name] = dep
+                if dep is None or dep not in self._svc_index:
+                    continue
+                node = _get(p, "spec", "node_name") or _get(
+                    p, "spec", "nodeName"
+                )
+                entries.append((name, self._svc_index[dep], node))
+            self._owner_memo = owner_memo  # pruned to the live listing
+            struct = {
+                "node_names": node_names,
+                "cap_cpu": cap_cpu,
+                "cap_mem": cap_mem,
+                "pods": entries,
+            }
+            if nodes_rv is not None and pods_rv is not None:
+                self._struct_memo = ((nodes_rv, pods_rv), struct)
+        node_names = struct["node_names"]
+        cap_cpu = struct["cap_cpu"]
+        cap_mem = struct["cap_mem"]
 
         # node usage (metrics-server) — used to derive per-node base load
         node_used: dict[str, float] = {}
@@ -411,14 +488,9 @@ class K8sBackend:
         services, pod_nodes, pod_cpu, pod_mem, pod_names = [], [], [], [], []
         tracked_cpu = {n: 0.0 for n in node_names}
         tracked_mem = {n: 0.0 for n in node_names}
-        for p in self._list_namespace_pods():
-            dep = self._deployment_for_pod(p)
-            if dep is None or dep not in self._svc_index:
-                continue
-            name = _get(p, "metadata", "name")
-            node = _get(p, "spec", "node_name") or _get(p, "spec", "nodeName")
+        for name, svc_idx, node in struct["pods"]:
             cpu, mem = pod_usage.get(name, (0.0, 0.0))
-            services.append(self._svc_index[dep])
+            services.append(svc_idx)
             pod_nodes.append(node_names.index(node) if node in node_names else UNASSIGNED)
             pod_cpu.append(cpu)
             pod_mem.append(mem)
@@ -509,9 +581,11 @@ class K8sBackend:
             for n in cordoned:
                 self.uncordon(n)
 
-    def _list_namespace_pods(self) -> list:
-        """This namespace's pods: server-side filtering when the client
-        offers ``list_namespaced_pod``, else the all-namespaces listing
+    def _list_namespace_pods_rv(self) -> tuple[list, str | None]:
+        """This namespace's pods plus the LIST object's resourceVersion
+        (the short-circuit memo key; None when the client exposes none):
+        server-side filtering when the client offers
+        ``list_namespaced_pod``, else the all-namespaces listing
         filtered here — ONE shared convention for every pod-listing
         caller (snapshot and restart probe alike)."""
         lister = getattr(self.core_api, "list_namespaced_pod", None)
@@ -519,16 +593,20 @@ class K8sBackend:
             pods = self._api(
                 "list_pods", lambda: lister(self.namespace, watch=False)
             )
-            return _get(pods, "items", default=[]) or []
+            return (_get(pods, "items", default=[]) or [], self._list_rv(pods))
         pods = self._api(
             "list_pods",
             lambda: self.core_api.list_pod_for_all_namespaces(watch=False),
         )
-        return [
+        items = [
             p
             for p in (_get(pods, "items", default=[]) or [])
             if _get(p, "metadata", "namespace") == self.namespace
         ]
+        return (items, self._list_rv(pods))
+
+    def _list_namespace_pods(self) -> list:
+        return self._list_namespace_pods_rv()[0]
 
     def pod_restart_counts(self) -> dict[str, int] | None:
         """Per-pod container ``restartCount`` sums over the namespace —

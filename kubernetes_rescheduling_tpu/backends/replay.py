@@ -1,0 +1,154 @@
+"""Replay backend: a recorded cluster trace behind the Backend surface.
+
+The shadow plane's transport (ROADMAP item 3): ``monitor()`` serves the
+trace's snapshot windows one per call — the TRACE drives the clock, the
+controller paces against recorded time, and each post-move monitor
+observes what the real cluster (and its real scheduler) actually did
+next. ``apply_move`` is **advisory-only by construction**: it records
+the recommendation in the shadow ledger (``recommendations``) and
+returns the requested target, but there is NO mutation path — the class
+holds no mutable cluster state to mutate, which is the strongest form of
+"asserts no applies". The controller marks replay intents advisory
+(``advisory_only``), so the PR-10 intent ledger adopts the observed
+(recorded) placement at the first diff instead of charging the real
+scheduler's choices as drift.
+
+Static shapes for free: every window builds at the trace-wide node
+table and max-window pod count (``traces.corpus.ClusterTrace``), so the
+decision kernels hold the 1-steady-state-trace invariant across the
+whole replay. Snapshot states are built FRESH per ``monitor`` (see that
+method — a memoized window object re-served on the clamped tail would
+hand the donated global carry deleted buffers); the trace itself is the
+only state, so fresh builds are bit-identical and the determinism pin
+(bit-identical recommendations across runs) has no hidden host state to
+drift on.
+"""
+
+from __future__ import annotations
+
+from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.telemetry.accounting import timed_call
+from kubernetes_rescheduling_tpu.telemetry.registry import get_registry
+from kubernetes_rescheduling_tpu.traces.corpus import ClusterTrace, window_state
+
+
+class ReplayBackend:
+    """Serve a :class:`~traces.corpus.ClusterTrace` as a cluster."""
+
+    # the controller reads this and marks every intent advisory: a
+    # recommendation is definitionally advisory, and the recorded
+    # scheduler's placement is the ground truth the ledger adopts
+    advisory_only = True
+    supports_pod_moves = True  # recommendations may be pod-granular
+
+    def __init__(
+        self,
+        trace: ClusterTrace,
+        *,
+        pod_capacity: int | None = None,
+        registry=None,
+    ) -> None:
+        windows = trace.windows()
+        if not windows:
+            raise ValueError(f"empty trace: {trace.source}")
+        if not any(w.pods for w in windows):
+            raise ValueError(
+                f"trace {trace.source} carries no pod records — nothing "
+                f"to replay (rounds.jsonl-converted traces are usage/"
+                f"placement corpora for the schema tooling, not replay "
+                f"inputs; use an external-format or native trace)"
+            )
+        self.trace = trace
+        self.registry = registry
+        self._windows = windows
+        self._pod_capacity = pod_capacity or trace.max_window_pods
+        self._graph = trace.comm_graph()
+        self._idx = -1
+        # phantom node references count ONCE, at load: monitor() rebuilds
+        # windows fresh every serve (clamped tail included), and the
+        # quarantine metric is documented as load-time row counts
+        declared = set(trace.node_names)
+        unknown = sum(
+            1
+            for w in windows
+            for rec in w.pods
+            if rec.get("node") is not None and rec["node"] not in declared
+        )
+        if unknown:
+            from kubernetes_rescheduling_tpu.traces.corpus import (
+                REASON_UNKNOWN_NODE_REF,
+                _count_quarantine,
+            )
+
+            _count_quarantine(registry, REASON_UNKNOWN_NODE_REF, unknown)
+        self.clock_s = 0.0
+        # the raw shadow ledger: every recommendation the controller
+        # issued, in order, with the window it was decided against
+        self.recommendations: list[dict] = []
+
+    # ---- Backend protocol ----
+
+    def comm_graph(self) -> CommGraph:
+        return self._graph
+
+    @property
+    def window(self) -> int:
+        """Index of the most recently served window."""
+        return max(self._idx, 0)
+
+    @property
+    def exhausted(self) -> bool:
+        """True once the last window has been served (further monitors
+        re-serve it — the steady tail)."""
+        return self._idx >= len(self._windows) - 1
+
+    def monitor(self) -> ClusterState:
+        """Serve the next snapshot window (clamped at the trace end).
+
+        Built FRESH per call, like the sim backend's monitor — the
+        global solver's donated carry consumes snapshot buffers, so a
+        memoized window object re-served on the clamped tail would hand
+        the controller deleted arrays. The trace itself is immutable;
+        fresh builds from it are bit-identical by construction (the
+        determinism pin in tests/test_shadow.py rides on this)."""
+        with timed_call("replay", "monitor"):
+            self._idx = min(self._idx + 1, len(self._windows) - 1)
+            self.clock_s = float(self._windows[self._idx].t)
+            return window_state(
+                self.trace,
+                self._idx,
+                pod_capacity=self._pod_capacity,
+                registry=self.registry,
+                count_refs=False,  # counted once at construction
+            )
+
+    def apply_move(self, move: MoveRequest) -> str | None:
+        """Record the recommendation; mutate nothing. Returns the
+        requested target (the advisory echo — the recorded scheduler's
+        actual choice shows at the next monitor)."""
+        with timed_call("replay", "apply_move"):
+            self.recommendations.append(
+                {
+                    "t": self.clock_s,
+                    "window": self.window,
+                    "service": move.service,
+                    "pod": move.pod,
+                    "target": move.target_node,
+                    "mechanism": move.mechanism,
+                }
+            )
+            reg = (
+                self.registry if self.registry is not None else get_registry()
+            )
+            reg.counter(
+                "shadow_recommendations_total",
+                "rescheduling moves recommended (never applied) by the "
+                "shadow plane's replay backend",
+            ).inc()
+            return move.target_node
+
+    def advance(self, seconds: float) -> None:
+        """Pacing is informational: the trace drives the clock (each
+        monitor stamps the served window's timestamp)."""
+        self.clock_s += float(seconds)

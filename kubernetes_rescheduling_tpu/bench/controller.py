@@ -127,6 +127,12 @@ class RoundRecord:
     # (so a fault-free run's records stay identical to a run with the
     # plane disabled, the golden-pin contract)
     reconcile: dict | None = None
+    # shadow mode (bench/shadow.py): the round's head-to-head against
+    # the replayed trace's actual scheduler — counterfactual cost/
+    # load-std, delta, running win-rate, and (with attribution on) the
+    # twin's sum-consistent attribution + per-edge deltas — None
+    # outside shadow runs and on unscored (degraded) rounds
+    shadow: dict | None = None
     # wall-clock lifecycle of the round (timing field — excluded from
     # the pipelined-vs-sequential bit-identity comparison): execute
     # start to record finalize
@@ -391,7 +397,16 @@ class _Runtime:
             else None
         )
         self.ledger = (
-            IntentLedger(config.reconcile, registry=registry, logger=logger)
+            IntentLedger(
+                config.reconcile,
+                registry=registry,
+                logger=logger,
+                # an advisory-only backend (shadow replay) makes the
+                # snapshot stream ground truth: diffs adopt, never charge
+                adopt_observed=getattr(
+                    self.boundary.raw_backend, "advisory_only", False
+                ),
+            )
             if config.reconcile.enabled
             else None
         )
@@ -405,6 +420,18 @@ class _Runtime:
                 registry=registry,
             )
         self.churn = churn
+        self.shadow = None
+        if config.shadow.enabled:
+            # the shadow plane: recommendations land in a shadow ledger
+            # (the replay backend records, never applies) and a
+            # counterfactual twin scores our cumulative placement vs the
+            # trace's actual one, riding the round-end bundle. Lazy
+            # import — live runs never touch the shadow module.
+            from kubernetes_rescheduling_tpu.bench.shadow import ShadowPlane
+
+            self.shadow = ShadowPlane(
+                config.shadow, registry=registry, logger=logger
+            )
         self.forecast_plane = None
         if config.algorithm == "proactive":
             # the forecast plane: one online forecaster per run, one kernel
@@ -588,6 +615,17 @@ class _Runtime:
                     self.state, service_names=self.metric_graph.names
                 )
             self._ledger_snap = self.ledger.snapshot()
+        if self.shadow is not None:
+            # twin := the first admitted snapshot's recorded placement;
+            # the guard's already-pulled host arrays mean no extra
+            # transfer (shadow validation requires admission on)
+            self.shadow.bind(
+                self.state,
+                self.metric_graph,
+                self.guard.host_arrays(self.state)
+                if self.guard is not None
+                else None,
+            )
 
     # ---- snapshot admission ----
 
@@ -722,6 +760,21 @@ class _Runtime:
         record.breaker_state = self.breaker.state
         record.boundary_failures = self.boundary.round_failures
         self._attach_metrics(rnd, record, closer)
+        if self.shadow is not None:
+            # AFTER the metrics piece: decode order inside the single
+            # flush guarantees the actual cost is on the record before
+            # the shadow decode scores against it — and the twin's
+            # bundle rides the SAME round_end transfer
+            self.shadow.observe_round(
+                rnd, record, self.state, self.metric_graph, closer,
+                arrays=(
+                    self.guard.host_arrays(self.state)
+                    if self.guard is not None
+                    else None
+                ),
+                fresh=new_state is not None,
+                top_k=self.attr_k,
+            )
 
     def _reconcile_round(self, record: RoundRecord, *, fresh: bool) -> None:
         """The reconciliation plane's per-round step — delegates to the
@@ -746,6 +799,19 @@ class _Runtime:
             self._ledger_snap = self.ledger.snapshot()
 
     # ---- per-round helpers ----
+
+    def record_intents(self, intents) -> None:
+        """Ledger capture for a round's applied moves. An advisory-only
+        backend (the shadow plane's replay backend) makes every intent
+        advisory regardless of mechanism: a recommendation is
+        definitionally advisory, and the ledger then adopts the observed
+        (recorded) placement at the next diff instead of charging the
+        real scheduler's choices as lost moves or drift."""
+        if not intents:
+            return
+        if getattr(self.boundary.raw_backend, "advisory_only", False):
+            intents = [(*i[:4], True) for i in intents]
+        self.ledger.record_moves(intents)
 
     def skip_round(self, rnd: int) -> None:
         """Safe mode: the open breaker froze this round — count it, pace,
@@ -853,7 +919,7 @@ class _Runtime:
                 # monitor (or a breaker skip) can still carry it forward
                 self.state = carry["state"]
             if intents:
-                self.ledger.record_moves(intents)
+                self.record_intents(intents)
             return record
         forecast_delta = None
         forecast_latency = 0.0
@@ -876,7 +942,7 @@ class _Runtime:
             registry=self.registry, intents=intents,
         )
         if intents:
-            self.ledger.record_moves(intents)
+            self.record_intents(intents)
         if self.forecast_plane is not None:
             # the forecast dispatch is decision work: count it in the
             # round's device latency budget so decisions/sec and the

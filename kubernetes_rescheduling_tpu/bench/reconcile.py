@@ -130,11 +130,25 @@ class IntentLedger:
     like ``chaos_faults_total``).
     """
 
-    def __init__(self, cfg, *, registry=None, logger=None, tenant=None):
+    def __init__(
+        self, cfg, *, registry=None, logger=None, tenant=None,
+        adopt_observed=False,
+    ):
         self.cfg = cfg
         self.registry = registry
         self.logger = logger
         self.tenant = tenant
+        # advisory-backend mode (the shadow plane's replay backend): the
+        # snapshot stream IS ground truth — the recorded cluster's own
+        # scheduler moving pods is the baseline under study, not another
+        # actor drifting state. Every diff ADOPTS the observed placement
+        # (advisory intents resolve exactly as PR 10's affinityOnly rule)
+        # and no divergence is charged or repaired: charging the real
+        # scheduler as external_drift — and issuing "corrective" moves
+        # that the replay backend would dutifully record as shadow
+        # recommendations — would poison both the divergence metrics and
+        # the shadow ledger.
+        self.adopt_observed = adopt_observed
         self.intent: dict[str, str | None] = {}  # pod name -> node name
         self.pod_service: dict[str, str] = {}
         # moves since the last observe: pod -> {service, requested,
@@ -341,6 +355,12 @@ class IntentLedger:
             # DATA in a fresh object is undetectable here by
             # construction — that is what the debounce and the repair
             # loop's convergence absorb.)
+            return {"divergences": []}
+
+        if self.adopt_observed:
+            # advisory backend: observed IS intent (see __init__) — one
+            # wholesale rebase, no classification, no repairs
+            self.rebase(state, service_names=service_names)
             return {"divergences": []}
 
         if host_arrays is not None:

@@ -1,0 +1,332 @@
+"""Shadow plane: score our recommendations against the real scheduler.
+
+In shadow mode (``config.shadow`` / ``--shadow``) the normal decide
+kernels run on each admitted REAL snapshot (a replayed trace window) and
+their moves land in a shadow ledger instead of the cluster
+(``backends.replay``). This module is the scoring half: a device-side
+**counterfactual twin** — the admitted snapshot's loads/capacities with
+``pod_node`` replaced by OUR cumulative placement (the trace's recorded
+placement plus every recommendation issued so far) — evaluated by the
+SAME compiled ``controller_round_end`` kernel the round already
+dispatches, with the result riding the round's ONE ``round_end``
+transfer (the PR-9 discipline: shadow scoring adds a device piece to the
+existing ``RoundCloser``, never a second pull).
+
+Per scored round the record grows a ``shadow`` block: comm-cost/load-std
+for the actual and counterfactual placements, the delta, the running
+win-rate, and — when attribution is on — the twin's full attribution
+record (sum-consistent by construction: the same kernel that makes the
+actual attribution consistent) plus per-edge deltas naming WHERE we beat
+the real scheduler. Gauges ``shadow_win_rate``/``shadow_cost_delta`` and
+the per-outcome ``shadow_rounds_total`` counter publish the head-to-head
+live; the watchdog's ``shadow_win_rate`` rule (``ObsConfig.
+slo_shadow_min_win_rate``) makes a losing shadow run a visible SLO.
+
+Host-side identity is name-keyed (pods shift index between windows);
+host arrays come from the admission guard's already-pulled copies — the
+plane pays no device→host transfer of its own.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetes_rescheduling_tpu.bench.round_end import (
+    METRIC_COST,
+    METRIC_HEAD,
+    METRIC_LOAD_STD,
+    dispatch_round_end,
+    fence,
+)
+from kubernetes_rescheduling_tpu.core.state import UNASSIGNED
+from kubernetes_rescheduling_tpu.elastic.buckets import device_graph, device_view
+from kubernetes_rescheduling_tpu.telemetry import attribution as attribution_mod
+from kubernetes_rescheduling_tpu.telemetry.registry import get_registry
+
+# edges reported in the per-round delta table (where we beat / lose)
+_DELTA_EDGES = 8
+
+
+class ShadowPlane:
+    """Counterfactual twin + head-to-head accounting (one per run)."""
+
+    def __init__(self, cfg, *, registry=None, logger=None) -> None:
+        self.cfg = cfg
+        self.registry = registry
+        self.logger = logger
+        # OUR cumulative placement: pod name -> node name (None =
+        # unscheduled). Pods the controller never moved track the
+        # observed (recorded) placement — the honest counterfactual:
+        # only our recommendations diverge from reality. ``_owned`` is
+        # the set of pod names a recommendation ever re-homed; only
+        # those keep our node through realignment (a recorded scheduler
+        # reshuffling pods we never touched happens in our world too).
+        self.twin: dict[str, str | None] = {}
+        self._owned: set[str] = set()
+        self.wins = 0
+        self.scored = 0
+        self.ledger: list[dict] = []  # per-round shadow blocks, in order
+        self._svc_index_memo: tuple[tuple, dict] | None = None
+
+    # ---- bookkeeping ----
+
+    def _reg(self):
+        return self.registry if self.registry is not None else get_registry()
+
+    def _svc_index(self, graph) -> dict[str, int]:
+        memo = self._svc_index_memo
+        if memo is None or memo[0] is not graph.names:
+            memo = (graph.names, {n: i for i, n in enumerate(graph.names)})
+            self._svc_index_memo = memo
+        return memo[1]
+
+    @staticmethod
+    def _observed(state, arrays) -> dict[str, str | None]:
+        """pod name -> node name from one admitted snapshot — THE
+        ledger's decode (``IntentLedger._observed``), shared so the
+        reconcile plane's and the twin's views of 'observed placement'
+        can never drift apart. ``arrays`` is the guard's already-pulled
+        host dict (``fence`` fallback for a guard-less caller — one
+        batched read, the designated idiom)."""
+        from kubernetes_rescheduling_tpu.bench.reconcile import IntentLedger
+
+        if arrays is None:
+            arrays = dict(
+                zip(
+                    ("pod_valid", "pod_node", "pod_service"),
+                    fence((state.pod_valid, state.pod_node, state.pod_service)),
+                )
+            )
+        obs, _svc_of = IntentLedger._observed(
+            state,
+            (),
+            arrays=(
+                arrays["pod_valid"], arrays["pod_node"], arrays["pod_service"]
+            ),
+        )
+        return obs
+
+    def bind(self, state, graph, arrays=None) -> None:
+        """Startup baseline: twin := the first admitted snapshot's
+        recorded placement (we diverge only by recommending)."""
+        self.twin = self._observed(state, arrays)
+
+    # ---- per-round step ----
+
+    def observe_round(
+        self, rnd, record, state, graph, closer, *, arrays, fresh, top_k
+    ) -> None:
+        """Fold this round's recommendations into the twin and (on fresh
+        rounds) defer the counterfactual scoring onto the round closer.
+
+        Called from ``begin_close`` AFTER the actual metrics piece is
+        attached: decode order inside the single flush guarantees
+        ``record.communication_cost`` is set before the shadow decode
+        compares against it.
+        """
+        svc_index = self._svc_index(graph)
+        if arrays is None:
+            # guard-less caller: one batched read, the designated idiom
+            arrays = dict(
+                zip(
+                    ("pod_valid", "pod_node", "pod_service", "node_valid"),
+                    fence(
+                        (
+                            state.pod_valid,
+                            state.pod_node,
+                            state.pod_service,
+                            state.node_valid,
+                        )
+                    ),
+                )
+            )
+        if not fresh:
+            # degraded round: no admitted snapshot to realign or score
+            # against — recommendations still accumulate on the twin,
+            # keyed by the carried snapshot's (unchanged) pod table
+            for service, landed in record.applied_moves:
+                self._rehome(state, arrays, svc_index, service, landed)
+            return
+
+        if not bool(np.asarray(arrays["pod_valid"]).any()):
+            # a pods-free window (machine-events-only stretch of a real
+            # corpus): both placements cost 0 by vacuity — scoring it
+            # would credit a free "win" and inflate shadow_win_rate /
+            # the SLO input. Recommendations cannot exist either (no
+            # pods to move); skip the round entirely.
+            return
+
+        obs = self._observed(state, arrays)
+        # realign to this window's pod table: new and never-re-homed
+        # pods track the recorded placement (the real scheduler's moves
+        # on pods we never touched happen in our world too), vanished
+        # pods drop, and only pods a recommendation re-homed keep our
+        # node — the counterfactual diverges by OUR moves alone. A
+        # recommended node that since DIED in the trace releases
+        # ownership: in our world it died too, and the recorded
+        # re-placement is the honest stand-in for the rescheduling any
+        # scheduler must then perform — scoring pods on a dead node
+        # would credit physically infeasible placements.
+        nv = arrays.get("node_valid")
+        if nv is None:
+            nv = fence(state.node_valid)
+        alive = {
+            state.node_names[i]
+            for i in np.flatnonzero(np.asarray(nv)).tolist()
+            if i < len(state.node_names)
+        }
+
+        def twin_node(name: str, observed_node: str | None) -> str | None:
+            if name in self._owned:
+                ours = self.twin.get(name, observed_node)
+                if ours is None or ours in alive:
+                    return ours
+                self._owned.discard(name)
+            return observed_node
+
+        self.twin = {
+            name: twin_node(name, node) for name, node in obs.items()
+        }
+        for service, landed in record.applied_moves:
+            self._rehome(state, arrays, svc_index, service, landed)
+
+        # the counterfactual twin: this snapshot's loads under OUR
+        # cumulative placement — same arrays, pod_node swapped
+        import jax.numpy as jnp
+
+        pv = np.asarray(arrays["pod_valid"])
+        node_index = {n: i for i, n in enumerate(state.node_names)}
+        twin_arr = np.array(np.asarray(arrays["pod_node"]))
+        pod_names = state.pod_names
+        for i in np.flatnonzero(pv).tolist():
+            if i >= len(pod_names):
+                continue
+            target = self.twin.get(pod_names[i])
+            ti = node_index.get(target) if target is not None else None
+            twin_arr[i] = ti if ti is not None else UNASSIGNED
+        twin_state = state.replace(pod_node=jnp.asarray(twin_arr))
+        dev = dispatch_round_end(
+            device_view(twin_state), device_graph(graph), top_k=top_k
+        )
+        ctx = {
+            "node_names": state.node_names,
+            "svc_names": graph.names,
+            "num_nodes": state.num_nodes,
+            "num_services": graph.num_services,
+        }
+        closer.defer(dev, lambda flat: self._score(rnd, record, ctx, top_k, flat))
+
+    def _rehome(self, state, arrays, svc_index, service, landed) -> None:
+        """Apply one service-unit recommendation to the twin: every
+        valid pod of the service moves to the recommended node."""
+        si = svc_index.get(service)
+        if si is None or arrays is None:
+            return
+        pv = np.asarray(arrays["pod_valid"])
+        ps = np.asarray(arrays["pod_service"])
+        pod_names = state.pod_names
+        for i in np.flatnonzero(pv & (ps == si)).tolist():
+            if i < len(pod_names):
+                self.twin[pod_names[i]] = landed
+                self._owned.add(pod_names[i])
+
+    # ---- the flush-time decode ----
+
+    def _score(self, rnd, record, ctx, top_k, flat) -> None:
+        cost_shadow = float(flat[METRIC_COST])
+        lstd_shadow = float(flat[METRIC_LOAD_STD])
+        cost_actual = float(record.communication_cost)
+        lstd_actual = float(record.load_std)
+        delta = cost_actual - cost_shadow
+        eps = 1e-6 * max(1.0, abs(cost_actual))
+        win = cost_shadow <= cost_actual * (1.0 - self.cfg.win_margin) + eps
+        self.scored += 1
+        if win:
+            self.wins += 1
+        win_rate = self.wins / self.scored
+
+        block: dict = {
+            "round": rnd,
+            "recommended": len(record.applied_moves),
+            "cost_actual": cost_actual,
+            "cost_shadow": cost_shadow,
+            "cost_delta": delta,
+            "load_std_actual": lstd_actual,
+            "load_std_shadow": lstd_shadow,
+            "win": bool(win),
+            "wins": self.wins,
+            "scored": self.scored,
+            "win_rate": win_rate,
+        }
+        if top_k > 0:
+            attr = attribution_mod.decode_attribution(
+                flat[METRIC_HEAD:],
+                node_names=ctx["node_names"],
+                service_names=ctx["svc_names"],
+                top_k=top_k,
+                num_nodes=ctx["num_nodes"],
+                num_services=ctx["num_services"],
+            )
+            block["attribution"] = attr
+            actual_attr = record.attribution
+            if isinstance(actual_attr, dict):
+                block["edges_delta"] = _edge_deltas(actual_attr, attr)
+        record.shadow = block
+        self.ledger.append(block)
+
+        reg = self._reg()
+        reg.gauge(
+            "shadow_win_rate",
+            "fraction of scored shadow rounds where the counterfactual "
+            "placement's communication cost was at or below the real "
+            "scheduler's (running, this run)",
+        ).set(win_rate)
+        reg.gauge(
+            "shadow_cost_delta",
+            "actual minus counterfactual communication cost of the most "
+            "recent scored shadow round (positive = we beat the real "
+            "scheduler)",
+        ).set(delta)
+        reg.counter(
+            "shadow_rounds_total",
+            "scored shadow rounds by head-to-head outcome against the "
+            "trace's actual scheduler",
+            labelnames=("outcome",),
+        ).labels(outcome="win" if win else "loss").inc()
+        if self.logger is not None:
+            self.logger.info(
+                "shadow_round",
+                round=rnd,
+                cost_actual=cost_actual,
+                cost_shadow=cost_shadow,
+                cost_delta=delta,
+                win=bool(win),
+                win_rate=win_rate,
+            )
+
+def _edge_deltas(actual: dict, shadow: dict) -> list[dict]:
+    """Per-service-edge head-to-head: actual minus counterfactual cost
+    for every edge either attribution recorded, best-for-us first. Only
+    edges in a top-k are visible — the tail is already carried in each
+    attribution's sum-consistent ``tail``."""
+
+    def by_pair(attr: dict) -> dict[tuple[str, str], float]:
+        out: dict[tuple[str, str], float] = {}
+        for e in attr.get("edges") or ():
+            key = (e.get("src_service"), e.get("dst_service"))
+            out[key] = out.get(key, 0.0) + float(e.get("cost", 0.0))
+        return out
+
+    a, s = by_pair(actual), by_pair(shadow)
+    rows = [
+        {
+            "src_service": src,
+            "dst_service": dst,
+            "actual": a.get((src, dst), 0.0),
+            "shadow": s.get((src, dst), 0.0),
+            "delta": a.get((src, dst), 0.0) - s.get((src, dst), 0.0),
+        }
+        for src, dst in set(a) | set(s)
+    ]
+    rows.sort(key=lambda r: r["delta"], reverse=True)
+    return rows[:_DELTA_EDGES]

@@ -37,16 +37,46 @@ class TraceStep:
     weights: dict[tuple[str, str], float] = field(default_factory=dict)
 
 
-def with_weights(graph: CommGraph, updates: dict[tuple[str, str], float]) -> CommGraph:
-    """New CommGraph with the given symmetric edge weights applied."""
+def with_weights(
+    graph: CommGraph,
+    updates: dict[tuple[str, str], float],
+    *,
+    registry=None,
+    logger=None,
+) -> CommGraph:
+    """New CommGraph with the given symmetric edge weights applied.
+
+    Updates naming a service the graph does not know are DROPPED — but
+    never silently: each is counted (``trace_unknown_refs_total``) and
+    the batch logs one structured ``swallowed_ref`` event, so a
+    malformed trace reads as a visible stream of swallowed updates
+    instead of an inexplicably static replay."""
     adj = np.asarray(graph.adj).copy()
     index = {n: i for i, n in enumerate(graph.names)}
+    swallowed: list[tuple[str, str]] = []
     for (a, b), w in updates.items():
         if a not in index or b not in index:
+            swallowed.append((a, b))
             continue
         i, j = index[a], index[b]
         adj[i, j] = w
         adj[j, i] = w
+    if swallowed:
+        from kubernetes_rescheduling_tpu.telemetry.registry import get_registry
+        from kubernetes_rescheduling_tpu.utils.logging import get_logger
+
+        reg = registry if registry is not None else get_registry()
+        reg.counter(
+            "trace_unknown_refs_total",
+            "streaming-trace weight updates dropped because a service "
+            "name is not in the comm graph (a malformed trace stays "
+            "visible, never a silent no-op)",
+        ).inc(len(swallowed))
+        (logger if logger is not None else get_logger("trace")).warn(
+            "swallowed_ref",
+            dropped=len(swallowed),
+            refs=[f"{a}~{b}" for a, b in swallowed[:8]],
+        )
     import jax.numpy as jnp
 
     return graph.replace(adj=jnp.asarray(adj))

@@ -303,6 +303,23 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--fleet-chaos-tenants", default="", metavar="I,J,...",
                    help="tenant indices the --chaos-profile wraps (empty = "
                         "all tenants) — the per-tenant fault-isolation knob")
+    r.add_argument("--shadow", default=None, metavar="TRACE",
+                   help="shadow mode: replay a recorded cluster trace (a "
+                        "native ClusterTrace .jsonl file, or a directory "
+                        "of Alibaba-style machines/containers CSVs or "
+                        "Borg-style machine_events/task_usage CSVs), "
+                        "recommend moves WITHOUT applying any, and score "
+                        "our counterfactual placement against what the "
+                        "trace's actual scheduler did (render the "
+                        "head-to-head with `telemetry shadow rounds.jsonl`)")
+    r.add_argument("--shadow-format", default="auto",
+                   choices=["auto", "native", "alibaba", "borg"],
+                   help="force the --shadow trace layout (auto detects "
+                        "from the path's contents)")
+    r.add_argument("--shadow-win-margin", type=float, default=0.0,
+                   help="undercut a shadow round must achieve to count as "
+                        "a win: counterfactual cost <= actual * (1 - "
+                        "margin); 0 = ties count as wins")
     r.add_argument("--perf-ledger", default=None, metavar="PATH",
                    help="append this run's decisions/sec to the perf ledger "
                         "at PATH and judge it with the [perf] block's "
@@ -442,8 +459,12 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("paths", nargs="+",
                    help="artifact files (kind detected from record shape); "
                         "an optional leading mode word — 'report' "
-                        "(default), 'explain', 'bundle', 'perf', or "
-                        "'topo' — selects the rendering; 'perf' takes "
+                        "(default), 'explain', 'bundle', 'perf', 'topo', "
+                        "'dataset', or 'shadow' — selects the rendering; "
+                        "'shadow' takes rounds.jsonl files (or "
+                        "flight-recorder bundles) from a --shadow run and "
+                        "renders the head-to-head win-rate table against "
+                        "the trace's actual scheduler; 'perf' takes "
                         "perf-ledger JSONL files and/or historical "
                         "BENCH_r*.json / MULTICHIP_r*.json snapshots and "
                         "renders the trend table with "
@@ -511,15 +532,19 @@ def cmd_telemetry(args) -> str:
         report_explain,
         report_perf,
         report_topo,
-    )
+    )  # report_shadow resolves below, with the mode word
 
     mode, paths = "report", list(args.paths)
     if paths and paths[0] in (
-        "report", "explain", "bundle", "perf", "topo", "dataset"
+        "report", "explain", "bundle", "perf", "topo", "dataset", "shadow"
     ):
         mode, paths = paths[0], paths[1:]
     if not paths:
         raise SystemExit(f"telemetry {mode}: no artifact paths given")
+    if mode == "shadow":
+        from kubernetes_rescheduling_tpu.telemetry.report import report_shadow
+
+        return report_shadow(paths)
     if mode == "dataset":
         # forecast training windows from recorded soaks — the numpy-only
         # dataset module + oracle fitter (the forecast package resolves
@@ -731,9 +756,30 @@ def cmd_reschedule(args) -> dict:
         ElasticConfig,
         PerfConfig,
         RescheduleConfig,
+        ShadowConfig,
     )
 
     algo = _norm_algo(args.algorithm)
+    if args.shadow:
+        # config.validate() rejects the same compositions; surface them
+        # as clean CLI exits before any trace parsing
+        for flag, why in (
+            (args.fleet, "--fleet (no per-tenant counterfactual twin)"),
+            (args.backend == "k8s", "--backend k8s (the trace IS the cluster)"),
+            (args.churn_profile != "none",
+             "--churn-profile (the trace replays recorded churn)"),
+            (args.chaos_profile != "none",
+             "--chaos-profile (corrupting the replayed trace poisons "
+             "the head-to-head scores)"),
+            (args.imbalance,
+             "--imbalance (recorded state cannot be mutated)"),
+            (args.placement_unit == "pod",
+             "--placement-unit pod (shadow scoring is service-granular)"),
+            (args.no_admission,
+             "--no-admission (replayed snapshots must ride the guard)"),
+        ):
+            if flag:
+                raise SystemExit(f"--shadow is incompatible with {why}")
     if args.fleet:
         return cmd_fleet_reschedule(args, algo)
     if args.backend == "k8s" and args.churn_profile != "none":
@@ -751,7 +797,16 @@ def cmd_reschedule(args) -> dict:
             "--placement-unit pod requires the sim backend: the k8s "
             "Deployment mechanism cannot pin a single replica"
         )
-    if args.backend == "k8s":
+    if args.shadow:
+        from kubernetes_rescheduling_tpu.backends.replay import ReplayBackend
+        from kubernetes_rescheduling_tpu.traces.adapters import (
+            load_shadow_trace,
+        )
+
+        backend = ReplayBackend(
+            load_shadow_trace(args.shadow, fmt=args.shadow_format)
+        )
+    elif args.backend == "k8s":
         from kubernetes_rescheduling_tpu.backends.k8s import K8sBackend
         from kubernetes_rescheduling_tpu.core.workmodel import (
             Workmodel,
@@ -784,7 +839,7 @@ def cmd_reschedule(args) -> dict:
         solver_restarts=args.restarts,
         solver_tp=args.tp,
         seed=args.seed,
-        backend=args.backend,
+        backend="replay" if args.shadow else args.backend,
         chaos=ChaosConfig(profile=args.chaos_profile, seed=args.chaos_seed),
         elastic=ElasticConfig(
             profile=args.churn_profile, seed=args.churn_seed
@@ -793,6 +848,9 @@ def cmd_reschedule(args) -> dict:
         forecast=_forecast_config(args),
         controller=_pipeline_config(args),
         reconcile=_reconcile_config(args),
+        shadow=ShadowConfig(
+            enabled=bool(args.shadow), win_margin=args.shadow_win_margin
+        ),
         perf=PerfConfig(ledger_path=args.perf_ledger),
     )
     ops, logger = _build_ops_plane(args, cfg)
@@ -817,6 +875,19 @@ def cmd_reschedule(args) -> dict:
     }
     if perf is not None:
         out["perf"] = perf
+    if args.shadow:
+        blocks = [r.shadow for r in result.rounds if r.shadow]
+        deltas = [b["cost_delta"] for b in blocks]
+        out["shadow"] = {
+            "trace": args.shadow,
+            "recommendations": len(backend.recommendations),
+            "scored_rounds": len(blocks),
+            "wins": sum(1 for b in blocks if b.get("win")),
+            "win_rate": blocks[-1]["win_rate"] if blocks else None,
+            "mean_cost_delta": (
+                sum(deltas) / len(deltas) if deltas else None
+            ),
+        }
     return out
 
 

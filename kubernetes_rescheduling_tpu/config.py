@@ -256,6 +256,33 @@ class ReconcileConfig:
 
 
 @dataclass(frozen=True)
+class ShadowConfig:
+    """Shadow-mode block (``[shadow]`` in TOML): replay a recorded
+    real-cluster trace, recommend moves without applying any, and score
+    our counterfactual placement against what the trace's actual
+    scheduler did (``backends.replay`` + ``bench.shadow``). jax-free,
+    like the other blocks, so config import stays light.
+
+    ``enabled`` turns the plane on; the run must use the replay backend
+    (the CLI's ``--shadow TRACE`` builds both together). ``win_margin``
+    is the undercut a round must achieve to count as a win: our
+    counterfactual comm cost must be at or below
+    ``actual · (1 − win_margin)`` — 0 means ties count (matching the
+    production scheduler at zero risk is a win)."""
+
+    enabled: bool = False
+    win_margin: float = 0.0
+
+    def validate(self) -> "ShadowConfig":
+        if not (0.0 <= self.win_margin < 1.0):
+            raise ValueError(
+                f"shadow win_margin must be in [0, 1) (a fraction of the "
+                f"actual cost to undercut), got {self.win_margin}"
+            )
+        return self
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Fault-injection block: which named ``backends.chaos`` profile wraps
     the loop's backend (``"none"`` = no wrapper), under which fault seed.
@@ -352,6 +379,14 @@ class ObsConfig:
                                            # persistent drift; only rounds
                                            # carrying reconcile data are
                                            # judged)
+    slo_shadow_min_win_rate: float = 0.0   # shadow_win_rate SLO rule: a
+                                           # shadow run whose running
+                                           # win-rate against the trace's
+                                           # actual scheduler sits below
+                                           # this is in violation (0 =
+                                           # off; only rounds carrying
+                                           # shadow data are judged, so
+                                           # live runs never trip it)
 
     def validate(self) -> "ObsConfig":
         if self.serve_port is not None and not (0 <= self.serve_port <= 65535):
@@ -388,6 +423,11 @@ class ObsConfig:
             raise ValueError(
                 "slo_reconcile_drift_pods must be >= 0 (0 disables the "
                 "reconcile_divergence rule)"
+            )
+        if not (0.0 <= self.slo_shadow_min_win_rate <= 1.0):
+            raise ValueError(
+                "slo_shadow_min_win_rate must be in [0, 1] (a win-rate "
+                "fraction; 0 disables the shadow_win_rate rule)"
             )
         return self
 
@@ -500,6 +540,11 @@ class RescheduleConfig:
     # ledger with rate-limited corrective moves — see ReconcileConfig.
     reconcile: ReconcileConfig = field(default_factory=ReconcileConfig)
 
+    # Shadow mode: replay a recorded real-cluster trace, recommend
+    # without applying, score against the trace's actual scheduler —
+    # see ShadowConfig.
+    shadow: ShadowConfig = field(default_factory=ShadowConfig)
+
     # Fleet mode: N tenants multiplexed over one device plane — see
     # FleetConfig. With tenants > 0 the `chaos` block above applies only
     # to the tenant indices in fleet.chaos_tenants.
@@ -597,6 +642,43 @@ class RescheduleConfig:
         self.obs.validate()
         self.perf.validate()
         self.reconcile.validate()
+        self.shadow.validate()
+        if self.shadow.enabled:
+            # shadow is the solo greedy/global loop over replayed real
+            # snapshots — the planes it cannot compose with must reject
+            # loudly rather than silently score nonsense
+            if self.fleet.tenants > 0:
+                raise ValueError(
+                    "shadow mode is a solo-loop plane: fleet multiplexing "
+                    "has no per-tenant counterfactual twin yet"
+                )
+            if self.elastic.profile != "none":
+                raise ValueError(
+                    "shadow mode replays RECORDED churn: the synthetic "
+                    "churn engine cannot compose with a trace-driven "
+                    "cluster"
+                )
+            if self.chaos.profile != "none":
+                raise ValueError(
+                    "shadow mode cannot compose with chaos injection: "
+                    "corrupting the replayed trace poisons the very "
+                    "head-to-head scores the plane exists to produce "
+                    "(and stale re-serves break the replay backend's "
+                    "fresh-snapshot contract)"
+                )
+            if self.placement_unit != "service":
+                raise ValueError(
+                    "shadow scoring re-homes whole services "
+                    "(applied_moves is service-granular); "
+                    "placement_unit='pod' is not supported in shadow mode"
+                )
+            if not self.reconcile.admission:
+                raise ValueError(
+                    "shadow mode requires the admission guard: replayed "
+                    "real-world snapshots are exactly the untrusted "
+                    "input it quarantines (and the shadow plane reuses "
+                    "its pulled host arrays)"
+                )
         self.fleet.validate()
         if self.fleet.tenants > 0:
             # the batched fleet kernel is the GREEDY decision vmapped over
@@ -641,6 +723,8 @@ class RescheduleConfig:
             data["chaos"] = ChaosConfig(**data["chaos"])
         if isinstance(data.get("reconcile"), dict):
             data["reconcile"] = ReconcileConfig(**data["reconcile"])
+        if isinstance(data.get("shadow"), dict):
+            data["shadow"] = ShadowConfig(**data["shadow"])
         if isinstance(data.get("fleet"), dict):
             fl = dict(data["fleet"])
             if isinstance(fl.get("chaos_tenants"), list):

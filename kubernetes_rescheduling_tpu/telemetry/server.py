@@ -296,6 +296,9 @@ class OpsPlane:
                 reconcile_max_drift_pods=getattr(
                     obs, "slo_reconcile_drift_pods", 0
                 ),
+                shadow_min_win_rate=getattr(
+                    obs, "slo_shadow_min_win_rate", 0.0
+                ),
             ),
             registry=registry,
             logger=logger,

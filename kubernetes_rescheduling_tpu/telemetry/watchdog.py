@@ -58,6 +58,7 @@ RULE_ATTRIBUTION = "attribution_drift"
 RULE_FORECAST = "forecast_skill"
 RULE_PIPELINE = "pipeline_overlap"
 RULE_RECONCILE = "reconcile_divergence"
+RULE_SHADOW = "shadow_win_rate"
 
 
 @dataclass(frozen=True)
@@ -100,6 +101,13 @@ class SLORules:
     # drift; only rounds carrying reconcile data are judged, so runs with
     # the plane off can never trip it).
     reconcile_max_drift_pods: int = 0
+    # shadow win-rate: the latest scored shadow round's RUNNING win-rate
+    # against the replayed trace's actual scheduler sitting below this
+    # means the shadow run is losing the head-to-head — promoting these
+    # recommendations to a live cluster would make placement worse (0
+    # disables; only rounds carrying shadow data are judged, so live
+    # runs can never trip it; min_samples scored rounds before judging).
+    shadow_min_win_rate: float = 0.0
 
     def validate(self) -> "SLORules":
         if self.window < 2:
@@ -127,6 +135,11 @@ class SLORules:
             raise ValueError(
                 "reconcile_max_drift_pods must be >= 0 (0 disables the "
                 "reconcile_divergence rule)"
+            )
+        if not (0.0 <= self.shadow_min_win_rate <= 1.0):
+            raise ValueError(
+                "shadow_min_win_rate must be in [0, 1] (a win-rate "
+                "fraction; 0 disables the shadow_win_rate rule)"
             )
         return self
 
@@ -169,6 +182,7 @@ class Watchdog:
         # tenants key their name): the rule judges the worst source, so
         # one tenant's convergence can never mask another's drift
         self._reconcile: dict[str | None, dict[str, Any]] = {}
+        self._shadow: dict[str, Any] | None = None  # latest shadow block
         # pipelined rounds' overlap ratios (rolling window)
         self._overlap: collections.deque[float] = collections.deque(
             maxlen=self.rules.window
@@ -193,6 +207,7 @@ class Watchdog:
         self._attr = None
         self._forecast = None
         self._reconcile = {}
+        self._shadow = None
         self._overlap.clear()
         self.active = (
             {RULE_PERF: self.active[RULE_PERF]}
@@ -220,6 +235,9 @@ class Watchdog:
         reconcile = getattr(record, "reconcile", None)
         if isinstance(reconcile, dict):
             self._reconcile[tenant] = reconcile
+        shadow = getattr(record, "shadow", None)
+        if isinstance(shadow, dict):
+            self._shadow = shadow
         pipeline = getattr(record, "pipeline", None)
         if isinstance(pipeline, dict) and "overlap_ratio" in pipeline:
             self._overlap.append(float(pipeline["overlap_ratio"]))
@@ -362,6 +380,22 @@ class Watchdog:
                     "divergences": len(worst.get("divergences") or ()),
                     "repairs_issued": len(worst.get("repairs") or ()),
                     **({"tenant": tenant} if tenant is not None else {}),
+                }
+        if (
+            r.shadow_min_win_rate > 0
+            and self._shadow is not None
+            and int(self._shadow.get("scored") or 0) >= r.min_samples
+        ):
+            # the latest scored round's RUNNING win-rate judges: a
+            # shadow run losing the head-to-head means promoting these
+            # recommendations would make real placement worse
+            win_rate = float(self._shadow.get("win_rate") or 0.0)
+            if win_rate < r.shadow_min_win_rate:
+                now[RULE_SHADOW] = {
+                    "win_rate": win_rate,
+                    "threshold": r.shadow_min_win_rate,
+                    "scored": int(self._shadow.get("scored") or 0),
+                    "cost_delta": self._shadow.get("cost_delta"),
                 }
         if self._perf_active:
             now[RULE_PERF] = {

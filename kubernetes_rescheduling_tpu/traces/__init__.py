@@ -1,0 +1,44 @@
+"""Trace corpus: recorded real-cluster data as a first-class input.
+
+Everything the repo measured before this package came from its own
+simulator. The corpus layer defines ONE normalized on-disk form — the
+``ClusterTrace`` JSONL schema (``traces.corpus``) — plus adapters from
+the public cluster-trace layouts (Alibaba cluster-trace-style and
+Borg-ClusterData-style CSVs, ``traces.adapters``) and a converter from
+our own recorded ``rounds.jsonl`` soaks. ``backends.replay.ReplayBackend``
+serves a loaded trace through the standard ``Backend`` surface so the
+unchanged control loop can run against recorded production data in
+shadow mode (``bench.shadow``): recommend, never apply, score against
+what the real scheduler actually did.
+
+jax-free at module level (the corpus builds host-side numpy; states
+convert at ``ClusterState.build``), like the telemetry package.
+"""
+
+from kubernetes_rescheduling_tpu.traces.corpus import (
+    ClusterTrace,
+    TraceWindow,
+    dump_trace_jsonl,
+    load_trace_jsonl,
+    parse_records,
+    window_state,
+)
+from kubernetes_rescheduling_tpu.traces.adapters import (
+    load_alibaba_csv,
+    load_borg_csv,
+    load_shadow_trace,
+    rounds_to_trace,
+)
+
+__all__ = [
+    "ClusterTrace",
+    "TraceWindow",
+    "dump_trace_jsonl",
+    "load_trace_jsonl",
+    "parse_records",
+    "window_state",
+    "load_alibaba_csv",
+    "load_borg_csv",
+    "load_shadow_trace",
+    "rounds_to_trace",
+]

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Static check: checked-in fixture traces satisfy the ClusterTrace schema.
+
+The corpus loader (``traces/corpus.py``) is deliberately lenient — a
+malformed row is quarantined and counted, never an error — so a drifted
+fixture would silently shrink the replay corpus instead of failing
+loudly. This checker is the loud half (the ``check_bench_schema.py``
+convention): every ``*.trace.jsonl`` fixture must parse with ZERO
+quarantined rows and satisfy the schema's structural contracts.
+
+Enforced per file:
+
+- every row parses as a JSON object with a known ``kind``
+  (``node`` | ``pod`` | ``edge`` | ``placement``) and its identity
+  fields present (the corpus loader's quarantine reasons, promoted to
+  errors for checked-in fixtures);
+- timestamps are finite and monotone non-decreasing across the file;
+- every numeric value field (``cpu_cap_m``/``mem_cap_b``/
+  ``cpu_used_m``/``mem_used_b``/``cpu_m``/``mem_b``/``w``) is finite —
+  checked-in fixtures model dirty data only in files deliberately named
+  OUTSIDE the ``*.trace.jsonl`` glob (e.g. ``corrupt_trace.jsonl``);
+- every pod's ``node`` reference (when non-null) names a declared node;
+- at least one window exists.
+
+Usage:
+    python scripts/check_trace_schema.py [FILE.trace.jsonl ...]
+
+With no arguments it checks every ``*.trace.jsonl`` under
+``tests/fixtures/`` — the self-check its test twin
+(tests/test_trace_schema.py) runs, alongside pinned corruption classes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = ROOT / "tests" / "fixtures"
+
+KINDS = ("node", "pod", "edge", "placement")
+REQUIRED = {
+    "node": ("node",),
+    "pod": ("pod", "service"),
+    "edge": ("a", "b"),
+    "placement": ("pod", "node"),
+}
+VALUE_FIELDS = (
+    "cpu_cap_m", "mem_cap_b", "cpu_used_m", "mem_used_b",
+    "cpu_m", "mem_b", "w",
+)
+
+
+def check_file(path: str | Path) -> list[str]:
+    """Violations in one fixture trace (empty = clean)."""
+    p = Path(path)
+    try:
+        lines = p.read_text().splitlines()
+    except OSError as e:
+        return [f"{p.name}: unreadable ({e})"]
+    out: list[str] = []
+    last_t: float | None = None
+    declared_nodes: set[str] = set()
+    windows = 0
+    for i, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            out.append(f"{p.name}:{i}: broken JSON")
+            continue
+        if not isinstance(rec, dict):
+            out.append(f"{p.name}:{i}: not a JSON object")
+            continue
+        kind = rec.get("kind")
+        if kind not in KINDS:
+            out.append(f"{p.name}:{i}: unknown kind {kind!r}")
+            continue
+        # absent/empty, NOT falsy: integer-id corpora use 0 legitimately
+        missing = [
+            f
+            for f in REQUIRED[kind]
+            if rec.get(f) is None or rec.get(f) == ""
+        ]
+        if missing:
+            out.append(
+                f"{p.name}:{i}: {kind} record missing {', '.join(missing)}"
+            )
+            continue
+        try:
+            t = float(rec.get("t", 0.0))
+        except (TypeError, ValueError):
+            out.append(f"{p.name}:{i}: non-numeric timestamp")
+            continue
+        if not math.isfinite(t):
+            out.append(f"{p.name}:{i}: non-finite timestamp")
+            continue
+        if last_t is not None and t < last_t:
+            out.append(
+                f"{p.name}:{i}: timestamp {t} < previous {last_t} "
+                f"(must be monotone non-decreasing)"
+            )
+        if last_t is None or t != last_t:
+            windows += 1
+        last_t = t
+        for f in VALUE_FIELDS:
+            if f in rec:
+                v = rec[f]
+                if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                        or not math.isfinite(float(v)):
+                    out.append(
+                        f"{p.name}:{i}: non-finite value field {f}={v!r}"
+                    )
+        if kind == "node":
+            declared_nodes.add(rec["node"])
+        elif kind == "pod" and rec.get("node") is not None:
+            if rec["node"] not in declared_nodes:
+                out.append(
+                    f"{p.name}:{i}: pod references undeclared node "
+                    f"{rec['node']!r}"
+                )
+    if windows == 0:
+        out.append(f"{p.name}: no snapshot windows (empty trace)")
+    return out
+
+
+def violations(paths=None) -> list[str]:
+    if paths is None:
+        paths = sorted(FIXTURES.rglob("*.trace.jsonl"))
+        if not paths:
+            return ["no *.trace.jsonl fixtures found under tests/fixtures/"]
+    out: list[str] = []
+    for p in paths:
+        out.extend(check_file(p))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    bad = violations(argv or None)
+    if bad:
+        sys.stderr.write(
+            "trace fixture schema drift — the corpus loader would "
+            "silently quarantine these rows:\n"
+            + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -421,6 +421,12 @@ def test_controller_attribution_soak_acceptance(registry, tmp_path):
     assert "INCONSISTENT" not in text
 
 
+@pytest.mark.slow  # heavy global-solve variant: attribution consistency
+# + move provenance stay pinned fast by
+# test_controller_attribution_soak_acceptance above (greedy rounds, same
+# invariants incl. delta telescoping), and global-round candidate/gain
+# consistency by
+# test_observability.test_global_round_explanation_scores_match_wave_selection
 def test_global_round_attribution_and_provenance(registry):
     logger = StructuredLogger(name="t")
     cfg = RescheduleConfig(

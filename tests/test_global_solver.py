@@ -82,6 +82,10 @@ def test_beats_greedy_car():
     assert global_cost <= greedy_cost
 
 
+@pytest.mark.slow  # heavy dense-mesh scenario variant: capacity stays
+# pinned fast by test_respects_capacity above (no-new-violation from an
+# imbalanced pile) and by the sharded capacity run in
+# test_parallel.test_sharded_global_assign_with_capacity_and_noise
 def test_capacity_frac_breaks_up_dense_pile():
     """On a dense mesh the comm objective prefers total colocation at any
     moderate lambda, leaving a piled-up node saturated; a packing budget

@@ -30,9 +30,51 @@ def test_with_weights_symmetric():
     j = g.names.index("reviews-v1")
     assert float(g2.adj[i, j]) == 0.25
     assert float(g2.adj[j, i]) == 0.25
-    # unknown names silently ignored
+    # unknown names leave the adjacency untouched
     g3 = with_weights(g, {("nope", "ratings"): 5.0})
     np.testing.assert_array_equal(np.asarray(g3.adj), np.asarray(g.adj))
+
+
+def test_with_weights_counts_swallowed_refs():
+    """A malformed trace is visible, never a silent no-op: dropped
+    updates count in trace_unknown_refs_total and emit one structured
+    swallowed_ref event per batch."""
+    from kubernetes_rescheduling_tpu.telemetry.registry import (
+        MetricsRegistry,
+        set_registry,
+    )
+    from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+
+    wm = bookinfo_workmodel()
+    g = wm.comm_graph()
+    prev = set_registry(MetricsRegistry())
+    try:
+        from kubernetes_rescheduling_tpu.telemetry.registry import get_registry
+
+        logger = StructuredLogger(name="t")
+        g2 = with_weights(
+            g,
+            {
+                ("nope", "ratings"): 5.0,
+                ("details", "ghost"): 2.0,
+                ("productpage", "details"): 0.5,  # known: applied
+            },
+            logger=logger,
+        )
+        i = g.names.index("productpage")
+        j = g.names.index("details")
+        assert float(g2.adj[i, j]) == 0.5
+        counts = {
+            rec["metric"]: rec.get("value")
+            for rec in get_registry().snapshot()
+        }
+        assert counts.get("trace_unknown_refs_total") == 2
+        events = [r for r in logger.records if r["event"] == "swallowed_ref"]
+        assert len(events) == 1
+        assert events[0]["dropped"] == 2
+        assert "nope~ratings" in events[0]["refs"]
+    finally:
+        set_registry(prev)
 
 
 def test_canary_trace_shifts_traffic():

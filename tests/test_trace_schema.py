@@ -1,0 +1,103 @@
+"""CI twin of ``scripts/check_trace_schema.py``: the checked-in fixture
+traces satisfy the ClusterTrace JSONL schema (finite values, monotone
+timestamps, known record kinds, declared node references), and the
+checker flags every pinned corruption class — the loud half of the
+corpus loader's deliberate leniency (``check_bench_schema.py``
+convention, including the no-args self-check)."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+
+def _load_checker():
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_trace_schema.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_trace_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_trace_schema", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_checked_in_fixtures_are_clean():
+    checker = _load_checker()
+    assert checker.violations() == []
+
+
+def _write(tmp_path, rows):
+    p = tmp_path / "t.trace.jsonl"
+    p.write_text(
+        "\n".join(r if isinstance(r, str) else json.dumps(r) for r in rows)
+        + "\n"
+    )
+    return p
+
+
+_NODE = {"kind": "node", "t": 0.0, "node": "n1", "cpu_cap_m": 1000.0}
+
+
+def test_checker_flags_non_monotone_timestamps(tmp_path):
+    checker = _load_checker()
+    p = _write(
+        tmp_path,
+        [
+            _NODE,
+            {"kind": "pod", "t": 5.0, "pod": "p", "service": "s", "node": "n1"},
+            {"kind": "pod", "t": 1.0, "pod": "q", "service": "s", "node": "n1"},
+        ],
+    )
+    assert any("monotone" in v for v in checker.check_file(p))
+
+
+def test_checker_flags_non_finite_values(tmp_path):
+    checker = _load_checker()
+    p = _write(
+        tmp_path,
+        [
+            _NODE,
+            {"kind": "pod", "t": 0.0, "pod": "p", "service": "s",
+             "node": "n1", "cpu_m": float("nan")},
+        ],
+    )
+    assert any("non-finite value" in v for v in checker.check_file(p))
+
+
+def test_checker_flags_unknown_kind_and_missing_fields(tmp_path):
+    checker = _load_checker()
+    p = _write(
+        tmp_path,
+        [
+            _NODE,
+            {"kind": "teleport", "t": 0.0},
+            {"kind": "pod", "t": 0.0, "pod": "p"},
+            "{broken",
+        ],
+    )
+    bad = checker.check_file(p)
+    assert any("unknown kind" in v for v in bad)
+    assert any("missing" in v for v in bad)
+    assert any("broken JSON" in v for v in bad)
+
+
+def test_checker_flags_undeclared_node_reference(tmp_path):
+    checker = _load_checker()
+    p = _write(
+        tmp_path,
+        [
+            _NODE,
+            {"kind": "pod", "t": 0.0, "pod": "p", "service": "s",
+             "node": "ghost"},
+        ],
+    )
+    assert any("undeclared node" in v for v in checker.check_file(p))
+
+
+def test_checker_flags_an_empty_trace(tmp_path):
+    checker = _load_checker()
+    p = _write(tmp_path, [])
+    assert any("no snapshot windows" in v for v in checker.check_file(p))

@@ -71,6 +71,29 @@ class LoadModel:
         )
 
 
+def workload_layout(
+    workmodel: Workmodel, service_capacity: int | None
+) -> tuple[CommGraph, dict[str, int]]:
+    """THE derived workload layout — capacity padding + service index —
+    shared by :meth:`SimBackend._refresh_workload` and the device twin
+    (``backends.sim_device.twin_of``). One definition, two consumers:
+    the Python simulator and the jittable twin must agree on how the
+    comm graph pads to the service bucket and how service names map to
+    indices (teardown compaction renumbers them), or a post-churn twin
+    would silently score a different topology than the backend serves
+    (regression-pinned in tests/test_scan.py).
+    """
+    cap = service_capacity
+    if cap is not None:
+        # never let a mid-step deploy outrun a stale bucket: the
+        # churn engine promotes capacities before applying events,
+        # but the graph build itself must stay safe regardless
+        cap = max(cap, len(workmodel.services))
+    graph = workmodel.comm_graph(capacity=cap)
+    svc_index = {n: i for i, n in enumerate(workmodel.names)}
+    return graph, svc_index
+
+
 @dataclass
 class SimBackend:
     """In-memory cluster with dynamics. All mutation host-side numpy; the
@@ -110,15 +133,12 @@ class SimBackend:
         (comm graph, service index, rps cache) follows. The no-churn
         path calls it exactly once, from ``__post_init__`` — a static
         run is bit-identical to the pre-elastic simulator
-        (regression-pinned in tests/test_elastic.py)."""
-        cap = self.service_capacity
-        if cap is not None:
-            # never let a mid-step deploy outrun a stale bucket: the
-            # churn engine promotes capacities before applying events,
-            # but the graph build itself must stay safe regardless
-            cap = max(cap, len(self.workmodel.services))
-        self._graph = self.workmodel.comm_graph(capacity=cap)
-        self._svc_index = {n: i for i, n in enumerate(self.workmodel.names)}
+        (regression-pinned in tests/test_elastic.py). Delegates to the
+        module-level :func:`workload_layout` — the one source of truth
+        the device twin shares."""
+        self._graph, self._svc_index = workload_layout(
+            self.workmodel, self.service_capacity
+        )
         self._rps_cache: dict[str, float] | None = None
 
     # ---- Backend protocol ----

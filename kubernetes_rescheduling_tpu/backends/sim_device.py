@@ -1,0 +1,177 @@
+"""Device-resident sim twin: the simulator's steady-state round update
+as pure, jittable array math.
+
+``SimBackend`` is host-side numpy by design — its pod table mutates, its
+clock advances, its events list grows. But the STEADY-STATE round (no
+churn, no chaos, no load noise) touches none of that richness: the
+monitor snapshot is a pure function of the placement (per-pod CPU comes
+from the load model, which depends only on the service — never on the
+node), and a round's only mutation is "move the victim Deployment's pods
+to the landing node". That update is what this module extracts, so the
+scanned round loop (``bench/scan.py``) can run K whole rounds — decide →
+apply → monitor → round-end metrics — inside ONE ``lax.scan`` without a
+host round trip.
+
+The contract, pinned by the bit-parity oracle test (tests/test_scan.py):
+seeded multi-round trajectories through the jitted :func:`sim_step` and
+the Python ``SimBackend`` produce bit-identical placements and loads —
+including moves that land on over-capacity nodes (the simulator never
+rejects on capacity, and neither does the twin) and the
+``affinityOnly`` scheduler-choice fallback (:func:`scheduler_choice`,
+the twin of ``SimBackend._scheduler_choice``). The Python backend stays
+the oracle: the scanned controller replays every scanned move back into
+it through the boundary, so anything the twin cannot express (churn,
+faults, noise) simply drains to the per-round path.
+
+Twin construction goes through :func:`twin_of`, which reuses the
+monitor snapshot and the backend's OWN :func:`~backends.sim.workload_layout`
+— capacity padding and service-index compaction have exactly one
+definition, so a post-churn rebuild cannot drift from what the backend
+serves (regression-pinned).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_rescheduling_tpu.backends.sim import SimBackend, workload_layout
+from kubernetes_rescheduling_tpu.core.state import (
+    UNASSIGNED,
+    ClusterState,
+    CommGraph,
+)
+from kubernetes_rescheduling_tpu.policies.victim import deployment_group
+
+
+def scheduler_choice(
+    state: ClusterState, exclude_mask: jax.Array
+) -> jax.Array:
+    """The jittable twin of ``SimBackend._scheduler_choice``: the node
+    the simulated default scheduler would pick — least-allocated CPU
+    among valid (alive), non-excluded nodes; tie → first in node order
+    (``argmin`` returns the first minimum, matching the Python loop's
+    strict ``<``). Returns -1 when no candidate exists.
+
+    Allocation is the sum of tracked pod CPU per node — the snapshot's
+    ``pod_cpu`` IS the load model's per-pod usage in the steady state,
+    and the sim's nodes carry no base load — computed in f32 where the
+    Python oracle sums f64 (the parity test pins agreement on seeded
+    scenarios; a disagreement would need two nodes within one f32 ulp).
+    """
+    n = state.num_nodes
+    assign = jnp.where(
+        state.pod_valid & (state.pod_node >= 0), state.pod_node, n
+    )
+    used = (
+        jnp.zeros((n + 1,), jnp.float32)
+        .at[assign]
+        .add(jnp.where(state.pod_valid, state.pod_cpu, 0.0))
+    )[:n]
+    cand = state.node_valid & ~exclude_mask
+    masked = jnp.where(cand, used, jnp.inf)
+    best = jnp.argmin(masked).astype(jnp.int32)
+    return jnp.where(jnp.any(cand), best, -1)
+
+
+def apply_decision(
+    state: ClusterState,
+    victim: jax.Array,
+    service: jax.Array,
+    target: jax.Array,
+    hazard_mask: jax.Array,
+    *,
+    pinned: bool = True,
+) -> tuple[ClusterState, jax.Array, jax.Array]:
+    """Apply one round's decision to the twin state — the device half of
+    ``SimBackend.apply_move`` + the steady-state monitor rebuild.
+
+    ``pinned=True`` models the ``nodeName``/``nodeSelector`` mechanisms
+    (the move lands exactly on ``target``); ``pinned=False`` models
+    ``affinityOnly`` — the requested target is advisory and the landing
+    is :func:`scheduler_choice` excluding the hazard nodes, exactly as
+    the Python simulator honors that mechanism. A dead/invalid landing
+    (or a no-op decision: ``victim``/``target`` -1) moves nothing, the
+    simulator's ``return None`` path.
+
+    Returns ``(new_state, landed, moved)``: the post-move twin state
+    (bit-equal to what the next ``monitor()`` would build — per-pod CPU
+    never depends on placement), the i32 landing node index (-1 when no
+    move happened), and the bool moved flag.
+    """
+    # ``service`` is implied by the victim's deployment_group (the same
+    # rule the sequential loop applies); it stays in the signature so
+    # decide's output tuple threads through unchanged
+    del service
+    if pinned:
+        landing = target
+    else:
+        landing = scheduler_choice(state, hazard_mask)
+    safe = jnp.clip(landing, 0, state.num_nodes - 1)
+    alive = state.node_valid[safe] & (landing >= 0)
+    do = (victim >= 0) & (target >= 0) & alive
+    group = deployment_group(state, victim)
+    new_pod_node = jnp.where(do & group, safe, state.pod_node)
+    new_state = state.replace(pod_node=new_pod_node)
+    return new_state, jnp.where(do, landing, -1), do
+
+
+def sim_step(
+    state: ClusterState,
+    decision: tuple[jax.Array, jax.Array, jax.Array, jax.Array],
+    *,
+    pinned: bool = True,
+) -> tuple[ClusterState, ClusterState]:
+    """One steady-state simulator round: apply ``decision`` — a
+    ``(victim, service, target, hazard_mask)`` tuple, the decide
+    kernel's outputs — and return ``(new_sim_state, snapshot)``.
+
+    In the steady state the monitor is the identity on the post-move
+    state (loads are placement-independent), so the snapshot IS the new
+    state; the pair return keeps the monitor's role explicit for
+    callers that treat the two differently (the scanned loop's round-end
+    metrics run on the snapshot half)."""
+    victim, service, target, hazard_mask = decision
+    new_state, _landed, _moved = apply_decision(
+        state, victim, service, target, hazard_mask, pinned=pinned
+    )
+    return new_state, new_state
+
+
+def twin_of(backend: SimBackend) -> tuple[ClusterState, CommGraph]:
+    """Build the device twin of a ``SimBackend``: the current monitor
+    snapshot (the twin's carried state) plus the comm graph from the
+    SHARED :func:`~backends.sim.workload_layout` — the same padding and
+    service-index compaction the backend itself serves, so a twin built
+    after arbitrary churn (deploys, teardowns, autoscaling) scores the
+    exact topology the backend's next snapshot will carry."""
+    graph, _svc_index = workload_layout(
+        backend.workmodel, backend.service_capacity
+    )
+    return backend.monitor(), graph
+
+
+def scan_compatible(backend) -> bool:
+    """Whether the scanned schedule's steady-state assumptions hold for
+    this backend: a RAW hermetic simulator (chaos wrappers, replay
+    backends, and live adapters inject behavior only the per-round path
+    can honor) with a noise-free load model (monitor must be a pure
+    function of placement) and no pending CPU-spike injections beyond
+    what the snapshot already reflects (spikes are static multipliers —
+    they bake into ``pod_cpu`` and stay steady unless mutated mid-run,
+    which only ``on_round`` could do; the controller gates on that
+    separately)."""
+    return (
+        type(backend) is SimBackend
+        and float(backend.load.noise_frac) == 0.0
+    )
+
+
+__all__ = [
+    "apply_decision",
+    "scan_compatible",
+    "scheduler_choice",
+    "sim_step",
+    "twin_of",
+    "UNASSIGNED",
+]

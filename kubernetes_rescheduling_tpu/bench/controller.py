@@ -658,6 +658,28 @@ class _Runtime:
 
     # ---- round-end bundle protocol ----
 
+    def metric_edges(self):
+        """The round-end fast path's precomputed edge list (attribution
+        off only — the dense S×S work is needed for the bundle anyway
+        when it is on), cached per metric-graph OBJECT so churn's graph
+        refreshes rebuild it and steady state never does. Shadow runs
+        stay dense too: the shadow plane dispatches the SAME
+        ``controller_round_end`` kernel for its counterfactual twin
+        without an edge list, so taking the fast path here would fork
+        the compiled signature (breaking the 1-trace pin) AND score the
+        head-to-head's two sides under different f32 summation orders."""
+        if self.attr_k > 0 or self.shadow is not None:
+            return None
+        graph = self.metric_graph
+        cached = getattr(self, "_edge_cache", None)
+        if cached is None or cached[0] is not graph:
+            from kubernetes_rescheduling_tpu.objectives.metrics import (
+                comm_edge_list,
+            )
+
+            self._edge_cache = (graph, comm_edge_list(graph))
+        return self._edge_cache[1]
+
     def note_fresh_snapshot(self, state) -> None:
         """Adopt a fresh monitor snapshot and dispatch its round-end
         bundle (async, never pulled unless it closes a record): the
@@ -674,9 +696,47 @@ class _Runtime:
         }
         dev = dispatch_round_end(
             device_view(state), device_graph(self.metric_graph),
-            top_k=self.attr_k,
+            top_k=self.attr_k, edges=self.metric_edges(),
         )
         self._pending_end = {"dev": dev, "ctx": ctx}
+
+    def _apply_round_metrics(
+        self, rnd: int, record: RoundRecord, cost: float, lstd: float,
+        attr_flat, ctx: dict,
+    ) -> None:
+        """Land a round's closing metrics on its record: cost/load-std
+        plus — with attribution on — the decoded bundle, provenance
+        deltas, gauges, and the attribution book. ONE definition for the
+        per-round protocol (``_attach_metrics``) and the scanned
+        schedule's block decode, so the two paths can never diverge in
+        what a closed record carries."""
+        record.communication_cost = cost
+        record.load_std = lstd
+        if self.attr_k > 0:
+            attr = attribution_mod.decode_attribution(
+                attr_flat,
+                node_names=ctx["node_names"],
+                service_names=ctx["svc_names"],
+                top_k=self.attr_k,
+                num_nodes=ctx["num_nodes"],
+                num_services=ctx["num_services"],
+            )
+            attr["round"] = rnd
+            attr["algorithm"] = self.config.algorithm
+            attr.update(
+                self.timeline.observe_round(
+                    rnd,
+                    record.applied_moves,
+                    pod_level=self.config.placement_unit == "pod",
+                )
+            )
+            record.attribution = attr
+            attribution_mod.publish_attribution(
+                self.registry, attr, top_k=self.attr_k
+            )
+            attribution_mod.get_attribution_book().update(
+                self.config.algorithm, rnd, attr
+            )
 
     def _attach_metrics(self, rnd: int, record: RoundRecord, closer: RoundCloser) -> None:
         """Register the record's closing metrics (cost/load-std +
@@ -688,33 +748,7 @@ class _Runtime:
         ctx = pend["ctx"]
 
         def apply_vals(cost: float, lstd: float, attr_flat) -> None:
-            record.communication_cost = cost
-            record.load_std = lstd
-            if self.attr_k > 0:
-                attr = attribution_mod.decode_attribution(
-                    attr_flat,
-                    node_names=ctx["node_names"],
-                    service_names=ctx["svc_names"],
-                    top_k=self.attr_k,
-                    num_nodes=ctx["num_nodes"],
-                    num_services=ctx["num_services"],
-                )
-                attr["round"] = rnd
-                attr["algorithm"] = self.config.algorithm
-                attr.update(
-                    self.timeline.observe_round(
-                        rnd,
-                        record.applied_moves,
-                        pod_level=self.config.placement_unit == "pod",
-                    )
-                )
-                record.attribution = attr
-                attribution_mod.publish_attribution(
-                    self.registry, attr, top_k=self.attr_k
-                )
-                attribution_mod.get_attribution_book().update(
-                    self.config.algorithm, rnd, attr
-                )
+            self._apply_round_metrics(rnd, record, cost, lstd, attr_flat, ctx)
 
         if "host" in pend:
             h = pend["host"]
@@ -1040,6 +1074,218 @@ class _Runtime:
         out = self.monitor_admitted()
         return out, time.perf_counter() - t0
 
+    # ---- the scanned schedule (bench/scan.py) ----
+
+    def scan_static_reason(self) -> str | None:
+        """Run-level conditions the scanned schedule can never honor —
+        checked once (config.validate() already rejected the config-level
+        incompatibilities: pipeline, non-pinning algorithms, shadow).
+        Returns the drain-reason label, or None when blocks may run."""
+        from kubernetes_rescheduling_tpu.backends.sim_device import (
+            scan_compatible,
+        )
+
+        if self.on_round is not None:
+            # on_round mutates backend load mid-run (the harness's
+            # sustained-load hook) — the twin's placement-pure monitor
+            # assumption would silently break
+            return "on-round"
+        if not scan_compatible(self.boundary.backend):
+            # the OUTERMOST backend, wrappers included (raw_backend would
+            # see through a chaos layer): chaos wrappers, replay
+            # backends, live adapters, or a noisy load model — only the
+            # per-round path can honor their faults
+            return "backend"
+        if self.mgr is not None:
+            # the sequential loop checkpoints every round; a scan block
+            # cannot (resume would land mid-block)
+            return "checkpoint"
+        if not self.graph_static:
+            return "streaming-graph"
+        return None
+
+    def scan_block_rounds(self, start: int, rounds: int) -> int:
+        """One scan block: dispatch the fused K-round kernel, pull the
+        whole block's diagnostics in ONE counted ``round_end`` transfer,
+        then replay the decided moves into the backend through the
+        boundary — the EXACT per-round call order the sequential loop
+        issues (begin_round, apply, advance), minus the K-1 intermediate
+        monitors the steady state never needed. Decoded rounds emit
+        ordinary ``RoundRecord``s (explain, attribution, reconcile,
+        watchdog all served), bit-identical to the sequential loop's
+        (test-pinned). Returns the number of rounds consumed (< rounds
+        only if a replayed landing diverged from the twin — impossible
+        on a scan-compatible backend, handled defensively)."""
+        from kubernetes_rescheduling_tpu.bench import scan as scan_mod
+
+        config = self.config
+        graph = self.graph_src()
+        scoring = scoring_policy(config.algorithm, config.forecast)
+        mech = PlacementMechanism[scoring]
+        pid = jnp.asarray(POLICY_IDS[scoring])
+        thr = jnp.asarray(config.hazard_threshold_pct)
+        state0 = self.state
+        ctx = {
+            "node_names": state0.node_names,
+            "svc_names": self.metric_graph.names,
+            "num_nodes": state0.num_nodes,
+            "num_services": self.metric_graph.num_services,
+        }
+        t0 = time.perf_counter()
+        with span(
+            "controller/scan_block", round=start, rounds=rounds,
+            algorithm=config.algorithm,
+        ):
+            flat_dev = scan_mod.scan_rounds(
+                device_view(state0),
+                device_graph(graph),
+                device_graph(self.metric_graph),
+                pid,
+                thr,
+                self.key,
+                jnp.asarray(start, jnp.int32),
+                self.metric_edges(),
+                rounds=rounds,
+                pinned=True,
+                explain_k=self.explain_k,
+                attr_k=self.attr_k,
+            )
+            flat = scan_mod.pull_block(flat_dev, self.registry)
+        fence_s = time.perf_counter() - t0
+        scan_mod.count_scan_block(self.registry, rounds)
+        views = scan_mod.decode_block(
+            flat,
+            rounds=rounds,
+            num_nodes=state0.num_nodes,
+            explain_k=self.explain_k,
+        )
+
+        consumed = 0
+        for i, v in enumerate(views):
+            rnd = start + i
+            t_r = time.perf_counter()
+            self.boundary.begin_round(rnd)  # CLOSED stays CLOSED
+            service_name = graph.names[v.service] if v.victim >= 0 else None
+            target_name = (
+                state0.node_names[v.target] if v.target >= 0 else None
+            )
+            hazard_node = (
+                state0.node_names[v.most] if v.most >= 0 else None
+            )
+            landed_name: str | None = None
+            diverged = False
+            # attempted == the sequential loop's apply condition (a
+            # decided victim with a decided target); the twin's landed
+            # flag must agree with what the backend then reports
+            attempted = v.victim >= 0 and v.target >= 0
+            if attempted:
+                hazard_names = tuple(
+                    state0.node_names[j]
+                    for j in range(state0.num_nodes)
+                    if bool(v.hazard[j])
+                )
+                landed_name = self.boundary.apply_move(
+                    MoveRequest(
+                        service=service_name,
+                        target_node=target_name,
+                        hazard_nodes=hazard_names,
+                        mechanism=mech,
+                    )
+                )
+                if self.ledger is not None:
+                    self.record_intents(
+                        [move_intent(mech, service_name, target_name,
+                                     landed_name)]
+                    )
+                expected = (
+                    state0.node_names[v.landed] if v.landed >= 0 else None
+                )
+                if landed_name != expected:
+                    # the backend disagreed with the twin about where
+                    # this move landed — every later scanned decision
+                    # was made against a diverged state. Finish THIS
+                    # round degraded, resync on a fresh monitor, and
+                    # hand the remaining rounds back to the per-round
+                    # path (defensive: a scan-compatible backend cannot
+                    # reach this — parity is oracle-pinned)
+                    diverged = True
+                    count_divergence(self.registry, KIND_UNKNOWN_LANDING)
+                    if self.logger is not None:
+                        self.logger.warn(
+                            "scan_twin_divergence",
+                            round=rnd,
+                            service=service_name,
+                            expected=expected,
+                            landed=landed_name,
+                        )
+            moved = attempted and landed_name is not None
+            record = RoundRecord(
+                round=rnd,
+                moved=moved,
+                most_hazard=hazard_node,
+                service=service_name if moved else None,
+                target=landed_name if moved else None,
+                communication_cost=0.0,  # filled from the block bundle
+                load_std=0.0,
+                services_moved=(service_name,) if moved else (),
+                decision_latencies_s=(fence_s / rounds,),
+                applied_moves=(
+                    ((service_name, landed_name),) if moved else ()
+                ),
+                degraded=diverged,
+            )
+            if v.explain is not None:
+                expl = greedy_explanation(
+                    v.explain,
+                    state0.node_names,
+                    round=rnd,
+                    seq=0,
+                    policy=config.algorithm,
+                    service=service_name,
+                    hazard_node=hazard_node,
+                    chosen=target_name if v.victim >= 0 else None,
+                )
+                if attempted:
+                    # the apply outcome, exactly as the sequential
+                    # loop's deferred decode patches it in
+                    expl["landed"] = landed_name
+                    expl["applied"] = landed_name is not None
+                    if landed_name is None:
+                        expl["stop"] = "boundary move failed"
+                        expl["why"] += " (boundary move failed)"
+                record.explanations = (expl,)
+                if self.logger is not None:
+                    self.logger.info("decision", **expl)
+            self.boundary.advance(config.sleep_after_action_s)
+            last = i == rounds - 1 or diverged
+            fresh = False
+            if last:
+                # block boundary: ONE admitted monitor realigns the
+                # controller with the backend (bit-equal to the twin's
+                # final state on a scan-compatible backend) and arms the
+                # degraded-close fallback for any following drain round
+                with span("backend/monitor"):
+                    new_state = self.monitor_admitted()
+                if new_state is None:
+                    record.degraded = True
+                else:
+                    self.note_fresh_snapshot(new_state)
+                    fresh = True
+            self._reconcile_round(record, fresh=fresh)
+            record.breaker_state = self.breaker.state
+            record.boundary_failures = self.boundary.round_failures
+            self._apply_round_metrics(
+                rnd, record, v.cost, v.load_std, v.attr_flat, ctx
+            )
+            record.wall_s = (
+                fence_s / rounds + time.perf_counter() - t_r
+            )
+            self.emit(rnd, record, mode="scanned")
+            consumed += 1
+            if diverged:
+                break
+        return consumed
+
 
 def _sequential_loop(rt: _Runtime) -> None:
     for rnd in range(rt.start_round, rt.config.max_rounds + 1):
@@ -1180,6 +1426,52 @@ def _pipelined_loop(rt: _Runtime) -> None:
         ex.shutdown(wait=True)
 
 
+def _scanned_loop(rt: _Runtime) -> None:
+    """The device-resident scanned schedule (``--scan-block K`` /
+    ``[controller] scan_block``): steady-state rounds advance K at a
+    time through ONE compiled ``lax.scan`` dispatch and ONE counted
+    ``round_end`` transfer per block (``bench/scan.py``), with the
+    decided moves replayed into the backend afterwards in the exact
+    sequential call order. Any round the scan cannot honor — a pending
+    churn event or re-mask debt, a breaker that is not CLOSED, a
+    checkpoint manager (it saves per round), an incompatible backend
+    (chaos wrapper, replay, live adapter, noisy load model), a
+    streaming decision graph, an ``on_round`` load hook, or a tail
+    shorter than one block — DRAINS to the per-round sequential path
+    (PR 9's discipline), counted as ``scan_drains_total{reason}``.
+    Records and event streams are bit-identical to the sequential loop
+    modulo timing fields (test-pinned)."""
+    from kubernetes_rescheduling_tpu.bench.scan import count_scan_drain
+
+    cfg = rt.config
+    k = cfg.controller.scan_block
+    static_reason = rt.scan_static_reason()
+    rnd = rt.start_round
+    while rnd <= cfg.max_rounds:
+        reason = static_reason
+        if reason is None:
+            if (
+                rt.churn is not None
+                or rt.pending_churn
+                or rt.remask_needed
+                or rt.rebind_timeline
+            ):
+                reason = "churn"
+            elif rt.breaker.state != "closed":
+                reason = "breaker"
+            elif cfg.max_rounds - rnd + 1 < k:
+                # a partial block would be a new static (rounds=...)
+                # signature — a retrace per distinct tail length; the
+                # tail runs per-round instead, keeping the 1-trace pin
+                reason = "tail"
+        if reason is not None:
+            count_scan_drain(rt.registry, reason)
+            rt.sequential_round(rnd)
+            rnd += 1
+            continue
+        rnd += rt.scan_block_rounds(rnd, k)
+
+
 def run_controller(
     backend: Backend,
     config: RescheduleConfig,
@@ -1279,6 +1571,17 @@ def run_controller(
     all accounting are bit-identical to the sequential schedule on the
     sim backend (test-pinned); rounds the pipeline cannot honor (open
     breaker, pending churn, streaming graph) drain and run sequentially.
+
+    ``config.controller.scan_block`` selects the third schedule — the
+    device-resident round scan (``bench/scan.py``): K steady-state
+    rounds fuse decide → sim-twin apply → monitor → round-end metrics
+    into ONE compiled ``lax.scan`` dispatch with ONE counted
+    ``round_end`` transfer per block, the decided moves replayed into
+    the backend afterwards in the sequential call order. Rounds the scan
+    cannot honor drain to the per-round path
+    (``scan_drains_total{reason}``); records stay bit-identical modulo
+    timing fields (test-pinned). Requires a raw noise-free sim backend —
+    anything else drains every round.
     """
     config = config.validate()
     registry = registry if registry is not None else get_registry()
@@ -1296,7 +1599,9 @@ def run_controller(
         churn=churn,
     )
     try:
-        if config.controller.pipeline:
+        if config.controller.scan_block:
+            _scanned_loop(rt)
+        elif config.controller.pipeline:
             _pipelined_loop(rt)
         else:
             _sequential_loop(rt)

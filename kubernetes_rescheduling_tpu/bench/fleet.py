@@ -419,186 +419,452 @@ def run_fleet_controller(
     # pending-churn rule, per tenant)
     pending_churn: dict[int, list[dict]] = {idx: [] for idx in churn}
 
-    def _run_rounds() -> None:
+    def emit_tenant_round(t: _Tenant, rec: RoundRecord, rnd: int) -> None:
+        """The per-tenant-round epilogue — result stream, fleet metric
+        families, the round event, the ops plane, ``on_round`` — shared
+        by the sequential round and the scanned block so a scanned
+        tenant-round is indistinguishable downstream."""
+        t.result.rounds.append(rec)
+        registry.counter(
+            "fleet_rounds_total",
+            "tenant rounds executed by the multiplexed fleet loop",
+            labelnames=("tenant",),
+        ).labels(tenant=t.name).inc()
+        if rec.moved:
+            registry.counter(
+                "fleet_moves_total",
+                "deployments moved per tenant by fleet rounds",
+                labelnames=("tenant",),
+            ).labels(tenant=t.name).inc()
+        if rec.degraded:
+            registry.counter(
+                "fleet_degraded_rounds_total",
+                "tenant rounds finished on a stale snapshot after "
+                "the post-move monitor failed",
+                labelnames=("tenant",),
+            ).labels(tenant=t.name).inc()
+        registry.gauge(
+            "fleet_communication_cost",
+            "per-tenant communication cost after the most recent "
+            "fleet round",
+            labelnames=("tenant",),
+        ).labels(tenant=t.name).set(rec.communication_cost)
+        registry.gauge(
+            "fleet_load_std",
+            "per-tenant node CPU-% standard deviation after the "
+            "most recent fleet round",
+            labelnames=("tenant",),
+        ).labels(tenant=t.name).set(rec.load_std)
+        round_event = dict(
+            tenant=t.name,
+            round=rnd,
+            moved=rec.moved,
+            service=rec.service,
+            target=rec.target,
+            communication_cost=rec.communication_cost,
+            load_std=rec.load_std,
+            breaker=rec.breaker_state,
+            degraded=rec.degraded,
+            boundary_failures=rec.boundary_failures,
+        )
+        if logger is not None:
+            logger.info("fleet_round", **round_event)
+        if ops is not None:
+            # the solo loop's per-round plane feed, per tenant-round:
+            # health counters + mark_round, the watchdog, and the
+            # flight-recorder ring (so a breaker-open bundle carries
+            # the fleet's recent rounds)
+            ops.observe_round(
+                rec,
+                t.state,
+                events=[{"event": "fleet_round", **round_event}],
+                # per-source watchdog state (the reconcile rule)
+                # keys on the tenant so interleaved tenant rounds
+                # never mask each other's drift
+                tenant=t.name,
+            )
+        if on_round is not None:
+            on_round(t.name, rec, t.state)
+
+    def apply_tenant_move(
+        t: _Tenant, decisions_row, hazard_row, *, apply: bool = True
+    ):
+        """The per-tenant apply half BOTH schedules share: decode the
+        packed decision row, issue the boundary move, record the ledger
+        intent — one definition, so the per-round path and the scanned
+        replay can never diverge at the apply site. Returns
+        ``(service_name, first_hazard, landed, attempted)``."""
+        state = t.state
+        most_i = int(decisions_row[ROW_MOST])
+        victim_i = int(decisions_row[ROW_VICTIM])
+        svc_i = int(decisions_row[ROW_SERVICE])
+        target_i = int(decisions_row[ROW_TARGET])
+        service_name = t.graph.names[svc_i] if victim_i >= 0 else None
+        first_hazard = state.node_names[most_i] if most_i >= 0 else None
+        landed: str | None = None
+        attempted = (
+            apply and most_i >= 0 and victim_i >= 0 and target_i >= 0
+        )
+        if attempted:
+            hazard_names = tuple(
+                state.node_names[j]
+                for j in range(state.num_nodes)
+                if bool(hazard_row[j])
+            )
+            landed = t.boundary.apply_move(
+                MoveRequest(
+                    service=service_name,
+                    target_node=state.node_names[target_i],
+                    hazard_nodes=hazard_names,
+                    mechanism=PlacementMechanism[config.algorithm],
+                )
+            )
+            if t.ledger is not None and landed is not None:
+                # intent recorded at apply time: the ledger diffs it
+                # against the next admitted snapshot. The advisory/
+                # pinning rule lives in move_intent — ONE definition
+                # shared with the solo loop
+                t.ledger.record_moves(
+                    [
+                        move_intent(
+                            PlacementMechanism[config.algorithm],
+                            service_name,
+                            state.node_names[target_i],
+                            landed,
+                        )
+                    ]
+                )
+        return service_name, first_hazard, landed, attempted
+
+    def round_once(rnd: int) -> None:
         nonlocal stacked_graphs
-        for rnd in range(1, config.max_rounds + 1):
-            churn_applied: dict[int, list[dict]] = {}
-            if churn:
-                promoted = False
-                graphs_changed = False
-                for idx in sorted(churn):
-                    applied = churn[idx].step(rnd)
-                    if applied:
-                        churn_applied[idx] = applied
-                        pending_churn.setdefault(idx, []).extend(applied)
-                        promoted = promoted or churn[idx].promoted
-                        graphs_changed = graphs_changed or churn[idx].graph_changed
-                        tenants[idx].remask = True
-                if promoted:
-                    # a shared-bucket promotion re-pads EVERY tenant:
-                    # graphs refresh host-side (no boundary traffic) and
-                    # every tenant owes a re-monitor — settled below,
-                    # BEHIND its own breaker gate, so an ailing tenant is
-                    # neither hammered while OPEN nor double-charged
-                    for t in tenants:
-                        t.graph = t.boundary.comm_graph()
-                        t.remask = True
-                    stacked_graphs = stack_tenants(
-                        [device_graph(t.graph) for t in tenants]
-                    )
-                elif graphs_changed:
-                    for idx in churn_applied:
-                        if churn[idx].graph_changed:
-                            tenants[idx].graph = (
-                                tenants[idx].boundary.comm_graph()
-                            )
-                    stacked_graphs = stack_tenants(
-                        [device_graph(t.graph) for t in tenants]
-                    )
-            active: list[int] = []
-            for i, t in enumerate(tenants):
-                mode = t.boundary.begin_round(rnd)
-                if mode == OPEN:
+        churn_applied: dict[int, list[dict]] = {}
+        if churn:
+            promoted = False
+            graphs_changed = False
+            for idx in sorted(churn):
+                applied = churn[idx].step(rnd)
+                if applied:
+                    churn_applied[idx] = applied
+                    pending_churn.setdefault(idx, []).extend(applied)
+                    promoted = promoted or churn[idx].promoted
+                    graphs_changed = graphs_changed or churn[idx].graph_changed
+                    tenants[idx].remask = True
+            if promoted:
+                # a shared-bucket promotion re-pads EVERY tenant:
+                # graphs refresh host-side (no boundary traffic) and
+                # every tenant owes a re-monitor — settled below,
+                # BEHIND its own breaker gate, so an ailing tenant is
+                # neither hammered while OPEN nor double-charged
+                for t in tenants:
+                    t.graph = t.boundary.comm_graph()
+                    t.remask = True
+                stacked_graphs = stack_tenants(
+                    [device_graph(t.graph) for t in tenants]
+                )
+            elif graphs_changed:
+                for idx in churn_applied:
+                    if churn[idx].graph_changed:
+                        tenants[idx].graph = (
+                            tenants[idx].boundary.comm_graph()
+                        )
+                stacked_graphs = stack_tenants(
+                    [device_graph(t.graph) for t in tenants]
+                )
+        active: list[int] = []
+        for i, t in enumerate(tenants):
+            mode = t.boundary.begin_round(rnd)
+            if mode == OPEN:
+                skip_round(t, rnd)
+                continue
+            if mode == HALF_OPEN or t.state is None or t.remask:
+                # half-open probe, a tenant that has never produced a
+                # snapshot, or one whose snapshot predates applied
+                # churn: ONE monitor — behind the gate — decides
+                # whether this round runs (a dark backend is a single
+                # counted failure; the re-mask debt carries forward)
+                probe = _admitted_monitor(t)
+                if probe is None:
                     skip_round(t, rnd)
                     continue
-                if mode == HALF_OPEN or t.state is None or t.remask:
-                    # half-open probe, a tenant that has never produced a
-                    # snapshot, or one whose snapshot predates applied
-                    # churn: ONE monitor — behind the gate — decides
-                    # whether this round runs (a dark backend is a single
-                    # counted failure; the re-mask debt carries forward)
-                    probe = _admitted_monitor(t)
-                    if probe is None:
-                        skip_round(t, rnd)
-                        continue
-                    t.state = probe
-                    t.remask = False
-                active.append(i)
-            if not active:
-                # the whole fleet skipped — nothing to dispatch this round
-                if ops is not None:
-                    ops.health.fleet = {t.name: t.health_row() for t in tenants}
-                continue
+                t.state = probe
+                t.remask = False
+            active.append(i)
+        if not active:
+            # the whole fleet skipped — nothing to dispatch this round
+            if ops is not None:
+                ops.health.fleet = {t.name: t.health_row() for t in tenants}
+            return
 
-            # ONE batched solve for every tenant slot: inactive slots carry a
-            # placeholder snapshot (shapes must stay static — 1 trace) and
-            # are masked so they can never emit a move. ALWAYS the filler
-            # for inactive slots: a skipped tenant's carried snapshot may
-            # predate a bucket promotion (stale shapes would break the
-            # stack), and masked rows never read their values anyway
-            filler = tenants[active[0]].state
-            active_set = set(active)
-            stacked_states = stack_tenants(
+        # ONE batched solve for every tenant slot: inactive slots carry a
+        # placeholder snapshot (shapes must stay static — 1 trace) and
+        # are masked so they can never emit a move. ALWAYS the filler
+        # for inactive slots: a skipped tenant's carried snapshot may
+        # predate a bucket promotion (stale shapes would break the
+        # stack), and masked rows never read their values anyway
+        filler = tenants[active[0]].state
+        active_set = set(active)
+        stacked_states = stack_tenants(
+            [
+                device_view(t.state if i in active_set else filler)
+                for i, t in enumerate(tenants)
+            ]
+        )
+        mask = np.zeros((T,), dtype=bool)
+        mask[active] = True
+        keys = _round_keys(stacked_keys, jnp.asarray(rnd))
+        t0 = time.perf_counter()
+        with span("fleet/solve", round=rnd, tenants=len(active)):
+            decisions_dev, hazard_dev = block(
+                solve_fn(
+                    stacked_states, stacked_graphs, pid, thr, keys,
+                    jnp.asarray(mask),
+                )
+            )
+        solve_s = time.perf_counter() - t0
+        result.batched_solves += 1
+        result.device_solve_s += solve_s
+        # the whole fleet's round comes home in ONE counted transfer:
+        # decisions (i32[T,4] — small indices, exact in f32) and the
+        # hazard masks packed into a single flat bundle (historically
+        # two pulls, fleet_decision + fleet_hazard)
+        n_nodes = int(hazard_dev.shape[1])
+        flat = _pull_round_bundle(
+            jnp.concatenate(
                 [
-                    device_view(t.state if i in active_set else filler)
-                    for i, t in enumerate(tenants)
+                    jnp.ravel(decisions_dev).astype(jnp.float32),
+                    jnp.ravel(hazard_dev).astype(jnp.float32),
                 ]
-            )
-            mask = np.zeros((T,), dtype=bool)
-            mask[active] = True
-            keys = _round_keys(stacked_keys, jnp.asarray(rnd))
-            t0 = time.perf_counter()
-            with span("fleet/solve", round=rnd, tenants=len(active)):
-                decisions_dev, hazard_dev = block(
-                    solve_fn(
-                        stacked_states, stacked_graphs, pid, thr, keys,
-                        jnp.asarray(mask),
-                    )
-                )
-            solve_s = time.perf_counter() - t0
-            result.batched_solves += 1
-            result.device_solve_s += solve_s
-            # the whole fleet's round comes home in ONE counted transfer:
-            # decisions (i32[T,4] — small indices, exact in f32) and the
-            # hazard masks packed into a single flat bundle (historically
-            # two pulls, fleet_decision + fleet_hazard)
-            n_nodes = int(hazard_dev.shape[1])
-            flat = _pull_round_bundle(
-                jnp.concatenate(
-                    [
-                        jnp.ravel(decisions_dev).astype(jnp.float32),
-                        jnp.ravel(hazard_dev).astype(jnp.float32),
-                    ]
-                ),
-                "fleet_decision",
-            )
-            decisions = flat[: T * 4].reshape(T, 4).astype(np.int64)
-            hazard = flat[T * 4 :].reshape(T, n_nodes) > 0.5
-            # the shared dispatch's cost, attributed evenly to the tenants
-            # that used it — the amortization IS the fleet-mode story
-            per_tenant_s = solve_s / len(active)
+            ),
+            "fleet_decision",
+        )
+        decisions = flat[: T * 4].reshape(T, 4).astype(np.int64)
+        hazard = flat[T * 4 :].reshape(T, n_nodes) > 0.5
+        # the shared dispatch's cost, attributed evenly to the tenants
+        # that used it — the amortization IS the fleet-mode story
+        per_tenant_s = solve_s / len(active)
 
-            def tenant_round(i: int) -> tuple[RoundRecord, float]:
-                """One tenant's boundary phase — apply, pace, post-move
-                monitor, record construction. Touches ONLY tenant i's
-                backend/boundary/breaker (plus the thread-safe registry),
-                which is what makes the pipelined fleet's concurrent
-                execution bit-identical per tenant."""
-                t_bg = time.perf_counter()
-                t = tenants[i]
-                most_i = int(decisions[i, ROW_MOST])
-                victim_i = int(decisions[i, ROW_VICTIM])
-                svc_i = int(decisions[i, ROW_SERVICE])
-                target_i = int(decisions[i, ROW_TARGET])
+        def tenant_round(i: int) -> tuple[RoundRecord, float]:
+            """One tenant's boundary phase — apply, pace, post-move
+            monitor, record construction. Touches ONLY tenant i's
+            backend/boundary/breaker (plus the thread-safe registry),
+            which is what makes the pipelined fleet's concurrent
+            execution bit-identical per tenant."""
+            t_bg = time.perf_counter()
+            t = tenants[i]
+            service_name, first_hazard, landed, _attempted = (
+                apply_tenant_move(t, decisions[i], hazard[i])
+            )
+            moved_name = service_name if landed is not None else None
+            t.boundary.advance(config.sleep_after_action_s)
+            new_state = _admitted_monitor(t)
+            degraded = new_state is None
+            if not degraded:
+                t.state = new_state
+            # elastic events consumed BEFORE the reconcile diff so
+            # legitimate churn never reads as drift (pending, not just
+            # this round's: a skipped tenant round's events flush into
+            # the next executed record)
+            churn_info = (
+                churn[i].round_info(pending_churn.pop(i, []))
+                if i in churn
+                else None
+            )
+            reconcile_block, t.last_drift = reconcile_round_block(
+                t.guard,
+                t.ledger,
+                state=t.state,
+                service_names=t.graph.names,
+                churn_events=(churn_info or {}).get("events") or (),
+                fresh=not degraded,
+                last_drift=t.last_drift,
+                boundary=t.boundary,
+                repair_budget=config.reconcile.repair_budget_per_round,
+            )
+            rec = RoundRecord(
+                round=rnd,
+                moved=moved_name is not None,
+                most_hazard=first_hazard,
+                service=moved_name,
+                target=landed,
+                communication_cost=0.0,  # filled from the batched metrics
+                load_std=0.0,
+                services_moved=(moved_name,) if moved_name else (),
+                decision_latencies_s=(per_tenant_s,),
+                breaker_state=t.breaker.state,
+                degraded=degraded,
+                boundary_failures=t.boundary.round_failures,
+                applied_moves=(
+                    ((moved_name, landed),) if moved_name else ()
+                ),
+                churn=churn_info,
+                reconcile=reconcile_block,
+            )
+            return rec, time.perf_counter() - t_bg
+
+        records: dict[int, RoundRecord] = {}
+        if pool is not None and len(active) > 1:
+            # pipelined fleet: every tenant's apply→pace→monitor chain
+            # is independent (own backend clock, own breaker, own
+            # chaos stream), so the N sequential boundary round-trips
+            # collapse to max-of-N wall clock. The registry locks its
+            # series; per-tenant results are bit-identical to the
+            # sequential interleaving (test-pinned).
+            t_par = time.perf_counter()
+            futs = {i: pool.submit(tenant_round, i) for i in active}
+            durs = []
+            for i in active:
+                records[i], d = futs[i].result()
+                durs.append(d)
+            par_wall = time.perf_counter() - t_par
+            total = sum(durs)
+            ratio = (
+                max(0.0, min(1.0, 1.0 - par_wall / total))
+                if total > 1e-9
+                else 0.0
+            )
+            overlap_gauge.set(ratio)
+        else:
+            for i in active:
+                records[i], _ = tenant_round(i)
+
+        # ONE batched metrics dispatch + ONE transfer closes the round's
+        # reporting for every active tenant (the solo loop pays 2 scalar
+        # pulls per tenant here)
+        # same filler rule as the solve stack: only active tenants'
+        # rows are read, and only active tenants are guaranteed to
+        # hold post-promotion shapes
+        filler = tenants[active[0]].state
+        stacked_after = stack_tenants(
+            [
+                device_view(t.state if i in active_set else filler)
+                for i, t in enumerate(tenants)
+            ]
+        )
+        metrics = _pull_round_bundle(
+            fleet_metrics(stacked_after, stacked_graphs),
+            "fleet_metrics",
+        )
+        observe_wall_round(registry, "fleet", time.perf_counter() - t0)
+        for i in active:
+            t = tenants[i]
+            rec = records[i]
+            rec.communication_cost = float(metrics[i, 0])
+            rec.load_std = float(metrics[i, 1])
+            emit_tenant_round(t, rec, rnd)
+        if ops is not None:
+            ops.health.fleet = {t.name: t.health_row() for t in tenants}
+
+    scan_k = config.controller.scan_block
+    if scan_k:
+        from kubernetes_rescheduling_tpu.backends.sim_device import (
+            scan_compatible,
+        )
+        from kubernetes_rescheduling_tpu.bench import scan as scan_mod
+
+    def scan_static_reason() -> str | None:
+        """Run-level conditions the fleet scan can never honor (the solo
+        loop's rule, fleet-shaped): the whole fleet must be raw
+        noise-free simulators with no churn engines and no load hook."""
+        if on_round is not None:
+            return "on-round"
+        if churn:
+            return "churn"
+        if any(not scan_compatible(t.boundary.backend) for t in tenants):
+            return "backend"
+        return None
+
+    def scan_block(start: int, k: int) -> None:
+        """One fleet scan block: ONE compiled dispatch advances EVERY
+        tenant ``k`` rounds (``bench.scan.fleet_scan_rounds`` — decide,
+        sim-twin apply, and the metrics pair vmapped over the tenant
+        axis inside one ``lax.scan``), the whole block pulled as ONE
+        counted ``round_end`` transfer, then the decided moves replayed
+        per tenant in the sequential call order. Per-tenant records are
+        bit-identical to the sequential fleet loop's (test-pinned)."""
+        n_nodes = tenants[0].state.num_nodes
+        stacked_states = stack_tenants(
+            [device_view(t.state) for t in tenants]
+        )
+        t0 = time.perf_counter()
+        with span("fleet/scan_block", round=start, rounds=k, tenants=T):
+            flat = _pull_round_bundle(
+                scan_mod.fleet_scan_rounds(
+                    stacked_states,
+                    stacked_graphs,
+                    pid,
+                    thr,
+                    stacked_keys,
+                    jnp.asarray(start, jnp.int32),
+                    rounds=k,
+                    pinned=True,
+                ),
+                scan_mod.ROUND_END_SITE,
+            )
+        fence_s = time.perf_counter() - t0
+        scan_mod.count_scan_block(registry, k)
+        result.batched_solves += 1
+        result.device_solve_s += fence_s
+        decisions, hazard, landed_idx, metrics = scan_mod.decode_fleet_block(
+            flat, rounds=k, tenants=T, num_nodes=n_nodes
+        )
+        per_tenant_s = fence_s / (k * T)
+        resync: set[int] = set()  # tenants whose replay diverged
+        for r in range(k):
+            rnd = start + r
+            t_r0 = time.perf_counter()
+            last = r == k - 1
+            for t in tenants:
+                t.boundary.begin_round(rnd)  # CLOSED stays CLOSED
+            for i, t in enumerate(tenants):
                 state = t.state
-                service_name = t.graph.names[svc_i] if victim_i >= 0 else None
-                moved_name: str | None = None
-                landed: str | None = None
-                first_hazard = (
-                    state.node_names[most_i] if most_i >= 0 else None
+                service_name, first_hazard, landed, attempted = (
+                    apply_tenant_move(
+                        t, decisions[r, i], hazard[r, i],
+                        apply=i not in resync,
+                    )
                 )
-                if most_i >= 0 and victim_i >= 0 and target_i >= 0:
-                    hazard_names = tuple(
-                        state.node_names[j]
-                        for j in range(state.num_nodes)
-                        if bool(hazard[i, j])
+                moved_name = service_name if landed is not None else None
+                if attempted:
+                    expected = (
+                        state.node_names[int(landed_idx[r, i])]
+                        if landed_idx[r, i] >= 0
+                        else None
                     )
-                    landed = t.boundary.apply_move(
-                        MoveRequest(
-                            service=service_name,
-                            target_node=state.node_names[target_i],
-                            hazard_nodes=hazard_names,
-                            mechanism=PlacementMechanism[config.algorithm],
-                        )
-                    )
-                    if t.ledger is not None and landed is not None:
-                        # intent recorded at apply time: the ledger diffs
-                        # it against the next admitted snapshot. The
-                        # advisory/pinning rule lives in move_intent —
-                        # ONE definition shared with the solo loop
-                        t.ledger.record_moves(
-                            [
-                                move_intent(
-                                    PlacementMechanism[config.algorithm],
-                                    service_name,
-                                    state.node_names[target_i],
-                                    landed,
-                                )
-                            ]
-                        )
-                    if landed is not None:
-                        moved_name = service_name
+                    if landed != expected:
+                        # the backend disagreed with the twin: this
+                        # tenant's remaining scanned decisions were made
+                        # against a diverged state — stop applying them,
+                        # degrade its rounds, and force a re-monitor
+                        # before its next block (defensive; a
+                        # scan-compatible backend cannot reach this)
+                        resync.add(i)
+                        t.remask = True
+                        if logger is not None:
+                            logger.warn(
+                                "scan_twin_divergence",
+                                tenant=t.name,
+                                round=rnd,
+                                service=service_name,
+                                expected=expected,
+                                landed=landed,
+                            )
                 t.boundary.advance(config.sleep_after_action_s)
-                new_state = _admitted_monitor(t)
-                degraded = new_state is None
-                if not degraded:
-                    t.state = new_state
-                # elastic events consumed BEFORE the reconcile diff so
-                # legitimate churn never reads as drift (pending, not just
-                # this round's: a skipped tenant round's events flush into
-                # the next executed record)
-                churn_info = (
-                    churn[i].round_info(pending_churn.pop(i, []))
-                    if i in churn
-                    else None
-                )
+                degraded = i in resync
+                fresh = False
+                if last and i not in resync:
+                    new_state = _admitted_monitor(t)
+                    degraded = new_state is None
+                    if not degraded:
+                        t.state = new_state
+                        fresh = True
                 reconcile_block, t.last_drift = reconcile_round_block(
                     t.guard,
                     t.ledger,
                     state=t.state,
                     service_names=t.graph.names,
-                    churn_events=(churn_info or {}).get("events") or (),
-                    fresh=not degraded,
+                    churn_events=(),
+                    fresh=fresh,
                     last_drift=t.last_drift,
                     boundary=t.boundary,
                     repair_budget=config.reconcile.repair_budget_per_round,
@@ -609,8 +875,8 @@ def run_fleet_controller(
                     most_hazard=first_hazard,
                     service=moved_name,
                     target=landed,
-                    communication_cost=0.0,  # filled from the batched metrics
-                    load_std=0.0,
+                    communication_cost=float(metrics[r, i, 0]),
+                    load_std=float(metrics[r, i, 1]),
                     services_moved=(moved_name,) if moved_name else (),
                     decision_latencies_s=(per_tenant_s,),
                     breaker_state=t.breaker.state,
@@ -619,123 +885,53 @@ def run_fleet_controller(
                     applied_moves=(
                         ((moved_name, landed),) if moved_name else ()
                     ),
-                    churn=churn_info,
+                    churn=None,
                     reconcile=reconcile_block,
                 )
-                return rec, time.perf_counter() - t_bg
-
-            records: dict[int, RoundRecord] = {}
-            if pool is not None and len(active) > 1:
-                # pipelined fleet: every tenant's apply→pace→monitor chain
-                # is independent (own backend clock, own breaker, own
-                # chaos stream), so the N sequential boundary round-trips
-                # collapse to max-of-N wall clock. The registry locks its
-                # series; per-tenant results are bit-identical to the
-                # sequential interleaving (test-pinned).
-                t_par = time.perf_counter()
-                futs = {i: pool.submit(tenant_round, i) for i in active}
-                durs = []
-                for i in active:
-                    records[i], d = futs[i].result()
-                    durs.append(d)
-                par_wall = time.perf_counter() - t_par
-                total = sum(durs)
-                ratio = (
-                    max(0.0, min(1.0, 1.0 - par_wall / total))
-                    if total > 1e-9
-                    else 0.0
-                )
-                overlap_gauge.set(ratio)
-            else:
-                for i in active:
-                    records[i], _ = tenant_round(i)
-
-            # ONE batched metrics dispatch + ONE transfer closes the round's
-            # reporting for every active tenant (the solo loop pays 2 scalar
-            # pulls per tenant here)
-            # same filler rule as the solve stack: only active tenants'
-            # rows are read, and only active tenants are guaranteed to
-            # hold post-promotion shapes
-            filler = tenants[active[0]].state
-            stacked_after = stack_tenants(
-                [
-                    device_view(t.state if i in active_set else filler)
-                    for i, t in enumerate(tenants)
-                ]
+                emit_tenant_round(t, rec, rnd)
+            observe_wall_round(
+                registry, "scanned",
+                fence_s / k + time.perf_counter() - t_r0,
             )
-            metrics = _pull_round_bundle(
-                fleet_metrics(stacked_after, stacked_graphs),
-                "fleet_metrics",
-            )
-            observe_wall_round(registry, "fleet", time.perf_counter() - t0)
-            for i in active:
-                t = tenants[i]
-                rec = records[i]
-                rec.communication_cost = float(metrics[i, 0])
-                rec.load_std = float(metrics[i, 1])
-                t.result.rounds.append(rec)
-                registry.counter(
-                    "fleet_rounds_total",
-                    "tenant rounds executed by the multiplexed fleet loop",
-                    labelnames=("tenant",),
-                ).labels(tenant=t.name).inc()
-                if rec.moved:
-                    registry.counter(
-                        "fleet_moves_total",
-                        "deployments moved per tenant by fleet rounds",
-                        labelnames=("tenant",),
-                    ).labels(tenant=t.name).inc()
-                if rec.degraded:
-                    registry.counter(
-                        "fleet_degraded_rounds_total",
-                        "tenant rounds finished on a stale snapshot after "
-                        "the post-move monitor failed",
-                        labelnames=("tenant",),
-                    ).labels(tenant=t.name).inc()
-                registry.gauge(
-                    "fleet_communication_cost",
-                    "per-tenant communication cost after the most recent "
-                    "fleet round",
-                    labelnames=("tenant",),
-                ).labels(tenant=t.name).set(rec.communication_cost)
-                registry.gauge(
-                    "fleet_load_std",
-                    "per-tenant node CPU-% standard deviation after the "
-                    "most recent fleet round",
-                    labelnames=("tenant",),
-                ).labels(tenant=t.name).set(rec.load_std)
-                round_event = dict(
-                    tenant=t.name,
-                    round=rnd,
-                    moved=rec.moved,
-                    service=rec.service,
-                    target=rec.target,
-                    communication_cost=rec.communication_cost,
-                    load_std=rec.load_std,
-                    breaker=rec.breaker_state,
-                    degraded=rec.degraded,
-                    boundary_failures=rec.boundary_failures,
-                )
-                if logger is not None:
-                    logger.info("fleet_round", **round_event)
-                if ops is not None:
-                    # the solo loop's per-round plane feed, per tenant-round:
-                    # health counters + mark_round, the watchdog, and the
-                    # flight-recorder ring (so a breaker-open bundle carries
-                    # the fleet's recent rounds)
-                    ops.observe_round(
-                        rec,
-                        t.state,
-                        events=[{"event": "fleet_round", **round_event}],
-                        # per-source watchdog state (the reconcile rule)
-                        # keys on the tenant so interleaved tenant rounds
-                        # never mask each other's drift
-                        tenant=t.name,
-                    )
-                if on_round is not None:
-                    on_round(t.name, rec, t.state)
             if ops is not None:
-                ops.health.fleet = {t.name: t.health_row() for t in tenants}
+                ops.health.fleet = {
+                    t.name: t.health_row() for t in tenants
+                }
+
+    def _run_rounds() -> None:
+        """The fleet's round driver: scanned blocks in the steady state
+        (``[controller] scan_block`` — one dispatch advances all
+        tenants K rounds), the per-round multiplexed path otherwise,
+        with PR 9's drain discipline: any round the scan cannot honor —
+        churn, a non-closed breaker, a dark/re-mask tenant, an
+        incompatible backend, a tail shorter than one block — runs
+        ``round_once`` and counts ``scan_drains_total{reason}``."""
+        static_reason = scan_static_reason() if scan_k else None
+        rnd = 1
+        while rnd <= config.max_rounds:
+            if scan_k:
+                reason = static_reason
+                if reason is None:
+                    # the solo loop's taxonomy: breaker events file under
+                    # "breaker", re-mask debt under "churn", and a tenant
+                    # that has never produced a snapshot (dark backend)
+                    # under "backend" — an operator alerting on breaker
+                    # drains must not see healthy-run noise
+                    if any(t.breaker.state != "closed" for t in tenants):
+                        reason = "breaker"
+                    elif any(t.state is None for t in tenants):
+                        reason = "backend"
+                    elif any(t.remask for t in tenants):
+                        reason = "churn"
+                    elif config.max_rounds - rnd + 1 < scan_k:
+                        reason = "tail"
+                if reason is None:
+                    scan_block(rnd, scan_k)
+                    rnd += scan_k
+                    continue
+                scan_mod.count_scan_drain(registry, reason)
+            round_once(rnd)
+            rnd += 1
 
     # the always-on crash-dump path (the solo loop's contract):
     # whatever escapes the multiplexed loop leaves a flight-recorder

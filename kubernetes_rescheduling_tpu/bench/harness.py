@@ -44,6 +44,7 @@ from kubernetes_rescheduling_tpu.bench.sinks import (
     node_std_sink,
 )
 from kubernetes_rescheduling_tpu.config import (
+    SCAN_POLICIES,
     ChaosConfig,
     ControllerConfig,
     ElasticConfig,
@@ -137,6 +138,13 @@ class ExperimentConfig:
     # clock and transfer timing change.
     pipeline: bool = False
     pipeline_depth: int = 2
+    # Device-resident round scan ([controller] scan_block): K steady-
+    # state rounds per compiled dispatch with one round_end transfer
+    # per block; incompatible rounds drain to the per-round path.
+    # NOTE: harness cells sustain load through on_round, which the
+    # scanned schedule drains on — scan cells are the bench.py
+    # BENCH_SCENARIO=scan loop (no load hook), not the matrix.
+    scan_block: int = 0
     # Reconciliation & admission plane ([reconcile]): on by default —
     # every cell's r2 loop admits its snapshots and reconciles its own
     # moves; chaos cells therefore self-heal injected drift.
@@ -580,7 +588,17 @@ def run_experiment(cfg: ExperimentConfig, **backend_kwargs) -> dict:
                     forecast=cfg.forecast,
                     max_consecutive_failures=cfg.max_consecutive_failures,
                     controller=ControllerConfig(
-                        pipeline=cfg.pipeline, depth=cfg.pipeline_depth
+                        pipeline=cfg.pipeline, depth=cfg.pipeline_depth,
+                        # the matrix mixes algorithms; scan only the
+                        # cells whose algorithm the scanned schedule can
+                        # express (validation would reject the rest —
+                        # the harness's analogue of the runtime drain)
+                        scan_block=(
+                            cfg.scan_block
+                            if algo in SCAN_POLICIES
+                            and cfg.moves_per_round == 1
+                            else 0
+                        ),
                     ),
                     reconcile=cfg.reconcile,
                 )

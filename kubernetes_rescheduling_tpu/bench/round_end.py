@@ -45,6 +45,7 @@ import numpy as np
 from kubernetes_rescheduling_tpu.objectives.metrics import (
     communication_cost,
     communication_cost_attribution,
+    communication_cost_edges,
     load_std,
 )
 from kubernetes_rescheduling_tpu.telemetry import instrument_jit, pull
@@ -58,18 +59,31 @@ METRIC_LOAD_STD = 1
 METRIC_HEAD = 2
 
 
-def round_end_metrics(state, graph, *, top_k: int = 0) -> jax.Array:
+def round_end_metrics(state, graph, *, top_k: int = 0, edges=None) -> jax.Array:
     """Everything the host needs to close a round's reporting, in one
     compiled program: ``[communication_cost, load_std]`` followed — when
     ``top_k > 0`` — by the flat attribution bundle
     (``objectives.metrics.communication_cost_attribution``; per-edge
     contributions sum back to the scalar recorded two slots earlier, so
-    the ``attribution_consistent`` invariant holds by construction)."""
+    the ``attribution_consistent`` invariant holds by construction).
+
+    ``edges`` (a precomputed ``objectives.metrics.comm_edge_list``) is
+    the attribution-off fast path: the cost scalar contracts over the
+    graph's actual edges in O(E·N) instead of the dense O(S²·N)
+    quadratic form — on CPU sim at powerlaw scale the difference
+    between the metrics kernel dominating the round and vanishing into
+    it. With ``top_k > 0`` the dense S×S work is needed for the
+    attribution bundle anyway, so the scalar stays on the dense kernel
+    (keeping the sum-consistency invariant's summation order); callers
+    must pick ONE formulation per run — the controller's round-end
+    protocol and the scanned schedule share this choice, which is what
+    keeps their records bit-identical."""
+    if top_k > 0 or edges is None:
+        cost = communication_cost(state, graph)
+    else:
+        cost = communication_cost_edges(state, graph.num_services, edges)
     head = jnp.stack(
-        [
-            communication_cost(state, graph).astype(jnp.float32),
-            load_std(state).astype(jnp.float32),
-        ]
+        [cost.astype(jnp.float32), load_std(state).astype(jnp.float32)]
     )
     if top_k > 0:
         return jnp.concatenate(
@@ -86,9 +100,9 @@ _round_end = instrument_jit(
 )
 
 
-def dispatch_round_end(state, graph, *, top_k: int = 0) -> jax.Array:
+def dispatch_round_end(state, graph, *, top_k: int = 0, edges=None) -> jax.Array:
     """Async dispatch of the round-end kernel (no host sync)."""
-    return _round_end(state, graph, top_k=top_k)
+    return _round_end(state, graph, top_k=top_k, edges=edges)
 
 
 def fence(tree):

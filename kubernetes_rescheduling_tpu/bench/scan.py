@@ -1,0 +1,336 @@
+"""Device-resident round scan: K controller rounds per dispatch.
+
+RESULTS.md's 50k fixed-cost hunt found the honest wall is op-dispatch
+glue plus the tunnel RTT — costs the pipelined loop (PR 9) can only
+HIDE, because it still pays one Python round trip per round. For the
+overwhelmingly common steady-state round (no churn, no breaker event,
+no checkpoint due, a noise-free hermetic simulator) nothing in that trip
+needs the host: the decide kernel, the simulator's round update
+(``backends.sim_device`` — pure array math), and the round-end metrics
+are all jittable. This module fuses them:
+
+- :func:`scan_rounds` — ONE compiled ``lax.scan`` over K rounds of
+  decide → apply-to-sim-state → monitor → round-end metrics
+  (``instrument_jit`` label ``scan_rounds``; the usual steady-state
+  invariant applies: ``jax_traces_total{fn="scan_rounds"} == 1`` plus
+  counted bucket promotions). Per-round keys derive in-trace exactly as
+  the sequential loop derives them (``split(fold_in(key, round))[1]``),
+  so the scanned decisions are bit-identical by construction.
+- :func:`fleet_scan_rounds` — the fleet composition: the same body with
+  the decide/apply/metrics stages vmapped over the leading tenant axis
+  (``solver.fleet``'s kernels), so ONE scan dispatch advances every
+  tenant K rounds.
+- The whole block's diagnostics — decisions, landings, hazard masks,
+  optional explain bundles, and the per-round metrics vectors — come
+  home as ONE flat f32 bundle pulled through :func:`pull_block`, the
+  module's designated transfer site (``site="round_end"``, statically
+  enforced by ``scripts/check_apply_boundary.py``): exactly one counted
+  ``round_end`` transfer per K rounds.
+
+The host half (:func:`decode_block` / :func:`decode_fleet_block`)
+slices the bundle back into per-round views the controller replays into
+ordinary ``RoundRecord``s — rounds.jsonl, explain, attribution, and the
+watchdog see per-round data indistinguishable from the sequential
+loop's (bit-identity test-pinned in tests/test_scan.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kubernetes_rescheduling_tpu.backends.sim_device import apply_decision
+from kubernetes_rescheduling_tpu.bench.round_end import (
+    METRIC_COST,
+    METRIC_HEAD,
+    METRIC_LOAD_STD,
+    ROUND_END_SITE,
+    round_end_metrics,
+)
+from kubernetes_rescheduling_tpu.solver.fleet import (
+    ROW_SERVICE,
+    ROW_TARGET,
+    ROW_VICTIM,
+    _fleet_decide,
+    _fleet_metrics,
+)
+from kubernetes_rescheduling_tpu.solver.round_loop import (
+    decide,
+    decide_explain,
+)
+from kubernetes_rescheduling_tpu.telemetry import instrument_jit, pull
+
+# columns of the per-round decision row inside the block bundle
+DEC_MOST, DEC_VICTIM, DEC_SERVICE, DEC_TARGET, DEC_LANDED = range(5)
+DEC_COLS = 5
+
+
+def _round_key(key: jax.Array, rnd: jax.Array) -> jax.Array:
+    """The sequential loop's per-round decide key, derived in-trace:
+    ``execute_round`` folds the round index into the run key and
+    ``_greedy_round`` splits once per move — with ``moves_per_round=1``
+    the decide key is exactly ``split(fold_in(key, round))[1]`` (the
+    fleet loop's ``_round_keys`` derivation, one definition away)."""
+    return jax.random.split(jax.random.fold_in(key, rnd))[1]
+
+
+def _scan_rounds(
+    state,
+    dec_graph,
+    metric_graph,
+    policy_id,
+    threshold,
+    key,
+    start_round,
+    edges=None,
+    *,
+    rounds: int,
+    pinned: bool,
+    explain_k: int,
+    attr_k: int,
+):
+    """The fused K-round body (see module docstring). Returns ONE flat
+    f32 vector: per-round decision rows, hazard masks, optional explain
+    bundles, and round-end metrics vectors, concatenated in that order
+    (each piece stacked rounds-leading) — the single-transfer layout
+    :func:`decode_block` unpacks."""
+
+    def body(st, rnd):
+        sub = _round_key(key, rnd)
+        if explain_k > 0:
+            most, hazard, victim, svc, target, bundle = decide_explain(
+                st, dec_graph, policy_id, threshold, sub, top_k=explain_k
+            )
+        else:
+            most, hazard, victim, svc, target = decide(
+                st, dec_graph, policy_id, threshold, sub
+            )
+            bundle = None
+        new_st, landed, _moved = apply_decision(
+            st, victim, svc, target, hazard, pinned=pinned
+        )
+        metrics = round_end_metrics(
+            new_st, metric_graph, top_k=attr_k, edges=edges
+        )
+        row = jnp.stack(
+            [most, victim, svc, target, landed]
+        ).astype(jnp.float32)
+        outs = (row, hazard.astype(jnp.float32), metrics)
+        if bundle is not None:
+            outs = outs + (bundle,)
+        return new_st, outs
+
+    rnds = start_round + jnp.arange(rounds, dtype=jnp.int32)
+    _final, outs = lax.scan(body, state, rnds)
+    if explain_k > 0:
+        rows, hazard, metrics, bundles = outs
+        pieces = (rows, hazard, bundles, metrics)
+    else:
+        rows, hazard, metrics = outs
+        pieces = (rows, hazard, metrics)
+    return jnp.concatenate([jnp.ravel(p) for p in pieces])
+
+
+# ONE compiled program per (shape, rounds, explain/attr config)
+# signature: the whole point of the scan is paying dispatch + transfer
+# once per K rounds, so a silent retrace would be the old per-round cost
+# in disguise — jax_traces_total{fn="scan_rounds"} == 1 + promotions is
+# the test-pinned invariant, exactly like the per-round decision kernels
+scan_rounds = instrument_jit(
+    _scan_rounds,
+    name="scan_rounds",
+    static_argnames=("rounds", "pinned", "explain_k", "attr_k"),
+)
+
+
+def _fleet_scan_rounds(
+    states,
+    graphs,
+    policy_id,
+    threshold,
+    tenant_keys,
+    start_round,
+    *,
+    rounds: int,
+    pinned: bool,
+):
+    """The fleet composition: one scan advancing every tenant K rounds —
+    the solo body with decide (``solver.fleet._fleet_decide``), the sim
+    twin's apply, and the metrics pair vmapped over the leading tenant
+    axis. Flat layout: decisions ``[K,T,4]``, hazard ``[K,T,N]``,
+    landings ``[K,T]``, metrics ``[K,T,2]`` (rounds-leading, raveled in
+    that order)."""
+    T = tenant_keys.shape[0]
+    mask = jnp.ones((T,), dtype=bool)
+
+    def body(sts, rnd):
+        keys = jax.vmap(lambda k: _round_key(k, rnd))(tenant_keys)
+        decisions, hazard = _fleet_decide(
+            sts, graphs, policy_id, threshold, keys, mask
+        )
+        new_sts, landed, _moved = jax.vmap(
+            lambda s, v, sv, t, h: apply_decision(s, v, sv, t, h, pinned=pinned)
+        )(
+            sts,
+            decisions[:, ROW_VICTIM],
+            decisions[:, ROW_SERVICE],
+            decisions[:, ROW_TARGET],
+            hazard,
+        )
+        metrics = _fleet_metrics(new_sts, graphs)
+        return new_sts, (
+            decisions.astype(jnp.float32),
+            hazard.astype(jnp.float32),
+            landed.astype(jnp.float32),
+            metrics,
+        )
+
+    rnds = start_round + jnp.arange(rounds, dtype=jnp.int32)
+    _final, outs = lax.scan(body, states, rnds)
+    return jnp.concatenate([jnp.ravel(p) for p in outs])
+
+
+fleet_scan_rounds = instrument_jit(
+    _fleet_scan_rounds,
+    name="fleet_scan_rounds",
+    static_argnames=("rounds", "pinned"),
+)
+
+
+def pull_block(flat_dev, registry=None) -> np.ndarray:
+    """THE scan module's designated device→host transfer: one counted
+    ``round_end`` pull per scan block — K rounds of diagnostics in one
+    crossing (``scripts/check_apply_boundary.py`` statically pins every
+    other sync out of this module and the control loops)."""
+    return pull(flat_dev, site=ROUND_END_SITE, registry=registry)
+
+
+@dataclass(frozen=True)
+class RoundView:
+    """One scanned round, decoded: the sequential loop's per-round
+    quantities as plain host scalars/arrays."""
+
+    most: int
+    victim: int
+    service: int
+    target: int
+    landed: int
+    hazard: np.ndarray            # bool[N]
+    cost: float
+    load_std: float
+    attr_flat: np.ndarray | None  # the flat attribution bundle (attr_k>0)
+    explain: np.ndarray | None    # f32[6, explain_k] (explain_k>0)
+
+    @property
+    def moved(self) -> bool:
+        return self.landed >= 0
+
+
+def decode_block(
+    flat: np.ndarray,
+    *,
+    rounds: int,
+    num_nodes: int,
+    explain_k: int,
+) -> list[RoundView]:
+    """Unpack one pulled block bundle into per-round views. The metrics
+    vector's width is derived from the residual length (attribution's
+    flat size depends on top_k × topology — the decode must not
+    re-implement that formula)."""
+    flat = np.asarray(flat, dtype=np.float32)
+    # decide_explain clamps its bundle to min(top_k, num_nodes) columns
+    # — the decode must apply the same clamp or a cluster smaller than
+    # explain_top_k shifts every later slice
+    explain_k = min(explain_k, num_nodes)
+    n_dec = rounds * DEC_COLS
+    n_hz = rounds * num_nodes
+    n_ex = rounds * 6 * explain_k
+    n_metrics = flat.size - n_dec - n_hz - n_ex
+    if n_metrics < rounds * METRIC_HEAD or n_metrics % rounds:
+        raise ValueError(
+            f"scan block bundle of {flat.size} values does not decode at "
+            f"rounds={rounds}, num_nodes={num_nodes}, explain_k={explain_k}"
+        )
+    h = n_metrics // rounds
+    dec = flat[:n_dec].reshape(rounds, DEC_COLS).astype(np.int64)
+    hazard = flat[n_dec : n_dec + n_hz].reshape(rounds, num_nodes) > 0.5
+    off = n_dec + n_hz
+    explain = (
+        flat[off : off + n_ex].reshape(rounds, 6, explain_k)
+        if explain_k > 0
+        else None
+    )
+    off += n_ex
+    metrics = flat[off:].reshape(rounds, h)
+    out: list[RoundView] = []
+    for r in range(rounds):
+        out.append(
+            RoundView(
+                most=int(dec[r, DEC_MOST]),
+                victim=int(dec[r, DEC_VICTIM]),
+                service=int(dec[r, DEC_SERVICE]),
+                target=int(dec[r, DEC_TARGET]),
+                landed=int(dec[r, DEC_LANDED]),
+                hazard=hazard[r],
+                cost=float(metrics[r, METRIC_COST]),
+                load_std=float(metrics[r, METRIC_LOAD_STD]),
+                attr_flat=(
+                    metrics[r, METRIC_HEAD:] if h > METRIC_HEAD else None
+                ),
+                explain=explain[r] if explain is not None else None,
+            )
+        )
+    return out
+
+
+def decode_fleet_block(
+    flat: np.ndarray, *, rounds: int, tenants: int, num_nodes: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Unpack one fleet scan bundle: ``(decisions i64[K,T,4],
+    hazard bool[K,T,N], landed i64[K,T], metrics f32[K,T,2])``."""
+    flat = np.asarray(flat, dtype=np.float32)
+    k, t, n = rounds, tenants, num_nodes
+    sizes = (k * t * 4, k * t * n, k * t, k * t * 2)
+    if flat.size != sum(sizes):
+        raise ValueError(
+            f"fleet scan bundle of {flat.size} values does not decode at "
+            f"rounds={k}, tenants={t}, num_nodes={n}"
+        )
+    o1, o2, o3 = np.cumsum(sizes)[:3]
+    decisions = flat[:o1].reshape(k, t, 4).astype(np.int64)
+    hazard = flat[o1:o2].reshape(k, t, n) > 0.5
+    landed = flat[o2:o3].reshape(k, t).astype(np.int64)
+    metrics = flat[o3:].reshape(k, t, 2)
+    return decisions, hazard, landed, metrics
+
+
+# ---- scan-plane accounting (OBSERVABILITY.md "Round scan") ----
+
+
+def count_scan_block(registry, rounds: int) -> None:
+    """One scan dispatch landed: count the block and publish how many
+    rounds it advanced per dispatch (the amortization headline)."""
+    registry.counter(
+        "scan_blocks_total",
+        "device-resident scan blocks dispatched (each advances "
+        "scan_rounds_per_dispatch rounds in one compiled program)",
+    ).inc()
+    registry.gauge(
+        "scan_rounds_per_dispatch",
+        "rounds advanced by the most recent scan-block dispatch",
+    ).set(rounds)
+
+
+def count_scan_drain(registry, reason: str) -> None:
+    """A round executed on the per-round path while the scanned schedule
+    was configured — the drain discipline's visible half."""
+    registry.counter(
+        "scan_drains_total",
+        "rounds drained from the scanned schedule to the per-round path, "
+        "by reason",
+        labelnames=("reason",),
+    ).labels(reason=reason).inc()

@@ -184,13 +184,26 @@ def _add_pipeline_flags(parser: argparse.ArgumentParser) -> None:
              "implemented — other values are rejected so telemetry "
              "never reports a schedule that did not run",
     )
+    parser.add_argument(
+        "--scan-block", type=int, default=0,
+        help="device-resident round scan: advance K steady-state rounds "
+             "per compiled dispatch (decide + sim-twin apply + round-end "
+             "metrics fused in one lax.scan, ONE counted round_end "
+             "transfer per block). Rounds the scan cannot honor — "
+             "churn, breaker events, checkpoints, chaos/live backends — "
+             "drain to the per-round path "
+             "(scan_drains_total{reason}). Requires a pinning greedy "
+             "algorithm with one move per round on the sim backend; "
+             "mutually exclusive with --pipeline. 0 = off",
+    )
 
 
 def _pipeline_config(args):
     from kubernetes_rescheduling_tpu.config import ControllerConfig
 
     return ControllerConfig(
-        pipeline=args.pipeline, depth=args.pipeline_depth
+        pipeline=args.pipeline, depth=args.pipeline_depth,
+        scan_block=args.scan_block,
     )
 
 
@@ -930,6 +943,7 @@ def cmd_bench(args) -> dict:
         forecast=_forecast_config(args),
         pipeline=args.pipeline,
         pipeline_depth=args.pipeline_depth,
+        scan_block=args.scan_block,
         reconcile=_reconcile_config(args),
         serve_port=args.serve,
         bundle_dir=args.bundle_dir,

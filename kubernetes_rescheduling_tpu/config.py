@@ -34,6 +34,22 @@ POLICIES: tuple[str, ...] = (
 )
 
 
+# greedy policies whose placement mechanism PINS the landing node
+# (nodeName/nodeSelector): the device-resident round scan can replay
+# their moves knowing where they land. Mirrored from
+# backends.k8s.PlacementMechanism so config validation stays
+# import-light — tests/test_scan.py asserts the two registries agree.
+# kubescheduling is deliberately absent: its affinityOnly mechanism
+# delegates the landing to the (simulated) scheduler, and a scanned
+# block must not bet K future decisions on an f32 twin of an f64 choice.
+SCAN_POLICIES: tuple[str, ...] = (
+    "spread",
+    "binpack",
+    "random",
+    "communication",
+)
+
+
 # the named churn profiles elastic/events.py implements (mirrored here so
 # config validation stays jax/numpy-free — the elastic package asserts the
 # two registries agree)
@@ -177,6 +193,19 @@ class ControllerConfig:
     never report a schedule that did not run; the knob reserves the
     config surface for speculative deeper variants.
 
+    ``scan_block`` selects the third schedule — the device-resident
+    round scan (``bench/scan.py``): K > 0 fuses K steady-state rounds
+    (decide → sim-twin apply → monitor → round-end metrics) into ONE
+    compiled ``lax.scan`` dispatch and ONE counted ``round_end``
+    transfer per block, draining to the per-round path on anything the
+    scan cannot honor (churn, breaker events, checkpoints, incompatible
+    backends — counted ``scan_drains_total{reason}``). Mutually
+    exclusive with ``pipeline`` (they are different schedules of the
+    same loop), and only meaningful for pinning greedy algorithms with
+    ``moves_per_round=1`` on the hermetic sim backend — validation in
+    ``RescheduleConfig`` enforces the config-level half; the loop
+    drains at runtime on the rest. 0 = off.
+
     ``donate_carry`` gates donation of the GLOBAL SOLVER's snapshot
     carry (``global_assign_donated`` — the output placement aliases the
     input instead of holding both; visible in the ``jax_hbm_*``
@@ -193,6 +222,7 @@ class ControllerConfig:
     pipeline: bool = False
     depth: int = 2
     donate_carry: bool = True
+    scan_block: int = 0
 
     def validate(self) -> "ControllerConfig":
         if self.depth != 2:
@@ -200,6 +230,18 @@ class ControllerConfig:
                 f"controller pipeline depth must be 2 (the only "
                 f"implemented schedule: one round closing while the next "
                 f"decides), got {self.depth}"
+            )
+        if self.scan_block < 0:
+            raise ValueError(
+                f"controller scan_block must be >= 0 (0 = scanned "
+                f"schedule off), got {self.scan_block}"
+            )
+        if self.scan_block and self.pipeline:
+            raise ValueError(
+                "controller scan_block and pipeline are mutually "
+                "exclusive schedules of the same loop: the scan already "
+                "amortizes dispatch and transfer over K rounds, so there "
+                "is no per-round tail left to overlap"
             )
         return self
 
@@ -639,6 +681,45 @@ class RescheduleConfig:
                 "item 5)"
             )
         self.controller.validate()
+        if self.controller.scan_block:
+            # two tiers of incompatibility: configurations whose
+            # DECISIONS are made outside the scan body (global/pod
+            # solvers, the forecast plane, affinityOnly landings, a live
+            # cluster, shadow replay) can never scan and are REJECTED
+            # here; environmental planes (chaos, elastic churn,
+            # checkpoints, load hooks) are legal and DRAIN per round at
+            # runtime instead — visibly, via scan_drains_total{reason}
+            # — because drain-heavy runs are a supported shape (the
+            # chaos-drain soaks are test-pinned) and churn/checkpoints
+            # can also arrive through run_controller arguments no
+            # config validation can see
+            if self.algorithm not in SCAN_POLICIES:
+                raise ValueError(
+                    f"controller scan_block requires a pinning greedy "
+                    f"algorithm {sorted(SCAN_POLICIES)} (got "
+                    f"{self.algorithm!r}: global/pod solvers and the "
+                    f"forecast plane decide outside the scan body, and "
+                    f"kubescheduling's affinityOnly landing belongs to "
+                    f"the scheduler, not the twin)"
+                )
+            if self.moves_per_round != 1:
+                raise ValueError(
+                    "controller scan_block requires moves_per_round=1 "
+                    "(the scan body is the reference-faithful "
+                    "one-decision round)"
+                )
+            if self.backend != "sim":
+                raise ValueError(
+                    "controller scan_block requires the hermetic sim "
+                    "backend: the device twin IS the simulator's "
+                    "steady-state update, and a live cluster has no twin"
+                )
+            if self.shadow.enabled:
+                raise ValueError(
+                    "controller scan_block cannot compose with shadow "
+                    "mode: replayed trace windows drive every round, so "
+                    "there is no steady state for the twin to scan"
+                )
         self.obs.validate()
         self.perf.validate()
         self.reconcile.validate()

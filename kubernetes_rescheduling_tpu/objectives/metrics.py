@@ -45,6 +45,66 @@ def communication_cost(state: ClusterState, graph: CommGraph) -> jax.Array:
     return 0.5 * jnp.sum(adj * cross)
 
 
+def comm_edge_list(graph: CommGraph):
+    """Host-side: the masked adjacency's upper-triangle nonzero edges as
+    ``(src i32[E], dst i32[E], w f32[E])`` device arrays — the static
+    structure :func:`communication_cost_edges` contracts against.
+
+    The dense quadratic form pays O(S²·N) FLOPs plus several S×S
+    temporaries per evaluation; real service meshes are sparse (the
+    powerlaw scenario carries ~4 edges per service), so the same scalar
+    is O(E·N) off the edge list — the difference between the round-end
+    metrics kernel dominating a CPU round and disappearing into it.
+    Build once per (static) graph and reuse.
+
+    E is padded up to the next power of two (floor 8 — the same
+    quantization rule as ``elastic.buckets.bucket_capacity``, mirrored
+    here so objectives stays import-light) with zero-weight self-edges:
+    a churn event that adds or removes a few graph edges must land in
+    the SAME compiled round-end signature, or every graph-changing
+    churn round would silently retrace the kernel the 1-trace invariant
+    pins (padding rows contribute exactly ``0·cross == 0``).
+    """
+    import numpy as np
+
+    adj = np.asarray(graph.adj)
+    valid = np.asarray(graph.service_valid)
+    masked = adj * valid[:, None] * valid[None, :]
+    src, dst = np.nonzero(np.triu(masked, k=1))
+    w = masked[src, dst].astype(np.float32)
+    cap = 8
+    while cap < src.size:
+        cap *= 2
+    pad = cap - src.size
+    src = np.concatenate([src, np.zeros(pad, np.int64)])
+    dst = np.concatenate([dst, np.zeros(pad, np.int64)])
+    w = np.concatenate([w, np.zeros(pad, np.float32)])
+    return (
+        jnp.asarray(src, jnp.int32),
+        jnp.asarray(dst, jnp.int32),
+        jnp.asarray(w, jnp.float32),
+    )
+
+
+def communication_cost_edges(
+    state: ClusterState, num_services: int, edges
+) -> jax.Array:
+    """:func:`communication_cost` contracted over a precomputed edge
+    list (:func:`comm_edge_list`): Σ_{i<j} w_ij·(tot_i·tot_j − occ_i·occ_j)
+    — the same quantity as the dense quadratic form (each unordered pair
+    once ≡ half the symmetric double sum), in O(E·N) instead of O(S²·N).
+    f32 summation ORDER differs from the dense kernel, so the two are
+    equal mathematically, not bit-for-bit — every consumer of a run must
+    use one formulation throughout (the round-end protocol picks per
+    run: edge list when attribution is off, dense — whose S×S work the
+    attribution bundle needs anyway — when it is on)."""
+    src, dst, w = edges
+    occ = state.service_node_counts(num_services)        # f32[S, N]
+    tot = occ.sum(axis=1)                                # f32[S]
+    cross = tot[src] * tot[dst] - jnp.sum(occ[src] * occ[dst], axis=1)
+    return jnp.sum(w * cross)
+
+
 def communication_cost_deployment(state: ClusterState, graph: CommGraph) -> jax.Array:
     """Deployment-level cost, exactly the reference's accounting.
 

@@ -17,11 +17,14 @@ One stray ``jax.block_until_ready`` / ``jax.device_get`` /
 per-round RTTs the single-bundle protocol removed — the exact failure
 mode BENCH_r04/r05 measured as a 4-5× wall-over-device gap. AST-based,
 like its sibling ``check_boundary_retry.py``: inside
-``bench/controller.py`` and ``bench/fleet.py``, a call named
-``block_until_ready``, ``device_get``, or ``pull`` is only legal inside
-the functions named in ``ALLOWED_FUNCS`` (the fleet loop's designated
-bundle-pull helper). ``bench/round_end.py`` is the designated home of
-the real sync primitives and is deliberately not checked.
+``bench/controller.py``, ``bench/fleet.py``, and ``bench/scan.py``, a
+call named ``block_until_ready``, ``device_get``, or ``pull`` is only
+legal inside that file's designated block-boundary fence functions (the
+per-file allowlist in ``CHECKED`` — the fleet loop's bundle-pull helper
+and the scan module's block pull, which together make "one transfer per
+K scanned rounds" statically enforceable). ``bench/round_end.py`` is
+the designated home of the real sync primitives and is deliberately not
+checked.
 
 Run directly (exit 1 on violation) or through its test twin
 (tests/test_apply_boundary.py).
@@ -34,16 +37,20 @@ import sys
 from pathlib import Path
 
 PACKAGE = Path(__file__).resolve().parent.parent / "kubernetes_rescheduling_tpu"
-# the control loops whose round helpers must stay sync-free outside the
-# designated boundaries (round_end.py itself is the designated module)
-CHECKED = (
-    PACKAGE / "bench" / "controller.py",
-    PACKAGE / "bench" / "fleet.py",
-)
 BANNED_CALLS = {"block_until_ready", "device_get", "pull"}
-# functions allowed to contain a banned call: the fleet loop's designated
-# round-end transfer site
-ALLOWED_FUNCS = {"_pull_round_bundle"}
+# the control loops whose round helpers must stay sync-free outside the
+# designated boundaries (round_end.py itself is the designated module):
+# file -> functions allowed to contain a banned call in that file
+CHECKED: dict[Path, frozenset[str]] = {
+    PACKAGE / "bench" / "controller.py": frozenset(),
+    # the fleet loop's designated round-end transfer site
+    PACKAGE / "bench" / "fleet.py": frozenset({"_pull_round_bundle"}),
+    # the scan module's designated block-boundary transfer: ONE counted
+    # round_end pull per K-round scan block
+    PACKAGE / "bench" / "scan.py": frozenset({"pull_block"}),
+}
+# the union, kept as the default for direct find_raw_syncs() callers
+ALLOWED_FUNCS = frozenset().union(*CHECKED.values())
 
 
 def _call_name(node: ast.Call) -> str | None:
@@ -55,9 +62,12 @@ def _call_name(node: ast.Call) -> str | None:
     return None
 
 
-def find_raw_syncs(path: Path) -> list[tuple[int, str]]:
+def find_raw_syncs(
+    path: Path, allowed: frozenset[str] | None = None
+) -> list[tuple[int, str]]:
     """(line, description) pairs for banned sync calls outside the
-    designated functions."""
+    designated functions (``allowed`` defaults to the union allowlist)."""
+    allowed = ALLOWED_FUNCS if allowed is None else allowed
     tree = ast.parse(path.read_text(), filename=str(path))
     out: list[tuple[int, str]] = []
 
@@ -68,7 +78,7 @@ def find_raw_syncs(path: Path) -> list[tuple[int, str]]:
                 child_func = child.name
             if isinstance(child, ast.Call):
                 name = _call_name(child)
-                if name in BANNED_CALLS and func not in ALLOWED_FUNCS:
+                if name in BANNED_CALLS and func not in allowed:
                     out.append(
                         (child.lineno, f"{name}(...) in {func or '<module>'}")
                     )
@@ -81,8 +91,8 @@ def find_raw_syncs(path: Path) -> list[tuple[int, str]]:
 def violations() -> list[str]:
     return [
         f"{path.relative_to(PACKAGE.parent)}:{line}: {what}"
-        for path in CHECKED
-        for line, what in find_raw_syncs(path)
+        for path, allowed in CHECKED.items()
+        for line, what in find_raw_syncs(path, allowed)
     ]
 
 

@@ -60,8 +60,15 @@ ENTRY_POINTS: dict[str, tuple[str, ...]] = {
         "capacity_violation",
         "node_pair_cost_matrix",
         "communication_cost_attribution",
+        "communication_cost_edges",
     ),
     "bench/round_end.py": ("round_end_metrics",),
+    "backends/sim_device.py": (
+        "scheduler_choice",
+        "apply_decision",
+        "sim_step",
+    ),
+    "bench/scan.py": ("_scan_rounds", "_fleet_scan_rounds"),
     "policies/hazard.py": ("detect_hazard",),
     "policies/scoring.py": ("node_features", "policy_scores", "choose_node"),
     "policies/victim.py": ("pick_victim", "deployment_group"),

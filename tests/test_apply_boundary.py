@@ -51,3 +51,30 @@ def test_checker_flags_module_level_calls(tmp_path):
     f = tmp_path / "mod.py"
     f.write_text("import jax\nx = jax.device_get(1)\n")
     assert [line for line, _ in checker.find_raw_syncs(f)] == [2]
+
+
+def test_scan_module_is_checked_with_its_own_allowlist(tmp_path):
+    """ISSUE 12: the scan module is covered with ``pull_block`` as its
+    ONLY designated sync site — 'one transfer per K scanned rounds' is a
+    static property, not a convention. Per-file allowlists must not
+    leak: the fleet helper's name does not legalize a sync in
+    controller.py, and vice versa."""
+    checker = _load_checker()
+    by_name = {p.name: allowed for p, allowed in checker.CHECKED.items()}
+    assert by_name["scan.py"] == frozenset({"pull_block"})
+    assert by_name["fleet.py"] == frozenset({"_pull_round_bundle"})
+    assert by_name["controller.py"] == frozenset()
+    # a pull anywhere else in a scan-shaped module is flagged
+    f = tmp_path / "scan.py"
+    f.write_text(
+        "def pull_block(arr):\n"
+        "    return pull(arr, site='round_end')\n"   # designated: allowed
+        "def decode_block(flat):\n"
+        "    return pull(flat, site='oops')\n"        # stray: flagged
+    )
+    hits = checker.find_raw_syncs(f, by_name["scan.py"])
+    assert [line for line, _ in hits] == [4]
+    # the fleet allowlist does NOT legalize scan.py's site (and the
+    # union default would — per-file scoping is the point)
+    hits_fleet = checker.find_raw_syncs(f, by_name["fleet.py"])
+    assert [line for line, _ in hits_fleet] == [2, 4]

@@ -83,8 +83,12 @@ def test_controller_global_mode():
 
 
 def test_harness_matrix(tmp_path):
+    # two algorithms cover both controller routes (greedy + global —
+    # the global cells are test_controller_global_mode's surviving fast
+    # pin) × two repeats for the per-run seeding/aggregate machinery;
+    # a third greedy policy re-proves nothing the policy suite doesn't
     cfg = ExperimentConfig(
-        algorithms=("spread", "communication", "global"),
+        algorithms=("communication", "global"),
         repeats=2,
         rounds=3,
         scenario="mubench",
@@ -92,8 +96,8 @@ def test_harness_matrix(tmp_path):
         seed=3,
     )
     summary = run_experiment(cfg)
-    assert len(summary["runs"]) == 6
-    assert set(summary["aggregate"]) == {"spread", "communication", "global"}
+    assert len(summary["runs"]) == 4
+    assert set(summary["aggregate"]) == {"communication", "global"}
     sessions = list(tmp_path.glob("session_*"))
     assert len(sessions) == 1
     run_dir = sessions[0] / "communication" / "run_1"
@@ -131,6 +135,7 @@ def test_moves_per_round_drains_hazard_faster():
         assert len(r.services_moved) <= 3
 
 
+@pytest.mark.slow  # the global-round machinery this routes into stays pinned fast by test_telemetry.test_run_controller_global_objectives_surface and the harness matrix's global cells; the moves_per_round="all" spelling shares the controller's algorithm=="global" branch and its config acceptance is pinned fast by test_moves_per_round_validation below — this variant re-proves the composition with its own ~20 s solver compile
 def test_moves_per_round_all_routes_to_global_solver():
     from kubernetes_rescheduling_tpu.objectives import load_std
 

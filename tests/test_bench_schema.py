@@ -135,3 +135,35 @@ def test_pipeline_headline_conforms():
         },
     }
     assert checker.check_parsed(pipeline_like, "pipeline") == []
+
+
+def test_scan_headline_conforms():
+    """The scan cell's result dict (bench.bench_scan's shape — the
+    scan_rounds_per_sec perf-ledger series, the first throughput series
+    with ``better: higher``) satisfies the same parsed-record schema the
+    history is held to."""
+    checker = _load_checker()
+    scan_like = {
+        "metric": "scan_rounds_per_sec",
+        "value": 183.4,
+        "unit": "rounds/s",
+        "better": "higher",
+        "vs_baseline": 18.3,
+        "extra": {
+            "scenario": "scan",
+            "rounds": 48,
+            "scan_block": 16,
+            "scan_blocks_total": 4,
+            "sequential_rounds_per_sec": 30.1,
+            "pipelined_rounds_per_sec": 31.9,
+            "whole_loop_rounds_per_sec": {
+                "sequential": 27.2, "pipelined": 27.3, "scanned": 105.4,
+            },
+            "speedup_vs_pipelined": 5.74,
+            "speedup_vs_sequential": 6.1,
+            "bit_identical": True,
+            "scan_traces": 1,
+            "traces_pinned": True,
+        },
+    }
+    assert checker.check_parsed(scan_like, "scan") == []

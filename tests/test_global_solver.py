@@ -339,15 +339,21 @@ def test_move_cost_sparse_matches_dense_semantics():
 def test_prepared_weights_identical_solve():
     """Injecting prepare_weights' matrix gives bit-identical decisions to
     the self-built path (it IS the same matrix)."""
-    from kubernetes_rescheduling_tpu.core.topology import synthetic_scenario
     from kubernetes_rescheduling_tpu.solver.global_solver import prepare_weights
 
-    scn = synthetic_scenario(n_pods=300, n_nodes=8, seed=4, mean_degree=4.0)
+    # EXACTLY the (shape, config) signature test_never_worse_than_input
+    # already compiled global_assign at — config is a static jit arg, so
+    # the identical signature keeps this test's no-w_mm solve off the
+    # tier-1 compile bill (the parity claim itself is size-independent;
+    # only the w_mm variant's distinct trace compiles here)
+    wm = mubench_workmodel_c()
+    scn_state = state_from_workmodel(wm, seed=12)
+    scn_graph = wm.comm_graph()
     cfg = GlobalSolverConfig(sweeps=4)
     key = jax.random.PRNGKey(2)
-    w_mm = prepare_weights(scn.state, scn.graph, cfg)
-    st_a, info_a = global_assign(scn.state, scn.graph, key, cfg)
-    st_b, info_b = global_assign(scn.state, scn.graph, key, cfg, w_mm=w_mm)
+    w_mm = prepare_weights(scn_state, scn_graph, cfg)
+    st_a, info_a = global_assign(scn_state, scn_graph, key, cfg)
+    st_b, info_b = global_assign(scn_state, scn_graph, key, cfg, w_mm=w_mm)
     np.testing.assert_array_equal(
         np.asarray(st_a.pod_node), np.asarray(st_b.pod_node)
     )

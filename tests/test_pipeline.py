@@ -367,7 +367,10 @@ def test_donated_global_carry_survives_degraded_round(registry, pipeline):
     pre-read placement) — so the degraded-round contract survives
     donation, with decisions identical to a donation-off run."""
     def run(donate_carry: bool):
-        backend = _FailOnceMonitor(_backend(11, seed=7), fail_call=3)
+        # n_nodes=10 deliberately matches the donated-carry global test
+        # above: the donated solver's compiled signature is shared, so
+        # this regression pays only the undonated twin's compile
+        backend = _FailOnceMonitor(_backend(10, seed=7), fail_call=3)
         cfg = RescheduleConfig(
             algorithm="global", max_rounds=4, sleep_after_action_s=0.0,
             balance_weight=0.5,

@@ -40,6 +40,7 @@ def test_pod_graph_expansion_matches_pod_level_metric():
         assert dense_metric == pytest.approx(sparse_metric, rel=1e-6)
 
 
+@pytest.mark.slow  # the splits-replicas capability stays pinned fast by test_capacity_stuck_fixture_through_controller below: the SAME stuck fixture driven end-to-end through the controller, asserting the final placement realizes the split service mode cannot reach — this is the kernel-level redundant variant (own solver compile)
 def test_pod_mode_splits_replicas_where_service_mode_cannot_move():
     """4 replicas of A on n1, their peer B on n0, caps that fit at most
     two 100m pods per node: whole-deployment placement is stuck (A cannot

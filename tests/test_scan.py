@@ -1,0 +1,586 @@
+"""ISSUE 12: device-resident round scan — K rounds per dispatch with a
+jittable sim twin.
+
+The contracts under test:
+
+- **Bit-parity oracle** — seeded multi-round trajectories through the
+  jitted ``backends.sim_device.sim_step`` and the Python ``SimBackend``
+  produce bit-identical placements and loads (placement sha1 pinned
+  equal per round), including moves that land on over-capacity nodes,
+  moves targeting dead nodes (no-ops on both sides), and the
+  ``affinityOnly`` scheduler-choice fallback. The shared
+  ``workload_layout`` keeps post-churn twins aligned with the backend.
+- **Scanned schedule** (``[controller] scan_block``) — records and
+  event streams bit-identical to the sequential loop modulo timing
+  fields, on static AND chaos-drain soaks; exactly ONE counted
+  ``round_end`` transfer per scan block; ``jax_traces_total
+  {fn="scan_rounds"} == 1`` in steady state; every per-round-path
+  fallback counted in ``scan_drains_total{reason}``.
+- **Fleet composition** — one ``fleet_scan_rounds`` dispatch advances
+  every tenant K rounds, per-tenant streams bit-identical to the
+  sequential fleet loop.
+
+Node counts in this file stay in the 16-23 range (prefix ``sn``) so the
+module-level kernels compile fresh here — trace pins cannot be
+satisfied by another test file's cache entries, and each pin test uses
+its own count so it cannot be satisfied by a sibling test's.
+"""
+
+import hashlib
+import io
+import json
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends.base import MoveRequest
+from kubernetes_rescheduling_tpu.backends.k8s import PlacementMechanism
+from kubernetes_rescheduling_tpu.backends.sim import (
+    LoadModel,
+    SimBackend,
+    workload_layout,
+)
+from kubernetes_rescheduling_tpu.backends.sim_device import (
+    apply_decision,
+    scan_compatible,
+    scheduler_choice,
+    sim_step,
+    twin_of,
+)
+from kubernetes_rescheduling_tpu.bench.controller import run_controller
+from kubernetes_rescheduling_tpu.config import (
+    SCAN_POLICIES,
+    POLICIES,
+    ChaosConfig,
+    ControllerConfig,
+    ElasticConfig,
+    RescheduleConfig,
+)
+from kubernetes_rescheduling_tpu.core.workmodel import (
+    ServiceSpec,
+    Workmodel,
+    mubench_workmodel_c,
+)
+from kubernetes_rescheduling_tpu.telemetry import get_registry
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MetricsRegistry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+from kubernetes_rescheduling_tpu.utils.retry import RetryPolicy
+
+
+@pytest.fixture()
+def registry():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+def _backend(n_nodes: int, seed: int = 0, cap_m: float = 20_000.0) -> SimBackend:
+    backend = SimBackend(
+        workmodel=mubench_workmodel_c(),
+        node_names=[f"sn{i}" for i in range(n_nodes)],
+        node_cpu_cap_m=cap_m,
+        seed=seed,
+        load=LoadModel(entry_rps=100.0, cost_per_req_m=8.0, idle_m=50.0),
+    )
+    backend.inject_imbalance(backend.node_names[0])
+    return backend
+
+
+# timing-only fields: everything else must be bit-equal (the pipeline
+# suite's convention)
+TIMING_FIELDS = {
+    "decision_latencies_s", "decision_latency_s", "wall_s", "pipeline",
+}
+
+
+def _strip(rec) -> dict:
+    return {k: v for k, v in rec.as_dict().items() if k not in TIMING_FIELDS}
+
+
+def _events(log):
+    out = []
+    for r in log.records:
+        if r["event"] in ("decision", "round"):
+            out.append({
+                k: v for k, v in r.items()
+                if k not in ("ts", "decision_latency_s")
+            })
+    return out
+
+
+def _run(
+    *, scan_block: int, n_nodes: int, rounds: int,
+    algo: str = "communication", chaos_profile: str = "none",
+    churn_profile: str = "none",
+    retry: RetryPolicy | None = None, max_consecutive_failures: int = 5,
+    with_logger: bool = True, seed: int = 0, checkpoint_dir=None,
+):
+    cfg = RescheduleConfig(
+        algorithm=algo,
+        max_rounds=rounds,
+        sleep_after_action_s=0.0,
+        seed=seed,
+        chaos=ChaosConfig(profile=chaos_profile, seed=seed),
+        elastic=ElasticConfig(profile=churn_profile, seed=0),
+        max_consecutive_failures=max_consecutive_failures,
+        retry=retry if retry is not None else RetryPolicy(),
+        controller=ControllerConfig(scan_block=scan_block),
+    )
+    logger = StructuredLogger(name="t") if with_logger else None
+    result = run_controller(
+        _backend(n_nodes, seed=seed), cfg,
+        key=jax.random.PRNGKey(seed), logger=logger,
+        checkpoint_dir=checkpoint_dir,
+    )
+    return result, logger
+
+
+# ---------------- the bit-parity oracle: jitted sim_step vs SimBackend ---
+
+
+def _digest(state) -> str:
+    return hashlib.sha1(
+        np.asarray(state.pod_node).tobytes()
+        + np.asarray(state.pod_valid).tobytes()
+    ).hexdigest()
+
+
+def test_sim_step_oracle_parity(registry):
+    """Seeded 24-round trajectory driven through BOTH halves: the jitted
+    twin and the Python simulator stay bit-identical — placements
+    (sha1) and loads — across pinned moves, moves that land on full
+    (over-capacity) nodes, moves targeting a dead node (no-ops on both
+    sides), and the affinityOnly scheduler-choice fallback."""
+    backend = _backend(16, seed=7, cap_m=700.0)  # tiny caps: nodes run full
+    backend.kill_node(backend.node_names[5])     # a dead target to aim at
+    state, graph = twin_of(backend)
+    assert np.array_equal(
+        np.asarray(state.pod_node), np.asarray(backend.monitor().pod_node)
+    )
+    step = jax.jit(sim_step, static_argnames=("pinned",))
+    rng = np.random.default_rng(7)
+    svc_arr = np.asarray(state.pod_service)
+    valid = np.asarray(state.pod_valid)
+    n = state.num_nodes
+    for rnd in range(24):
+        svc = int(rng.integers(len(backend.workmodel.services)))
+        pods = np.flatnonzero(valid & (svc_arr == svc))
+        victim = int(pods[0])
+        # every 4th move targets the dead node; every 3rd goes through
+        # the scheduler-choice fallback with a random hazard set
+        target = 5 if rnd % 4 == 3 else int(rng.integers(n))
+        affinity = rnd % 3 == 1
+        hazard = np.zeros(n, dtype=bool)
+        hazard[rng.choice(n, size=4, replace=False)] = True
+        mech = "affinityOnly" if affinity else "nodeName"
+        new_state, snap = step(
+            state,
+            (jnp.asarray(victim), jnp.asarray(svc), jnp.asarray(target),
+             jnp.asarray(hazard)),
+            pinned=not affinity,
+        )
+        backend.apply_move(
+            MoveRequest(
+                service=backend.workmodel.services[svc].name,
+                target_node=backend.node_names[target],
+                hazard_nodes=tuple(
+                    backend.node_names[j] for j in np.flatnonzero(hazard)
+                ),
+                mechanism=mech,
+            )
+        )
+        observed = backend.monitor()
+        assert _digest(snap) == _digest(observed), f"round {rnd} diverged"
+        np.testing.assert_array_equal(
+            np.asarray(snap.pod_node), np.asarray(observed.pod_node)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(snap.pod_cpu), np.asarray(observed.pod_cpu)
+        )
+        state = new_state
+
+
+def test_sim_step_post_churn_parity(registry):
+    """Satellite 6: twin construction and ``SimBackend._refresh_workload``
+    share ONE ``workload_layout`` — after deploys, teardowns (index
+    compaction), and autoscaling under a padded service bucket, a
+    rebuilt twin still tracks the backend bit-for-bit."""
+    wm = Workmodel(
+        services=(
+            ServiceSpec(name="a", callees=("b",), replicas=2),
+            ServiceSpec(name="b", callees=("c",)),
+            ServiceSpec(name="c"),
+        )
+    )
+    backend = SimBackend(
+        workmodel=wm,
+        node_names=[f"sn{i}" for i in range(4)],
+        seed=3,
+        load=LoadModel(entry_service="a"),
+        service_capacity=8,
+        pod_capacity=32,
+    )
+    backend.deploy_service(ServiceSpec(name="d", callees=("a",), replicas=2))
+    backend.teardown_service("b")   # compacts every later service index
+    backend.scale_replicas("a", 3)
+    state, graph = twin_of(backend)
+    # the layout the twin sees IS the layout the backend serves
+    g2, idx = workload_layout(backend.workmodel, backend.service_capacity)
+    assert graph.names == backend.comm_graph().names == g2.names
+    assert graph.num_services == backend.comm_graph().num_services
+    step = jax.jit(sim_step, static_argnames=("pinned",))
+    svc_arr = np.asarray(state.pod_service)
+    valid = np.asarray(state.pod_valid)
+    for rnd, name in enumerate(("a", "c", "d")):
+        svc = idx[name]
+        victim = int(np.flatnonzero(valid & (svc_arr == svc))[0])
+        target = rnd % len(backend.node_names)
+        hazard = np.zeros(state.num_nodes, dtype=bool)
+        state, snap = step(
+            state,
+            (jnp.asarray(victim), jnp.asarray(svc), jnp.asarray(target),
+             jnp.asarray(hazard)),
+            pinned=True,
+        )
+        backend.apply_move(
+            MoveRequest(
+                service=name,
+                target_node=backend.node_names[target],
+                hazard_nodes=(),
+                mechanism="nodeName",
+            )
+        )
+        assert _digest(snap) == _digest(backend.monitor())
+
+
+def test_scheduler_choice_matches_python(registry):
+    """The device scheduler-choice twin picks exactly the node the
+    Python ``_scheduler_choice`` would — least allocated CPU among
+    alive non-excluded nodes, tie → first in node order."""
+    backend = _backend(17, seed=1)
+    backend.kill_node(backend.node_names[3])
+    state, _ = twin_of(backend)
+    for excl in ((), (0, 1), (0, 1, 2, 4)):
+        hazard = np.zeros(state.num_nodes, dtype=bool)
+        hazard[list(excl)] = True
+        want = backend._scheduler_choice(
+            exclude=tuple(backend.node_names[j] for j in excl)
+        )
+        got = int(jax.jit(scheduler_choice)(state, jnp.asarray(hazard)))
+        assert got == want
+    # nothing eligible -> -1 (the Python None path)
+    all_h = np.ones(state.num_nodes, dtype=bool)
+    assert int(jax.jit(scheduler_choice)(state, jnp.asarray(all_h))) == -1
+
+
+def test_scan_policy_registry_mirrors_mechanism_table():
+    """SCAN_POLICIES (config-side mirror, import-light) must equal the
+    greedy policies whose PlacementMechanism pins the landing node."""
+    assert set(SCAN_POLICIES) == {
+        p for p in POLICIES if PlacementMechanism[p] != "affinityOnly"
+    }
+    assert scan_compatible(_backend(4)) is True
+    noisy = _backend(4)
+    noisy.load.noise_frac = 0.1
+    assert scan_compatible(noisy) is False
+
+
+# ---------------- scanned schedule: bit-identity + transfer/trace pins ---
+
+
+def test_scanned_bit_identical_to_sequential_acceptance(registry):
+    """THE acceptance soak (tier-1): scanned records and event streams
+    bit-identical to the sequential loop (explain + attribution live),
+    exactly ONE counted round_end transfer per scan block, 1 steady-
+    state trace of the fused kernel, and tail rounds drained+counted."""
+    rounds, block = 8, 3
+    fam = registry.counter("device_transfers_total", labelnames=("site",))
+    seq, seq_log = _run(scan_block=0, n_nodes=18, rounds=rounds)
+    assert fam.labels(site="round_end").value == rounds
+    sc, sc_log = _run(scan_block=block, n_nodes=18, rounds=rounds)
+    # 2 full blocks (1 transfer each) + 2 drained tail rounds (1 each)
+    assert fam.labels(site="round_end").value == rounds + 4
+    assert len(seq.rounds) == len(sc.rounds) == rounds
+    for a, b in zip(seq.rounds, sc.rounds):
+        assert _strip(a) == _strip(b)
+    assert _events(seq_log) == _events(sc_log)
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="scan_rounds").value == 1
+    assert registry.counter("scan_blocks_total").value == 2
+    assert registry.gauge("scan_rounds_per_dispatch").value == block
+    drains = registry.counter("scan_drains_total", labelnames=("reason",))
+    assert drains.labels(reason="tail").value == 2
+
+
+def test_scanned_chaos_drain_soak_bit_identical(registry):
+    """Chaos wraps the backend, so the scanned schedule must drain EVERY
+    round to the per-round path (reason="backend") and remain
+    bit-identical to the sequential chaos run — skips, breaker
+    transitions, and records included."""
+    kwargs = dict(
+        n_nodes=19, rounds=14, chaos_profile="soak",
+        retry=RetryPolicy(max_attempts=1), max_consecutive_failures=2,
+    )
+    seq, _ = _run(scan_block=0, **kwargs)
+    sc, _ = _run(scan_block=4, **kwargs)
+    assert len(sc.rounds) + sc.skipped_rounds == 14
+    assert sc.skipped_rounds == seq.skipped_rounds > 0
+    assert [t["to"] for t in sc.breaker_transitions] == [
+        t["to"] for t in seq.breaker_transitions
+    ]
+    for a, b in zip(seq.rounds, sc.rounds):
+        assert _strip(a) == _strip(b)
+    drains = registry.counter("scan_drains_total", labelnames=("reason",))
+    assert drains.labels(reason="backend").value == 14
+    assert registry.counter("scan_blocks_total").value == 0
+
+
+def test_scanned_drain_reasons_checkpoint_and_churn(registry, tmp_path):
+    """A checkpoint manager (per-round saves) and a churn engine (events
+    the scan cannot foresee) each force the per-round path, counted
+    under their own reasons — and the runs still complete exactly."""
+    res, _ = _run(
+        scan_block=2, n_nodes=20, rounds=2,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    assert len(res.rounds) == 2
+    drains = registry.counter("scan_drains_total", labelnames=("reason",))
+    assert drains.labels(reason="checkpoint").value == 2
+    res2, _ = _run(
+        scan_block=2, n_nodes=20, rounds=2,
+        churn_profile="steady",
+    )
+    assert len(res2.rounds) + res2.skipped_rounds == 2
+    assert drains.labels(reason="churn").value == 2
+    assert registry.counter("scan_blocks_total").value == 0
+
+
+@pytest.mark.slow  # 40-round greedy scan soak: the scanned-vs-sequential invariant stays pinned fast by test_scanned_bit_identical_to_sequential_acceptance above — this is the long-horizon redundant variant
+def test_scanned_long_soak_bit_identical(registry):
+    rounds, block = 40, 8
+    seq, seq_log = _run(scan_block=0, n_nodes=22, rounds=rounds)
+    sc, sc_log = _run(scan_block=block, n_nodes=22, rounds=rounds)
+    for a, b in zip(seq.rounds, sc.rounds):
+        assert _strip(a) == _strip(b)
+    assert _events(seq_log) == _events(sc_log)
+    assert registry.counter("scan_blocks_total").value == rounds // block
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="scan_rounds").value == 1
+
+
+@pytest.mark.slow  # spread/binpack/random scanned parity: the scanned schedule's bit-identity stays pinned fast by the communication-policy acceptance soak above — these are the per-policy redundant variants
+@pytest.mark.parametrize("algo", ["spread", "binpack", "random"])
+def test_scanned_bit_identical_other_policies(registry, algo):
+    seq, _ = _run(scan_block=0, n_nodes=23, rounds=6, algo=algo)
+    sc, _ = _run(scan_block=3, n_nodes=23, rounds=6, algo=algo)
+    for a, b in zip(seq.rounds, sc.rounds):
+        assert _strip(a) == _strip(b)
+
+
+# ---------------- bare loop: edge-list metrics + transfer budget ---------
+
+
+def test_scanned_bare_loop_edge_metrics_bit_identical(registry):
+    """The bare loop (no logger → attribution off) routes the round-end
+    cost scalar over the precomputed edge list in BOTH schedules — the
+    records must still agree bit-for-bit, at one transfer per block."""
+    rounds, block = 4, 2
+    seq, _ = _run(scan_block=0, n_nodes=21, rounds=rounds, with_logger=False)
+    fam = registry.counter("device_transfers_total", labelnames=("site",))
+    assert fam.labels(site="round_end").value == rounds
+    sc, _ = _run(
+        scan_block=block, n_nodes=21, rounds=rounds, with_logger=False
+    )
+    assert fam.labels(site="round_end").value == rounds + 2
+    for a, b in zip(seq.rounds, sc.rounds):
+        assert _strip(a) == _strip(b)
+    assert all(np.isfinite(r.communication_cost) for r in sc.rounds)
+
+
+def test_edge_list_cost_matches_dense_kernel(registry):
+    """``communication_cost_edges`` computes the same quantity as the
+    dense quadratic form — exactly on integer-weighted graphs (mubench)
+    and to f32 tolerance in general."""
+    from kubernetes_rescheduling_tpu.objectives.metrics import (
+        comm_edge_list,
+        communication_cost,
+        communication_cost_edges,
+    )
+
+    backend = _backend(16, seed=2)
+    state = backend.monitor()
+    graph = backend.comm_graph()
+    edges = comm_edge_list(graph)
+    dense = float(communication_cost(state, graph))
+    sparse = float(
+        communication_cost_edges(state, graph.num_services, edges)
+    )
+    assert sparse == dense  # integer-valued at mubench scale: exact
+    # E pads to the power-of-two bucket with INERT zero-weight edges:
+    # small edge-count churn must reuse one compiled signature, and the
+    # padding must not move the scalar
+    src, dst, w = edges
+    assert src.shape[0] >= 8 and src.shape[0] & (src.shape[0] - 1) == 0
+    trimmed = (src[:-1], dst[:-1], w[:-1])
+    if float(w[-1]) == 0.0:  # the padded tail really is inert
+        assert float(
+            communication_cost_edges(state, graph.num_services, trimmed)
+        ) == sparse
+    # a graph-changing churn event within the same bucket must land in
+    # the SAME compiled signature: dropping one edge keeps E's padded
+    # shape (the round-end kernel's 1-trace invariant under churn)
+    adj2 = np.asarray(graph.adj).copy()
+    i, j = int(src[0]), int(dst[0])
+    adj2[i, j] = adj2[j, i] = 0.0
+    fewer = comm_edge_list(graph.replace(adj=jnp.asarray(adj2)))
+    assert fewer[0].shape == src.shape
+    # empty graph -> all-padding list, zero cost
+    empty = graph.replace(adj=jnp.zeros_like(graph.adj))
+    esrc, _edst, ew = comm_edge_list(empty)
+    assert esrc.shape[0] == 8 and float(np.sum(np.asarray(ew))) == 0.0
+    assert float(
+        communication_cost_edges(state, graph.num_services, (esrc, _edst, ew))
+    ) == 0.0
+
+
+def test_scanned_explain_clamp_on_tiny_cluster(registry):
+    """``decide_explain`` clamps its bundle to min(top_k, num_nodes)
+    columns; the block decode must apply the same clamp — a cluster
+    with fewer nodes than explain_top_k previously shifted every later
+    slice (confirmed decode crash)."""
+    backend = SimBackend(
+        workmodel=mubench_workmodel_c(),
+        node_names=["sn0", "sn1"],  # 2 < the default explain_top_k of 3
+        node_cpu_cap_m=20_000.0,
+        seed=0,
+        load=LoadModel(entry_rps=100.0, cost_per_req_m=8.0, idle_m=50.0),
+    )
+    backend.inject_imbalance("sn0")
+
+    def run(scan_block):
+        cfg = RescheduleConfig(
+            algorithm="communication", max_rounds=4,
+            sleep_after_action_s=0.0, seed=0,
+            controller=ControllerConfig(scan_block=scan_block),
+        )
+        b = SimBackend(
+            workmodel=mubench_workmodel_c(),
+            node_names=["sn0", "sn1"],
+            node_cpu_cap_m=20_000.0,
+            seed=0,
+            load=LoadModel(entry_rps=100.0, cost_per_req_m=8.0, idle_m=50.0),
+        )
+        b.inject_imbalance("sn0")
+        return run_controller(
+            b, cfg, key=jax.random.PRNGKey(0),
+            logger=StructuredLogger(name="t"),
+        )
+
+    seq = run(0)
+    sc = run(2)
+    assert len(sc.rounds) == 4
+    for a, b in zip(seq.rounds, sc.rounds):
+        assert _strip(a) == _strip(b)
+
+
+# ---------------- config / CLI surfaces ----------------------------------
+
+
+def test_scan_config_validation():
+    ok = RescheduleConfig(
+        algorithm="communication",
+        controller=ControllerConfig(scan_block=8),
+    ).validate()
+    assert ok.controller.scan_block == 8
+    with pytest.raises(ValueError):
+        ControllerConfig(scan_block=-1).validate()
+    with pytest.raises(ValueError):
+        ControllerConfig(scan_block=4, pipeline=True).validate()
+    for bad in (
+        dict(algorithm="kubescheduling"),   # affinityOnly landing
+        dict(algorithm="global"),           # solver decides outside scan
+        dict(algorithm="proactive"),        # forecast outside scan
+        dict(algorithm="communication", moves_per_round=2),
+        dict(algorithm="communication", backend="k8s"),
+    ):
+        with pytest.raises(ValueError):
+            RescheduleConfig(
+                controller=ControllerConfig(scan_block=4), **bad
+            ).validate()
+
+
+def test_scan_block_from_toml(tmp_path):
+    cfg_file = tmp_path / "scan.toml"
+    cfg_file.write_text(
+        "algorithm = 'communication'\n"
+        "[controller]\nscan_block = 16\n"
+    )
+    cfg = RescheduleConfig.from_toml(cfg_file)
+    assert cfg.controller.scan_block == 16
+
+
+def test_cli_scan_smoke(registry):
+    from kubernetes_rescheduling_tpu.cli import main as cli_main
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli_main([
+            "reschedule", "--scan-block", "2", "--rounds", "2",
+            "--scenario", "mubench", "--imbalance",
+        ])
+    assert rc == 0
+    payload = json.loads(out.getvalue())
+    assert len(payload["rounds"]) == 2
+
+
+# ---------------- fleet composition --------------------------------------
+
+
+def _fleet_run(scan_block: int):
+    from kubernetes_rescheduling_tpu.backends.fleet import make_fleet
+    from kubernetes_rescheduling_tpu.bench.fleet import run_fleet_controller
+    from kubernetes_rescheduling_tpu.config import FleetConfig
+
+    fleet = make_fleet("mubench", 3, seed=5)
+    fleet.inject_imbalance()
+    cfg = RescheduleConfig(
+        algorithm="communication",
+        max_rounds=6,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=3),
+        controller=ControllerConfig(scan_block=scan_block),
+    )
+    return run_fleet_controller(fleet, cfg, key=jax.random.PRNGKey(5))
+
+
+def test_fleet_scan_bit_identical_per_tenant(registry):
+    """One scan dispatch advances ALL tenants K rounds: per-tenant round
+    streams bit-identical to the sequential fleet loop, one round_end
+    transfer per block (the per-round fleet_decision/fleet_metrics
+    sites stay silent on scanned rounds), 1 steady-state trace."""
+    seq = _fleet_run(0)
+    fam = registry.counter("device_transfers_total", labelnames=("site",))
+    seq_dec = fam.labels(site="fleet_decision").value
+    sc = _fleet_run(3)
+    assert fam.labels(site="fleet_decision").value == seq_dec  # no new ones
+    assert fam.labels(site="round_end").value == 2  # 6 rounds / block of 3
+    assert seq.tenants == sc.tenants
+    for name in seq.tenants:
+        a, b = seq.results[name], sc.results[name]
+        assert len(a.rounds) == len(b.rounds) == 6
+        assert a.skipped_rounds == b.skipped_rounds == 0
+        for ra, rb in zip(a.rounds, b.rounds):
+            assert _strip(ra) == _strip(rb)
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="fleet_scan_rounds").value == 1
+    assert registry.counter("scan_blocks_total").value == 2
+    # one dispatch per block on the fleet accounting too
+    assert sc.batched_solves == 2 and seq.batched_solves == 6

@@ -28,6 +28,7 @@ def _scn(n_pods=1024, n_nodes=16, seed=12):
     return scn, sg
 
 
+@pytest.mark.slow  # tp↔single-chip sparse bit parity stays pinned fast by test_sparse_dp_of_tp_restarts_decision_parity below: composed dp-of-tp solves must equal dp-only single-chip solves bit-for-bit, which transits this exact tp route — this is the direct-comparison redundant variant (own ~27 s compile)
 def test_bit_parity_with_single_chip_sparse():
     scn, sg = _scn()
     assert sg.num_blocks > 1
@@ -45,8 +46,10 @@ def test_bit_parity_with_single_chip_sparse():
     assert int(info_shard["tp"]) == 4
 
 
-@pytest.mark.slow  # tier-1 keeps bit parity via the single-chip case above
-# and hub coverage via test_sparse_solver's hub-blocks test
+@pytest.mark.slow  # tier-1 keeps sharded-sparse bit parity via
+# test_sparse_dp_of_tp_restarts_decision_parity below (the composed
+# route transits the same tp path) and hub coverage via
+# test_sparse_solver's hub-blocks test
 def test_bit_parity_with_hub_groups():
     # star services force hub blocks → the hub-group pass must stay in
     # lockstep with the single-chip path too

@@ -324,6 +324,46 @@ def bench_fleet(reps: int, baseline_ms: float, tenants: int) -> dict:
         .labels(fn="fleet_solve")
         .value
     )
+
+    # rollup overhead: the same steady-state fleet round (solve + the
+    # round-closing metrics bundle) with device-side tenant rollups ON
+    # vs OFF — what the bounded observability plane costs the loop
+    from kubernetes_rescheduling_tpu.solver.fleet import fleet_metrics
+    from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
+        dispatch_fleet_bundle,
+    )
+
+    last_pair = jnp.zeros((tenants, 2), jnp.float32)
+    flags = jnp.zeros((tenants, 3), jnp.float32)
+    act = jnp.ones((tenants,), bool)
+    rollup_k = 3
+    np.asarray(fleet_metrics(st, gr))  # compile both closers
+    np.asarray(
+        dispatch_fleet_bundle(st, gr, last_pair, flags, act, top_k=rollup_k)
+    )
+
+    def rounds_per_sec(with_rollup: bool) -> float:
+        times = []
+        for i in range(reps):
+            keys = round_keys(100 + i)
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                fleet_solve(st, gr, pid, thr, keys, mask)
+            )
+            if with_rollup:
+                np.asarray(
+                    dispatch_fleet_bundle(
+                        st, gr, last_pair, flags, act, top_k=rollup_k
+                    )
+                )
+            else:
+                np.asarray(fleet_metrics(st, gr))
+            times.append(time.perf_counter() - t0)
+        return 1.0 / sorted(times)[len(times) // 2]
+
+    rollup_on_rs = rounds_per_sec(True)
+    rollup_off_rs = rounds_per_sec(False)
+
     return {
         "metric": "device_round_ms_fleet_per_tenant",
         "value": round(per_tenant_ms, 4),
@@ -343,7 +383,29 @@ def bench_fleet(reps: int, baseline_ms: float, tenants: int) -> dict:
             # once per round for the whole fleet
             "rtt_ms": round(rtt_ms, 3),
             "fleet_solve_traces": traces,
+            "rollup_rounds_per_sec": round(rollup_on_rs, 3),
+            "rollup_off_rounds_per_sec": round(rollup_off_rs, 3),
+            "rollup_overhead_frac": round(
+                max(0.0, rollup_off_rs / max(rollup_on_rs, 1e-9) - 1.0), 4
+            ),
             "devices": [str(d) for d in jax.devices()],
+        },
+        # the second ledger series the fleet cell appends (BENCH_LEDGER):
+        # steady-state fleet rounds/sec WITH the rollup plane on — a
+        # throughput series (better: higher), so a future regression in
+        # the rollup kernel shows up as this number falling
+        "rollup_reading": {
+            "metric": "fleet_rounds_per_sec_rollup",
+            "value": round(rollup_on_rs, 3),
+            "unit": "rounds/s",
+            "better": "higher",
+            "extra": {
+                "scenario": "fleet",
+                "tenants": tenants,
+                "rollup_top_k": 3,
+                "rollup_off_rounds_per_sec": round(rollup_off_rs, 3),
+                "devices": [str(d) for d in jax.devices()],
+            },
         },
     }
 
@@ -776,6 +838,10 @@ def main() -> int:
     if scenario == "fleet":
         result = bench_fleet(reps, baseline_ms, _env_int("BENCH_TENANTS", 16))
         _ledger_append(result)
+        # the rollup-overhead reading is its own ledger series (a
+        # throughput metric, better: higher)
+        if isinstance(result.get("rollup_reading"), dict):
+            _ledger_append(result["rollup_reading"])
         print(json.dumps(result))
         return 0
 

@@ -91,6 +91,15 @@ from kubernetes_rescheduling_tpu.solver.fleet import (
     stack_tenants,
 )
 from kubernetes_rescheduling_tpu.telemetry import get_registry, pull, span
+from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
+    TenantSeries,
+    decode_fleet_bundle,
+    decode_rollup,
+    dispatch_fleet_bundle,
+    fleet_health_block,
+    publish_rollup,
+    rollup_event,
+)
 from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
 
 
@@ -126,7 +135,10 @@ class _Tenant:
     """Host-side runtime of one tenant: its boundary, last good snapshot,
     graph, key stream, and result accumulator."""
 
-    def __init__(self, name, backend, config, *, logger, registry, key):
+    def __init__(
+        self, name, backend, config, *, logger, registry, key,
+        tenant_series=None,
+    ):
         self.name = name
         self.breaker = CircuitBreaker(
             max_consecutive_failures=config.max_consecutive_failures,
@@ -163,6 +175,10 @@ class _Tenant:
                 registry=registry,
                 logger=logger,
                 tenant=name,
+                # the budget-gated gateway: over-budget fleets suppress
+                # the per-tenant drift gauge (the rollup's drift
+                # dimension carries the signal instead)
+                tenant_series=tenant_series,
             )
             if config.reconcile.enabled
             else None
@@ -288,6 +304,14 @@ def run_fleet_controller(
             for t, b in enumerate(backends)
         ]
 
+    # the cardinality budget (ObsConfig.tenant_label_budget): at or
+    # under budget the legacy per-tenant families emit bit-identically;
+    # over budget they suppress (counted) and the bounded rollup
+    # families carry the fleet's observability instead
+    obs = config.obs
+    tseries = TenantSeries(
+        registry, tenants=len(backends), budget=obs.tenant_label_budget
+    )
     tenants = [
         _Tenant(
             name,
@@ -296,12 +320,41 @@ def run_fleet_controller(
             logger=logger,
             registry=registry,
             key=jax.random.fold_in(key, t),
+            tenant_series=tseries,
         )
         for t, (name, backend) in enumerate(
             zip(fleet.tenant_names, backends)
         )
     ]
     T = len(tenants)
+    names = [t.name for t in tenants]
+    rollup_on = obs.fleet_rollup
+    rollup_k = min(obs.fleet_rollup_top_k, T)
+    # per-tenant last-good (cost, load_std): dark/skipped tenants
+    # contribute these to the rollup instead of a filler row's garbage.
+    # A tenant that has NEVER produced a round (dark since startup) has
+    # no last-good value — until its first executed round it borrows
+    # the round's computed row (the filler tenant's live state against
+    # its own graph): a representative stand-in, where a zero row would
+    # drag every fleet quantile toward a healthier-looking floor
+    last_pair = np.zeros((T, 2), np.float32)
+    ever_good = np.zeros((T,), bool)
+    # the latest rollup's named event payload — the over-budget
+    # /healthz summary and breaker-open bundles read it
+    last_rollup_event: list = [None]
+    prev_logger_state = None
+    if logger is not None:
+        # fleet ring fairness, armed FOR THE RUN and restored on exit
+        # (get_logger memoizes loggers process-wide — a later solo run
+        # must not keep counting drops into this run's registry, and a
+        # later fleet of a different size must recompute its own fair
+        # share): drop accounting lands in THIS run's registry, and the
+        # shared ring gets a per-tenant share so one chatty tenant
+        # cannot evict every other tenant's events
+        prev_logger_state = (logger.registry, logger.max_records_per_tenant)
+        logger.registry = registry
+        if logger.max_records_per_tenant == 0 and T > 1:
+            logger.max_records_per_tenant = max(4, logger.max_records // T)
     if churn is None and config.elastic.profile != "none":
         churn = make_fleet_churn(fleet, config.elastic, registry=registry)
     churn = dict(churn or {})
@@ -324,13 +377,44 @@ def run_fleet_controller(
     registry.gauge(
         "fleet_tenants", "tenants served by the multiplexed fleet loop"
     ).set(T)
+    def update_fleet_health() -> None:
+        """Refresh /healthz's fleet block: per-tenant rows at budget
+        (bit-identical to the pre-budget plane), the bounded summary —
+        breaker counts + the rollup's worst-k rows — over it."""
+        if ops is None:
+            return
+        ops.health.fleet = fleet_health_block(
+            {t.name: t.health_row() for t in tenants},
+            budget=obs.tenant_label_budget,
+            event=last_rollup_event[0],
+        )
+
+    def emit_rollup(rollup: dict, rnd: int) -> None:
+        """One fleet round's rollup lands everywhere at once: the
+        bounded metric families, the named fleet_rollup event, the
+        watchdog's fleet_tail_cost window, and the breaker-open bundle
+        cache."""
+        publish_rollup(registry, rollup)
+        ev = rollup_event(rollup, names, round=rnd)
+        last_rollup_event[0] = ev
+        if logger is not None:
+            logger.info("fleet_rollup", **ev)
+        if ops is not None:
+            ops.observe_fleet_rollup(rollup, event=ev)
+
     if ops is not None:
         ops.bind(logger=logger, algorithm=config.algorithm)
-        ops.health.fleet = {t.name: t.health_row() for t in tenants}
+        update_fleet_health()
         for t in tenants:
             # a tenant breaker opening is exactly the moment the flight
-            # recorder should dump, same as the solo loop's wiring
-            t.breaker.on_transition = ops.on_breaker_transition
+            # recorder should dump, same as the solo loop's wiring —
+            # tagged with the tenant so the bundle ships the rollup plus
+            # ONLY the offending tenant's summary ring
+            t.breaker.on_transition = (
+                lambda rec, _name=t.name: ops.on_breaker_transition(
+                    {**rec, "tenant": _name}
+                )
+            )
 
     if config.fleet.plane == "dp":
         from kubernetes_rescheduling_tpu.parallel.fleet import fleet_solve_dp
@@ -389,12 +473,19 @@ def run_fleet_controller(
 
     def skip_round(t: _Tenant, rnd: int) -> None:
         t.result.skipped_rounds += 1
-        registry.counter(
+        tseries.counter_inc(
             "fleet_rounds_skipped_total",
             "tenant rounds frozen by that tenant's open breaker (or a "
             "dark backend) — counted, never silently lost",
-            labelnames=("tenant",),
-        ).labels(tenant=t.name).inc()
+            t.name,
+        )
+        if ops is not None:
+            ops.observe_tenant(
+                t.name,
+                breaker=t.breaker.state,
+                drift=t.last_drift,
+                skipped=True,
+            )
         # the solo loop's rule: a rejection in this round's gate belongs
         # to this skip, never to the tenant's next executed record
         adm = t.guard.take_info() if t.guard is not None else {}
@@ -425,36 +516,38 @@ def run_fleet_controller(
         by the sequential round and the scanned block so a scanned
         tenant-round is indistinguishable downstream."""
         t.result.rounds.append(rec)
-        registry.counter(
+        tseries.counter_inc(
             "fleet_rounds_total",
             "tenant rounds executed by the multiplexed fleet loop",
-            labelnames=("tenant",),
-        ).labels(tenant=t.name).inc()
+            t.name,
+        )
         if rec.moved:
-            registry.counter(
+            tseries.counter_inc(
                 "fleet_moves_total",
                 "deployments moved per tenant by fleet rounds",
-                labelnames=("tenant",),
-            ).labels(tenant=t.name).inc()
+                t.name,
+            )
         if rec.degraded:
-            registry.counter(
+            tseries.counter_inc(
                 "fleet_degraded_rounds_total",
                 "tenant rounds finished on a stale snapshot after "
                 "the post-move monitor failed",
-                labelnames=("tenant",),
-            ).labels(tenant=t.name).inc()
-        registry.gauge(
+                t.name,
+            )
+        tseries.gauge_set(
             "fleet_communication_cost",
             "per-tenant communication cost after the most recent "
             "fleet round",
-            labelnames=("tenant",),
-        ).labels(tenant=t.name).set(rec.communication_cost)
-        registry.gauge(
+            t.name,
+            rec.communication_cost,
+        )
+        tseries.gauge_set(
             "fleet_load_std",
             "per-tenant node CPU-% standard deviation after the "
             "most recent fleet round",
-            labelnames=("tenant",),
-        ).labels(tenant=t.name).set(rec.load_std)
+            t.name,
+            rec.load_std,
+        )
         round_event = dict(
             tenant=t.name,
             round=rnd,
@@ -482,6 +575,22 @@ def run_fleet_controller(
                 # keys on the tenant so interleaved tenant rounds
                 # never mask each other's drift
                 tenant=t.name,
+            )
+            # the /tenants drill-down ring: per-tenant detail lives
+            # HERE (bounded, LRU), not in metric label space
+            ops.observe_tenant(
+                t.name,
+                record={
+                    "round": rnd,
+                    "moved": rec.moved,
+                    "service": rec.service,
+                    "target": rec.target,
+                    "communication_cost": rec.communication_cost,
+                    "load_std": rec.load_std,
+                    "degraded": rec.degraded,
+                },
+                breaker=rec.breaker_state,
+                drift=t.last_drift,
             )
         if on_round is not None:
             on_round(t.name, rec, t.state)
@@ -592,8 +701,7 @@ def run_fleet_controller(
             active.append(i)
         if not active:
             # the whole fleet skipped — nothing to dispatch this round
-            if ops is not None:
-                ops.health.fleet = {t.name: t.health_row() for t in tenants}
+            update_fleet_health()
             return
 
         # ONE batched solve for every tenant slot: inactive slots carry a
@@ -730,7 +838,9 @@ def run_fleet_controller(
 
         # ONE batched metrics dispatch + ONE transfer closes the round's
         # reporting for every active tenant (the solo loop pays 2 scalar
-        # pulls per tenant here)
+        # pulls per tenant here). With rollups on, the device-side
+        # tenant rollup CONCATENATES into the same bundle — the fleet's
+        # whole observability plane still costs zero extra transfers
         # same filler rule as the solve stack: only active tenants'
         # rows are read, and only active tenants are guaranteed to
         # hold post-promotion shapes
@@ -741,19 +851,57 @@ def run_fleet_controller(
                 for i, t in enumerate(tenants)
             ]
         )
-        metrics = _pull_round_bundle(
-            fleet_metrics(stacked_after, stacked_graphs),
-            "fleet_metrics",
-        )
+        rollup = None
+        if rollup_on:
+            flags = np.zeros((T, 3), np.float32)
+            for i, t in enumerate(tenants):
+                if i in active_set:
+                    if records[i].degraded:
+                        flags[i, 0] = 1.0
+                else:
+                    flags[i, 1] = 1.0
+                flags[i, 2] = float(t.last_drift)
+            flat = _pull_round_bundle(
+                dispatch_fleet_bundle(
+                    stacked_after,
+                    stacked_graphs,
+                    jnp.asarray(last_pair),
+                    jnp.asarray(flags),
+                    # merge mask: active rows take the fresh pair; so do
+                    # never-good rows (their last_pair is no value at
+                    # all — the computed stand-in beats a zero row)
+                    jnp.asarray(mask | ~ever_good),
+                    top_k=rollup_k,
+                ),
+                "fleet_metrics",
+            )
+            metrics, rollup = decode_fleet_bundle(
+                flat, tenants=T, top_k=rollup_k
+            )
+        else:
+            metrics = _pull_round_bundle(
+                fleet_metrics(stacked_after, stacked_graphs),
+                "fleet_metrics",
+            )
         observe_wall_round(registry, "fleet", time.perf_counter() - t0)
+        for i in range(T):
+            if i in active_set:
+                continue
+            if not ever_good[i]:
+                # never-good tenant: adopt the computed stand-in row so
+                # the NEXT round's rollup carries it instead of zeros
+                last_pair[i] = metrics[i]
         for i in active:
             t = tenants[i]
             rec = records[i]
             rec.communication_cost = float(metrics[i, 0])
             rec.load_std = float(metrics[i, 1])
+            last_pair[i] = metrics[i]
+            ever_good[i] = True
             emit_tenant_round(t, rec, rnd)
-        if ops is not None:
-            ops.health.fleet = {t.name: t.health_row() for t in tenants}
+        if rollup is not None:
+            emit_rollup(rollup, rnd)
+        update_fleet_health()
 
     scan_k = config.controller.scan_block
     if scan_k:
@@ -786,6 +934,23 @@ def run_fleet_controller(
         stacked_states = stack_tenants(
             [device_view(t.state) for t in tenants]
         )
+        scan_rollup_k = rollup_k if rollup_on else 0
+        # drift is host state the scan body cannot compute: the vector
+        # AT BLOCK START rides the dispatch as an argument (uploads are
+        # free of the one-counted-transfer budget, which covers
+        # device→host pulls). The replay's reconcile below CAN move
+        # drift mid-block (fresh diff + repairs on the block's last
+        # round), so a block's rollups carry drift at most one block
+        # stale — the per-round RoundRecord.reconcile stays exact
+        drift_vec = (
+            jnp.asarray(
+                np.asarray(
+                    [float(t.last_drift) for t in tenants], np.float32
+                )
+            )
+            if scan_rollup_k
+            else None
+        )
         t0 = time.perf_counter()
         with span("fleet/scan_block", round=start, rounds=k, tenants=T):
             flat = _pull_round_bundle(
@@ -796,8 +961,10 @@ def run_fleet_controller(
                     thr,
                     stacked_keys,
                     jnp.asarray(start, jnp.int32),
+                    drift_vec,
                     rounds=k,
                     pinned=True,
+                    rollup_k=scan_rollup_k,
                 ),
                 scan_mod.ROUND_END_SITE,
             )
@@ -805,9 +972,15 @@ def run_fleet_controller(
         scan_mod.count_scan_block(registry, k)
         result.batched_solves += 1
         result.device_solve_s += fence_s
-        decisions, hazard, landed_idx, metrics = scan_mod.decode_fleet_block(
-            flat, rounds=k, tenants=T, num_nodes=n_nodes
+        decoded = scan_mod.decode_fleet_block(
+            flat, rounds=k, tenants=T, num_nodes=n_nodes,
+            rollup_k=scan_rollup_k,
         )
+        if scan_rollup_k:
+            decisions, hazard, landed_idx, metrics, rollups = decoded
+        else:
+            decisions, hazard, landed_idx, metrics = decoded
+            rollups = None
         per_tenant_s = fence_s / (k * T)
         resync: set[int] = set()  # tenants whose replay diverged
         for r in range(k):
@@ -888,15 +1061,18 @@ def run_fleet_controller(
                     churn=None,
                     reconcile=reconcile_block,
                 )
+                last_pair[i] = metrics[r, i]
+                ever_good[i] = True
                 emit_tenant_round(t, rec, rnd)
+            if rollups is not None:
+                emit_rollup(
+                    decode_rollup(rollups[r], top_k=scan_rollup_k), rnd
+                )
             observe_wall_round(
                 registry, "scanned",
                 fence_s / k + time.perf_counter() - t_r0,
             )
-            if ops is not None:
-                ops.health.fleet = {
-                    t.name: t.health_row() for t in tenants
-                }
+            update_fleet_health()
 
     def _run_rounds() -> None:
         """The fleet's round driver: scanned blocks in the steady state
@@ -945,6 +1121,10 @@ def run_fleet_controller(
     finally:
         if pool is not None:
             pool.shutdown(wait=True)
+        if prev_logger_state is not None:
+            logger.registry, logger.max_records_per_tenant = (
+                prev_logger_state
+            )
 
     for t in tenants:
         t.result.breaker_transitions = list(t.breaker.transitions)

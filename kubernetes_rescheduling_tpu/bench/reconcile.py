@@ -132,12 +132,17 @@ class IntentLedger:
 
     def __init__(
         self, cfg, *, registry=None, logger=None, tenant=None,
-        adopt_observed=False,
+        adopt_observed=False, tenant_series=None,
     ):
         self.cfg = cfg
         self.registry = registry
         self.logger = logger
         self.tenant = tenant
+        # the budget-gated gateway for the per-tenant drift gauge
+        # (telemetry.fleet_rollup.TenantSeries — the only legal way to
+        # register a tenant label key); the fleet loop injects its
+        # budget-aware instance, a bare fleet ledger gets an ungated one
+        self.tenant_series = tenant_series
         # advisory-backend mode (the shadow plane's replay backend): the
         # snapshot stream IS ground truth — the recorded cluster's own
         # scheduler moving pods is the baseline under study, not another
@@ -188,12 +193,25 @@ class IntentLedger:
                 "the controller's intent (corrective moves pending)",
             ).set(len(self.repairs))
         else:
-            reg.gauge(
+            series = self.tenant_series
+            if series is None:
+                from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
+                    TenantSeries,
+                )
+
+                # ungated (budget=None): the historical always-publish
+                # behavior for ledgers built outside the fleet loop —
+                # built per call, NOT cached, so it follows _reg()'s
+                # per-call registry resolution (set_registry swaps must
+                # keep reaching the live registry)
+                series = TenantSeries(reg, tenants=1, budget=None)
+            series.gauge_set(
                 "fleet_reconcile_drift_pods",
                 "per-tenant pods whose observed placement currently "
                 "diverges from that tenant's intent",
-                labelnames=("tenant",),
-            ).labels(tenant=self.tenant).set(len(self.repairs))
+                self.tenant,
+                len(self.repairs),
+            )
 
     @property
     def pending_repairs(self) -> bool:

@@ -63,6 +63,10 @@ from kubernetes_rescheduling_tpu.solver.round_loop import (
     decide_explain,
 )
 from kubernetes_rescheduling_tpu.telemetry import instrument_jit, pull
+from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
+    rollup_matrix,
+    rollup_size,
+)
 
 # columns of the per-round decision row inside the block bundle
 DEC_MOST, DEC_VICTIM, DEC_SERVICE, DEC_TARGET, DEC_LANDED = range(5)
@@ -154,16 +158,26 @@ def _fleet_scan_rounds(
     threshold,
     tenant_keys,
     start_round,
+    drift=None,
     *,
     rounds: int,
     pinned: bool,
+    rollup_k: int = 0,
 ):
     """The fleet composition: one scan advancing every tenant K rounds —
     the solo body with decide (``solver.fleet._fleet_decide``), the sim
     twin's apply, and the metrics pair vmapped over the leading tenant
     axis. Flat layout: decisions ``[K,T,4]``, hazard ``[K,T,N]``,
     landings ``[K,T]``, metrics ``[K,T,2]`` (rounds-leading, raveled in
-    that order)."""
+    that order), then — with ``rollup_k > 0`` — per-round fleet rollups
+    ``[K, rollup_size(rollup_k)]`` (``telemetry.fleet_rollup``: the
+    device-side tenant observability riding the block's ONE transfer).
+    ``drift`` is the host's per-tenant reconcile-drift vector AT BLOCK
+    START (f32[T], constant across the block: the replay's reconcile
+    runs host-side after this dispatch returns, so a block's rollups
+    carry drift at most one block stale — the per-round records stay
+    exact); degraded/skipped flags are zero inside a scan by
+    construction (anything that degrades or skips drains the block)."""
     T = tenant_keys.shape[0]
     mask = jnp.ones((T,), dtype=bool)
 
@@ -182,12 +196,27 @@ def _fleet_scan_rounds(
             hazard,
         )
         metrics = _fleet_metrics(new_sts, graphs)
-        return new_sts, (
+        outs = (
             decisions.astype(jnp.float32),
             hazard.astype(jnp.float32),
             landed.astype(jnp.float32),
             metrics,
         )
+        if rollup_k > 0:
+            flags = jnp.concatenate(
+                [
+                    jnp.zeros((T, 2), jnp.float32),  # degraded, skipped
+                    (
+                        jnp.zeros((T,), jnp.float32)
+                        if drift is None
+                        else drift.astype(jnp.float32)
+                    )[:, None],
+                ],
+                axis=1,
+            )
+            matrix = jnp.concatenate([metrics, flags], axis=1)
+            outs = outs + (rollup_matrix(matrix, top_k=rollup_k),)
+        return new_sts, outs
 
     rnds = start_round + jnp.arange(rounds, dtype=jnp.int32)
     _final, outs = lax.scan(body, states, rnds)
@@ -197,7 +226,7 @@ def _fleet_scan_rounds(
 fleet_scan_rounds = instrument_jit(
     _fleet_scan_rounds,
     name="fleet_scan_rounds",
-    static_argnames=("rounds", "pinned"),
+    static_argnames=("rounds", "pinned", "rollup_k"),
 )
 
 
@@ -288,24 +317,36 @@ def decode_block(
 
 
 def decode_fleet_block(
-    flat: np.ndarray, *, rounds: int, tenants: int, num_nodes: int
-) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    flat: np.ndarray,
+    *,
+    rounds: int,
+    tenants: int,
+    num_nodes: int,
+    rollup_k: int = 0,
+):
     """Unpack one fleet scan bundle: ``(decisions i64[K,T,4],
-    hazard bool[K,T,N], landed i64[K,T], metrics f32[K,T,2])``."""
+    hazard bool[K,T,N], landed i64[K,T], metrics f32[K,T,2])`` plus —
+    when the block carried rollups (``rollup_k > 0``) — a fifth
+    ``f32[K, rollup_size(rollup_k)]`` array of per-round fleet
+    rollups (``telemetry.fleet_rollup.decode_rollup`` unpacks each)."""
     flat = np.asarray(flat, dtype=np.float32)
     k, t, n = rounds, tenants, num_nodes
-    sizes = (k * t * 4, k * t * n, k * t, k * t * 2)
+    roll = rollup_size(rollup_k) if rollup_k > 0 else 0
+    sizes = (k * t * 4, k * t * n, k * t, k * t * 2, k * roll)
     if flat.size != sum(sizes):
         raise ValueError(
             f"fleet scan bundle of {flat.size} values does not decode at "
-            f"rounds={k}, tenants={t}, num_nodes={n}"
+            f"rounds={k}, tenants={t}, num_nodes={n}, rollup_k={rollup_k}"
         )
-    o1, o2, o3 = np.cumsum(sizes)[:3]
+    o1, o2, o3, o4 = np.cumsum(sizes)[:4]
     decisions = flat[:o1].reshape(k, t, 4).astype(np.int64)
     hazard = flat[o1:o2].reshape(k, t, n) > 0.5
     landed = flat[o2:o3].reshape(k, t).astype(np.int64)
-    metrics = flat[o3:].reshape(k, t, 2)
-    return decisions, hazard, landed, metrics
+    metrics = flat[o3:o4].reshape(k, t, 2)
+    if rollup_k <= 0:
+        return decisions, hazard, landed, metrics
+    rollups = flat[o4:].reshape(k, roll)
+    return decisions, hazard, landed, metrics, rollups
 
 
 # ---- scan-plane accounting (OBSERVABILITY.md "Round scan") ----

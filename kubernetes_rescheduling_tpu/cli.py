@@ -316,6 +316,13 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--fleet-chaos-tenants", default="", metavar="I,J,...",
                    help="tenant indices the --chaos-profile wraps (empty = "
                         "all tenants) — the per-tenant fault-isolation knob")
+    r.add_argument("--tenant-label-budget", type=int, default=None,
+                   metavar="N",
+                   help="fleet cardinality budget: fleets with more than N "
+                        "tenants suppress the per-tenant labeled metric "
+                        "series (counted) and observe through the bounded "
+                        "device-side rollup families instead (default: the "
+                        "obs config's tenant_label_budget, 64)")
     r.add_argument("--shadow", default=None, metavar="TRACE",
                    help="shadow mode: replay a recorded cluster trace (a "
                         "native ClusterTrace .jsonl file, or a directory "
@@ -473,7 +480,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="artifact files (kind detected from record shape); "
                         "an optional leading mode word — 'report' "
                         "(default), 'explain', 'bundle', 'perf', 'topo', "
-                        "'dataset', or 'shadow' — selects the rendering; "
+                        "'dataset', 'shadow', or 'fleet' — selects the "
+                        "rendering; 'fleet' takes a fleet run's "
+                        "structured-event JSONL (or flight-recorder "
+                        "bundles) and renders the tenant-rollup quantile "
+                        "trend plus the worst-offender table; "
                         "'shadow' takes rounds.jsonl files (or "
                         "flight-recorder bundles) from a --shadow run and "
                         "renders the head-to-head win-rate table against "
@@ -549,7 +560,8 @@ def cmd_telemetry(args) -> str:
 
     mode, paths = "report", list(args.paths)
     if paths and paths[0] in (
-        "report", "explain", "bundle", "perf", "topo", "dataset", "shadow"
+        "report", "explain", "bundle", "perf", "topo", "dataset", "shadow",
+        "fleet",
     ):
         mode, paths = paths[0], paths[1:]
     if not paths:
@@ -558,6 +570,10 @@ def cmd_telemetry(args) -> str:
         from kubernetes_rescheduling_tpu.telemetry.report import report_shadow
 
         return report_shadow(paths)
+    if mode == "fleet":
+        from kubernetes_rescheduling_tpu.telemetry.report import report_fleet
+
+        return report_fleet(paths)
     if mode == "dataset":
         # forecast training windows from recorded soaks — the numpy-only
         # dataset module + oracle fitter (the forecast package resolves
@@ -667,6 +683,7 @@ def cmd_fleet_reschedule(args, algo: str) -> dict:
         ChaosConfig,
         ElasticConfig,
         FleetConfig,
+        ObsConfig,
         RescheduleConfig,
     )
 
@@ -711,6 +728,11 @@ def cmd_fleet_reschedule(args, algo: str) -> dict:
             tenants=args.fleet,
             plane=args.fleet_plane,
             chaos_tenants=_parse_tenant_list(args.fleet_chaos_tenants),
+        ),
+        obs=(
+            ObsConfig(tenant_label_budget=args.tenant_label_budget)
+            if args.tenant_label_budget is not None
+            else ObsConfig()
         ),
     )
     try:

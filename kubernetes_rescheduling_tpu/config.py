@@ -391,6 +391,23 @@ class ObsConfig:
     attribution_top_k: int = 8           # service edges / node pairs recorded
     attribution_drift_frac: float = 0.0  # attribution_drift SLO rule: top-1
                                          # edge share of total cost (0 = off)
+    # fleet observability (telemetry.fleet_rollup): the cardinality
+    # budget — fleets with at most this many tenants keep the legacy
+    # per-tenant labeled families (fleet_rounds_total{tenant}, cost/load
+    # gauges, per-tenant /healthz rows) bit-identically; larger fleets
+    # suppress them (counted tenant_series_suppressed_total{family}) and
+    # observe through the bounded rollup families instead
+    tenant_label_budget: int = 64
+    fleet_rollup: bool = True            # device-side tenant rollups riding
+                                         # the fleet round-end bundle
+    fleet_rollup_top_k: int = 3          # worst tenants recorded per rollup
+                                         # dimension (rank-labeled, bounded)
+    slo_fleet_tail_frac: float = 0.0     # fleet_tail_cost SLO rule: the p99
+                                         # cost rollup rising more than this
+                                         # fraction above the rolling
+                                         # window's best is a violation
+                                         # (0 = off; the window rebases with
+                                         # the run, like the cost rule)
     flight_recorder_rounds: int = 16     # ring capacity (rounds)
     bundle_dir: str = "flight_recorder"  # where trigger dumps land
     max_round_age_s: float = 0.0         # /healthz staleness rule (0 = off)
@@ -439,6 +456,18 @@ class ObsConfig:
             raise ValueError("attribution_top_k must be >= 1")
         if not (0.0 <= self.attribution_drift_frac <= 1.0):
             raise ValueError("attribution_drift_frac must be in [0, 1]")
+        if self.tenant_label_budget < 0:
+            raise ValueError(
+                "tenant_label_budget must be >= 0 (0 = per-tenant series "
+                "always suppressed in fleet mode)"
+            )
+        if self.fleet_rollup_top_k < 1:
+            raise ValueError("fleet_rollup_top_k must be >= 1")
+        if self.slo_fleet_tail_frac < 0:
+            raise ValueError(
+                "slo_fleet_tail_frac must be >= 0 (0 disables the "
+                "fleet_tail_cost rule)"
+            )
         if self.flight_recorder_rounds < 1:
             raise ValueError("flight_recorder_rounds must be >= 1")
         if self.max_round_age_s < 0:

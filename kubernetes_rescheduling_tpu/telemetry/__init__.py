@@ -30,6 +30,13 @@ that lives now:
   device transfer per round), cardinality-bounded topology gauges, and
   the placement-timeline / move-provenance tracker whose per-move edge
   deltas telescope to the round's objective delta (consistency-checked).
+- :mod:`fleet_rollup` — fleet-scale observability: device-side tenant
+  rollups (quantiles + worst-k over the per-tenant metric matrix,
+  riding the fleet round-end bundle at zero extra transfers), the
+  tenant-label cardinality budget (:class:`TenantSeries` — the one
+  legal gateway for tenant-labeled families, statically enforced), and
+  the bounded live-plane views behind ``/tenants`` and the over-budget
+  ``/healthz`` fleet summary.
 - :mod:`flight_recorder` — bounded ring of recent rounds, dumped as a
   self-contained diagnostics bundle on breaker-open / crash / SIGUSR1.
 - :mod:`watchdog` — rolling-window SLO rules (latency p95, comm-cost
@@ -90,6 +97,10 @@ from kubernetes_rescheduling_tpu.telemetry.attribution import (
     attribution_consistent,
     get_attribution_book,
 )
+from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
+    TenantSeries,
+    TenantSummaryRing,
+)
 from kubernetes_rescheduling_tpu.telemetry.perf_ledger import PerfLedger
 from kubernetes_rescheduling_tpu.telemetry.flight_recorder import FlightRecorder
 from kubernetes_rescheduling_tpu.telemetry.server import (
@@ -122,6 +133,8 @@ __all__ = [
     "get_costbook",
     "sample_device_memory",
     "PerfLedger",
+    "TenantSeries",
+    "TenantSummaryRing",
     "explanation_consistent",
     "AttributionBook",
     "PlacementTimeline",

@@ -430,6 +430,99 @@ def report_shadow(paths: list[str]) -> str:
     return "\n".join(out)
 
 
+def _fleet_rollup_events(path: Path) -> list[dict[str, Any]]:
+    """``fleet_rollup`` events from a structured-event JSONL file or a
+    flight-recorder bundle (ring events + the breaker-open dump's
+    top-level ``fleet_rollup`` payload)."""
+    rounds = _topo_rounds(path)
+    out: list[dict[str, Any]] = []
+    for r in rounds:
+        if r.get("event") == "fleet_rollup":
+            out.append(r)
+        for e in r.get("events") or ():
+            if isinstance(e, dict) and e.get("event") == "fleet_rollup":
+                out.append(e)
+    if not out and path.suffix == ".json":
+        try:
+            obj = json.loads(path.read_text())
+        except json.JSONDecodeError:
+            obj = None
+        if isinstance(obj, dict) and isinstance(
+            obj.get("fleet_rollup"), dict
+        ):
+            out.append(obj["fleet_rollup"])
+    return out
+
+
+def report_fleet(paths: list[str]) -> str:
+    """The ``telemetry fleet`` report: the bounded fleet-observability
+    plane rendered from recorded ``fleet_rollup`` events (a fleet run's
+    event JSONL, or flight-recorder bundles) — the per-dimension
+    quantile trend across rounds, the latest fleet totals, and the
+    offender table (which tenants kept landing in the worst-k, by
+    dimension) that replaces scrolling O(T) per-tenant series."""
+    out = []
+    for p in paths:
+        out.append(f"== {p} ==")
+        path = Path(p)
+        if not path.is_file():
+            out.append("  not a file")
+            continue
+        evs = _fleet_rollup_events(path)
+        if not evs:
+            out.append(
+                "  no fleet_rollup events (was this a fleet run with "
+                "obs.fleet_rollup on?)"
+            )
+            continue
+        first, last = evs[0], evs[-1]
+        out.append(
+            f"  fleet rollups: {len(evs)} rounds "
+            f"(r{first.get('round', '?')} -> r{last.get('round', '?')}, "
+            f"top_k={last.get('top_k', '?')})"
+        )
+        out.append(
+            "  dim              p50 first->last      p99 first->last"
+            "      max first->last"
+        )
+        for dim in ("cost", "load_std", "drift"):
+            fq = (first.get("quantiles") or {}).get(dim) or {}
+            lq = (last.get("quantiles") or {}).get(dim) or {}
+            cells = "".join(
+                f"  {fq.get(q, float('nan')):>8.4g} -> {lq.get(q, float('nan')):<8.4g}"
+                for q in ("p50", "p99", "max")
+            )
+            out.append(f"  {dim:<15}{cells}")
+        sums = last.get("sums") or {}
+        out.append(
+            f"  latest fleet totals: degraded={sums.get('degraded', 0):g} "
+            f"skipped={sums.get('skipped', 0):g} "
+            f"drift_pods={sums.get('drift', 0):g}"
+        )
+        # offender table: appearances in the worst-k across all rounds
+        seen: dict[str, dict[str, list[float]]] = {}
+        for ev in evs:
+            for row in ev.get("worst") or ():
+                tenant = str(row.get("tenant"))
+                seen.setdefault(tenant, {}).setdefault(
+                    str(row.get("dim")), []
+                ).append(float(row.get("value", 0.0)))
+        ranked = sorted(
+            seen.items(),
+            key=lambda kv: sum(len(v) for v in kv[1].values()),
+            reverse=True,
+        )[:10]
+        if ranked:
+            out.append("  worst offenders (appearances in the top-k):")
+            for tenant, dims in ranked:
+                cells = " ".join(
+                    f"{dim}×{len(vals)} (max {max(vals):.4g})"
+                    for dim, vals in sorted(dims.items())
+                )
+                out.append(f"    {tenant:<16} {cells}")
+    return "\n".join(out)
+
+
 def report_bundle(paths: list[str]) -> str:
     """The ``telemetry bundle`` report: summarize flight-recorder bundles."""
     out = []

@@ -16,6 +16,12 @@ Endpoints (``--serve PORT`` on ``reschedule``/``bench``):
   (``?n=`` tail-limits for cheap polling; default = the full ring,
   which is itself bounded) — the StructuredLogger ring, without
   grepping JSONL files mid-incident.
+- ``GET /tenants`` / ``GET /tenants/<name>`` — fleet drill-down from
+  the bounded per-tenant summary ring
+  (``telemetry.fleet_rollup.TenantSummaryRing``): the per-tenant detail
+  the cardinality budget keeps OUT of ``/metrics`` label space (last
+  round, breaker, drift, a capped cost window). 404s when no fleet run
+  is attached or the tenant is unknown/evicted.
 
 The server runs daemon threads and binds 127.0.0.1 by default; port 0
 picks an ephemeral port (tests). Handlers never write to stdout/stderr —
@@ -135,12 +141,14 @@ class OpsServer:
         registry: MetricsRegistry | None = None,
         health: HealthState | None = None,
         events_source=None,  # zero-arg callable -> list[dict]
+        tenants_source=None,  # zero-arg callable -> TenantSummaryRing | None
     ) -> None:
         self._port = port
         self.host = host
         self.registry = registry
         self.health = health
         self.events_source = events_source
+        self.tenants_source = tenants_source
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -198,11 +206,22 @@ def _make_handler(ops: OpsServer):
         def do_GET(self) -> None:  # noqa: N802 — stdlib signature
             url = urlsplit(self.path)
             endpoint = url.path.rstrip("/") or "/"
+            # request accounting must stay cardinality-bounded: the
+            # drill-down's tenant name is a PATH, never a label value —
+            # and arbitrary 404 paths (favicon probes, port scanners)
+            # must not mint one memoized series each
+            if endpoint.startswith("/tenants/"):
+                counted = "/tenants/<name>"
+            elif endpoint in ("/", "/metrics", "/healthz", "/events",
+                              "/tenants"):
+                counted = endpoint
+            else:
+                counted = "<other>"
             ops._reg().counter(
                 "ops_http_requests_total",
                 "requests served by the live ops endpoint",
                 labelnames=("endpoint",),
-            ).labels(endpoint=endpoint).inc()
+            ).labels(endpoint=counted).inc()
             if endpoint == "/metrics":
                 body = ops._reg().expose().encode()
                 self._respond(
@@ -236,12 +255,54 @@ def _make_handler(ops: OpsServer):
                     events[len(events) - n:], default=float
                 ).encode()
                 self._respond(200, body, "application/json")
+            elif endpoint == "/tenants" or endpoint.startswith("/tenants/"):
+                ring = (
+                    ops.tenants_source()
+                    if ops.tenants_source is not None
+                    else None
+                )
+                if ring is None:
+                    self._respond(
+                        404,
+                        json.dumps(
+                            {"error": "no fleet run attached"}
+                        ).encode(),
+                        "application/json",
+                    )
+                elif endpoint == "/tenants":
+                    self._respond(
+                        200,
+                        json.dumps(
+                            ring.overview(), default=float
+                        ).encode(),
+                        "application/json",
+                    )
+                else:
+                    name = endpoint[len("/tenants/"):]
+                    detail = ring.detail(name)
+                    if detail is None:
+                        self._respond(
+                            404,
+                            json.dumps(
+                                {"error": f"unknown tenant {name!r} "
+                                          "(never seen, or evicted from "
+                                          "the bounded summary ring)"}
+                            ).encode(),
+                            "application/json",
+                        )
+                    else:
+                        self._respond(
+                            200,
+                            json.dumps(detail, default=float).encode(),
+                            "application/json",
+                        )
             else:
                 self._respond(
                     404,
                     json.dumps(
                         {"error": "not found",
-                         "endpoints": ["/metrics", "/healthz", "/events"]}
+                         "endpoints": ["/metrics", "/healthz", "/events",
+                                       "/tenants", "/tenants/<name>"]}
                     ).encode(),
                     "application/json",
                 )
@@ -262,6 +323,11 @@ class OpsPlane:
     recorder: FlightRecorder | None = None
     health: HealthState = field(default_factory=HealthState)
     server: OpsServer | None = None
+    # fleet mode: the bounded per-tenant summary store behind /tenants
+    # (telemetry.fleet_rollup.TenantSummaryRing) and the latest decoded
+    # rollup — breaker-open bundles ship both, scoped to the offender
+    tenant_ring: Any = None
+    latest_fleet_rollup: Any = field(default=None, repr=False)
     span_tail: int = 12
     _prev_sigusr1: Any = field(default=None, repr=False)
     _sig_installed: bool = field(default=False, repr=False)
@@ -299,6 +365,7 @@ class OpsPlane:
                 shadow_min_win_rate=getattr(
                     obs, "slo_shadow_min_win_rate", 0.0
                 ),
+                fleet_tail_frac=getattr(obs, "slo_fleet_tail_frac", 0.0),
             ),
             registry=registry,
             logger=logger,
@@ -309,12 +376,17 @@ class OpsPlane:
             registry=registry,
             logger=logger,
         )
+        from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
+            TenantSummaryRing,
+        )
+
         plane = cls(
             registry=registry,
             logger=logger,
             watchdog=watchdog,
             recorder=recorder,
             health=health,
+            tenant_ring=TenantSummaryRing(),
         )
         if obs.serve_port is not None:
             plane.server = OpsServer(
@@ -322,11 +394,18 @@ class OpsPlane:
                 registry=registry,
                 health=health,
                 events_source=plane._events,
+                tenants_source=plane._tenants,
             )
         return plane
 
     def _events(self) -> list[dict]:
         return self.logger.records if self.logger is not None else []
+
+    def _tenants(self):
+        """The /tenants source: the ring once a fleet run has fed it
+        (a solo run's empty ring reads as 'no fleet attached')."""
+        ring = self.tenant_ring
+        return ring if ring is not None and len(ring) else None
 
     # ---- lifecycle ----
 
@@ -337,6 +416,8 @@ class OpsPlane:
                 self.server.health = self.health
             if self.server.events_source is None:
                 self.server.events_source = self._events
+            if self.server.tenants_source is None:
+                self.server.tenants_source = self._tenants
             self.server.start()
         if (
             self.recorder is not None
@@ -434,6 +515,37 @@ class OpsPlane:
         if self.watchdog is not None:
             self.watchdog.observe_perf(verdicts)
 
+    def observe_fleet_rollup(self, rollup: dict, event: dict | None = None) -> None:
+        """Feed one fleet round's decoded tenant rollup
+        (``telemetry.fleet_rollup.decode_rollup``): arms the watchdog's
+        ``fleet_tail_cost`` rule and keeps the latest named event
+        payload for breaker-open bundles and the over-budget
+        ``/healthz`` fleet summary."""
+        self.latest_fleet_rollup = event if event is not None else rollup
+        if self.watchdog is not None:
+            self.watchdog.observe_fleet_rollup(rollup)
+
+    def observe_tenant(
+        self,
+        tenant: str,
+        *,
+        record: dict | None = None,
+        breaker: str | None = None,
+        drift: int | None = None,
+        skipped: bool = False,
+    ) -> None:
+        """Update one tenant's row in the bounded summary ring (the
+        /tenants drill-down source). No-op when the plane has no ring
+        (a hand-built plane)."""
+        if self.tenant_ring is not None:
+            self.tenant_ring.observe(
+                tenant,
+                record=record,
+                breaker=breaker,
+                drift=drift,
+                skipped=skipped,
+            )
+
     def observe_skip(self, rnd: int, breaker_state: str | None = None) -> None:
         self.health.skipped_rounds += 1
         self.health.mark_round()
@@ -443,9 +555,22 @@ class OpsPlane:
     def on_breaker_transition(self, rec: dict) -> None:
         """Wired to ``CircuitBreaker.on_transition``: an OPEN transition
         dumps a bundle — the moment an operator will want the last N
-        rounds, captured while they are still in memory."""
+        rounds, captured while they are still in memory. A fleet
+        tenant's transition (the fleet loop tags ``rec["tenant"]``)
+        ships the latest fleet rollup plus ONLY the offending tenant's
+        summary-ring entry — the bounded-bundle discipline: never all T
+        tenants' state for one tenant's incident."""
         if rec.get("to") == "open" and self.recorder is not None:
-            self.recorder.dump("breaker_open", transition=rec)
+            extra: dict[str, Any] = {}
+            tenant = rec.get("tenant")
+            if tenant is not None:
+                if self.latest_fleet_rollup is not None:
+                    extra["fleet_rollup"] = self.latest_fleet_rollup
+                if self.tenant_ring is not None:
+                    summary = self.tenant_ring.detail(tenant)
+                    if summary is not None:
+                        extra["tenant_summary"] = summary
+            self.recorder.dump("breaker_open", transition=rec, **extra)
 
     def on_crash(self, exc: BaseException) -> None:
         if self.recorder is not None:

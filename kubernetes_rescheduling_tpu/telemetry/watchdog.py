@@ -59,6 +59,7 @@ RULE_FORECAST = "forecast_skill"
 RULE_PIPELINE = "pipeline_overlap"
 RULE_RECONCILE = "reconcile_divergence"
 RULE_SHADOW = "shadow_win_rate"
+RULE_FLEET_TAIL = "fleet_tail_cost"
 
 
 @dataclass(frozen=True)
@@ -101,6 +102,21 @@ class SLORules:
     # drift; only rounds carrying reconcile data are judged, so runs with
     # the plane off can never trip it).
     reconcile_max_drift_pods: int = 0
+    # fleet tail cost: the p99 of the fleet's per-tenant communication
+    # cost rollup (telemetry.fleet_rollup — observe_fleet_rollup feeds
+    # it) rising more than this fraction above the rolling window's
+    # best means the fleet's WORST tenants are regressing even if the
+    # median looks fine — exactly the signal per-tenant series used to
+    # carry and the cardinality budget suppressed (0 disables; only
+    # runs feeding rollups are judged; the window resets on rebase,
+    # like the cost rule, so a new run's cost scale is never misjudged)
+    fleet_tail_frac: float = 0.0
+    # fleet tenant-state TTL: per-source state keyed by tenant (the
+    # reconcile blocks) is pruned once a tenant goes unseen for this
+    # many observed rounds — under tenant churn the dict would
+    # otherwise grow without bound (counted
+    # watchdog_tenants_pruned_total; 0 disables pruning)
+    tenant_ttl_rounds: int = 100
     # shadow win-rate: the latest scored shadow round's RUNNING win-rate
     # against the replayed trace's actual scheduler sitting below this
     # means the shadow run is losing the head-to-head — promoting these
@@ -140,6 +156,16 @@ class SLORules:
             raise ValueError(
                 "shadow_min_win_rate must be in [0, 1] (a win-rate "
                 "fraction; 0 disables the shadow_win_rate rule)"
+            )
+        if self.fleet_tail_frac < 0:
+            raise ValueError(
+                "fleet_tail_frac must be >= 0 (0 disables the "
+                "fleet_tail_cost rule)"
+            )
+        if self.tenant_ttl_rounds < 0:
+            raise ValueError(
+                "tenant_ttl_rounds must be >= 0 (0 disables per-tenant "
+                "state pruning)"
             )
         return self
 
@@ -182,7 +208,16 @@ class Watchdog:
         # tenants key their name): the rule judges the worst source, so
         # one tenant's convergence can never mask another's drift
         self._reconcile: dict[str | None, dict[str, Any]] = {}
+        # last round index each tenant was seen at — per-tenant state is
+        # PRUNED once unseen for tenant_ttl_rounds (counted), so tenant
+        # churn cannot grow the per-source dicts without bound
+        self._tenant_seen: dict[str, int] = {}
+        self._last_round: int = 0
         self._shadow: dict[str, Any] | None = None  # latest shadow block
+        # fleet cost-rollup tail (p99 per fleet round) — rolling window
+        self._fleet_tail: collections.deque[float] = collections.deque(
+            maxlen=self.rules.window
+        )
         # pipelined rounds' overlap ratios (rolling window)
         self._overlap: collections.deque[float] = collections.deque(
             maxlen=self.rules.window
@@ -207,8 +242,11 @@ class Watchdog:
         self._attr = None
         self._forecast = None
         self._reconcile = {}
+        self._tenant_seen = {}
+        self._last_round = 0
         self._shadow = None
         self._overlap.clear()
+        self._fleet_tail.clear()
         self.active = (
             {RULE_PERF: self.active[RULE_PERF]}
             if RULE_PERF in self.active
@@ -235,6 +273,17 @@ class Watchdog:
         reconcile = getattr(record, "reconcile", None)
         if isinstance(reconcile, dict):
             self._reconcile[tenant] = reconcile
+        rnd = getattr(record, "round", None)
+        advanced = isinstance(rnd, (int, float)) and int(rnd) > self._last_round
+        if advanced:
+            self._last_round = int(rnd)
+        if tenant is not None:
+            self._tenant_seen[tenant] = self._last_round
+        if advanced:
+            # prune once per ROUND, not per tenant-observation: a fleet
+            # round fans T observe_round calls through here, and nothing
+            # new can expire until the round index moves
+            self._prune_tenants()
         shadow = getattr(record, "shadow", None)
         if isinstance(shadow, dict):
             self._shadow = shadow
@@ -253,6 +302,44 @@ class Watchdog:
                 elif p > self._promo_seen:
                     self._promo_allow += p - self._promo_seen
                     self._promo_seen = p
+        return self.check()
+
+    def _prune_tenants(self) -> None:
+        """Drop per-tenant state (the reconcile blocks) for tenants
+        unseen for ``tenant_ttl_rounds`` rounds — the churn-proofing
+        half of the per-source design: without it a fleet that retires
+        tenants would grow the dicts forever, and a long-gone tenant's
+        stale drift block could hold the reconcile rule in violation."""
+        ttl = self.rules.tenant_ttl_rounds
+        if ttl <= 0 or not self._tenant_seen:
+            return
+        dead = [
+            t
+            for t, seen in self._tenant_seen.items()
+            if self._last_round - seen > ttl
+        ]
+        for t in dead:
+            self._tenant_seen.pop(t, None)
+            self._reconcile.pop(t, None)
+            self._reg().counter(
+                "watchdog_tenants_pruned_total",
+                "per-tenant watchdog state entries pruned after the "
+                "tenant went unseen for tenant_ttl_rounds rounds",
+            ).inc()
+
+    def observe_fleet_rollup(
+        self, rollup: dict[str, Any]
+    ) -> list[dict[str, Any]]:
+        """Feed one fleet round's decoded tenant rollup
+        (``telemetry.fleet_rollup.decode_rollup``): the p99 of the
+        per-tenant cost dimension joins the rolling tail window the
+        ``fleet_tail_cost`` rule judges. Returns the newly raised
+        violations, like :meth:`observe_round`."""
+        try:
+            p99 = float(rollup["dims"]["cost"]["quantiles"]["p99"])
+        except (KeyError, TypeError):
+            return []
+        self._fleet_tail.append(p99)
         return self.check()
 
     def observe_perf(self, verdicts: dict[str, dict[str, Any]]) -> list[dict[str, Any]]:
@@ -380,6 +467,23 @@ class Watchdog:
                     "divergences": len(worst.get("divergences") or ()),
                     "repairs_issued": len(worst.get("repairs") or ()),
                     **({"tenant": tenant} if tenant is not None else {}),
+                }
+        if r.fleet_tail_frac > 0 and len(self._fleet_tail) >= max(
+            r.min_samples, 2
+        ):
+            # the cost-regression rule's shape, applied to the fleet's
+            # TAIL: the latest round's p99 cost rollup vs the window's
+            # best — the worst tenants regressing is an SLO signal even
+            # while the fleet median holds (the baseline excludes the
+            # latest sample, so >= 2 samples whatever min_samples says)
+            latest = self._fleet_tail[-1]
+            baseline = min(list(self._fleet_tail)[:-1])
+            if baseline > 0 and latest > (1.0 + r.fleet_tail_frac) * baseline:
+                now[RULE_FLEET_TAIL] = {
+                    "p99_cost": latest,
+                    "baseline": baseline,
+                    "threshold_frac": r.fleet_tail_frac,
+                    "window": len(self._fleet_tail),
                 }
         if (
             r.shadow_min_win_rate > 0

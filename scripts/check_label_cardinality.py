@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Static check: unbounded-identity label keys stay out of metric space.
+
+The cardinality budget (OBSERVABILITY.md "Fleet observability") is only
+enforceable if per-tenant metric series cannot come into existence
+anywhere BUT the budget-gated gateway: one stray
+``registry.counter(..., labelnames=("tenant",))`` call site re-creates
+the O(T) series explosion the budget exists to prevent, silently and
+permanently (registry children are memoized forever). Same story for
+``service``/``pod`` label keys — service and pod names are unbounded
+identity spaces (PR 5's convention: names ride event payloads and
+rank-labeled values, never label KEYS).
+
+This checker walks every ``.counter(...)`` / ``.gauge(...)`` /
+``.histogram(...)`` call in ``kubernetes_rescheduling_tpu/`` (AST, not
+regex — multi-line calls and keyword/positional ``labelnames`` both
+resolve) and fails if any registers a label key from
+``UNBOUNDED_LABELS`` outside the allowlisted budget-gated helpers in
+``telemetry/fleet_rollup.py``. A ``labelnames`` argument that is not a
+literal tuple/list is also flagged outside the allowlist — a
+dynamically built label set cannot be audited statically.
+
+Run directly (exit 1 on violations) or through its test twin
+(tests/test_label_cardinality.py); the no-args self-check over the
+checked-in tree must stay green.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "kubernetes_rescheduling_tpu"
+
+# identity spaces that grow with the workload: tenants, services, pods
+UNBOUNDED_LABELS = ("tenant", "service", "pod")
+
+# the budget-gated helpers — THE one legal home for tenant-labeled
+# registrations (telemetry.fleet_rollup.TenantSeries)
+ALLOWED_FILES = ("kubernetes_rescheduling_tpu/telemetry/fleet_rollup.py",)
+
+_REGISTER_METHODS = ("counter", "gauge", "histogram")
+
+
+def _labelnames_node(call: ast.Call) -> ast.AST | None:
+    """The labelnames argument of one registration call, keyword or
+    positional (counter(name, help, labelnames))."""
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def _literal_strings(node: ast.AST) -> list[str] | None:
+    """The label keys when the node is a literal tuple/list of string
+    constants; None when it cannot be statically read."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.Constant) and node.value == ():
+        return []
+    return None
+
+
+def scan_source(text: str, rel_path: str) -> list[str]:
+    """Violations in one module's source (``rel_path`` is repo-relative,
+    used for the allowlist and the messages)."""
+    if rel_path.replace("\\", "/") in ALLOWED_FILES:
+        return []
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:  # pragma: no cover - the suite parses
+        return [f"{rel_path}: unparseable ({e})"]
+    out: list[str] = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REGISTER_METHODS
+        ):
+            continue
+        ln = _labelnames_node(node)
+        if ln is None:
+            continue
+        keys = _literal_strings(ln)
+        if keys is None:
+            out.append(
+                f"{rel_path}:{node.lineno}: .{node.func.attr}() labelnames "
+                f"is not a literal tuple/list of strings — unauditable "
+                f"label keys are only allowed in the budget-gated helpers "
+                f"({', '.join(ALLOWED_FILES)})"
+            )
+            continue
+        bad = [k for k in keys if k in UNBOUNDED_LABELS]
+        if bad:
+            out.append(
+                f"{rel_path}:{node.lineno}: .{node.func.attr}() registers "
+                f"unbounded-identity label key(s) {bad} — per-tenant/"
+                f"service/pod series may only be created through the "
+                f"budget-gated helpers in {ALLOWED_FILES[0]} "
+                f"(telemetry.fleet_rollup.TenantSeries)"
+            )
+    return out
+
+
+def violations() -> list[str]:
+    out: list[str] = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        out.extend(
+            scan_source(path.read_text(), str(path.relative_to(ROOT)))
+        )
+    return out
+
+
+def main() -> int:
+    bad = violations()
+    if bad:
+        sys.stderr.write(
+            "unbounded-identity label keys outside the budget-gated "
+            "helpers:\n" + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

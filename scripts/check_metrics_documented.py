@@ -10,8 +10,11 @@ longer drift from what the ``/metrics`` endpoint actually serves.
 Source side: a regex over the package for ``.counter("...")`` /
 ``.gauge("...")`` / ``.histogram("...")`` call sites with a literal
 first argument (the registry's get-or-create surface; ``\\s*`` spans the
-newline in multi-line calls). A registration whose name is built
-dynamically would be invisible to this check — keep names literal.
+newline in multi-line calls), plus ``.counter_inc("...")`` /
+``.gauge_set("...")`` — the budget-gated ``TenantSeries`` gateway
+(``telemetry/fleet_rollup.py``) through which every tenant-labeled
+family registers. A registration whose name is built dynamically would
+be invisible to this check — keep names literal.
 
 Doc side: backticked tokens in the FIRST column of the inventory table's
 rows (lines starting with ``| `` in OBSERVABILITY.md).
@@ -31,7 +34,9 @@ PACKAGE = ROOT / "kubernetes_rescheduling_tpu"
 DOC = ROOT / "OBSERVABILITY.md"
 
 _REGISTER = re.compile(
-    r"\.(?:counter|gauge|histogram)\(\s*\"([a-zA-Z_][a-zA-Z0-9_]*)\"", re.S
+    r"\.(?:counter|gauge|histogram|counter_inc|gauge_set)"
+    r"\(\s*\"([a-zA-Z_][a-zA-Z0-9_]*)\"",
+    re.S,
 )
 _TICKED = re.compile(r"`([a-z_][a-z0-9_]*)`")
 

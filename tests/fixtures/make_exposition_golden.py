@@ -8,6 +8,11 @@ from pathlib import Path
 from kubernetes_rescheduling_tpu.telemetry.attribution import (
     publish_attribution,
 )
+from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
+    decode_rollup,
+    publish_rollup,
+    rollup_numpy,
+)
 from kubernetes_rescheduling_tpu.telemetry.registry import MetricsRegistry
 
 
@@ -42,6 +47,18 @@ def build_registry() -> MetricsRegistry:
             "egress": {"n0": 5.0, "n1": 5.0},
         },
         top_k=2,
+    )
+    # the fleet-rollup families render through the same real publisher
+    # (a fixed 4-tenant matrix: cost, load_std, degraded, skipped, drift)
+    matrix = [
+        [10.0, 1.0, 0.0, 0.0, 0.0],
+        [40.0, 4.0, 1.0, 0.0, 2.0],
+        [20.0, 2.0, 0.0, 0.0, 0.0],
+        [30.0, 3.0, 0.0, 1.0, 1.0],
+    ]
+    publish_rollup(
+        registry,
+        decode_rollup(rollup_numpy(matrix, top_k=2), top_k=2),
     )
     return registry
 

@@ -112,6 +112,26 @@ def test_fleet_headline_conforms():
     assert checker.check_parsed(fleet_like, "fleet") == []
 
 
+def test_fleet_rollup_reading_conforms():
+    """The fleet cell's second ledger series — steady-state rounds/sec
+    with the tenant-rollup plane on (better: higher) — satisfies the
+    same parsed-record schema as the headline."""
+    checker = _load_checker()
+    rollup_like = {
+        "metric": "fleet_rounds_per_sec_rollup",
+        "value": 83.1,
+        "unit": "rounds/s",
+        "better": "higher",
+        "extra": {
+            "scenario": "fleet",
+            "tenants": 16,
+            "rollup_top_k": 3,
+            "rollup_off_rounds_per_sec": 85.0,
+        },
+    }
+    assert checker.check_parsed(rollup_like, "fleet-rollup") == []
+
+
 def test_pipeline_headline_conforms():
     """The pipeline cell's result dict (bench.bench_pipeline's shape —
     the wall_round_ms perf-ledger series) satisfies the same

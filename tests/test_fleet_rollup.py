@@ -1,0 +1,815 @@
+"""Fleet-scale observability: device-side tenant rollups, the
+cardinality budget, and the bounded live plane.
+
+The invariants pinned here are the fleet-observability contract:
+
+- the device rollup kernel re-derives against a host-side numpy twin
+  within f32 tolerance (same nearest-rank quantiles, same tie order);
+- an at-budget fleet's legacy per-tenant series and /healthz fleet
+  block are BIT-IDENTICAL to the pre-budget plane (golden-pinned from
+  the pre-PR code);
+- an over-budget fleet's registry series count is independent of T —
+  no tenant label keys exist anywhere, suppressions are counted, and
+  the T=256 soak closes each round in the same ONE counted transfer
+  (per K-round block under scan) with 1 steady-state trace per kernel;
+- the watchdog prunes per-tenant state under churn and judges the p99
+  cost rollup (fleet_tail_cost);
+- the shared event ring is fair across tenants, with counted drops.
+"""
+
+import json
+import math
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends.fleet import make_fleet
+from kubernetes_rescheduling_tpu.bench.fleet import run_fleet_controller
+from kubernetes_rescheduling_tpu.config import (
+    ChaosConfig,
+    ControllerConfig,
+    FleetConfig,
+    ObsConfig,
+    RescheduleConfig,
+)
+from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
+    DIMS,
+    NUM_DIMS,
+    QUANTS,
+    TenantSeries,
+    TenantSummaryRing,
+    decode_rollup,
+    fleet_health_block,
+    publish_rollup,
+    rollup_event,
+    rollup_matrix,
+    rollup_numpy,
+    rollup_size,
+)
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MetricsRegistry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.telemetry.server import OpsPlane
+from kubernetes_rescheduling_tpu.telemetry.watchdog import (
+    RULE_FLEET_TAIL,
+    SLORules,
+    Watchdog,
+)
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+from kubernetes_rescheduling_tpu.utils.retry import RetryPolicy
+
+
+@pytest.fixture()
+def registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+# ---------------- kernel vs numpy twin ----------------
+
+
+@pytest.mark.parametrize("tenants,top_k", [(5, 1), (64, 3), (256, 4)])
+def test_rollup_matrix_matches_numpy_twin(tenants, top_k):
+    """The jitted device rollup and the host recompute agree: quantile
+    values within f32 tolerance, worst-tenant indices exactly (distinct
+    column values — ties are pinned separately)."""
+    rng = np.random.default_rng(7 + tenants)
+    matrix = rng.uniform(0.0, 100.0, size=(tenants, NUM_DIMS)).astype(
+        np.float32
+    )
+    dev = np.asarray(jax.jit(
+        lambda m: rollup_matrix(m, top_k=top_k)
+    )(jnp.asarray(matrix)))
+    host = rollup_numpy(matrix, top_k=top_k)
+    assert dev.shape == host.shape == (rollup_size(top_k),)
+    nq = NUM_DIMS * len(QUANTS)
+    np.testing.assert_allclose(dev[:nq], host[:nq], rtol=1e-6)
+    # sums: f32 accumulation order may differ — tolerance
+    np.testing.assert_allclose(
+        dev[nq : nq + NUM_DIMS], host[nq : nq + NUM_DIMS], rtol=1e-5
+    )
+    off = nq + NUM_DIMS
+    np.testing.assert_allclose(
+        dev[off : off + NUM_DIMS * top_k],
+        host[off : off + NUM_DIMS * top_k],
+        rtol=1e-6,
+    )
+    # indices: exact (values are distinct with probability 1)
+    np.testing.assert_array_equal(
+        dev[off + NUM_DIMS * top_k :], host[off + NUM_DIMS * top_k :]
+    )
+
+
+def test_rollup_tie_order_is_lowest_index_first():
+    """Equal values (a fleet of identical tenants — the common mubench
+    case) rank by tenant index on BOTH halves, so the worst-k rows stay
+    deterministic and comparable."""
+    matrix = np.ones((6, NUM_DIMS), np.float32)
+    dev = np.asarray(
+        jax.jit(lambda m: rollup_matrix(m, top_k=3))(jnp.asarray(matrix))
+    )
+    host = rollup_numpy(matrix, top_k=3)
+    np.testing.assert_array_equal(dev, host)
+    decoded = decode_rollup(dev, top_k=3)
+    assert [r["tenant"] for r in decoded["dims"]["cost"]["worst"]] == [0, 1, 2]
+
+
+def test_decode_rollup_roundtrip_and_errors():
+    matrix = np.arange(4 * NUM_DIMS, dtype=np.float32).reshape(4, NUM_DIMS)
+    flat = rollup_numpy(matrix, top_k=2)
+    d = decode_rollup(flat, top_k=2)
+    assert set(d["dims"]) == set(DIMS)
+    cost = d["dims"]["cost"]
+    assert set(cost["quantiles"]) == set(QUANTS)
+    assert cost["quantiles"]["max"] == matrix[:, 0].max()
+    assert cost["sum"] == pytest.approx(matrix[:, 0].sum())
+    assert cost["worst"][0]["tenant"] == 3  # highest cost row
+    with pytest.raises(ValueError, match="does not decode"):
+        decode_rollup(flat[:-1], top_k=2)
+
+
+# ---------------- the budget gate ----------------
+
+
+def test_tenant_series_budget_gate(registry):
+    under = TenantSeries(registry, tenants=3, budget=4)
+    under.counter_inc("fleet_rounds_total", "h", "t0")
+    under.gauge_set("fleet_communication_cost", "h", "t0", 5.0)
+    c = registry.counter("fleet_rounds_total", labelnames=("tenant",))
+    assert c.labels(tenant="t0").value == 1
+
+    over = TenantSeries(registry, tenants=5, budget=4)
+    assert not over.enabled
+    over.counter_inc("fleet_moves_total", "h", "t1")
+    over.gauge_set("fleet_load_std", "h", "t1", 1.0)
+    snap = registry.snapshot()
+    assert not any(r["metric"] == "fleet_moves_total" for r in snap)
+    sup = registry.counter(
+        "tenant_series_suppressed_total", labelnames=("family",)
+    )
+    assert sup.labels(family="fleet_moves_total").value == 1
+    assert sup.labels(family="fleet_load_std").value == 1
+
+    unlimited = TenantSeries(registry, tenants=10_000, budget=None)
+    assert unlimited.enabled  # the solo ledger's ungated path
+
+
+def test_tenant_summary_ring_bounded_and_lru():
+    ring = TenantSummaryRing(cost_window=2, max_tenants=3)
+    for i in range(5):
+        ring.observe(
+            f"t{i}",
+            record={"round": 1, "communication_cost": float(i),
+                    "degraded": False, "moved": True},
+            breaker="closed",
+            drift=i,
+        )
+    assert len(ring) == 3 and ring.evicted == 2
+    assert ring.detail("t0") is None  # LRU-evicted
+    d = ring.detail("t4")
+    assert d["drift"] == 4 and d["costs"] == [4.0]
+    ring.observe("t4", record={"communication_cost": 9.0})
+    ring.observe("t4", record={"communication_cost": 8.0})
+    assert ring.detail("t4")["costs"] == [9.0, 8.0]  # window capped at 2
+    rows = ring.overview()
+    assert [r["tenant"] for r in rows] == ["t2", "t3", "t4"]
+    ring.observe("t2", skipped=True, breaker="open")
+    assert ring.detail("t2")["skipped_rounds"] == 1
+    assert ring.overview()[-1]["tenant"] == "t2"  # moved to MRU
+
+
+def test_fleet_health_block_budget_gate():
+    rows = {
+        f"t{i}": {"breaker": "closed", "rounds": 2, "skipped_rounds": 0,
+                  "degraded_rounds": 0}
+        for i in range(4)
+    }
+    assert fleet_health_block(rows, budget=4) is rows  # bit-identical
+    rows["t0"]["breaker"] = "open"
+    out = fleet_health_block(rows, budget=3)
+    assert out["suppressed"] and out["tenants"] == 4
+    assert out["breaker_states"] == {"closed": 3, "open": 1}
+    assert out["rounds"] == 8
+    matrix = np.arange(4 * NUM_DIMS, dtype=np.float32).reshape(4, NUM_DIMS)
+    rollup = decode_rollup(rollup_numpy(matrix, top_k=2), top_k=2)
+    out = fleet_health_block(
+        rows, budget=3, event=rollup_event(rollup, list(rows))
+    )
+    assert out["worst"][0]["tenant"] == "t3"
+    assert set(out["quantiles"]) == set(DIMS)
+
+
+# ---------------- watchdog: fleet_tail_cost + tenant pruning ----------------
+
+
+def _rollup_with_p99(p99: float) -> dict:
+    matrix = np.zeros((4, NUM_DIMS), np.float32)
+    matrix[:, 0] = [1.0, 1.0, 1.0, p99]  # max == p99 position at T=4
+    return decode_rollup(rollup_numpy(matrix, top_k=1), top_k=1)
+
+
+def test_watchdog_fleet_tail_rule_fires_and_recovers(registry):
+    wd = Watchdog(
+        SLORules(window=8, min_samples=2, fleet_tail_frac=0.5),
+        registry=registry,
+    )
+    for _ in range(3):
+        assert wd.observe_fleet_rollup(_rollup_with_p99(10.0)) == []
+    raised = wd.observe_fleet_rollup(_rollup_with_p99(20.0))
+    assert [r["rule"] for r in raised] == [RULE_FLEET_TAIL]
+    assert raised[0]["p99_cost"] == 20.0 and raised[0]["baseline"] == 10.0
+    assert not wd.healthy
+    # recovery: the tail drops back under threshold
+    wd.observe_fleet_rollup(_rollup_with_p99(10.0))
+    assert wd.healthy
+    # rebase clears the window (a new run's cost scale is not judged
+    # against the old run's)
+    wd.observe_fleet_rollup(_rollup_with_p99(1.0))
+    wd.rebase()
+    assert wd.observe_fleet_rollup(_rollup_with_p99(100.0)) == []
+
+
+class _Rec:
+    def __init__(self, rnd, tenant_drift=None):
+        self.round = rnd
+        self.decision_latency_s = 0.001
+        self.communication_cost = 1.0
+        self.reconcile = (
+            {"drift_pods": tenant_drift} if tenant_drift is not None else None
+        )
+
+
+def test_watchdog_prunes_churned_tenant_state(registry):
+    """Regression (satellite): per-tenant windows grew without bound
+    under tenant churn — unseen tenants now prune after
+    tenant_ttl_rounds, counted, and a retired tenant's stale drift can
+    no longer hold the reconcile rule in violation forever."""
+    wd = Watchdog(
+        SLORules(window=4, tenant_ttl_rounds=10, reconcile_max_drift_pods=1),
+        registry=registry,
+    )
+    # 60 churning tenants, each seen exactly once at round r
+    for r in range(1, 61):
+        wd.observe_round(_Rec(r, tenant_drift=1), tenant=f"t{r}")
+    assert len(wd._reconcile) <= 12  # bounded by the TTL, not by churn
+    pruned = registry.counter("watchdog_tenants_pruned_total")
+    assert pruned.value == 60 - len(wd._reconcile)
+    # a persistent tenant is never pruned
+    wd2 = Watchdog(SLORules(tenant_ttl_rounds=5), registry=registry)
+    for r in range(1, 31):
+        wd2.observe_round(_Rec(r, tenant_drift=0), tenant="steady")
+    assert "steady" in wd2._reconcile
+    # ttl=0 disables pruning
+    wd3 = Watchdog(SLORules(tenant_ttl_rounds=0), registry=registry)
+    for r in range(1, 31):
+        wd3.observe_round(_Rec(r, tenant_drift=0), tenant=f"t{r}")
+    assert len(wd3._reconcile) == 30
+
+
+# ---------------- event-ring fairness ----------------
+
+
+def test_logger_ring_fairness_caps_chatty_tenant(registry):
+    log = StructuredLogger(
+        max_records=16, max_records_per_tenant=4, registry=registry
+    )
+    for i in range(40):
+        log.info("spam", tenant="chatty", i=i)
+    log.info("quiet_event", tenant="quiet")
+    for i in range(40):
+        log.info("spam", tenant="chatty", i=i)
+    recs = log.records
+    # the chatty tenant evicted ITS OWN oldest events, never quiet's
+    assert sum(1 for r in recs if r.get("tenant") == "chatty") == 4
+    assert any(r.get("tenant") == "quiet" for r in recs)
+    drops = registry.counter(
+        "fleet_events_dropped_total", labelnames=("reason",)
+    )
+    assert drops.labels(reason="tenant_cap").value == 76
+    assert log.dropped_by_tenant["chatty"] == 76
+    assert log.dropped_by_tenant["quiet"] == 0
+
+
+def test_logger_ring_full_evictions_are_counted(registry):
+    log = StructuredLogger(max_records=4, registry=registry)
+    for i in range(4):
+        log.info("e", tenant=f"t{i}")
+    log.info("no_tenant_event")  # evicts t0 — counted
+    drops = registry.counter(
+        "fleet_events_dropped_total", labelnames=("reason",)
+    )
+    assert drops.labels(reason="ring_full").value == 1
+    assert log.dropped_by_tenant["t0"] == 1
+    log.info("another")  # evicts t1
+    assert drops.labels(reason="ring_full").value == 2
+
+
+def test_fleet_chaos_soak_ring_fairness(registry):
+    """The satellite's pin: a seeded chaos soak makes one tenant chatty
+    (boundary failures, skips, breaker events) on a SMALL shared ring —
+    every healthy tenant's events survive, and the chatty tenant's
+    overflow is counted drops, not other tenants' silence."""
+    fleet = make_fleet("mubench", 4, seed=0)
+    cfg = RescheduleConfig(
+        algorithm="communication",
+        max_rounds=14,
+        sleep_after_action_s=0.0,
+        retry=RetryPolicy(max_attempts=1, base_delay_s=0.01),
+        max_consecutive_failures=2,
+        breaker_cooldown_rounds=2,
+        chaos=ChaosConfig(profile="soak", seed=5),
+        fleet=FleetConfig(tenants=4, chaos_tenants=(3,)),
+    )
+    logger = StructuredLogger(max_records=24)
+    run_fleet_controller(
+        fleet, cfg, key=jax.random.PRNGKey(0), registry=registry,
+        logger=logger,
+    )
+    # the fleet loop armed fairness FOR THE RUN and restored the
+    # logger's own config on exit (loggers are process-cached)
+    assert logger.max_records_per_tenant == 0
+    assert logger.registry is None
+    by_tenant = {}
+    for r in logger.records:
+        if r.get("tenant"):
+            by_tenant.setdefault(r["tenant"], []).append(r)
+    for name in ("tenant0", "tenant1", "tenant2"):
+        assert by_tenant.get(name), f"{name} evicted from the ring"
+    drops = registry.counter(
+        "fleet_events_dropped_total", labelnames=("reason",)
+    )
+    total_drops = sum(
+        drops.labels(reason=reason).value
+        for reason in ("tenant_cap", "ring_full")
+    )
+    assert total_drops > 0
+    assert sum(logger.dropped_by_tenant.values()) == total_drops
+
+
+# ---------------- at-budget bit-identity (golden) ----------------
+
+LEGACY_FAMILIES = (
+    "fleet_tenants",
+    "fleet_rounds_total",
+    "fleet_rounds_skipped_total",
+    "fleet_degraded_rounds_total",
+    "fleet_moves_total",
+    "fleet_communication_cost",
+    "fleet_load_std",
+    "fleet_reconcile_drift_pods",
+)
+
+
+def _legacy_lines(registry) -> list[str]:
+    out = []
+    for line in registry.expose().splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            name = line.split(" ")[2]
+        else:
+            name = line.split("{")[0].split(" ")[0]
+        if name in LEGACY_FAMILIES:
+            out.append(line)
+    return out
+
+
+def test_at_budget_fleet_matches_pre_budget_golden(registry, request):
+    """An at-budget fleet's per-tenant series and /healthz fleet block
+    are BYTE-IDENTICAL to the pre-PR plane (fixture captured from the
+    pre-budget code on this exact seeded run)."""
+    golden = json.loads(
+        (request.config.rootpath / "tests" / "fixtures"
+         / "fleet_legacy_golden.json").read_text()
+    )
+    fleet = make_fleet("mubench", 3, seed=0)
+    fleet.inject_imbalance()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=3, sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=3),
+    )
+    ops = OpsPlane.from_config(
+        ObsConfig(serve_port=None), registry=registry
+    ).start()
+    try:
+        run_fleet_controller(
+            fleet, cfg, key=jax.random.PRNGKey(0), registry=registry,
+            ops=ops,
+        )
+        payload, healthy = ops.health.snapshot()
+    finally:
+        ops.close()
+    assert healthy
+    assert _legacy_lines(registry) == golden["exposition"]
+    assert payload["fleet"] == golden["healthz_fleet"]
+
+
+# ---------------- over-budget: series count independent of T ----------------
+
+
+def _fleet_series_keys(registry):
+    return sorted(
+        (r["metric"], tuple(sorted((r.get("labels") or {}).items())))
+        for r in registry.snapshot()
+        if r["metric"].startswith("fleet_")
+        or r["metric"] == "tenant_series_suppressed_total"
+    )
+
+
+def _run_over_budget(tenants: int, registry) -> None:
+    fleet = make_fleet("mubench", tenants, seed=0)
+    fleet.inject_imbalance()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=2, sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=tenants),
+        obs=ObsConfig(tenant_label_budget=4),
+    )
+    run_fleet_controller(
+        fleet, cfg, key=jax.random.PRNGKey(0), registry=registry
+    )
+
+
+def test_over_budget_series_set_is_independent_of_tenant_count():
+    """The cardinality-budget pin: two over-budget fleets of different
+    sizes produce the SAME fleet-family series set — growing T grows no
+    series, and no series anywhere carries a tenant label key."""
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    prev = set_registry(reg_a)
+    try:
+        _run_over_budget(10, reg_a)
+        set_registry(reg_b)
+        _run_over_budget(14, reg_b)
+    finally:
+        set_registry(prev)
+    keys_a, keys_b = _fleet_series_keys(reg_a), _fleet_series_keys(reg_b)
+    assert keys_a == keys_b
+    for reg in (reg_a, reg_b):
+        assert not any(
+            "tenant" in (r.get("labels") or {}) for r in reg.snapshot()
+        )
+        sup = reg.counter(
+            "tenant_series_suppressed_total", labelnames=("family",)
+        )
+        assert sup.labels(family="fleet_rounds_total").value > 0
+
+
+# ---------------- the T=256 acceptance soaks ----------------
+
+ROLLUP_SERIES_BUDGET = (
+    1            # fleet_tenants
+    + 3 * 4      # cost/load_std/drift quantile families
+    + 3          # degraded/skipped tenants + drift_pods totals
+    + 5 * 3      # fleet_worst_tenant{rank,dim} at top_k=3
+    + len(LEGACY_FAMILIES)  # suppression counters, one per family max
+)
+
+
+def _recompute_matrix(res, rnd: int, tenants: int) -> np.ndarray:
+    """Rebuild the round's per-tenant metric matrix from the recorded
+    per-tenant RoundRecords — the host-side oracle."""
+    matrix = np.zeros((tenants, NUM_DIMS), np.float32)
+    for t_idx in range(tenants):
+        rec = next(
+            r for r in res.results[f"tenant{t_idx}"].rounds
+            if r.round == rnd
+        )
+        matrix[t_idx, 0] = rec.communication_cost
+        matrix[t_idx, 1] = rec.load_std
+        matrix[t_idx, 2] = 1.0 if rec.degraded else 0.0
+        drift = (rec.reconcile or {}).get("drift_pods") or 0
+        matrix[t_idx, 4] = float(drift)
+    return matrix
+
+
+def _check_rollup_events_vs_numpy(events, res, tenants, top_k):
+    assert events, "no fleet_rollup events recorded"
+    for ev in events:
+        rnd = ev["round"]
+        matrix = _recompute_matrix(res, rnd, tenants)
+        oracle = decode_rollup(
+            rollup_numpy(matrix, top_k=top_k), top_k=top_k
+        )
+        for dim in DIMS:
+            for q in QUANTS:
+                assert ev["quantiles"][dim][q] == pytest.approx(
+                    oracle["dims"][dim]["quantiles"][q], rel=1e-5, abs=1e-5
+                ), (rnd, dim, q)
+            assert ev["sums"][dim] == pytest.approx(
+                oracle["dims"][dim]["sum"], rel=1e-4, abs=1e-4
+            )
+        got_worst = {
+            (w["dim"], w["rank"]): w["value"] for w in ev["worst"]
+        }
+        for dim in DIMS:
+            for rank, row in enumerate(oracle["dims"][dim]["worst"]):
+                assert got_worst[(dim, rank)] == pytest.approx(
+                    row["value"], rel=1e-5, abs=1e-5
+                )
+
+
+def test_fleet_rollup_acceptance_t256_per_round(registry):
+    """THE acceptance soak, per-round path: a 256-tenant fleet holds the
+    series budget (independent of T), matches the numpy rollup oracle
+    every round, closes each round in the same ONE counted metrics
+    transfer, and runs 1 steady-state trace per kernel."""
+    tenants = 256
+    rounds = 3
+    fleet = make_fleet("mubench", tenants, seed=0)
+    fleet.inject_imbalance()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=rounds,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=tenants),
+        obs=ObsConfig(tenant_label_budget=64),
+    )
+    logger = StructuredLogger(max_records=4096)
+    res = run_fleet_controller(
+        fleet, cfg, key=jax.random.PRNGKey(0), registry=registry,
+        logger=logger,
+    )
+    assert res.total_rounds == tenants * rounds
+    # cardinality: bounded independent of T, zero tenant label keys
+    snap = registry.snapshot()
+    assert not any("tenant" in (r.get("labels") or {}) for r in snap)
+    fleet_series = [
+        r for r in snap
+        if r["metric"].startswith("fleet_")
+        or r["metric"] == "tenant_series_suppressed_total"
+    ]
+    assert len(fleet_series) <= ROLLUP_SERIES_BUDGET
+    # one counted decision transfer + one counted metrics transfer per
+    # round — the rollup added ZERO
+    pulls = registry.counter(
+        "device_transfers_total", labelnames=("site",)
+    )
+    assert pulls.labels(site="fleet_decision").value == rounds
+    assert pulls.labels(site="fleet_metrics").value == rounds
+    # 1 steady-state trace per kernel
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="fleet_solve").value == 1
+    assert traces.labels(fn="fleet_round_bundle").value == 1
+    # the device rollup re-derives from the recorded per-tenant rounds
+    events = [r for r in logger.records if r["event"] == "fleet_rollup"]
+    assert len(events) == rounds
+    _check_rollup_events_vs_numpy(events, res, tenants, top_k=3)
+
+
+def test_fleet_rollup_acceptance_t256_scan_block(registry):
+    """THE acceptance soak, scan path: the same 256-tenant fleet
+    advanced by ONE scan dispatch per K-round block — rollups ride the
+    block's single counted round_end transfer, per-round rollups still
+    match the oracle, and per-tenant streams match the per-round loop."""
+    tenants = 256
+    k = 3
+    fleet = make_fleet("mubench", tenants, seed=0)
+    fleet.inject_imbalance()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=k,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=tenants),
+        obs=ObsConfig(tenant_label_budget=64),
+        controller=ControllerConfig(scan_block=k),
+    )
+    logger = StructuredLogger(max_records=4096)
+    res = run_fleet_controller(
+        fleet, cfg, key=jax.random.PRNGKey(0), registry=registry,
+        logger=logger,
+    )
+    assert res.total_rounds == tenants * k
+    assert registry.counter("scan_blocks_total").value == 1
+    # the whole block came home in ONE counted transfer
+    pulls = registry.counter(
+        "device_transfers_total", labelnames=("site",)
+    )
+    assert pulls.labels(site="round_end").value == 1
+    assert pulls.labels(site="fleet_decision").value == 0
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="fleet_scan_rounds").value == 1
+    snap = registry.snapshot()
+    assert not any("tenant" in (r.get("labels") or {}) for r in snap)
+    events = [r for r in logger.records if r["event"] == "fleet_rollup"]
+    assert len(events) == k
+    _check_rollup_events_vs_numpy(events, res, tenants, top_k=3)
+
+
+# ---------------- live plane: /tenants + breaker bundles ----------------
+
+
+def _get(port, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_tenants_endpoints_serve_bounded_drilldown(registry):
+    fleet = make_fleet("mubench", 3, seed=0)
+    fleet.inject_imbalance()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=2, sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=3),
+        obs=ObsConfig(serve_port=0, tenant_label_budget=1),
+    )
+    ops = OpsPlane.from_config(cfg.obs, registry=registry).start()
+    try:
+        port = ops.server.port
+        # before any fleet run: no drill-down
+        status, body = _get(port, "/tenants")
+        assert status == 404
+        run_fleet_controller(
+            fleet, cfg, key=jax.random.PRNGKey(0), registry=registry,
+            ops=ops,
+        )
+        status, rows = _get(port, "/tenants")
+        assert status == 200
+        assert {r["tenant"] for r in rows} == {
+            "tenant0", "tenant1", "tenant2"
+        }
+        assert all(r["rounds"] == 2 for r in rows)
+        status, detail = _get(port, "/tenants/tenant1")
+        assert status == 200
+        assert detail["tenant"] == "tenant1"
+        assert len(detail["costs"]) == 2
+        assert detail["last"]["round"] == 2
+        status, err = _get(port, "/tenants/nope")
+        assert status == 404 and "unknown tenant" in err["error"]
+        # the over-budget /healthz block is the bounded summary
+        status, health = _get(port, "/healthz")
+        assert health["fleet"]["suppressed"]
+        assert health["fleet"]["tenants"] == 3
+        assert health["fleet"]["worst"]
+        # request accounting normalized the drill-down path (no
+        # per-tenant endpoint label values)
+        c = registry.counter(
+            "ops_http_requests_total", labelnames=("endpoint",)
+        )
+        assert c.labels(endpoint="/tenants/<name>").value == 2
+    finally:
+        ops.close()
+
+
+def test_breaker_open_bundle_scopes_to_offending_tenant(
+    tmp_path, registry
+):
+    """A tenant breaker opening dumps a bundle carrying the latest
+    fleet rollup plus ONLY the offending tenant's summary ring — never
+    all T tenants' state for one tenant's incident."""
+    fleet = make_fleet("mubench", 4, seed=0)
+    cfg = RescheduleConfig(
+        algorithm="communication",
+        max_rounds=14,
+        sleep_after_action_s=0.0,
+        retry=RetryPolicy(max_attempts=1, base_delay_s=0.01),
+        max_consecutive_failures=2,
+        breaker_cooldown_rounds=2,
+        chaos=ChaosConfig(profile="soak", seed=5),
+        fleet=FleetConfig(tenants=4, chaos_tenants=(3,)),
+        obs=ObsConfig(serve_port=None, bundle_dir=str(tmp_path)),
+    )
+    ops = OpsPlane.from_config(cfg.obs, registry=registry).start()
+    try:
+        res = run_fleet_controller(
+            fleet, cfg, key=jax.random.PRNGKey(0), registry=registry,
+            ops=ops,
+        )
+    finally:
+        ops.close()
+    assert any(
+        tr["to"] == "open"
+        for tr in res.results["tenant3"].breaker_transitions
+    )
+    bundles = sorted(tmp_path.glob("flight_*_breaker_open.json"))
+    assert bundles
+    bundle = json.loads(bundles[-1].read_text())
+    assert bundle["transition"]["tenant"] == "tenant3"
+    assert bundle["tenant_summary"]["tenant"] == "tenant3"
+    assert bundle["fleet_rollup"]["worst"]
+    assert set(bundle["fleet_rollup"]["quantiles"]) == set(DIMS)
+
+
+# ---------------- the CLI report ----------------
+
+
+def test_telemetry_fleet_report_renders(tmp_path, registry, capsys):
+    fleet = make_fleet("mubench", 5, seed=0)
+    fleet.inject_imbalance()
+    events = tmp_path / "events.jsonl"
+    logger = StructuredLogger(max_records=512, path=events)
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=3, sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=5),
+        obs=ObsConfig(tenant_label_budget=2),
+    )
+    run_fleet_controller(
+        fleet, cfg, key=jax.random.PRNGKey(0), registry=registry,
+        logger=logger,
+    )
+    from kubernetes_rescheduling_tpu.cli import main as cli_main
+
+    rc = cli_main(["telemetry", "fleet", str(events)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "fleet rollups: 3 rounds" in out
+    assert "worst offenders" in out
+    assert "cost" in out and "drift" in out
+
+
+# ---------------- config & publish surfaces ----------------
+
+
+def test_obs_config_fleet_rollup_validation():
+    ObsConfig(tenant_label_budget=0, fleet_rollup_top_k=1).validate()
+    with pytest.raises(ValueError, match="tenant_label_budget"):
+        ObsConfig(tenant_label_budget=-1).validate()
+    with pytest.raises(ValueError, match="fleet_rollup_top_k"):
+        ObsConfig(fleet_rollup_top_k=0).validate()
+    with pytest.raises(ValueError, match="slo_fleet_tail_frac"):
+        ObsConfig(slo_fleet_tail_frac=-0.1).validate()
+    with pytest.raises(ValueError, match="fleet_tail_frac"):
+        SLORules(fleet_tail_frac=-1).validate()
+    with pytest.raises(ValueError, match="tenant_ttl_rounds"):
+        SLORules(tenant_ttl_rounds=-1).validate()
+
+
+def test_obs_toml_fleet_block(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        "[obs]\n"
+        "tenant_label_budget = 8\n"
+        "fleet_rollup = false\n"
+        "fleet_rollup_top_k = 5\n"
+        "slo_fleet_tail_frac = 0.25\n"
+    )
+    cfg = RescheduleConfig.from_toml(p)
+    assert cfg.obs.tenant_label_budget == 8
+    assert cfg.obs.fleet_rollup is False
+    assert cfg.obs.fleet_rollup_top_k == 5
+    assert cfg.obs.slo_fleet_tail_frac == 0.25
+
+
+def test_rollup_off_keeps_legacy_metrics_kernel(registry):
+    """obs.fleet_rollup=False restores the historical fleet_metrics
+    closer exactly: no rollup families, no fleet_round_bundle kernel."""
+    fleet = make_fleet("mubench", 3, seed=0)
+    fleet.inject_imbalance()
+    cfg = RescheduleConfig(
+        algorithm="communication", max_rounds=2, sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=3),
+        obs=ObsConfig(fleet_rollup=False),
+    )
+    run_fleet_controller(
+        fleet, cfg, key=jax.random.PRNGKey(0), registry=registry
+    )
+    snap = registry.snapshot()
+    assert not any(
+        r["metric"].startswith("fleet_cost_quantile") for r in snap
+    )
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="fleet_metrics").value == 1
+    assert traces.labels(fn="fleet_round_bundle").value == 0
+
+
+def test_exposition_conformance_fleet_rollup_families(registry):
+    """Strict-parser pass over the rollup families as a live fleet
+    emits them across rounds (the PR 5 conformance convention)."""
+    from tests.test_observability import assert_exposition_conformant
+
+    rng = np.random.default_rng(3)
+    for _ in range(3):
+        matrix = rng.uniform(0, 50, size=(8, NUM_DIMS)).astype(np.float32)
+        publish_rollup(
+            registry,
+            decode_rollup(rollup_numpy(matrix, top_k=2), top_k=2),
+        )
+    families, samples = assert_exposition_conformant(registry.expose())
+    for fam in (
+        "fleet_cost_quantile",
+        "fleet_load_std_quantile",
+        "fleet_drift_quantile",
+        "fleet_worst_tenant",
+        "fleet_degraded_tenants",
+        "fleet_skipped_tenants",
+        "fleet_drift_pods",
+    ):
+        assert families[fam]["type"] == "gauge"
+    # label budget: 4 q-points per quantile family, rank×dim for worst
+    q_series = [k for k in samples if k[0] == "fleet_cost_quantile"]
+    assert len(q_series) == 4
+    worst_series = [k for k in samples if k[0] == "fleet_worst_tenant"]
+    assert len(worst_series) == 2 * NUM_DIMS
+
+
+def test_rollup_event_names_tenants():
+    matrix = np.zeros((3, NUM_DIMS), np.float32)
+    matrix[:, 0] = [1.0, 9.0, 5.0]
+    rollup = decode_rollup(rollup_numpy(matrix, top_k=2), top_k=2)
+    ev = rollup_event(rollup, ["a", "b", "c"], round=7)
+    assert ev["round"] == 7
+    cost_rows = [w for w in ev["worst"] if w["dim"] == "cost"]
+    assert [w["tenant"] for w in cost_rows] == ["b", "c"]

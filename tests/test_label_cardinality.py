@@ -1,0 +1,73 @@
+"""CI twin of ``scripts/check_label_cardinality.py``: the checked-in
+package registers NO unbounded-identity label keys (tenant/service/pod)
+outside the budget-gated helpers in ``telemetry/fleet_rollup.py`` — the
+static half of the cardinality budget (one stray call site would
+re-create the O(T) series explosion the budget suppresses) — and the
+checker flags every pinned violation shape (``check_bench_schema.py``
+convention, including the no-args self-check)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+
+def _load_checker():
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_label_cardinality.py"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "check_label_cardinality", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_label_cardinality", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_checked_in_tree_is_clean():
+    checker = _load_checker()
+    assert checker.violations() == []
+
+
+def test_flags_tenant_label_outside_allowlist():
+    checker = _load_checker()
+    src = (
+        "reg.counter(\n"
+        '    "my_total", "help",\n'
+        '    labelnames=("tenant",),\n'
+        ").labels(tenant=name).inc()\n"
+    )
+    bad = checker.scan_source(src, "kubernetes_rescheduling_tpu/bench/x.py")
+    assert len(bad) == 1 and "tenant" in bad[0]
+
+
+def test_flags_positional_labelnames_and_service_pod_keys():
+    checker = _load_checker()
+    src = 'registry.gauge("g", "h", ("rank", "service"))\n'
+    bad = checker.scan_source(src, "kubernetes_rescheduling_tpu/a.py")
+    assert len(bad) == 1 and "service" in bad[0]
+    src = 'registry.histogram("h", "h", labelnames=["pod"])\n'
+    bad = checker.scan_source(src, "kubernetes_rescheduling_tpu/a.py")
+    assert len(bad) == 1 and "pod" in bad[0]
+
+
+def test_flags_unauditable_dynamic_labelnames():
+    checker = _load_checker()
+    src = 'registry.counter("c", "h", labelnames=keys)\n'
+    bad = checker.scan_source(src, "kubernetes_rescheduling_tpu/a.py")
+    assert len(bad) == 1 and "literal" in bad[0]
+
+
+def test_bounded_labels_and_allowlisted_file_pass():
+    checker = _load_checker()
+    ok = 'registry.counter("c", "h", labelnames=("rank", "dim", "q"))\n'
+    assert checker.scan_source(ok, "kubernetes_rescheduling_tpu/a.py") == []
+    tenant = 'registry.counter("c", "h", labelnames=("tenant",))\n'
+    assert (
+        checker.scan_source(
+            tenant, "kubernetes_rescheduling_tpu/telemetry/fleet_rollup.py"
+        )
+        == []
+    )

@@ -211,6 +211,24 @@ def test_exposition_golden_file(registry):
         },
         top_k=2,
     )
+    # the fleet-rollup families render through the same real publisher
+    # (keep the matrix IDENTICAL to make_exposition_golden.py's)
+    from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
+        decode_rollup,
+        publish_rollup,
+        rollup_numpy,
+    )
+
+    matrix = [
+        [10.0, 1.0, 0.0, 0.0, 0.0],
+        [40.0, 4.0, 1.0, 0.0, 2.0],
+        [20.0, 2.0, 0.0, 0.0, 0.0],
+        [30.0, 3.0, 0.0, 1.0, 1.0],
+    ]
+    publish_rollup(
+        registry,
+        decode_rollup(rollup_numpy(matrix, top_k=2), top_k=2),
+    )
     assert registry.expose() == golden.read_text()
 
 

@@ -304,6 +304,36 @@ class BoundaryClient:
             host._solver_caches = caches
         return caches.setdefault((name, self.tenant), {})
 
+    def evict_solver_caches(self, *, reason: str = "teardown") -> int:
+        """Drop EVERY solver-cache slot keyed to this boundary's tenant.
+
+        The slots deliberately outlive a run (the PR-6 reuse contract),
+        which is also how they leak: a fleet tenant whose graph a churn
+        wave just rewrote — or whose backend is being torn down — leaves
+        its ``(name, tenant)`` slots holding the OLD graph's derived
+        values (a SparseCommGraph is tens of MB at bench scale), and a
+        long deploy-waves soak accretes one stale generation per churned
+        tenant with nothing ever reclaiming them. Eviction is counted
+        (``solver_cache_evictions_total{reason}``) so soaks can alert on
+        an eviction rate that implies cache-defeating churn. Returns the
+        number of slots dropped."""
+        host = self.raw_backend
+        caches = getattr(host, "_solver_caches", None)
+        if not caches:
+            return 0
+        doomed = [k for k in caches if k[1] == self.tenant]
+        for k in doomed:
+            del caches[k]
+        if doomed and self.registry is not None:
+            self.registry.counter(
+                "solver_cache_evictions_total",
+                "tenant solver-cache slots dropped (churn rewrote the "
+                "tenant's graph, or the tenant was torn down) — stale "
+                "derived graphs must not accrete across a long soak",
+                labelnames=("reason",),
+            ).labels(reason=reason).inc(len(doomed))
+        return len(doomed)
+
     def advance(self, seconds: float) -> None:
         self.backend.advance(seconds)
 

@@ -35,13 +35,31 @@ per round exactly as the solo loop derives them, so
 ``run_fleet_controller(fleet, cfg, key=k)`` makes the same decisions as
 N solo ``run_controller(backend_t, cfg, key=fold_in(k, t))`` runs.
 
-Scope: fleet mode batches the GREEDY decision kernel (one move per
-tenant per round — ``config.validate()`` enforces it); global/pod solves
-keep the solo loop. Checkpoint/resume is solo-only for now.
+Scope (fleet v2): THREE decision planes batch over the tenant axis —
+
+- the GREEDY kernel (one move per tenant per round, PR 6);
+- the PROACTIVE kernel: per-tenant forecast RLS state stacked
+  ``[T, N, ...]`` (``forecast.fleet``), ONE forecast dispatch + ONE
+  predicted-state decide dispatch per round, the diag matrix riding the
+  round's single counted bundle pull;
+- the GLOBAL solver (``algorithm='global'`` / ``moves_per_round='all'``,
+  dense backend): ONE batched solve re-places every service in every
+  tenant (``solver.fleet_global``, restart fan-out included), the
+  decided per-tenant move lists coming home in the same single pull.
+
+Tenants may have HETEROGENEOUS shapes: at startup the loop fits one
+shared power-of-two shape bucket over every tenant's live counts
+(``elastic.buckets.bucket_capacity``) and pins each backend's snapshot
+padding to it, so the stacked batch compiles once and padded slots stay
+inert (the mask-twin contract — per-tenant decisions bit-exact vs an
+unpadded solo run). Pod-unit solves, sparse-backend solves, and integer
+wave caps keep the solo loop (``config.validate()`` names the reason
+for each). Checkpoint/resume is solo-only for now.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -76,11 +94,13 @@ from kubernetes_rescheduling_tpu.bench.reconcile import (
 from kubernetes_rescheduling_tpu.bench.round_end import block
 from kubernetes_rescheduling_tpu.config import RescheduleConfig
 from kubernetes_rescheduling_tpu.elastic.buckets import (
+    bucket_capacity,
     device_graph,
     device_view,
 )
 from kubernetes_rescheduling_tpu.elastic.engine import make_fleet_churn
 from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.policies.proactive import scoring_policy
 from kubernetes_rescheduling_tpu.solver.fleet import (
     ROW_MOST,
     ROW_SERVICE,
@@ -88,8 +108,17 @@ from kubernetes_rescheduling_tpu.solver.fleet import (
     ROW_VICTIM,
     fleet_metrics,
     fleet_solve,
+    fleet_solve_proactive,
     stack_tenants,
 )
+from kubernetes_rescheduling_tpu.solver.fleet_global import (
+    decode_fleet_global,
+    fleet_global_solve,
+)
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+)
+from kubernetes_rescheduling_tpu.forecast.model import DIAG_SIZE
 from kubernetes_rescheduling_tpu.telemetry import get_registry, pull, span
 from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
     TenantSeries,
@@ -234,6 +263,61 @@ def _round_keys(tenant_keys: jax.Array, rnd: jax.Array) -> jax.Array:
     )(tenant_keys)
 
 
+# the GLOBAL round's key rule: the solo loop hands fold_in(key, round)
+# straight to the solver (no split — _global_round consumes the round
+# key whole), so the batched solve must too for restart/sweep parity
+@jax.jit
+def _round_keys_global(tenant_keys: jax.Array, rnd: jax.Array) -> jax.Array:
+    return jax.vmap(lambda k: jax.random.fold_in(k, rnd))(tenant_keys)
+
+
+def _align_fleet_buckets(backends, *, floor: int, registry) -> dict | None:
+    """Heterogeneous tenants: fit ONE shared power-of-two shape bucket
+    over every tenant's live counts and pin each backend's snapshot
+    padding to it, so ``stack_tenants`` sees one common shape and the
+    batch compiles once. Same-shaped fleets are left untouched (the
+    historical unpadded behavior — and its test pins — survive). Returns
+    the shared capacities, or None when nothing needed aligning.
+
+    Requires the sim mutator surface (``live_counts``/
+    ``set_capacities``); a fleet of mismatched backends without it fails
+    at ``stack_tenants`` with the existing sizing error."""
+    counts = []
+    for b in backends:
+        raw = b
+        while hasattr(raw, "inner"):  # chaos wrappers pass through
+            raw = raw.inner
+        if not (hasattr(raw, "live_counts") and hasattr(raw, "set_capacities")):
+            return None
+        counts.append(raw.live_counts())
+    if len({tuple(sorted(c.items())) for c in counts}) <= 1:
+        return None
+    caps = {
+        axis: bucket_capacity(max(c[axis] for c in counts), floor=floor)
+        for axis in ("services", "nodes", "pods")
+    }
+    for b in backends:
+        raw = b
+        while hasattr(raw, "inner"):
+            raw = raw.inner
+        raw.set_capacities(
+            node=caps["nodes"], pod=caps["pods"], service=caps["services"]
+        )
+    registry.gauge(
+        "fleet_bucket_services",
+        "shared fleet shape bucket: service capacity every tenant pads to",
+    ).set(caps["services"])
+    registry.gauge(
+        "fleet_bucket_nodes",
+        "shared fleet shape bucket: node capacity every tenant pads to",
+    ).set(caps["nodes"])
+    registry.gauge(
+        "fleet_bucket_pods",
+        "shared fleet shape bucket: pod capacity every tenant pads to",
+    ).set(caps["pods"])
+    return caps
+
+
 def run_fleet_controller(
     fleet: FleetBackend,
     config: RescheduleConfig,
@@ -276,18 +360,25 @@ def run_fleet_controller(
             f"config.fleet.tenants={config.fleet.tenants} but the fleet "
             f"backend has {fleet.num_tenants} tenants"
         )
-    # enforce the fleet gate even when the config's [fleet] block is off
-    # (tenants=0) — the caller handed us a fleet regardless
-    if (
-        config.algorithm not in POLICY_IDS
-        or config.moves_per_round != 1
-        or config.placement_unit != "service"
-    ):
-        raise ValueError(
-            "fleet mode batches the greedy decision kernel: it requires a "
-            "greedy algorithm with moves_per_round=1 and "
-            "placement_unit='service'"
-        )
+    if not config.fleet.tenants:
+        # enforce the full fleet gate even when the config's [fleet]
+        # block is off (tenants=0) — the caller handed us a fleet
+        # regardless, so run the ONE validation rule with the tenant
+        # count filled in rather than a drifting local copy of it
+        config = dataclasses.replace(
+            config,
+            fleet=dataclasses.replace(
+                config.fleet, tenants=fleet.num_tenants
+            ),
+        ).validate()
+    # which batched decision plane this run dispatches (the config gate
+    # above guarantees exactly one of these holds)
+    if config.algorithm == "global" or config.moves_per_round == "all":
+        fleet_mode = "global"
+    elif config.algorithm == "proactive":
+        fleet_mode = "proactive"
+    else:
+        fleet_mode = "greedy"
     registry = registry if registry is not None else get_registry()
     key = key if key is not None else jax.random.PRNGKey(config.seed)
 
@@ -303,6 +394,14 @@ def run_fleet_controller(
             else b
             for t, b in enumerate(backends)
         ]
+
+    # heterogeneous tenants: align every backend to ONE shared shape
+    # bucket BEFORE any tenant reads its graph or snapshot — stacking
+    # requires a common shape, and the mask-native kernels keep the
+    # padding inert (same-shaped fleets are untouched)
+    _align_fleet_buckets(
+        backends, floor=config.elastic.bucket_floor, registry=registry
+    )
 
     # the cardinality budget (ObsConfig.tenant_label_budget): at or
     # under budget the legacy per-tenant families emit bit-identically;
@@ -416,12 +515,65 @@ def run_fleet_controller(
                 )
             )
 
-    if config.fleet.plane == "dp":
-        from kubernetes_rescheduling_tpu.parallel.fleet import fleet_solve_dp
+    # device-plane selection, per batched decision plane. The dp mesh is
+    # resolved ONCE (the global decode needs its dp extent; per-call
+    # auto-shaping would also re-key the shard cache for nothing).
+    forecast_plane = None
+    global_cfg = None
+    solve_fn = None
+    g_solve = None
+    g_dp = 1
+    if fleet_mode == "global":
+        global_cfg = GlobalSolverConfig(
+            sweeps=config.global_solver_iters,
+            balance_weight=config.balance_weight,
+            enforce_capacity=config.enforce_capacity,
+            capacity_frac=config.capacity_frac,
+            move_cost=config.move_cost,
+        )
+        if config.fleet.plane == "dp":
+            from kubernetes_rescheduling_tpu.parallel.fleet import (
+                _fleet_mesh,
+                fleet_global_solve_dp,
+            )
 
-        solve_fn = fleet_solve_dp
+            g_mesh = _fleet_mesh(T, None)
+            g_dp = g_mesh.shape["dp"]
+            g_solve = lambda st, gr, ks, m: fleet_global_solve_dp(  # noqa: E731
+                st, gr, ks, m,
+                config=global_cfg,
+                n_restarts=config.solver_restarts,
+                mesh=g_mesh,
+            )
+        else:
+            g_solve = lambda st, gr, ks, m: fleet_global_solve(  # noqa: E731
+                st, gr, ks, m,
+                config=global_cfg,
+                n_restarts=config.solver_restarts,
+            )
+    elif fleet_mode == "proactive":
+        from kubernetes_rescheduling_tpu.forecast.fleet import (
+            FleetForecastPlane,
+        )
+
+        forecast_plane = FleetForecastPlane(config.forecast, T)
+        if config.fleet.plane == "dp":
+            from kubernetes_rescheduling_tpu.parallel.fleet import (
+                fleet_solve_proactive_dp,
+            )
+
+            solve_fn = fleet_solve_proactive_dp
+        else:
+            solve_fn = fleet_solve_proactive
     else:
-        solve_fn = fleet_solve
+        if config.fleet.plane == "dp":
+            from kubernetes_rescheduling_tpu.parallel.fleet import (
+                fleet_solve_dp,
+            )
+
+            solve_fn = fleet_solve_dp
+        else:
+            solve_fn = fleet_solve
 
     # pipelined fleet ([controller] pipeline): the per-tenant boundary
     # phases (apply → pace → post-move monitor) run concurrently — each
@@ -440,8 +592,21 @@ def run_fleet_controller(
         pipeline_depth_gauge(registry).set(config.controller.depth)
         overlap_gauge = pipeline_overlap_gauge(registry)
 
-    pid = jnp.asarray(POLICY_IDS[config.algorithm])
+    # the policy a round actually scores with: proactive delegates to its
+    # base policy (the forecast moves the STATE, not the policy — the
+    # solo loop's scoring_policy rule); global rounds score nothing here
+    scoring = (
+        scoring_policy(config.algorithm, config.forecast)
+        if fleet_mode != "global"
+        else None
+    )
+    pid = (
+        jnp.asarray(POLICY_IDS[scoring]) if scoring is not None else None
+    )
     thr = jnp.asarray(config.hazard_threshold_pct)
+    mech = PlacementMechanism[
+        scoring if scoring is not None else "global"
+    ]
     # graphs and tenant key roots are static per tenant — stacked ONCE
     # (name-stripped device views, elastic.buckets: static name tuples
     # would put churnable metadata into the jit key); under churn the
@@ -548,6 +713,26 @@ def run_fleet_controller(
             t.name,
             rec.load_std,
         )
+        if rec.forecast is not None:
+            # the proactive plane's per-tenant skill (budget-gated like
+            # every per-tenant family) plus the solo loop's mode counter
+            # — one increment per tenant-round, same family/help so the
+            # series never forks between loops
+            tseries.gauge_set(
+                "fleet_forecast_skill",
+                "per-tenant forecast skill (1 - mae_model/"
+                "mae_persistence) after the most recent proactive "
+                "fleet round",
+                t.name,
+                rec.forecast["skill"],
+            )
+            registry.counter(
+                "forecast_rounds_total",
+                "proactive rounds by forecast path (cold = warming up, "
+                "predictive = model steering, degraded = skill gate fell "
+                "back to reactive)",
+                labelnames=("mode",),
+            ).labels(mode=rec.forecast["mode"]).inc()
         round_event = dict(
             tenant=t.name,
             round=rnd,
@@ -625,7 +810,10 @@ def run_fleet_controller(
                     service=service_name,
                     target_node=state.node_names[target_i],
                     hazard_nodes=hazard_names,
-                    mechanism=PlacementMechanism[config.algorithm],
+                    # proactive resolves to its base policy's mechanism
+                    # (the forecast changes the state scored, not how
+                    # the move pins) — the solo loop's rule
+                    mechanism=mech,
                 )
             )
             if t.ledger is not None and landed is not None:
@@ -636,7 +824,7 @@ def run_fleet_controller(
                 t.ledger.record_moves(
                     [
                         move_intent(
-                            PlacementMechanism[config.algorithm],
+                            mech,
                             service_name,
                             state.node_names[target_i],
                             landed,
@@ -644,6 +832,40 @@ def run_fleet_controller(
                     ]
                 )
         return service_name, first_hazard, landed, attempted
+
+    def apply_tenant_global_moves(t: _Tenant, moves_t):
+        """The GLOBAL round's apply half: the decoded per-tenant move
+        list — ``(service_index, target_node_index)`` in the solo loop's
+        first-moved-pod order — issued through that tenant's boundary
+        with the solo ``_global_round``'s intent rule. Returns
+        ``(moved_names, applied_moves)``."""
+        state = t.state
+        moved_names: list[str] = []
+        applied_moves: list[tuple[str, str]] = []
+        for s, target_i in moves_t:
+            service_name = t.graph.names[s]
+            landed = t.boundary.apply_move(
+                MoveRequest(
+                    service=service_name,
+                    target_node=state.node_names[target_i],
+                    mechanism=mech,
+                )
+            )
+            if t.ledger is not None:
+                t.ledger.record_moves(
+                    [
+                        move_intent(
+                            mech,
+                            service_name,
+                            state.node_names[target_i],
+                            landed,
+                        )
+                    ]
+                )
+            if landed is not None:
+                moved_names.append(service_name)
+                applied_moves.append((service_name, landed))
+        return moved_names, applied_moves
 
     def round_once(rnd: int) -> None:
         nonlocal stacked_graphs
@@ -664,10 +886,14 @@ def run_fleet_controller(
                 # graphs refresh host-side (no boundary traffic) and
                 # every tenant owes a re-monitor — settled below,
                 # BEHIND its own breaker gate, so an ailing tenant is
-                # neither hammered while OPEN nor double-charged
+                # neither hammered while OPEN nor double-charged. Every
+                # tenant's derived-graph caches are stale (their keyed
+                # graph objects are gone) — evict, counted, so a long
+                # deploy-waves soak never accretes stale generations
                 for t in tenants:
                     t.graph = t.boundary.comm_graph()
                     t.remask = True
+                    t.boundary.evict_solver_caches(reason="promotion")
                 stacked_graphs = stack_tenants(
                     [device_graph(t.graph) for t in tenants]
                 )
@@ -676,6 +902,12 @@ def run_fleet_controller(
                     if churn[idx].graph_changed:
                         tenants[idx].graph = (
                             tenants[idx].boundary.comm_graph()
+                        )
+                        # churn rewrote this tenant's graph: its cached
+                        # derived values (sparse/pod graphs) can never
+                        # be hit again — drop them now, counted
+                        tenants[idx].boundary.evict_solver_caches(
+                            reason="churn"
                         )
                 stacked_graphs = stack_tenants(
                     [device_graph(t.graph) for t in tenants]
@@ -720,37 +952,142 @@ def run_fleet_controller(
         )
         mask = np.zeros((T,), dtype=bool)
         mask[active] = True
-        keys = _round_keys(stacked_keys, jnp.asarray(rnd))
+        fc_rows = None
+        g_moves = g_objs = None
         t0 = time.perf_counter()
-        with span("fleet/solve", round=rnd, tenants=len(active)):
-            decisions_dev, hazard_dev = block(
-                solve_fn(
-                    stacked_states, stacked_graphs, pid, thr, keys,
-                    jnp.asarray(mask),
+        if fleet_mode == "global":
+            # ONE batched global solve re-places every service in every
+            # active tenant; the decided per-tenant move lists, the solo
+            # loop's move ORDER, and the solver objective rows all come
+            # home in ONE counted transfer
+            keys = _round_keys_global(stacked_keys, jnp.asarray(rnd))
+            with span("fleet/global_solve", round=rnd, tenants=len(active)):
+                flat_dev = block(
+                    g_solve(
+                        stacked_states, stacked_graphs, keys,
+                        jnp.asarray(mask),
+                    )
                 )
+            solve_s = time.perf_counter() - t0
+            flat = _pull_round_bundle(flat_dev, "fleet_decision")
+            num_services = int(stacked_graphs.adj.shape[1])
+            if g_dp > 1:
+                from kubernetes_rescheduling_tpu.parallel.fleet import (
+                    decode_fleet_global_dp,
+                )
+
+                g_moves, g_objs = decode_fleet_global_dp(
+                    flat, tenants=T, num_services=num_services, dp=g_dp
+                )
+            else:
+                g_moves, g_objs = decode_fleet_global(
+                    flat, tenants=T, num_services=num_services
+                )
+        else:
+            keys = _round_keys(stacked_keys, jnp.asarray(rnd))
+            diag_dev = None
+            if fleet_mode == "proactive":
+                # fold every active tenant's observed loads into its
+                # model and predict the next window — one batched
+                # forecast dispatch; the diag matrix stays device-side
+                # and rides the decision bundle below (the solo plane's
+                # round_end discipline, fleet-shaped)
+                with span("fleet/forecast", round=rnd, tenants=len(active)):
+                    deltas, diag_dev = forecast_plane.observe_and_predict(
+                        stacked_states, jnp.asarray(mask)
+                    )
+            with span("fleet/solve", round=rnd, tenants=len(active)):
+                if fleet_mode == "proactive":
+                    decisions_dev, hazard_dev = block(
+                        solve_fn(
+                            stacked_states, stacked_graphs, pid, thr,
+                            keys, jnp.asarray(mask), deltas,
+                        )
+                    )
+                else:
+                    decisions_dev, hazard_dev = block(
+                        solve_fn(
+                            stacked_states, stacked_graphs, pid, thr,
+                            keys, jnp.asarray(mask),
+                        )
+                    )
+            solve_s = time.perf_counter() - t0
+            # the whole fleet's round comes home in ONE counted
+            # transfer: decisions (i32[T,4] — small indices, exact in
+            # f32), the hazard masks, and — proactive — the forecast
+            # diag matrix, packed into a single flat bundle
+            n_nodes = int(hazard_dev.shape[1])
+            parts = [
+                jnp.ravel(decisions_dev).astype(jnp.float32),
+                jnp.ravel(hazard_dev).astype(jnp.float32),
+            ]
+            if diag_dev is not None:
+                parts.append(jnp.ravel(diag_dev))
+            flat = _pull_round_bundle(
+                jnp.concatenate(parts), "fleet_decision"
             )
-        solve_s = time.perf_counter() - t0
+            decisions = flat[: T * 4].reshape(T, 4).astype(np.int64)
+            hazard = flat[T * 4: T * 4 + T * n_nodes].reshape(T, n_nodes) > 0.5
+            if diag_dev is not None:
+                fc_rows = flat[T * 4 + T * n_nodes:].reshape(T, DIAG_SIZE)
         result.batched_solves += 1
         result.device_solve_s += solve_s
-        # the whole fleet's round comes home in ONE counted transfer:
-        # decisions (i32[T,4] — small indices, exact in f32) and the
-        # hazard masks packed into a single flat bundle (historically
-        # two pulls, fleet_decision + fleet_hazard)
-        n_nodes = int(hazard_dev.shape[1])
-        flat = _pull_round_bundle(
-            jnp.concatenate(
-                [
-                    jnp.ravel(decisions_dev).astype(jnp.float32),
-                    jnp.ravel(hazard_dev).astype(jnp.float32),
-                ]
-            ),
-            "fleet_decision",
-        )
-        decisions = flat[: T * 4].reshape(T, 4).astype(np.int64)
-        hazard = flat[T * 4 :].reshape(T, n_nodes) > 0.5
         # the shared dispatch's cost, attributed evenly to the tenants
         # that used it — the amortization IS the fleet-mode story
         per_tenant_s = solve_s / len(active)
+
+        def tenant_round_global(i: int) -> tuple[RoundRecord, float]:
+            """One tenant's GLOBAL boundary phase — the move-list apply,
+            pace, post-move monitor, record construction. The per-tenant
+            isolation contract of ``tenant_round`` holds unchanged."""
+            t_bg = time.perf_counter()
+            t = tenants[i]
+            moved_names, applied_moves = apply_tenant_global_moves(
+                t, g_moves[i]
+            )
+            t.boundary.advance(config.sleep_after_action_s)
+            new_state = _admitted_monitor(t)
+            degraded = new_state is None
+            if not degraded:
+                t.state = new_state
+            churn_info = (
+                churn[i].round_info(pending_churn.pop(i, []))
+                if i in churn
+                else None
+            )
+            reconcile_block, t.last_drift = reconcile_round_block(
+                t.guard,
+                t.ledger,
+                state=t.state,
+                service_names=t.graph.names,
+                churn_events=(churn_info or {}).get("events") or (),
+                fresh=not degraded,
+                last_drift=t.last_drift,
+                boundary=t.boundary,
+                repair_budget=config.reconcile.repair_budget_per_round,
+            )
+            obj_before, obj_after, improved, _pen = g_objs[i]
+            rec = RoundRecord(
+                round=rnd,
+                moved=bool(moved_names),
+                most_hazard=None,
+                service=None,
+                target=None,
+                communication_cost=0.0,  # filled from the batched metrics
+                load_std=0.0,
+                services_moved=tuple(moved_names),
+                decision_latencies_s=(per_tenant_s,),
+                objective_before=obj_before,
+                objective_after=obj_after,
+                solver_improved=improved,
+                breaker_state=t.breaker.state,
+                degraded=degraded,
+                boundary_failures=t.boundary.round_failures,
+                applied_moves=tuple(applied_moves),
+                churn=churn_info,
+                reconcile=reconcile_block,
+            )
+            return rec, time.perf_counter() - t_bg
 
         def tenant_round(i: int) -> tuple[RoundRecord, float]:
             """One tenant's boundary phase — apply, pace, post-move
@@ -807,9 +1144,20 @@ def run_fleet_controller(
                 ),
                 churn=churn_info,
                 reconcile=reconcile_block,
+                # proactive: this tenant's decoded forecast block (skill,
+                # MAEs, cold/predictive/degraded path) — the solo plane's
+                # round_info, from the diag row that rode the bundle
+                forecast=(
+                    FleetForecastPlane.decode_diag(fc_rows[i])
+                    if fc_rows is not None
+                    else None
+                ),
             )
             return rec, time.perf_counter() - t_bg
 
+        round_fn = (
+            tenant_round_global if fleet_mode == "global" else tenant_round
+        )
         records: dict[int, RoundRecord] = {}
         if pool is not None and len(active) > 1:
             # pipelined fleet: every tenant's apply→pace→monitor chain
@@ -819,7 +1167,7 @@ def run_fleet_controller(
             # series; per-tenant results are bit-identical to the
             # sequential interleaving (test-pinned).
             t_par = time.perf_counter()
-            futs = {i: pool.submit(tenant_round, i) for i in active}
+            futs = {i: pool.submit(round_fn, i) for i in active}
             durs = []
             for i in active:
                 records[i], d = futs[i].result()
@@ -834,7 +1182,7 @@ def run_fleet_controller(
             overlap_gauge.set(ratio)
         else:
             for i in active:
-                records[i], _ = tenant_round(i)
+                records[i], _ = round_fn(i)
 
         # ONE batched metrics dispatch + ONE transfer closes the round's
         # reporting for every active tenant (the solo loop pays 2 scalar

@@ -337,18 +337,37 @@ class ChaosConfig:
 
 @dataclass(frozen=True)
 class FleetConfig:
-    """Fleet-mode block (``[fleet]`` in TOML): N same-shaped tenants
-    solved by ONE batched device program per round under the multiplexed
-    controller (``bench.fleet``). jax-free, like the other blocks, so
-    config import stays light.
+    """Fleet-mode block (``[fleet]`` in TOML): N tenants solved by ONE
+    batched device program per round under the multiplexed controller
+    (``bench.fleet``). jax-free, like the other blocks, so config import
+    stays light.
 
     ``tenants == 0`` means fleet mode is off (the historical
     one-backend-one-loop controller). ``plane`` selects the device
     batching: ``"vmap"`` (one program, leading tenant axis —
-    ``solver.fleet``) or ``"dp"`` (one tenant per device over the mesh's
-    dp axis — ``parallel.fleet``). ``chaos_tenants`` wraps ONLY those
-    tenant indices in the run's chaos profile — the per-tenant fault
-    domain the isolation tests pin."""
+    ``solver.fleet`` / ``solver.fleet_global`` / ``forecast.fleet``) or
+    ``"dp"`` (one tenant group per device over the mesh's dp axis —
+    ``parallel.fleet``). ``chaos_tenants`` wraps ONLY those tenant
+    indices in the run's chaos profile — the per-tenant fault domain the
+    isolation tests pin.
+
+    Which decision planes batch (fleet v2): the greedy kernel
+    (``moves_per_round=1``), the ``proactive`` kernel (per-tenant
+    recursive-least-squares forecast state stacked ``[T, N, ...]``, the
+    skill gate judged per tenant), and the dense global solver
+    (``algorithm='global'`` / ``moves_per_round='all'`` — swap phases
+    and ``solver_restarts`` fan out inside the one batched dispatch).
+    Still rejected, with the reason in the error: ``placement_unit=
+    'pod'`` (host-built per-tenant pod graphs), ``solver_backend=
+    'sparse'`` (per-tenant static block layout forks the compiled
+    signature), an integer ``global_moves_cap`` (sequential host-side
+    wave-cap re-scoring — use ``move_cost``), and ``solver_tp`` (the
+    fleet dp axis owns the mesh). Tenants may have HETEROGENEOUS shapes:
+    the multiplexed loop aligns every tenant to shared power-of-two
+    shape buckets at startup (``elastic.buckets``), pads snapshots to
+    the bucket, and the mask-native kernels keep padded slots inert —
+    per-tenant decisions stay bit-exact with an unpadded solo run (the
+    mask-twin pin)."""
 
     tenants: int = 0
     plane: str = "vmap"                  # "vmap" | "dp"
@@ -707,7 +726,7 @@ class RescheduleConfig:
             raise ValueError(
                 "churn injection requires the hermetic sim backend: a live "
                 "cluster churns itself (watch-driven snapshots are ROADMAP "
-                "item 5)"
+                "item 3)"
             )
         self.controller.validate()
         if self.controller.scan_block:
@@ -791,26 +810,61 @@ class RescheduleConfig:
                 )
         self.fleet.validate()
         if self.fleet.tenants > 0:
-            # the batched fleet kernel is the GREEDY decision vmapped over
-            # tenants; the global/pod solvers keep the solo loop (their
-            # fleet story is the dp plane's one-solve-per-device future)
-            if self.algorithm == "global" or self.moves_per_round != 1:
+            # fleet v2: three batched decision planes — the greedy kernel,
+            # the proactive (forecast-steered) kernel with per-tenant RLS
+            # state, and the global solver (dense, swap phases and restart
+            # fan-out included) — each one device program per round over a
+            # leading tenant axis. Combinations whose decisions are made
+            # host-side per tenant (pod graphs, wave-cap selection) or
+            # whose compiled signature forks per tenant (sparse block
+            # structure) still reject, loudly, below.
+            if self.placement_unit != "service":
                 raise ValueError(
-                    "fleet mode batches the greedy decision kernel: it "
-                    "requires a greedy algorithm with moves_per_round=1 "
+                    "fleet mode requires placement_unit='service': the "
+                    "expanded per-pod graph is built host-side per tenant, "
+                    "which the batched device plane cannot amortize"
+                )
+            greedy_family = (
+                self.algorithm in POLICIES or self.algorithm == "proactive"
+            ) and self.moves_per_round == 1
+            global_family = (
+                self.algorithm == "global" or self.moves_per_round == "all"
+            )
+            if not (greedy_family or global_family):
+                raise ValueError(
+                    "fleet mode batches whole decision planes: it requires "
+                    "a greedy/proactive algorithm with moves_per_round=1, "
+                    "or a global round (algorithm='global' / "
+                    "moves_per_round='all') "
                     f"(got algorithm={self.algorithm!r}, "
                     f"moves_per_round={self.moves_per_round!r})"
                 )
-            if self.placement_unit != "service":
-                raise ValueError(
-                    "fleet mode requires placement_unit='service'"
-                )
-            if self.algorithm == "proactive":
-                raise ValueError(
-                    "fleet mode does not support algorithm='proactive' "
-                    "yet: the batched fleet kernel has no per-tenant "
-                    "forecast state"
-                )
+            if global_family:
+                if self.solver_backend == "sparse":
+                    raise ValueError(
+                        "fleet mode cannot batch solver_backend='sparse': "
+                        "the sparse form's degree-sorted block layout is "
+                        "static per-tenant metadata, so every tenant would "
+                        "fork the compiled signature the batching exists "
+                        "to share (the dense solver batches; sparse stays "
+                        "solo)"
+                    )
+                if self.global_moves_cap != "all":
+                    raise ValueError(
+                        "fleet mode does not support an integer "
+                        "global_moves_cap: wave-cap selection is a "
+                        "sequential host-side re-scoring loop per tenant, "
+                        "which defeats the batched dispatch (use move_cost "
+                        "— disruption pricing is the in-solver lever and "
+                        "batches for free)"
+                    )
+                if self.solver_tp != 1:
+                    raise ValueError(
+                        "fleet mode does not compose with solver_tp yet: "
+                        "the mesh's dp axis is the tenant axis "
+                        "(fleet.plane='dp'); node-axis sharding of each "
+                        "tenant's solve would need a dp×tp fleet mesh"
+                    )
         if self.max_consecutive_failures < 0:
             raise ValueError("max_consecutive_failures must be >= 0")
         if self.breaker_cooldown_rounds < 1:

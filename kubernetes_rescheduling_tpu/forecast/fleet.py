@@ -1,0 +1,211 @@
+"""Fleet mode, forecast plane: per-tenant RLS state batched over tenants.
+
+PR 8's online forecaster carries per-node state (rolling history, the
+recursive-least-squares normal-equation statistics, the skill window) —
+a pytree that stacks naturally to ``[T, N, ...]``. This module owns that
+stacked state and dispatches ONE device program per fleet round that
+scores, updates, solves, and predicts for every tenant at once, which is
+what lets the multiplexed loop serve ``algorithm='proactive'`` without
+paying the per-solve fixed cost per tenant.
+
+Batching is ``lax.map`` over the tenant axis, deliberately NOT ``vmap``:
+the map body is the solo ``forecast_step`` traced at exactly the solo
+shapes, so every tenant's model state, applied delta, skill verdict,
+and diagnostic vector are BIT-EXACT with a solo proactive run under the
+same snapshots (vmap re-fuses the elementwise RLS updates and drifts at
+the ulp level — measured, and enough to break the parity pin). The
+per-tenant work is O(N·F²); a device-side scan over tenants amortizes
+the dispatch exactly like the batched decide kernel.
+
+Masking: each tenant's slot carries an ``active`` flag — a skipped
+tenant round (open breaker, dark backend) must not fold a filler
+snapshot into that tenant's model, exactly as the solo loop's skipped
+rounds never reach the forecast plane. Inactive slots pass their state
+through untouched and emit a zero delta + zero diag.
+
+The stacked state is a DONATED carry (the solo plane's rule): every
+output leaf has the input's shape and the plane replaces its handle
+each round, so XLA aliases the ``[T, N, F, F]`` normal-equation block —
+the largest resident piece — in place.
+
+The diag matrix (``f32[T, DIAG_SIZE]``) stays device-resident and rides
+the fleet round's single counted decision-bundle pull
+(``bench.fleet``); :meth:`FleetForecastPlane.decode_diag` turns the
+pulled rows into the per-tenant ``RoundRecord.forecast`` blocks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubernetes_rescheduling_tpu.forecast.model import (
+    DIAG_FRAC_MODEL,
+    DIAG_MAE_MODEL,
+    DIAG_MAE_PERSIST,
+    DIAG_ROUNDS,
+    DIAG_SKILL,
+    DIAG_TRAINED,
+    ForecastState,
+    forecast_step,
+    init_forecast_state,
+)
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
+
+
+def init_fleet_forecast_state(
+    lags: int, tenants: int, num_nodes: int
+) -> ForecastState:
+    """A fresh all-cold forecaster per tenant, stacked ``[T, ...]``."""
+    if tenants < 1:
+        raise ValueError(f"tenants must be >= 1, got {tenants}")
+    f0 = init_forecast_state(lags, num_nodes)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.tile(x[None], (tenants,) + (1,) * x.ndim), f0
+    )
+
+
+def repad_fleet_forecast_state(
+    fstates: ForecastState, num_nodes: int
+) -> ForecastState:
+    """Grow every tenant's node axis to a promoted bucket capacity —
+    the stacked twin of ``repad_forecast_state`` (new slots arrive cold
+    and invalid; buckets never demote)."""
+    n_old = int(fstates.history.shape[2])
+    if num_nodes < n_old:
+        raise ValueError(
+            f"fleet forecast state cannot shrink ({n_old} -> {num_nodes}); "
+            "shape buckets never demote"
+        )
+    if num_nodes == n_old:
+        return fstates
+    pad = num_nodes - n_old
+
+    def pad_nodes(x, axis):
+        width = [(0, 0)] * x.ndim
+        width[axis] = (0, pad)
+        return jnp.pad(x, width)
+
+    return fstates.replace(
+        history=pad_nodes(fstates.history, 2),
+        count=pad_nodes(fstates.count, 1),
+        A=pad_nodes(fstates.A, 1),
+        b=pad_nodes(fstates.b, 1),
+        prev_model_pred=pad_nodes(fstates.prev_model_pred, 1),
+        prev_model_valid=pad_nodes(fstates.prev_model_valid, 1),
+        prev_valid=pad_nodes(fstates.prev_valid, 1),
+    )
+
+
+def _fleet_forecast_step(
+    states,
+    fstates: ForecastState,
+    tenant_mask: jax.Array,
+    ridge: jax.Array,
+    min_skill: jax.Array,
+    min_history: jax.Array,
+    decay: jax.Array,
+    fit_decay: jax.Array,
+):
+    """One fleet forecast round: the solo ``forecast_step`` mapped over
+    the tenant axis (see module docstring for why ``lax.map``). Returns
+    ``(fstates', deltas f32[T, N], diags f32[T, DIAG_SIZE])``; inactive
+    slots (``tenant_mask`` False) pass through untouched with zero
+    delta/diag — a skipped tenant round never trains.
+
+    Masking is SELECT-based (compute, then keep the old state),
+    deliberately not ``lax.cond``: outlining the step into a cond branch
+    re-fuses the RLS accumulation and drifts the statistics at the ulp
+    level vs the solo jit (measured — enough to break the bit-exactness
+    pin), while a post-step select leaves the step's own computation
+    untouched. The discarded work on a masked slot is one tiny
+    O(N·F²) solve — skipped tenant rounds are the rare case."""
+
+    def one(args):
+        state, fstate, active = args
+        new_fstate, delta, diag = forecast_step(
+            state, fstate, ridge, min_skill, min_history, decay, fit_decay
+        )
+        kept = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(active, new, old), new_fstate, fstate
+        )
+        zero = jnp.float32(0.0)
+        return (
+            kept,
+            jnp.where(active, delta, zero),
+            jnp.where(active, diag, zero),
+        )
+
+    return lax.map(one, (states, fstates, tenant_mask))
+
+
+# one dispatch per proactive fleet round; donated stacked RLS carry
+# (donate_argnums=1 — the solo plane's aliasing rule, fleet-shaped).
+# Steady state: jax_traces_total{fn="fleet_forecast"} == 1 + counted
+# bucket promotions (the node axis re-pads; nothing else changes shape).
+_fleet_forecast = instrument_jit(
+    _fleet_forecast_step, name="fleet_forecast", donate_argnums=(1,)
+)
+
+
+class FleetForecastPlane:
+    """One per proactive fleet run: owns the stacked per-tenant model
+    state across rounds, absorbs bucket promotions by re-padding the
+    node axis, and decodes the pulled diag rows into the per-tenant
+    forecast blocks the records and metric families consume."""
+
+    def __init__(self, config, tenants: int) -> None:
+        self.config = config
+        self.tenants = int(tenants)
+        self._fstates: ForecastState | None = None
+        # traced scalars so every configuration reuses one compiled
+        # kernel signature (the solo plane's rule)
+        self._ridge = jnp.float32(config.ridge)
+        self._min_skill = jnp.float32(config.min_skill)
+        self._min_history = jnp.float32(config.min_history)
+        self._decay = jnp.float32(config.decay)
+        self._fit_decay = jnp.float32(config.fit_decay)
+
+    def observe_and_predict(self, states, tenant_mask: jax.Array):
+        """Fold every ACTIVE tenant's observed loads into its model and
+        return ``(deltas f32[T, N], diag f32[T, DIAG_SIZE])``, both
+        device-resident — the diag must ride the fleet round's single
+        counted bundle pull, never its own transfer."""
+        n = int(states.node_valid.shape[1])
+        if self._fstates is None:
+            self._fstates = init_fleet_forecast_state(
+                self.config.lags, self.tenants, n
+            )
+        elif int(self._fstates.history.shape[2]) != n:
+            # bucket promotion: one legal retrace (counted elsewhere)
+            self._fstates = repad_fleet_forecast_state(self._fstates, n)
+        self._fstates, deltas, diag = _fleet_forecast(
+            states, self._fstates, tenant_mask, self._ridge,
+            self._min_skill, self._min_history, self._decay,
+            self._fit_decay,
+        )
+        return deltas, diag
+
+    @staticmethod
+    def decode_diag(row) -> dict:
+        """One tenant's pulled diag row -> its ``RoundRecord.forecast``
+        block (the solo plane's ``_decode_diag``, per tenant)."""
+        trained = bool(row[DIAG_TRAINED] > 0)
+        frac = float(row[DIAG_FRAC_MODEL])
+        if not trained:
+            mode = "cold"
+        elif frac > 0:
+            mode = "predictive"
+        else:
+            mode = "degraded"
+        return {
+            "skill": float(row[DIAG_SKILL]),
+            "mae_model": float(row[DIAG_MAE_MODEL]),
+            "mae_persistence": float(row[DIAG_MAE_PERSIST]),
+            "scored_weight": float(row[DIAG_ROUNDS]),
+            "model_node_frac": frac,
+            "trained": trained,
+            "mode": mode,
+            "target": "node_load",
+        }

@@ -1,19 +1,48 @@
-"""Fleet mode, dp-mesh plane: one tenant per device.
+"""Fleet mode, dp-mesh plane: one tenant (group) per device.
 
-The vmap plane (``solver.fleet``) batches tenants into one program on
-one device — the right shape when the per-tenant kernel is small and
-fixed cost dominates. On a multi-chip mesh the same tenant axis can
-instead shard over ``dp``, exactly the way the sharded-restart machinery
-(``parallel.sharded._run_shard``) shards independent solves: each dp
-slice owns a contiguous block of tenants and runs the SAME vmapped
-decision kernel over its block, so the two planes are decision-identical
-by construction (the shard body IS ``solver.fleet._fleet_decide`` —
-parity is structural, and test-pinned).
+The vmap plane (``solver.fleet`` / ``solver.fleet_global``) batches
+tenants into one program on one device — the right shape when the
+per-tenant kernel is small and fixed cost dominates. On a multi-chip
+mesh the same tenant axis can instead shard over ``dp``, exactly the way
+the sharded-restart machinery (``parallel.sharded._run_shard``) shards
+independent solves: each dp slice owns a contiguous block of tenants and
+runs the SAME batched kernel over its block, so the two planes are
+decision-identical by construction (the shard bodies ARE
+``solver.fleet._fleet_decide`` / ``_fleet_decide_proactive`` /
+``solver.fleet_global._fleet_global_solve`` — parity is structural, and
+test-pinned).
 
-Like ``_run_shard``, the jitted shard_map is cached per mesh so the
-multiplexed controller's per-round dispatch hits the compile cache, and
-instrumented (``fn="fleet_solve_dp"``) under the usual 1-trace
-steady-state invariant.
+Three dp kernels, one per batched decision plane:
+
+- :func:`fleet_solve_dp` — the greedy decide (PR 6);
+- :func:`fleet_solve_proactive_dp` — the proactive decide against each
+  tenant's predicted state (the forecast RLS state itself stays a
+  single-device ``lax.map`` program in ``forecast.fleet`` — its per-round
+  deltas shard here with the states);
+- :func:`fleet_global_solve_dp` — the batched global solve, one tenant
+  group's full re-placement (restart fan-out included) per device. This
+  is the MULTICHIP fleet-matrix configuration: ~1k tenants × 2k services
+  sharded one-group-per-chip with per-tenant decisions bit-exact vs the
+  solo kernels.
+
+Like ``_run_shard``, each jitted shard_map is cached per mesh (and, for
+the global solve, per static config) so the multiplexed controller's
+per-round dispatch hits the compile cache, and instrumented under the
+usual 1-trace steady-state invariant.
+
+Parity boundary (global solve): the shard bodies are the vmap plane's
+functions, so parity is structural — and bitwise on every objective
+term that is EXACT in f32 (comm cut mass and the disruption bill:
+integer-valued pair weights times replica counts). The sqrt-balance
+term is irrational, and a differently-partitioned executable (one
+tenant group per device vs one batch on one device) may reduce it in a
+different order — enough to flip a near-tie admission and land on a
+DIFFERENT never-worse optimum of the same quality (measured on the
+8-device CPU mesh; test-pinned as never-worse, with bitwise parity
+pinned on the balance-free configuration). This is the same
+ulps-not-bitwise contract ``input_comm_cost`` documents for its two
+branches — cross-executable float reduction order is not part of any
+kernel's contract.
 """
 
 from __future__ import annotations
@@ -24,7 +53,13 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubernetes_rescheduling_tpu.parallel.compat import shard_map
-from kubernetes_rescheduling_tpu.solver.fleet import _fleet_decide
+from kubernetes_rescheduling_tpu.solver.fleet import (
+    _fleet_decide,
+    _fleet_decide_proactive,
+)
+from kubernetes_rescheduling_tpu.solver.fleet_global import (
+    _fleet_global_solve,
+)
 from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
 
 # jitted shard-mapped fleet kernels keyed by mesh — the dp twin of
@@ -78,7 +113,16 @@ def fleet_solve_dp(
     degenerates to the vmap plane's single-device program, so the same
     call works from laptop CPU to a pod slice.
     """
-    t = int(tenant_mask.shape[0])
+    mesh = _fleet_mesh(int(tenant_mask.shape[0]), mesh)
+    return _fleet_shard(mesh)(
+        states, graphs, policy_id, threshold, keys, tenant_mask
+    )
+
+
+def _fleet_mesh(t: int, mesh: Mesh | None) -> Mesh:
+    """Resolve (or auto-shape) the fleet dp mesh and validate that the
+    tenant count divides its dp extent — ONE rule for all three dp
+    kernels."""
     if mesh is None:
         from kubernetes_rescheduling_tpu.parallel.mesh import make_mesh
         from kubernetes_rescheduling_tpu.parallel.sharded import (
@@ -90,6 +134,131 @@ def fleet_solve_dp(
     dp = mesh.shape["dp"]
     if t % dp:
         raise ValueError(f"tenant count {t} must be a multiple of dp={dp}")
-    return _fleet_shard(mesh)(
-        states, graphs, policy_id, threshold, keys, tenant_mask
+    return mesh
+
+
+# dp twins of the proactive decide and the batched global solve — cached
+# like _FLEET_SHARD_CACHE (the controller re-dispatches per round and
+# must not retrace a fresh closure each time)
+_FLEET_PROACTIVE_SHARD_CACHE: dict = {}
+_FLEET_GLOBAL_SHARD_CACHE: dict = {}
+
+
+def _fleet_proactive_shard(mesh: Mesh):
+    fn = _FLEET_PROACTIVE_SHARD_CACHE.get(mesh)
+    if fn is None:
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P("dp"), P("dp"), P(), P(), P("dp"), P("dp"), P("dp")),
+            out_specs=(P("dp"), P("dp")),
+            check_vma=False,
+        )
+        def run_shard(states, graphs, policy_id, threshold, keys, mask,
+                      deltas):
+            # the shard body IS the vmap plane's batched proactive kernel
+            return _fleet_decide_proactive(
+                states, graphs, policy_id, threshold, keys, mask, deltas
+            )
+
+        fn = instrument_jit(run_shard, name="fleet_solve_proactive_dp")
+        _FLEET_PROACTIVE_SHARD_CACHE[mesh] = fn
+    return fn
+
+
+def fleet_solve_proactive_dp(
+    states,
+    graphs,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    keys: jax.Array,
+    tenant_mask: jax.Array,
+    deltas: jax.Array,
+    *,
+    mesh: Mesh | None = None,
+):
+    """:func:`solver.fleet.fleet_solve_proactive` with the tenant axis
+    (states, keys, mask, AND the per-tenant forecast deltas) sharded
+    over the mesh's ``dp`` dimension — the proactive twin of
+    :func:`fleet_solve_dp`."""
+    mesh = _fleet_mesh(int(tenant_mask.shape[0]), mesh)
+    return _fleet_proactive_shard(mesh)(
+        states, graphs, policy_id, threshold, keys, tenant_mask, deltas
     )
+
+
+def _fleet_global_shard(mesh: Mesh, config, n_restarts: int):
+    cache_key = (mesh, config, n_restarts)
+    fn = _FLEET_GLOBAL_SHARD_CACHE.get(cache_key)
+    if fn is None:
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P("dp"), P("dp"), P("dp"), P("dp")),
+            out_specs=P("dp"),
+            check_vma=False,
+        )
+        def run_shard(states, graphs, keys, mask):
+            # the shard body IS the vmap plane's batched global solve —
+            # its flat bundle concatenates over the tenant axis shard
+            return _fleet_global_solve(
+                states, graphs, keys, mask,
+                config=config, n_restarts=n_restarts,
+            )
+
+        fn = instrument_jit(run_shard, name="fleet_global_solve_dp")
+        _FLEET_GLOBAL_SHARD_CACHE[cache_key] = fn
+    return fn
+
+
+def fleet_global_solve_dp(
+    states,
+    graphs,
+    keys: jax.Array,
+    tenant_mask: jax.Array,
+    *,
+    config,
+    n_restarts: int = 1,
+    mesh: Mesh | None = None,
+):
+    """:func:`solver.fleet_global.fleet_global_solve` with the tenant
+    axis sharded over the mesh's ``dp`` dimension — one tenant group's
+    global re-placement per device, the fleet-matrix MULTICHIP shape.
+
+    The flat per-shard bundles concatenate along dp into the SAME layout
+    the vmap plane emits, so ``decode_fleet_global`` serves both planes
+    unchanged — but note the concatenation is per-shard-blockwise: each
+    shard's ``[svc_target, first_pod, obj]`` triple is contiguous.
+    :func:`decode_fleet_global_dp` re-interleaves to the vmap layout."""
+    t = int(tenant_mask.shape[0])
+    mesh = _fleet_mesh(t, mesh)
+    return _fleet_global_shard(mesh, config, n_restarts)(
+        states, graphs, keys, tenant_mask
+    )
+
+
+def decode_fleet_global_dp(flat, *, tenants: int, num_services: int, dp: int):
+    """Decode the dp plane's bundle: each dp shard emitted the vmap
+    layout over ITS tenant block, concatenated — re-split per shard and
+    merge the per-tenant move lists/objective rows in tenant order."""
+    import numpy as np
+
+    from kubernetes_rescheduling_tpu.solver.fleet_global import (
+        decode_fleet_global,
+    )
+
+    flat = np.asarray(flat)
+    if tenants % dp:
+        raise ValueError(f"tenants {tenants} not divisible by dp={dp}")
+    per = tenants // dp
+    block = flat.reshape(dp, -1)
+    moves, objs = [], []
+    for d in range(dp):
+        m, o = decode_fleet_global(
+            block[d], tenants=per, num_services=num_services
+        )
+        moves.extend(m)
+        objs.extend(o)
+    return moves, objs

@@ -44,7 +44,10 @@ from kubernetes_rescheduling_tpu.objectives.metrics import (
     communication_cost,
     load_std,
 )
-from kubernetes_rescheduling_tpu.solver.round_loop import decide
+from kubernetes_rescheduling_tpu.solver.round_loop import (
+    decide,
+    decide_with_forecast,
+)
 from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
 
 
@@ -128,6 +131,48 @@ def _fleet_decide(
 # tenant axis went shape-polymorphic and every round re-pays the compile
 # the batching exists to amortize (test-pinned, like controller_decide).
 fleet_solve = instrument_jit(_fleet_decide, name="fleet_solve")
+
+
+def _fleet_decide_proactive(
+    states: ClusterState,
+    graphs: CommGraph,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    keys: jax.Array,
+    tenant_mask: jax.Array,
+    deltas: jax.Array,
+):
+    """The batched PROACTIVE decision: ``decide_with_forecast`` vmapped
+    over the leading tenant axis — the same packed ``(decisions,
+    hazard_mask)`` contract as :func:`_fleet_decide`, with each tenant's
+    forecast ``delta`` (f32[T, N], from ``forecast.fleet``) folded into
+    its predicted state inside the trace. A zero delta row reproduces
+    that tenant's reactive decisions bit-for-bit (the
+    reactive-equivalence contract, fleet-shaped); masked slots never
+    emit moves."""
+    most, hazard_mask, victim, svc, target = jax.vmap(
+        decide_with_forecast, in_axes=(0, 0, None, None, 0, 0)
+    )(states, graphs, policy_id, threshold, keys, deltas)
+    neg = jnp.int32(-1)
+    m = tenant_mask
+    decisions = jnp.stack(
+        [
+            jnp.where(m, most, neg),
+            jnp.where(m, victim, neg),
+            jnp.where(m, svc, jnp.int32(0)),
+            jnp.where(m, target, neg),
+        ],
+        axis=1,
+    )
+    return decisions, hazard_mask & m[:, None]
+
+
+# the proactive fleet program: one dispatch decides for every tenant
+# against its own predicted next-window state. Same 1-steady-state-trace
+# invariant as fleet_solve, own fn label.
+fleet_solve_proactive = instrument_jit(
+    _fleet_decide_proactive, name="fleet_solve_proactive"
+)
 
 
 def _fleet_metrics(states: ClusterState, graphs: CommGraph):

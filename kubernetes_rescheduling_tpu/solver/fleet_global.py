@@ -1,0 +1,220 @@
+"""Fleet mode, global-solver plane: the batched global solve over tenants.
+
+PR 6 batched the greedy decision kernel; this module lifts the same
+tenant axis over the DENSE global solver — the quality family that wins
+the RESULTS.md round-5 gap table (global/sparse/swap ≤ 8.5% of optimum)
+— so a fleet round's re-placement of every service in every tenant is
+ONE device program instead of N sequential solves. RESULTS.md round 5
+measured per-solve FIXED cost + dispatch as the dominant term at every
+scale; the global solver pays a much larger fixed cost than the greedy
+kernel (pair-weight build, chunk scans, sweep epilogues), so the
+amortization win is correspondingly larger (the ``BENCH_SCENARIO=fleet``
+``fleet_global`` reading measures it).
+
+Composition mirrors the solo path exactly, which is what makes the
+parity pin possible:
+
+- ``n_restarts <= 1``: the per-tenant body IS ``global_assign`` under
+  the original key (the solo ``solve_with_restarts`` single-restart
+  path);
+- ``n_restarts > 1``: per tenant, a ``lax.scan`` over
+  ``jax.random.split(key, R)`` with device-side
+  ``argmin(objective + penalty)`` selection — term-for-term
+  ``parallel.sharded.parallel_restarts``'s shard body, so the batched
+  restart fan-out selects the same restart the solo dp path selects
+  (bit-exact, test-pinned). Like the solo restart path, only
+  ``objective_after``/``move_penalty`` are reported (``objective_before``
+  and ``improved`` ride as NaN and decode to None — the
+  ``_defer_solver_objectives`` absent-key contract).
+
+The swap phases (``config.swap_every``) and disruption pricing
+(``config.move_cost``) live inside ``global_assign`` and batch for free.
+``solver_backend='sparse'`` does NOT batch: the sparse form's
+degree-sorted block layout is static per-tenant pytree metadata, so each
+tenant would fork the compiled signature — config validation rejects the
+combination with that reason.
+
+The whole fleet's round comes home in ONE flat f32 bundle
+(:func:`decode_fleet_global`): per-tenant service targets, the
+first-moved-pod index per service (the solo host loop discovers moves in
+pod-index order — the decode preserves that order so applied-move
+streams are bit-identical), and the solver objective row. Padded tenant
+slots (``tenant_mask`` False) never emit moves.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.solver.global_solver import (
+    GlobalSolverConfig,
+    global_assign,
+)
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
+
+# objective row layout (per tenant, appended after the two [T, S] planes):
+# NaN in OBJ_BEFORE/OBJ_IMPROVED means "absent" (the restart fan-out
+# reports only the selected restart's after/penalty, like the solo path)
+OBJ_BEFORE, OBJ_AFTER, OBJ_IMPROVED, OBJ_PENALTY, OBJ_ROWS = range(5)
+
+
+def _solve_one(
+    state: ClusterState,
+    graph: CommGraph,
+    key: jax.Array,
+    config: GlobalSolverConfig,
+    n_restarts: int,
+):
+    """One tenant's global round: solve (with the solo restart
+    composition), then collapse the pod-level move set to the service
+    level — the device twin of the solo ``_global_round`` host loop."""
+    if n_restarts <= 1:
+        new_state, info = global_assign.__wrapped__(state, graph, key, config)
+        obj = jnp.stack(
+            [
+                jnp.asarray(info["objective_before"], jnp.float32),
+                jnp.asarray(info["objective_after"], jnp.float32),
+                jnp.asarray(info["improved"], jnp.float32),
+                jnp.asarray(info["move_penalty"], jnp.float32),
+            ]
+        )
+    else:
+        keys = jax.random.split(key, n_restarts)
+
+        def body(carry, k):
+            ns, info = global_assign.__wrapped__(state, graph, k, config)
+            return carry, (
+                ns.pod_node,
+                info["objective_after"],
+                info["move_penalty"],
+            )
+
+        _, (pods, objs, pens) = lax.scan(body, 0, keys)
+        # gated penalized selection — parallel_restarts' rule verbatim
+        best = jnp.argmin(objs + pens)
+        new_state = state.replace(pod_node=pods[best])
+        nan = jnp.float32(jnp.nan)
+        obj = jnp.stack(
+            [nan, jnp.asarray(objs[best], jnp.float32), nan,
+             jnp.asarray(pens[best], jnp.float32)]
+        )
+
+    S = graph.num_services
+    P = state.num_pods
+    moved = state.pod_valid & (new_state.pod_node != state.pod_node)
+    svc = jnp.where(
+        moved, jnp.clip(state.pod_service, 0, S - 1), S
+    ).astype(jnp.int32)
+    # first moved pod per service: the solo loop walks pods in index
+    # order and takes each changed service at its first changed pod —
+    # the decode sorts by this so the applied-move ORDER is preserved
+    first_pod = (
+        jnp.full((S + 1,), P, jnp.int32)
+        .at[svc]
+        .min(jnp.where(moved, jnp.arange(P), P).astype(jnp.int32))[:S]
+    )
+    # all moved pods of a service share one solver target (the adopted
+    # assignment is service-granular) — max over the service's moved pods
+    svc_target = (
+        jnp.full((S + 1,), -1, jnp.int32)
+        .at[svc]
+        .max(jnp.where(moved, new_state.pod_node, -1).astype(jnp.int32))[:S]
+    )
+    return svc_target, first_pod, obj
+
+
+def _fleet_global_solve(
+    states: ClusterState,
+    graphs: CommGraph,
+    keys: jax.Array,
+    tenant_mask: jax.Array,
+    *,
+    config: GlobalSolverConfig,
+    n_restarts: int = 1,
+):
+    """The batched fleet global round: ``_solve_one`` mapped over the
+    leading tenant axis, masked so padded slots never emit moves, packed
+    into ONE flat f32 bundle for the fleet loop's single counted pull.
+
+    ``lax.map`` (a device-side scan over tenants), deliberately NOT
+    ``vmap`` — for exactly the reasons ``parallel_restarts`` scans its
+    restarts instead of vmapping them: batching the solver multiplies
+    its working set (one occupancy matrix and one set of gathered W row
+    blocks PER TENANT resident at once), vmapping its scatter updates
+    produces variadic-scatter HLO the TPU backend cannot emit, and the
+    batch-width-dependent matmul tiling drifts near-tie admissions at
+    the ulp level — which would break the bit-exactness pin against the
+    solo kernel AND between the vmap and dp planes (a dp shard sees a
+    narrower tenant block; measured). The map body is the solo solver
+    traced at solo shapes, so parity is structural; the amortization win
+    — fixed cost + dispatch paid once per FLEET round instead of per
+    tenant — is a property of the single dispatch, not of instruction-
+    level batching.
+
+    Layout: ``[svc_target (T·S), first_pod (T·S), obj rows (T·OBJ_ROWS)]``
+    — small integers are exact in f32, and one concatenated vector means
+    one transfer, the fleet transfer discipline."""
+    svc_target, first_pod, obj = lax.map(
+        lambda args: _solve_one(
+            *args, config=config, n_restarts=n_restarts
+        ),
+        (states, graphs, keys),
+    )
+    m = tenant_mask
+    P = states.pod_node.shape[1]
+    svc_target = jnp.where(m[:, None], svc_target, jnp.int32(-1))
+    first_pod = jnp.where(m[:, None], first_pod, jnp.int32(P))
+    obj = jnp.where(m[:, None], obj, jnp.float32(0.0))
+    return jnp.concatenate(
+        [
+            jnp.ravel(svc_target).astype(jnp.float32),
+            jnp.ravel(first_pod).astype(jnp.float32),
+            jnp.ravel(obj),
+        ]
+    )
+
+
+# ONE device program for the whole fleet's global round — the same
+# 1-steady-state-trace invariant as fleet_solve (test-pinned); a retrace
+# means a tenant axis went shape-polymorphic and every round re-pays the
+# (large) solver compile the batching exists to amortize.
+fleet_global_solve = instrument_jit(
+    _fleet_global_solve,
+    name="fleet_global_solve",
+    static_argnames=("config", "n_restarts"),
+)
+
+
+def decode_fleet_global(flat, *, tenants: int, num_services: int):
+    """Decode the batched bundle into per-tenant move lists + objectives.
+
+    Returns ``(moves, objs)``: ``moves[t]`` is ``[(service, target), …]``
+    in the solo loop's first-moved-pod order; ``objs[t]`` is
+    ``(objective_before, objective_after, improved, move_penalty)`` with
+    None where the kernel reported NaN (the restart fan-out's
+    absent-keys contract)."""
+    flat = np.asarray(flat)
+    ts = tenants * num_services
+    svc_target = flat[:ts].reshape(tenants, num_services).astype(np.int64)
+    first_pod = flat[ts: 2 * ts].reshape(tenants, num_services)
+    obj = flat[2 * ts:].reshape(tenants, OBJ_ROWS)
+    moves: list[list[tuple[int, int]]] = []
+    objs: list[tuple] = []
+    for t in range(tenants):
+        changed = np.flatnonzero(svc_target[t] >= 0)
+        order = changed[np.argsort(first_pod[t][changed], kind="stable")]
+        moves.append([(int(s), int(svc_target[t, s])) for s in order])
+        before, after, improved, pen = obj[t]
+        objs.append(
+            (
+                None if np.isnan(before) else float(before),
+                float(after),
+                None if np.isnan(improved) else bool(improved),
+                float(pen),
+            )
+        )
+    return moves, objs

@@ -43,11 +43,17 @@ BANNED_CALLS = {"block_until_ready", "device_get", "pull"}
 # file -> functions allowed to contain a banned call in that file
 CHECKED: dict[Path, frozenset[str]] = {
     PACKAGE / "bench" / "controller.py": frozenset(),
-    # the fleet loop's designated round-end transfer site
+    # the fleet loop's designated round-end transfer site (ALL fleet
+    # planes — greedy, proactive, global — route their single pull here)
     PACKAGE / "bench" / "fleet.py": frozenset({"_pull_round_bundle"}),
     # the scan module's designated block-boundary transfer: ONE counted
     # round_end pull per K-round scan block
     PACKAGE / "bench" / "scan.py": frozenset({"pull_block"}),
+    # the batched fleet planes must stay sync-free end to end: the
+    # forecast diag and the global solver's move bundle ride the fleet
+    # loop's one counted pull, never their own
+    PACKAGE / "forecast" / "fleet.py": frozenset(),
+    PACKAGE / "solver" / "fleet_global.py": frozenset(),
 }
 # the union, kept as the default for direct find_raw_syncs() callers
 ALLOWED_FUNCS = frozenset().union(*CHECKED.values())

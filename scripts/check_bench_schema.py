@@ -65,6 +65,13 @@ def check_parsed(parsed, where: str) -> list[str]:
         parsed["vs_baseline"]
     ):
         out.append(f"{where}: parsed.vs_baseline must be a finite number")
+    # nested ledger readings (``*_reading`` — the fleet cell's rollup and
+    # global-amortization series, and any future sibling): each is
+    # appended to the perf ledger as its OWN series, so each must carry
+    # the same headline-record keys or the ledger silently drops it
+    for key, sub in parsed.items():
+        if key.endswith("_reading"):
+            out.extend(check_parsed(sub, f"{where}: parsed.{key}"))
     return out
 
 

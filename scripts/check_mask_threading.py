@@ -50,8 +50,18 @@ ENTRY_POINTS: dict[str, tuple[str, ...]] = {
         "decide_explain_with_forecast",
     ),
     "forecast/model.py": ("forecast_step", "node_loads"),
-    "solver/fleet.py": ("_fleet_decide", "_fleet_metrics"),
-    "parallel/fleet.py": ("fleet_solve_dp",),
+    "forecast/fleet.py": ("_fleet_forecast_step",),
+    "solver/fleet.py": (
+        "_fleet_decide",
+        "_fleet_decide_proactive",
+        "_fleet_metrics",
+    ),
+    "solver/fleet_global.py": ("_fleet_global_solve",),
+    "parallel/fleet.py": (
+        "fleet_solve_dp",
+        "fleet_solve_proactive_dp",
+        "fleet_global_solve_dp",
+    ),
     "objectives/metrics.py": (
         "communication_cost",
         "communication_cost_deployment",

@@ -359,9 +359,12 @@ def test_cli_fleet_rejects_unsupported_flags():
 
     with pytest.raises(SystemExit, match="greedy"):
         cli_main(["reschedule", "--fleet", "2", "--moves-per-round", "3"])
-    with pytest.raises(SystemExit, match="greedy"):
+    # fleet v2: --algorithm global is fleet-legal now; the sparse
+    # backend still rejects (per-tenant static block structure)
+    with pytest.raises(SystemExit, match="sparse"):
         cli_main(
-            ["reschedule", "--fleet", "2", "--algorithm", "global"]
+            ["reschedule", "--fleet", "2", "--algorithm", "global",
+             "--solver-backend", "sparse"]
         )
     with pytest.raises(SystemExit, match="perf-ledger"):
         cli_main(
@@ -417,20 +420,43 @@ def test_fleet_config_validation():
         FleetConfig(plane="pmap").validate()
     with pytest.raises(ValueError, match="out of range"):
         FleetConfig(tenants=2, chaos_tenants=(2,)).validate()
-    # fleet mode batches the greedy kernel — global/pod solos stay solo
-    with pytest.raises(ValueError, match="greedy"):
-        RescheduleConfig(
-            algorithm="global", fleet=FleetConfig(tenants=2)
-        ).validate()
+    # fleet v2: the global and proactive planes are fleet-servable now
+    RescheduleConfig(
+        algorithm="global", fleet=FleetConfig(tenants=2)
+    ).validate()
+    RescheduleConfig(
+        algorithm="proactive", fleet=FleetConfig(tenants=2)
+    ).validate()
+    RescheduleConfig(
+        moves_per_round="all", fleet=FleetConfig(tenants=2)
+    ).validate()
+    # ... but a greedy multi-move drain stays a solo loop
     with pytest.raises(ValueError, match="greedy"):
         RescheduleConfig(
             moves_per_round=2, fleet=FleetConfig(tenants=2)
+        ).validate()
+    # the combinations whose decisions or signatures cannot batch keep
+    # rejecting, each naming its reason
+    with pytest.raises(ValueError, match="sparse"):
+        RescheduleConfig(
+            algorithm="global", solver_backend="sparse",
+            fleet=FleetConfig(tenants=2),
+        ).validate()
+    with pytest.raises(ValueError, match="move_cost"):
+        RescheduleConfig(
+            algorithm="global", global_moves_cap=2,
+            fleet=FleetConfig(tenants=2),
+        ).validate()
+    with pytest.raises(ValueError, match="solver_tp"):
+        RescheduleConfig(
+            algorithm="global", solver_tp=2, fleet=FleetConfig(tenants=2)
         ).validate()
     # the loop enforces the same gate even with the [fleet] block off
     # (tenants=0 validates — but the caller handed it a fleet anyway)
     with pytest.raises(ValueError, match="greedy"):
         run_fleet_controller(
-            make_fleet("mubench", 2), RescheduleConfig(algorithm="global")
+            make_fleet("mubench", 2),
+            RescheduleConfig(moves_per_round=2),
         )
 
 

@@ -1,0 +1,675 @@
+"""Fleet v2: the batched global-solver and forecast planes + lifted gates.
+
+The invariants pinned here extend the PR-6 fleet contract to every newly
+batched decision plane (ISSUE 15):
+
+- the batched GLOBAL solve (``solver.fleet_global``) — restart fan-out
+  included — makes per-tenant decisions BIT-EXACT with the solo
+  ``solve_with_restarts`` path, in the solo loop's applied-move ORDER,
+  on both device planes (vmap and the dp shard_map);
+- the batched PROACTIVE plane: the stacked forecast RLS state
+  (``forecast.fleet``) evolves bit-exactly with the solo jitted forecast
+  kernel (including the per-tenant skill gate), and the predicted-state
+  decide matches the solo proactive kernel, vmap AND dp;
+- mask twins: a tenant padded to a shared fleet bucket and mask-threaded
+  makes the SAME decisions as its unpadded solo run;
+- one counted device transfer per fleet round survives on the new
+  planes (loop-pinned per site, kernel-pinned at T=256);
+- chaos isolation holds on the new planes: one tenant on fire leaves
+  every other tenant's records bit-identical to a no-chaos run;
+- solver-cache slots evict (counted) when churn rewrites a tenant's
+  graph, so long deploy-waves soaks cannot accrete stale generations.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends.fleet import FleetBackend, make_fleet
+from kubernetes_rescheduling_tpu.bench.boundary import BoundaryClient
+from kubernetes_rescheduling_tpu.bench.controller import run_controller
+from kubernetes_rescheduling_tpu.bench.fleet import run_fleet_controller
+from kubernetes_rescheduling_tpu.bench.harness import make_backend
+from kubernetes_rescheduling_tpu.config import (
+    ChaosConfig,
+    ElasticConfig,
+    FleetConfig,
+    ForecastConfig,
+    RescheduleConfig,
+)
+from kubernetes_rescheduling_tpu.forecast.fleet import (
+    _fleet_forecast,
+    init_fleet_forecast_state,
+    repad_fleet_forecast_state,
+)
+from kubernetes_rescheduling_tpu.forecast.model import (
+    forecast_step,
+    init_forecast_state,
+)
+from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.solver.fleet import (
+    fleet_solve_proactive,
+    stack_tenants,
+)
+from kubernetes_rescheduling_tpu.solver.fleet_global import (
+    decode_fleet_global,
+    fleet_global_solve,
+)
+from kubernetes_rescheduling_tpu.solver.global_solver import GlobalSolverConfig
+from kubernetes_rescheduling_tpu.solver.round_loop import decide_with_forecast
+from kubernetes_rescheduling_tpu.parallel.sharded import solve_with_restarts
+from kubernetes_rescheduling_tpu.telemetry import (
+    MetricsRegistry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.utils.retry import RetryPolicy
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+def _mubench_fleet(n=3, seed=0):
+    fleet = make_fleet("mubench", n, seed=seed)
+    fleet.inject_imbalance()
+    return fleet
+
+
+def _stacked(fleet):
+    states = [b.monitor() for b in fleet.backends]
+    graphs = [b.comm_graph() for b in fleet.backends]
+    return states, graphs, stack_tenants(states), stack_tenants(graphs)
+
+
+def _keys(n, seed=0):
+    return jnp.stack(
+        [jax.random.fold_in(jax.random.PRNGKey(seed), t) for t in range(n)]
+    )
+
+
+def _solo_changed_moves(state, new_state):
+    """The solo ``_global_round`` host loop's move extraction: changed
+    services in first-moved-pod order — the ordering oracle the batched
+    decode must reproduce."""
+    old = np.asarray(state.pod_node)
+    new = np.asarray(new_state.pod_node)
+    valid = np.asarray(state.pod_valid)
+    svc = np.asarray(state.pod_service)
+    changed, seen = [], set()
+    for i in np.flatnonzero(valid & (old != new)):
+        s = int(svc[i])
+        if s in seen:
+            continue
+        seen.add(s)
+        changed.append((s, int(new[i])))
+    return changed
+
+
+# ---------------- batched global solve ----------------
+
+
+@pytest.mark.parametrize("n_restarts", [1, 2])
+def test_fleet_global_solve_bit_exact_vs_solo(n_restarts):
+    """ONE batched dispatch re-places every tenant's services with the
+    solo solver's exact decisions — restart fan-out included (the scan +
+    argmin composition is parallel_restarts' shard body verbatim)."""
+    fleet = _mubench_fleet(3)
+    states, graphs, st, gr = _stacked(fleet)
+    cfg = GlobalSolverConfig(sweeps=3, balance_weight=0.5, move_cost=0.5)
+    keys = _keys(3, seed=7)
+    mask = jnp.asarray(np.array([True, False, True]))
+    flat = fleet_global_solve(
+        st, gr, keys, mask, config=cfg, n_restarts=n_restarts
+    )
+    moves, objs = decode_fleet_global(
+        np.asarray(flat), tenants=3, num_services=graphs[0].num_services
+    )
+    # the masked slot never emits a move whatever its (filler) state says
+    assert moves[1] == []
+    for t in (0, 2):
+        solo_state, solo_info = solve_with_restarts(
+            states[t], graphs[t], keys[t], n_restarts=n_restarts, config=cfg
+        )
+        assert moves[t] == _solo_changed_moves(states[t], solo_state)
+        # objective equality is EXACT: same traced body, same key stream
+        assert objs[t][1] == float(solo_info["objective_after"])
+        if n_restarts == 1:
+            assert objs[t][0] == float(solo_info["objective_before"])
+            assert objs[t][2] == bool(solo_info["improved"])
+        else:
+            # the restart path's absent-keys contract (solo parity)
+            assert objs[t][0] is None and objs[t][2] is None
+
+
+@pytest.mark.parametrize("n_restarts", [1, 2])
+def test_fleet_global_dp_plane_matches_vmap_plane(n_restarts):
+    """dp shard_map == vmap plane, bit-exact, restart fan-out included —
+    on the EXACT-objective configuration (comm + disruption pricing;
+    integer-valued at mubench weights). The sqrt-balance term's
+    cross-partitioning reduction order can flip near-tie admissions
+    between differently-partitioned executables (see parallel/fleet.py),
+    so balance runs pin vmap-vs-solo bitwise (the solo cases above) and
+    dp-vs-vmap to never-worse quality below."""
+    from kubernetes_rescheduling_tpu.parallel.fleet import (
+        _fleet_mesh,
+        decode_fleet_global_dp,
+        fleet_global_solve_dp,
+    )
+
+    fleet = _mubench_fleet(2)
+    _, graphs, st, gr = _stacked(fleet)
+    cfg = GlobalSolverConfig(sweeps=3, balance_weight=0.0, move_cost=0.5)
+    keys = _keys(2, seed=3)
+    mask = jnp.ones((2,), bool)
+    f1 = fleet_global_solve(
+        st, gr, keys, mask, config=cfg, n_restarts=n_restarts
+    )
+    f2 = fleet_global_solve_dp(
+        st, gr, keys, mask, config=cfg, n_restarts=n_restarts
+    )
+    m1, o1 = decode_fleet_global(
+        np.asarray(f1), tenants=2, num_services=graphs[0].num_services
+    )
+    # on the 8-device virtual CPU mesh the auto mesh shards dp=2 — the
+    # decode must be told the real dp extent (per-shard block layout)
+    dp = _fleet_mesh(2, None).shape["dp"]
+    m2, o2 = decode_fleet_global_dp(
+        np.asarray(f2), tenants=2, num_services=graphs[0].num_services, dp=dp
+    )
+    assert dp == 2  # the conftest virtual mesh really sharded tenants
+    assert m1 == m2
+    assert o1 == o2
+
+
+def test_fleet_global_dp_plane_never_worse_under_balance():
+    """With the sqrt-balance term on, dp and vmap may legitimately adopt
+    different near-tie optima (ulp-order flips across differently
+    partitioned executables — parallel/fleet.py documents the boundary)
+    — but both must stay in the never-worse family: adopted objectives
+    at or below the input's, and the same quality class."""
+    from kubernetes_rescheduling_tpu.parallel.fleet import (
+        _fleet_mesh,
+        decode_fleet_global_dp,
+        fleet_global_solve_dp,
+    )
+
+    fleet = _mubench_fleet(2)
+    _, graphs, st, gr = _stacked(fleet)
+    cfg = GlobalSolverConfig(sweeps=3, balance_weight=0.5)
+    keys = _keys(2, seed=3)
+    mask = jnp.ones((2,), bool)
+    f1 = fleet_global_solve(st, gr, keys, mask, config=cfg)
+    f2 = fleet_global_solve_dp(st, gr, keys, mask, config=cfg)
+    _, o1 = decode_fleet_global(
+        np.asarray(f1), tenants=2, num_services=graphs[0].num_services
+    )
+    dp = _fleet_mesh(2, None).shape["dp"]
+    _, o2 = decode_fleet_global_dp(
+        np.asarray(f2), tenants=2, num_services=graphs[0].num_services, dp=dp
+    )
+    for (b1, a1, _i1, _p1), (b2, a2, _i2, _p2) in zip(o1, o2):
+        # the solver's contract on BOTH planes: never worse than the
+        # input (which near-tie optimum a plane lands on is not part of
+        # it — a 3-sweep annealed search on a toy instance has high
+        # variance between legitimate optima)
+        assert b1 == b2  # same input objective (exact: same snapshot)
+        assert a1 <= b1 + 1e-4
+        assert a2 <= b2 + 1e-4
+
+
+def test_fleet_global_steady_state_single_trace(registry):
+    fleet = _mubench_fleet(4)
+    _, graphs, st, gr = _stacked(fleet)
+    cfg = GlobalSolverConfig(sweeps=2)
+    mask = jnp.ones((4,), bool)
+    for rnd in range(3):
+        jax.block_until_ready(
+            fleet_global_solve(st, gr, _keys(4, rnd), mask, config=cfg)
+        )
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="fleet_global_solve").value == 1
+
+
+# ---------------- batched proactive plane ----------------
+
+
+def test_fleet_forecast_bit_exact_vs_solo_kernel():
+    """The stacked RLS state evolves bit-exactly with the solo JITTED
+    forecast kernel per tenant — including rounds where one tenant is
+    masked out (a skipped tenant round must not train its model)."""
+    fleet = _mubench_fleet(3)
+    states, _, _, _ = _stacked(fleet)
+    n = states[0].num_nodes
+    sc = (
+        jnp.float32(1e-3), jnp.float32(0.0), jnp.float32(4),
+        jnp.float32(0.85), jnp.float32(0.97),
+    )
+    # scalars as traced ARGUMENTS — the production solo plane's dispatch
+    # shape (closing over them as constants changes XLA's folding enough
+    # to drift the RLS statistics at the ulp level)
+    solo_jit = jax.jit(forecast_step)
+    fstack = init_fleet_forecast_state(2, 3, n)
+    fsolo = [init_forecast_state(2, n) for _ in range(3)]
+    for rnd in range(9):
+        sts = [
+            s.replace(
+                node_base_cpu=s.node_base_cpu + 7.0 * rnd * ((t + 1) % 2 + 1)
+            )
+            for t, s in enumerate(states)
+        ]
+        stk = stack_tenants(sts)
+        mask = np.array([True, rnd % 3 != 0, True])
+        fstack, dstack, diagstack = _fleet_forecast(
+            stk, fstack, jnp.asarray(mask), *sc
+        )
+        for t in range(3):
+            if not mask[t]:
+                # inert slot: no delta, no diag, untouched state
+                assert not np.asarray(dstack[t]).any()
+                assert not np.asarray(diagstack[t]).any()
+                continue
+            fsolo[t], d, diag = solo_jit(sts[t], fsolo[t], *sc)
+            assert np.array_equal(np.asarray(dstack[t]), np.asarray(d))
+            assert np.array_equal(np.asarray(diagstack[t]), np.asarray(diag))
+            for name in ("A", "b", "history", "err_model_sum"):
+                assert np.array_equal(
+                    np.asarray(getattr(fsolo[t], name)),
+                    np.asarray(getattr(fstack, name)[t]),
+                ), name
+
+
+def test_fleet_forecast_repad_grows_cold_slots():
+    fst = init_fleet_forecast_state(2, 3, 4)
+    grown = repad_fleet_forecast_state(fst, 8)
+    assert grown.history.shape == (3, 3, 8)
+    assert grown.A.shape == (3, 8, 3, 3)
+    with pytest.raises(ValueError, match="shrink"):
+        repad_fleet_forecast_state(grown, 4)
+
+
+def test_fleet_proactive_decide_bit_exact_vs_solo():
+    """The batched predicted-state decide equals the solo proactive
+    kernel per tenant under shared deltas — vmap AND dp planes."""
+    from kubernetes_rescheduling_tpu.parallel.fleet import (
+        fleet_solve_proactive_dp,
+    )
+    from kubernetes_rescheduling_tpu.solver.fleet import (
+        ROW_MOST,
+        ROW_SERVICE,
+        ROW_TARGET,
+        ROW_VICTIM,
+    )
+
+    fleet = _mubench_fleet(3)
+    states, graphs, st, gr = _stacked(fleet)
+    pid = jnp.asarray(POLICY_IDS["communication"])
+    thr = jnp.asarray(30.0)
+    keys = _keys(3, seed=2)
+    mask = jnp.asarray(np.array([True, True, False]))
+    n = states[0].num_nodes
+    # a nonzero per-tenant delta pattern so the predicted state differs
+    deltas = jnp.stack(
+        [jnp.full((n,), 120.0 * (t + 1), jnp.float32) for t in range(3)]
+    )
+    d1, h1 = fleet_solve_proactive(st, gr, pid, thr, keys, mask, deltas)
+    d2, h2 = fleet_solve_proactive_dp(st, gr, pid, thr, keys, mask, deltas)
+    assert np.array_equal(np.asarray(d1), np.asarray(d2))
+    assert np.array_equal(np.asarray(h1), np.asarray(h2))
+    decisions = np.asarray(d1)
+    for t in range(2):
+        most, hz, victim, svc, target = jax.jit(decide_with_forecast)(
+            states[t], graphs[t], pid, thr, keys[t], deltas[t]
+        )
+        assert decisions[t, ROW_MOST] == int(most)
+        assert decisions[t, ROW_VICTIM] == int(victim)
+        assert decisions[t, ROW_SERVICE] == int(svc)
+        assert decisions[t, ROW_TARGET] == int(target)
+        assert np.array_equal(np.asarray(h1)[t], np.asarray(hz))
+    # the masked slot is a no-op row
+    assert decisions[2, ROW_MOST] == -1
+    assert not np.asarray(h1)[2].any()
+
+
+# ---------------- multiplexed controller, new planes ----------------
+
+
+def _solo_vs_fleet(algo, rounds=4, tenants=3, seed=1, key_seed=3, **extra):
+    key = jax.random.PRNGKey(key_seed)
+    cfg = RescheduleConfig(
+        algorithm=algo,
+        max_rounds=rounds,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=tenants),
+        **extra,
+    )
+    res = run_fleet_controller(_mubench_fleet(tenants, seed=seed), cfg, key=key)
+    solo_cfg = RescheduleConfig(
+        algorithm=algo, max_rounds=rounds, sleep_after_action_s=0.0, **extra
+    )
+    solo_fleet = _mubench_fleet(tenants, seed=seed)
+    out = []
+    for t, (name, backend) in enumerate(solo_fleet):
+        solo = run_controller(backend, solo_cfg, key=jax.random.fold_in(key, t))
+        out.append((name, solo, res.results[name]))
+    return out
+
+
+def test_fleet_global_controller_matches_n_solo_controllers():
+    """The multiplexed GLOBAL loop IS N solo global loops on one device
+    plane: same applied moves in the same order, same solver
+    objectives, same post-round metrics."""
+    for name, solo, fl in _solo_vs_fleet("global", balance_weight=0.5):
+        assert len(solo.rounds) == len(fl.rounds) == 4
+        for a, b in zip(solo.rounds, fl.rounds):
+            assert a.services_moved == b.services_moved
+            assert a.moved == b.moved
+            assert [m for m in a.applied_moves] == [m for m in b.applied_moves]
+            assert a.objective_after == pytest.approx(
+                b.objective_after, rel=1e-6
+            )
+            assert a.solver_improved == b.solver_improved
+            assert a.communication_cost == pytest.approx(
+                b.communication_cost, rel=1e-5
+            )
+            assert a.load_std == pytest.approx(b.load_std, rel=1e-5)
+
+
+def test_fleet_proactive_controller_matches_n_solo_controllers():
+    """The multiplexed PROACTIVE loop: per-tenant forecast state,
+    skill-gated deltas, and decisions all match N solo proactive runs
+    (cold rounds are reactive-identical by the zero-delta contract)."""
+    fc = ForecastConfig(min_history=4)
+    for name, solo, fl in _solo_vs_fleet(
+        "proactive", rounds=6, forecast=fc
+    ):
+        assert len(solo.rounds) == len(fl.rounds) == 6
+        for a, b in zip(solo.rounds, fl.rounds):
+            assert (a.most_hazard, a.service, a.target, a.moved) == (
+                b.most_hazard, b.service, b.target, b.moved,
+            )
+            assert a.communication_cost == pytest.approx(
+                b.communication_cost, rel=1e-5
+            )
+            fa, fb = a.forecast, b.forecast
+            assert (fa is None) == (fb is None)
+            if fa is not None:
+                assert fa["mode"] == fb["mode"]
+                assert fa["skill"] == pytest.approx(fb["skill"], abs=1e-6)
+                assert fa["trained"] == fb["trained"]
+
+
+def test_fleet_heterogeneous_tenants_match_unpadded_solo(registry):
+    """Heterogeneous shapes: a fleet of two different-sized tenants is
+    aligned to ONE shared shape bucket (padded, mask-threaded), and the
+    smaller tenant's decisions are bit-exact with its UNPADDED solo run
+    — the mask-twin contract at the loop level."""
+    def small():
+        b = make_backend("mubench", 1)
+        b.inject_imbalance(b.node_names[0])
+        return b
+
+    big = make_backend("mubench", 2)
+    extra = dataclasses.replace(
+        big.workmodel.services[0], name="extra-svc", replicas=2
+    )
+    big.deploy_service(extra)
+    big.inject_imbalance(big.node_names[0])
+    fleet = FleetBackend(backends=[small(), big])
+    key = jax.random.PRNGKey(5)
+    cfg = RescheduleConfig(
+        algorithm="communication",
+        max_rounds=3,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=2),
+    )
+    res = run_fleet_controller(fleet, cfg, key=key, registry=registry)
+    # the shared bucket was actually fitted (and is a power of two)
+    svc_cap = registry.gauge("fleet_bucket_services").value
+    assert svc_cap >= 21 and (int(svc_cap) & (int(svc_cap) - 1)) == 0
+    solo = run_controller(
+        small(),
+        RescheduleConfig(
+            algorithm="communication", max_rounds=3, sleep_after_action_s=0.0
+        ),
+        key=jax.random.fold_in(key, 0),
+    )
+    frounds = res.results["tenant0"].rounds
+    assert len(solo.rounds) == len(frounds) == 3
+    for a, b in zip(solo.rounds, frounds):
+        assert (a.most_hazard, a.service, a.target, a.moved) == (
+            b.most_hazard, b.service, b.target, b.moved,
+        )
+        assert a.communication_cost == pytest.approx(
+            b.communication_cost, rel=1e-5
+        )
+
+
+@pytest.mark.parametrize("algo,extra", [
+    ("global", {"balance_weight": 0.5}),
+    ("proactive", {}),
+])
+def test_fleet_new_planes_chaos_isolation(registry, algo, extra):
+    """The isolation acceptance pin on the NEW planes: a seeded chaos
+    soak on the last tenant leaves every other tenant's executed-round
+    counts and cost trajectories identical to a no-chaos run."""
+    key = jax.random.PRNGKey(0)
+
+    def run(chaos: bool):
+        fleet = _mubench_fleet(3)
+        cfg = RescheduleConfig(
+            algorithm=algo,
+            max_rounds=8,
+            sleep_after_action_s=0.0,
+            retry=RetryPolicy(max_attempts=1, base_delay_s=0.01),
+            max_consecutive_failures=2,
+            breaker_cooldown_rounds=2,
+            chaos=ChaosConfig(profile="soak" if chaos else "none", seed=5),
+            fleet=FleetConfig(
+                tenants=3, chaos_tenants=(2,) if chaos else ()
+            ),
+            **extra,
+        )
+        return run_fleet_controller(fleet, cfg, key=key, registry=registry)
+
+    clean = run(False)
+    chaotic = run(True)
+    for name in ("tenant0", "tenant1"):
+        a, b = clean.results[name], chaotic.results[name]
+        assert len(a.rounds) == len(b.rounds) == 8
+        assert a.skipped_rounds == b.skipped_rounds == 0
+        assert [r.communication_cost for r in a.rounds] == [
+            r.communication_cost for r in b.rounds
+        ]
+        assert [r.services_moved for r in a.rounds] == [
+            r.services_moved for r in b.rounds
+        ]
+    t2 = chaotic.results["tenant2"]
+    assert len(t2.rounds) + t2.skipped_rounds == 8
+    assert t2.boundary_failures > 0
+
+
+def test_fleet_one_transfer_per_round_on_new_planes(registry):
+    """The fleet transfer discipline survives the new planes: per
+    executed round exactly ONE fleet_decision pull (decisions + hazard
+    [+ forecast diag] or the global move bundle) and ONE fleet_metrics
+    pull — statically enforced by check_apply_boundary, counted here."""
+    for algo, extra in (
+        ("global", {"balance_weight": 0.5}),
+        ("proactive", {}),
+    ):
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            cfg = RescheduleConfig(
+                algorithm=algo,
+                max_rounds=3,
+                sleep_after_action_s=0.0,
+                fleet=FleetConfig(tenants=2),
+                **extra,
+            )
+            run_fleet_controller(
+                _mubench_fleet(2), cfg, key=jax.random.PRNGKey(0),
+                registry=reg,
+            )
+            transfers = reg.counter(
+                "device_transfers_total", labelnames=("site",)
+            )
+            assert transfers.labels(site="fleet_decision").value == 3, algo
+            assert transfers.labels(site="fleet_metrics").value == 3, algo
+        finally:
+            set_registry(prev)
+
+
+# ---------------- T >= 256 scale pin ----------------
+
+
+def test_fleet_bundle_is_one_transfer_at_t256(registry):
+    """The acceptance-scale pin: at T=256 tenants the whole fleet
+    round's decisions still come home as ONE flat bundle = ONE counted
+    pull, from ONE steady-state trace (tiny per-tenant clusters — the
+    tenant-axis mechanics are what is under test; bench-scale cells are
+    the slow-marked matrix variant below)."""
+    from kubernetes_rescheduling_tpu.telemetry import pull
+
+    T = 256
+    b = make_backend("mubench", 1)
+    state, graph = b.monitor(), b.comm_graph()
+    st = jax.tree_util.tree_map(
+        lambda x: jnp.tile(x[None], (T,) + (1,) * x.ndim), state
+    )
+    gr = jax.tree_util.tree_map(
+        lambda x: jnp.tile(x[None], (T,) + (1,) * x.ndim), graph
+    )
+    keys = _keys(T)
+    mask = jnp.ones((T,), bool)
+    cfg = GlobalSolverConfig(sweeps=2)
+    for rnd in range(2):
+        flat = fleet_global_solve(
+            st, gr, _keys(T, rnd), mask, config=cfg
+        )
+    got = pull(flat, site="fleet_decision", registry=registry)
+    moves, objs = decode_fleet_global(
+        got, tenants=T, num_services=graph.num_services
+    )
+    assert len(moves) == T
+    # every tenant slot decoded from the ONE transfer
+    transfers = registry.counter(
+        "device_transfers_total", labelnames=("site",)
+    )
+    assert transfers.labels(site="fleet_decision").value == 1
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="fleet_global_solve").value == 1
+
+
+# ---------------- solver-cache eviction ----------------
+
+
+def test_solver_cache_evicts_on_churn(registry):
+    """Counted eviction: churn that rewrites a tenant's graph drops that
+    tenant's solver-cache slots from the raw backend instead of leaving
+    stale derived graphs resident for the life of the soak."""
+    fleet = _mubench_fleet(2)
+    # pre-populate tenant0's slot the way a solo sparse/pod run would
+    ba = BoundaryClient(fleet.backends[0], tenant="tenant0", registry=None)
+    ba.registry = registry
+    slot = ba.solver_cache("sparse_graph")
+    slot["graph"], slot["value"] = "g-old", "v-old"
+    assert ba.evict_solver_caches(reason="churn") == 1
+    assert ba.solver_cache("sparse_graph") == {}
+    evs = registry.counter(
+        "solver_cache_evictions_total", labelnames=("reason",)
+    )
+    assert evs.labels(reason="churn").value == 1
+    # idempotent: nothing left to evict
+    ba.solver_cache("sparse_graph").clear()
+    getattr(ba.raw_backend, "_solver_caches").clear()
+    assert ba.evict_solver_caches(reason="churn") == 0
+
+
+def test_fleet_loop_evicts_caches_under_deploy_waves(registry):
+    """Loop-level: a deploy-waves fleet soak counts cache evictions the
+    round churn rewrites a tenant's graph (the slots were populated
+    before the run, as a prior solo run would leave them)."""
+    fleet = _mubench_fleet(2)
+    for t, b in enumerate(fleet.backends):
+        bc = BoundaryClient(b, tenant=f"tenant{t}")
+        bc.solver_cache("sparse_graph")["value"] = f"stale-{t}"
+    cfg = RescheduleConfig(
+        algorithm="communication",
+        max_rounds=6,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=2),
+        elastic=ElasticConfig(profile="deploy-waves", seed=3),
+    )
+    run_fleet_controller(
+        fleet, cfg, key=jax.random.PRNGKey(0), registry=registry
+    )
+    evs = registry.counter(
+        "solver_cache_evictions_total", labelnames=("reason",)
+    )
+    total = sum(
+        evs.labels(reason=r).value for r in ("churn", "promotion")
+    )
+    assert total >= 1
+    caches = getattr(fleet.backends[0], "_solver_caches", {})
+    assert all("stale" not in str(v) for v in caches.values())
+
+
+# ---------------- slow fleet-matrix cells ----------------
+
+
+@pytest.mark.slow  # the 1k-tenant fleet-matrix cell at bench-like tenant
+# count; the tenant-axis mechanics stay pinned fast by
+# test_fleet_bundle_is_one_transfer_at_t256 and the parity cases above
+def test_fleet_matrix_1k_tenants_single_dispatch():
+    """1024 tenants advanced by ONE batched greedy dispatch + ONE pull,
+    from one steady-state trace — the MULTICHIP_r06 fleet-matrix shape
+    (tiny per-tenant clusters on CPU; the 2k×256 per-tenant cells run
+    on-rig via BENCH_SCENARIO=fleet BENCH_TENANTS=1024)."""
+    from kubernetes_rescheduling_tpu.solver.fleet import fleet_solve
+    from kubernetes_rescheduling_tpu.telemetry import pull
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        T = 1024
+        b = make_backend("mubench", 1)
+        state, graph = b.monitor(), b.comm_graph()
+        st = jax.tree_util.tree_map(
+            lambda x: jnp.tile(x[None], (T,) + (1,) * x.ndim), state
+        )
+        gr = jax.tree_util.tree_map(
+            lambda x: jnp.tile(x[None], (T,) + (1,) * x.ndim), graph
+        )
+        pid = jnp.asarray(POLICY_IDS["communication"])
+        mask = jnp.ones((T,), bool)
+        for rnd in range(2):
+            decisions_dev, hazard_dev = fleet_solve(
+                st, gr, pid, jnp.asarray(30.0), _keys(T, rnd), mask
+            )
+        flat = pull(
+            jnp.concatenate(
+                [
+                    jnp.ravel(decisions_dev).astype(jnp.float32),
+                    jnp.ravel(hazard_dev).astype(jnp.float32),
+                ]
+            ),
+            site="fleet_decision",
+            registry=reg,
+        )
+        assert flat.shape[0] == T * 4 + T * state.num_nodes
+        traces = reg.counter("jax_traces_total", labelnames=("fn",))
+        assert traces.labels(fn="fleet_solve").value == 1
+        transfers = reg.counter(
+            "device_transfers_total", labelnames=("site",)
+        )
+        assert transfers.labels(site="fleet_decision").value == 1
+    finally:
+        set_registry(prev)

@@ -394,10 +394,11 @@ def test_forecast_config_validation():
         RescheduleConfig(
             algorithm="proactive", placement_unit="pod"
         ).validate()
-    with pytest.raises(ValueError):
-        RescheduleConfig(
-            algorithm="proactive", fleet=FleetConfig(tenants=2)
-        ).validate()
+    # fleet v2: proactive IS fleet-servable now (the batched forecast
+    # plane in forecast/fleet.py carries per-tenant RLS state)
+    RescheduleConfig(
+        algorithm="proactive", fleet=FleetConfig(tenants=2)
+    ).validate()
     assert scoring_policy("proactive", ForecastConfig()) == "communication"
     assert scoring_policy("spread", ForecastConfig()) == "spread"
 

@@ -638,17 +638,20 @@ def bench_pipeline(baseline_ms: float, rounds: int) -> dict:
 
 
 def bench_scan(baseline_ms: float, rounds: int, block: int) -> dict:
-    """Device-resident round scan: the SAME live greedy loop run three
+    """Device-resident round scan: the SAME live greedy loop run four
     ways on identically-seeded 2k-svc × 200-node powerlaw clusters —
     sequential, software-pipelined (the PR 9 schedule the scan must
-    beat), and scanned (``[controller] scan_block``: K rounds fused into
+    beat), scanned (``[controller] scan_block``: K rounds fused into
     one ``lax.scan`` dispatch + ONE counted ``round_end`` transfer per
-    block, moves replayed afterwards). The headline is the scanned
-    loop's throughput in rounds/sec (``better: higher`` — the first
-    throughput series in the ledger); the structural claims ride in
-    ``extra``: records bit-identical across all three schedules,
-    ``jax_traces_total{scan_rounds}`` pinned at 1, exactly one
-    ``round_end`` transfer per block, and the speedups vs both per-round
+    block, moves replayed afterwards) with the in-block tripwire plane
+    armed (the default), and scanned with tripwires compiled out. The
+    headline is the armed scanned loop's throughput in rounds/sec
+    (``better: higher`` — the first throughput series in the ledger);
+    the structural claims ride in ``extra``: records bit-identical
+    across all four schedules, ``jax_traces_total{scan_rounds}`` pinned
+    at one compile per tripwire variant, exactly one ``round_end``
+    transfer per block, the tripwire plane's throughput overhead
+    (``tripwire_overhead_frac``), and the speedups vs both per-round
     schedules (the CPU-sim acceptance gate is ≥5× vs pipelined here;
     the 10× target is the on-rig BENCH_r06 number, where each avoided
     round trip also buys back a ~100 ms tunnel RTT).
@@ -662,6 +665,7 @@ def bench_scan(baseline_ms: float, rounds: int, block: int) -> dict:
     from kubernetes_rescheduling_tpu.bench.harness import make_backend
     from kubernetes_rescheduling_tpu.config import (
         ControllerConfig,
+        ObsConfig,
         RescheduleConfig,
     )
     from kubernetes_rescheduling_tpu.telemetry import get_registry
@@ -676,8 +680,9 @@ def bench_scan(baseline_ms: float, rounds: int, block: int) -> dict:
             seed=0,
             controller=ControllerConfig(
                 pipeline=mode == "pipelined",
-                scan_block=block if mode == "scanned" else 0,
+                scan_block=block if mode.startswith("scanned") else 0,
             ),
+            obs=ObsConfig(scan_tripwires=mode != "scanned_off"),
         )
         t0 = time.perf_counter()
         result = run_controller(backend, cfg, key=jax.random.PRNGKey(0))
@@ -699,7 +704,7 @@ def bench_scan(baseline_ms: float, rounds: int, block: int) -> dict:
     rates = {}
     wall_rates = {}
     results = {}
-    for mode in ("sequential", "pipelined", "scanned"):
+    for mode in ("sequential", "pipelined", "scanned", "scanned_off"):
         run(mode, block)  # warm-up: pay the compiles
         res, wall = run(mode, rounds)
         # steady-state throughput: the median per-round wall with the
@@ -722,6 +727,7 @@ def bench_scan(baseline_ms: float, rounds: int, block: int) -> dict:
         stream(results["sequential"])
         == stream(results["pipelined"])
         == stream(results["scanned"])
+        == stream(results["scanned_off"])
     )
     reg = get_registry()
     scan_traces = int(
@@ -757,11 +763,24 @@ def bench_scan(baseline_ms: float, rounds: int, block: int) -> dict:
                 value / max(rates["sequential"], 1e-9), 3
             ),
             "bit_identical": bit_identical,
-            # 1 steady-state compile of the fused kernel across warm-up
-            # + timed runs (same shapes — a retrace would be the old
-            # per-round dispatch cost wearing a scan costume)
+            # the tripwire plane's cost: the same scanned loop with the
+            # in-block tripwires compiled out (ObsConfig.scan_tripwires
+            # False restores the pre-tripwire program byte-for-byte);
+            # overhead_frac is the throughput the armed plane gives up
+            "scanned_tripwire_off_rounds_per_sec": round(
+                rates["scanned_off"], 3
+            ),
+            "tripwire_overhead_frac": round(
+                1.0 - rates["scanned"] / max(rates["scanned_off"], 1e-9),
+                4,
+            ),
+            # 1 steady-state compile of the fused kernel PER tripwire
+            # variant across warm-up + timed runs (same shapes — a
+            # retrace would be the old per-round dispatch cost wearing
+            # a scan costume); tripwire on/off is a static flag, so the
+            # two schedules legitimately compile once each
             "scan_traces": scan_traces,
-            "traces_pinned": scan_traces == 1,
+            "traces_pinned": scan_traces == 2,
             "devices": [str(d.platform) for d in jax.devices()],
         },
     }

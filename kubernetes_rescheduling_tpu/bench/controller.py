@@ -465,6 +465,14 @@ class _Runtime:
             else 0
         )
         self.timeline = attribution_mod.PlacementTimeline() if self.attr_k > 0 else None
+        # in-block tripwires (telemetry.tripwire): device-side health
+        # predicates inside the scan body; the latest tripped block's
+        # decoded report lives in scan_trip until _scanned_loop drains it
+        self.scan_tripwire = bool(
+            config.controller.scan_block
+            and getattr(config.obs, "scan_tripwires", True)
+        )
+        self.scan_trip = None
         # decisions may run on an estimated graph; TELEMETRY always reports on
         # the backend's declared graph so round costs stay comparable across
         # configurations (and with the harness's before/after metrics)
@@ -1115,8 +1123,13 @@ class _Runtime:
         watchdog all served), bit-identical to the sequential loop's
         (test-pinned). Returns the number of rounds consumed (< rounds
         only if a replayed landing diverged from the twin — impossible
-        on a scan-compatible backend, handled defensively)."""
+        on a scan-compatible backend, handled defensively — or if the
+        in-block tripwire latched: the replay then commits exactly the
+        rounds BEFORE the trip, the trip report lands in
+        ``self.scan_trip``, and ``_scanned_loop`` drains the tripped
+        round to the per-round path under reason ``tripwire``)."""
         from kubernetes_rescheduling_tpu.bench import scan as scan_mod
+        from kubernetes_rescheduling_tpu.telemetry import tripwire as tripwire_mod
 
         config = self.config
         graph = self.graph_src()
@@ -1131,6 +1144,10 @@ class _Runtime:
             "num_nodes": state0.num_nodes,
             "num_services": self.metric_graph.num_services,
         }
+        if self.ops is not None:
+            # K rounds of healthy silence follow: scale the /healthz
+            # staleness budget so a long block never spuriously 503s
+            self.ops.health.mark_block_inflight(rounds)
         t0 = time.perf_counter()
         with span(
             "controller/scan_block", round=start, rounds=rounds,
@@ -1145,20 +1162,45 @@ class _Runtime:
                 self.key,
                 jnp.asarray(start, jnp.int32),
                 self.metric_edges(),
+                (
+                    tripwire_mod.trip_config_array(config.obs)
+                    if self.scan_tripwire
+                    else None
+                ),
                 rounds=rounds,
                 pinned=True,
                 explain_k=self.explain_k,
                 attr_k=self.attr_k,
+                tripwire=self.scan_tripwire,
             )
             flat = scan_mod.pull_block(flat_dev, self.registry)
         fence_s = time.perf_counter() - t0
         scan_mod.count_scan_block(self.registry, rounds)
+        self.scan_trip = None
+        trip = None
+        if self.scan_tripwire:
+            flat, trip = tripwire_mod.split_tripwire(flat, rounds=rounds)
         views = scan_mod.decode_block(
             flat,
             rounds=rounds,
             num_nodes=state0.num_nodes,
             explain_k=self.explain_k,
         )
+        if trip is not None and trip.tripped:
+            # the trip round's decision was made against the state the
+            # rules judged unhealthy — commit only the rounds BEFORE it
+            # and leave the trip report for _scanned_loop's drain
+            views = views[: trip.trip_round]
+            tripwire_mod.count_tripwire(self.registry, trip.rules)
+            self.scan_trip = {
+                "round": start + trip.trip_round,
+                "block_start": start,
+                "block_round": trip.trip_round,
+                "rules": list(trip.rules),
+                "mask": trip.trip_mask,
+            }
+            if self.logger is not None:
+                self.logger.warn("scan_tripwire", **self.scan_trip)
 
         consumed = 0
         for i, v in enumerate(views):
@@ -1257,7 +1299,7 @@ class _Runtime:
                 if self.logger is not None:
                     self.logger.info("decision", **expl)
             self.boundary.advance(config.sleep_after_action_s)
-            last = i == rounds - 1 or diverged
+            last = i == len(views) - 1 or diverged
             fresh = False
             if last:
                 # block boundary: ONE admitted monitor realigns the
@@ -1284,6 +1326,12 @@ class _Runtime:
             consumed += 1
             if diverged:
                 break
+        if self.ops is not None:
+            # every block reports — a clean one clears the scan_tripwire
+            # SLO rule and the in-flight staleness scaling; a tripped one
+            # flips /healthz and dumps a bundle scoped to the partial
+            # block
+            self.ops.observe_scan_block(rounds=rounds, trip=self.scan_trip)
         return consumed
 
 
@@ -1466,10 +1514,24 @@ def _scanned_loop(rt: _Runtime) -> None:
                 reason = "tail"
         if reason is not None:
             count_scan_drain(rt.registry, reason)
+            if rt.ops is not None:
+                rt.ops.observe_scan_drain(reason)
             rt.sequential_round(rnd)
             rnd += 1
             continue
         rnd += rt.scan_block_rounds(rnd, k)
+        if rt.scan_trip is not None:
+            # the tripwire latched mid-block: the replay committed the
+            # rounds before the trip; the tripped round itself re-runs
+            # on the per-round path (bit-identical decision by key
+            # parity) under its own counted drain reason — guaranteed
+            # progress even when the trip lands on block round 0
+            count_scan_drain(rt.registry, "tripwire")
+            if rt.ops is not None:
+                rt.ops.observe_scan_drain("tripwire")
+            rt.scan_trip = None
+            rt.sequential_round(rnd)
+            rnd += 1
 
 
 def run_controller(

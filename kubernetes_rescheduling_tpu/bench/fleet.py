@@ -1257,6 +1257,11 @@ def run_fleet_controller(
             scan_compatible,
         )
         from kubernetes_rescheduling_tpu.bench import scan as scan_mod
+        from kubernetes_rescheduling_tpu.telemetry import (
+            tripwire as tripwire_mod,
+        )
+    # in-block tripwires: per-tenant latches inside the fleet scan body
+    trip_on = bool(scan_k) and getattr(obs, "scan_tripwires", True)
 
     def scan_static_reason() -> str | None:
         """Run-level conditions the fleet scan can never honor (the solo
@@ -1270,14 +1275,20 @@ def run_fleet_controller(
             return "backend"
         return None
 
-    def scan_block(start: int, k: int) -> None:
+    def scan_block(start: int, k: int) -> int:
         """One fleet scan block: ONE compiled dispatch advances EVERY
         tenant ``k`` rounds (``bench.scan.fleet_scan_rounds`` — decide,
         sim-twin apply, and the metrics pair vmapped over the tenant
         axis inside one ``lax.scan``), the whole block pulled as ONE
         counted ``round_end`` transfer, then the decided moves replayed
         per tenant in the sequential call order. Per-tenant records are
-        bit-identical to the sequential fleet loop's (test-pinned)."""
+        bit-identical to the sequential fleet loop's (test-pinned).
+        Returns the rounds committed: ``k``, or — when a tenant's
+        in-block tripwire latched — the EARLIEST trip round across
+        tenants (only rounds every tenant ran healthy commit; the
+        un-tripped tenants' discarded rounds re-decide bit-identically
+        on the per-round path by key parity, so fleet-wide truncation
+        costs correctness nothing)."""
         n_nodes = tenants[0].state.num_nodes
         stacked_states = stack_tenants(
             [device_view(t.state) for t in tenants]
@@ -1299,6 +1310,8 @@ def run_fleet_controller(
             if scan_rollup_k
             else None
         )
+        if ops is not None:
+            ops.health.mark_block_inflight(k)
         t0 = time.perf_counter()
         with span("fleet/scan_block", round=start, rounds=k, tenants=T):
             flat = _pull_round_bundle(
@@ -1310,9 +1323,15 @@ def run_fleet_controller(
                     stacked_keys,
                     jnp.asarray(start, jnp.int32),
                     drift_vec,
+                    (
+                        tripwire_mod.trip_config_array(obs)
+                        if trip_on
+                        else None
+                    ),
                     rounds=k,
                     pinned=True,
                     rollup_k=scan_rollup_k,
+                    tripwire=trip_on,
                 ),
                 scan_mod.ROUND_END_SITE,
             )
@@ -1320,6 +1339,11 @@ def run_fleet_controller(
         scan_mod.count_scan_block(registry, k)
         result.batched_solves += 1
         result.device_solve_s += fence_s
+        trip = None
+        if trip_on:
+            flat, trip = tripwire_mod.split_fleet_tripwire(
+                flat, rounds=k, tenants=T
+            )
         decoded = scan_mod.decode_fleet_block(
             flat, rounds=k, tenants=T, num_nodes=n_nodes,
             rollup_k=scan_rollup_k,
@@ -1329,12 +1353,55 @@ def run_fleet_controller(
         else:
             decisions, hazard, landed_idx, metrics = decoded
             rollups = None
+        commit = k
+        trip_info = None
+        if trip is not None and trip.tripped:
+            # fleet-wide truncation at the EARLIEST trip: each tenant's
+            # latch froze only its own lane in-trace, but the host
+            # commits one shared prefix so every tenant's round ledger
+            # advances in lockstep (max_rounds accounting holds); the
+            # tripped round itself re-runs per-round via the drain
+            trip_rounds = np.asarray(trip.trip_round)
+            commit = int(trip_rounds[trip_rounds >= 0].min())
+            tripped_tenants: dict[str, dict] = {}
+            for i, t in enumerate(tenants):
+                if trip_rounds[i] < 0:
+                    continue
+                t_rules = tripwire_mod.rules_from_mask(
+                    int(trip.trip_mask[i])
+                )
+                tripwire_mod.count_tripwire(registry, t_rules)
+                tseries.counter_inc(
+                    "fleet_scan_tripwires_total",
+                    "scan blocks tripped by this tenant's in-block "
+                    "tripwire lane (budget-gated per-tenant twin of "
+                    "scan_tripwires_total)",
+                    t.name,
+                )
+                tripped_tenants[t.name] = {
+                    "round": start + int(trip_rounds[i]),
+                    "block_round": int(trip_rounds[i]),
+                    "rules": list(t_rules),
+                    "mask": int(trip.trip_mask[i]),
+                }
+            trip_info = {
+                "round": start + commit,
+                "block_start": start,
+                "block_round": commit,
+                "rules": list(trip.rules),
+                "mask": int(
+                    np.bitwise_or.reduce(np.asarray(trip.trip_mask))
+                ),
+                "tenants": tripped_tenants,
+            }
+            if logger is not None:
+                logger.warn("scan_tripwire", **trip_info)
         per_tenant_s = fence_s / (k * T)
         resync: set[int] = set()  # tenants whose replay diverged
-        for r in range(k):
+        for r in range(commit):
             rnd = start + r
             t_r0 = time.perf_counter()
-            last = r == k - 1
+            last = r == commit - 1
             for t in tenants:
                 t.boundary.begin_round(rnd)  # CLOSED stays CLOSED
             for i, t in enumerate(tenants):
@@ -1421,6 +1488,12 @@ def run_fleet_controller(
                 fence_s / k + time.perf_counter() - t_r0,
             )
             update_fleet_health()
+        if ops is not None:
+            # every block reports: clean blocks clear the scan_tripwire
+            # SLO rule and the in-flight staleness scaling; a tripped
+            # one flips /healthz and dumps a partial-block bundle
+            ops.observe_scan_block(rounds=k, trip=trip_info)
+        return commit
 
     def _run_rounds() -> None:
         """The fleet's round driver: scanned blocks in the steady state
@@ -1450,10 +1523,22 @@ def run_fleet_controller(
                     elif config.max_rounds - rnd + 1 < scan_k:
                         reason = "tail"
                 if reason is None:
-                    scan_block(rnd, scan_k)
-                    rnd += scan_k
+                    consumed = scan_block(rnd, scan_k)
+                    rnd += consumed
+                    if consumed < scan_k:
+                        # a tripwire truncated the block: the earliest
+                        # tripped round re-runs per-round under its own
+                        # counted drain reason (progress is guaranteed
+                        # even when the trip lands on block round 0)
+                        scan_mod.count_scan_drain(registry, "tripwire")
+                        if ops is not None:
+                            ops.observe_scan_drain("tripwire")
+                        round_once(rnd)
+                        rnd += 1
                     continue
                 scan_mod.count_scan_drain(registry, reason)
+                if ops is not None:
+                    ops.observe_scan_drain(reason)
             round_once(rnd)
             rnd += 1
 

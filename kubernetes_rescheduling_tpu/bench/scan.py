@@ -52,6 +52,7 @@ from kubernetes_rescheduling_tpu.bench.round_end import (
     round_end_metrics,
 )
 from kubernetes_rescheduling_tpu.solver.fleet import (
+    ROW_MOST,
     ROW_SERVICE,
     ROW_TARGET,
     ROW_VICTIM,
@@ -66,6 +67,11 @@ from kubernetes_rescheduling_tpu.telemetry import instrument_jit, pull
 from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
     rollup_matrix,
     rollup_size,
+)
+from kubernetes_rescheduling_tpu.telemetry.tripwire import (
+    fleet_tripwire_step,
+    tripwire_init,
+    tripwire_step,
 )
 
 # columns of the per-round decision row inside the block bundle
@@ -91,19 +97,33 @@ def _scan_rounds(
     key,
     start_round,
     edges=None,
+    trip_cfg=None,
     *,
     rounds: int,
     pinned: bool,
     explain_k: int,
     attr_k: int,
+    tripwire: bool = False,
 ):
     """The fused K-round body (see module docstring). Returns ONE flat
     f32 vector: per-round decision rows, hazard masks, optional explain
     bundles, and round-end metrics vectors, concatenated in that order
     (each piece stacked rounds-leading) — the single-transfer layout
-    :func:`decode_block` unpacks."""
+    :func:`decode_block` unpacks. With ``tripwire`` (static) the carry
+    grows the in-block tripwire state (``telemetry.tripwire``): each
+    round's post-apply health bits are judged in-trace against the
+    block-start baselines riding the carry; once a rule trips, the latch
+    masks every later round's decide outputs to the apply's ``-1`` no-op
+    sentinel — the remaining rounds are identity rounds — and the
+    per-round bits plus the final (trip round, trip mask) append to the
+    SAME bundle (``split_tripwire`` strips them; the transfer count is
+    unchanged). Tripwire off is the pre-tripwire program verbatim."""
 
-    def body(st, rnd):
+    def body(carry, rnd):
+        if tripwire:
+            st, trip = carry
+        else:
+            st = carry
         sub = _round_key(key, rnd)
         if explain_k > 0:
             most, hazard, victim, svc, target, bundle = decide_explain(
@@ -114,6 +134,15 @@ def _scan_rounds(
                 st, dec_graph, policy_id, threshold, sub
             )
             bundle = None
+        if tripwire:
+            # latched ⇒ identity round: -1 victim/target is the apply's
+            # no-op sentinel (where(False, ...) is value-exact, so a
+            # trip-free block's outputs match tripwire-off bit for bit)
+            latched = trip[0]
+            most = jnp.where(latched, -1, most)
+            victim = jnp.where(latched, -1, victim)
+            target = jnp.where(latched, -1, target)
+            hazard = jnp.where(latched, False, hazard)
         new_st, landed, _moved = apply_decision(
             st, victim, svc, target, hazard, pinned=pinned
         )
@@ -126,9 +155,39 @@ def _scan_rounds(
         outs = (row, hazard.astype(jnp.float32), metrics)
         if bundle is not None:
             outs = outs + (bundle,)
+        if tripwire:
+            trip, bits = tripwire_step(
+                trip,
+                new_st,
+                metrics[METRIC_COST],
+                metrics[METRIC_LOAD_STD],
+                most,
+                trip_cfg,
+            )
+            return (new_st, trip), outs + (bits.astype(jnp.float32),)
         return new_st, outs
 
     rnds = start_round + jnp.arange(rounds, dtype=jnp.int32)
+    if tripwire:
+        # block-start baselines (head-only metrics: no attribution work)
+        base = round_end_metrics(state, metric_graph, top_k=0, edges=edges)
+        carry0 = (
+            state,
+            tripwire_init(base[METRIC_COST], base[METRIC_LOAD_STD]),
+        )
+        final, outs = lax.scan(body, carry0, rnds)
+        *core, bits = outs
+        if explain_k > 0:
+            rows, hazard, metrics, bundles = core
+            pieces = (rows, hazard, bundles, metrics)
+        else:
+            rows, hazard, metrics = core
+            pieces = (rows, hazard, metrics)
+        trip = final[1]
+        tail = jnp.stack([trip[1], trip[2]]).astype(jnp.float32)
+        return jnp.concatenate(
+            [jnp.ravel(p) for p in pieces] + [jnp.ravel(bits), tail]
+        )
     _final, outs = lax.scan(body, state, rnds)
     if explain_k > 0:
         rows, hazard, metrics, bundles = outs
@@ -147,7 +206,7 @@ def _scan_rounds(
 scan_rounds = instrument_jit(
     _scan_rounds,
     name="scan_rounds",
-    static_argnames=("rounds", "pinned", "explain_k", "attr_k"),
+    static_argnames=("rounds", "pinned", "explain_k", "attr_k", "tripwire"),
 )
 
 
@@ -159,10 +218,12 @@ def _fleet_scan_rounds(
     tenant_keys,
     start_round,
     drift=None,
+    trip_cfg=None,
     *,
     rounds: int,
     pinned: bool,
     rollup_k: int = 0,
+    tripwire: bool = False,
 ):
     """The fleet composition: one scan advancing every tenant K rounds —
     the solo body with decide (``solver.fleet._fleet_decide``), the sim
@@ -177,15 +238,30 @@ def _fleet_scan_rounds(
     runs host-side after this dispatch returns, so a block's rollups
     carry drift at most one block stale — the per-round records stay
     exact); degraded/skipped flags are zero inside a scan by
-    construction (anything that degrades or skips drains the block)."""
+    construction (anything that degrades or skips drains the block).
+    With ``tripwire`` (static) the carry grows PER-TENANT tripwire state
+    (``telemetry.tripwire``, vmapped): each tenant latches alone — one
+    bad tenant freezes only its own lane — and the bundle grows bits
+    ``[K,T]`` plus per-tenant (trip round, trip mask), stripped by
+    ``split_fleet_tripwire`` before the ordinary decode."""
     T = tenant_keys.shape[0]
     mask = jnp.ones((T,), dtype=bool)
 
-    def body(sts, rnd):
+    def body(carry, rnd):
+        if tripwire:
+            sts, trip = carry
+        else:
+            sts = carry
         keys = jax.vmap(lambda k: _round_key(k, rnd))(tenant_keys)
         decisions, hazard = _fleet_decide(
             sts, graphs, policy_id, threshold, keys, mask
         )
+        if tripwire:
+            # latched tenants run identity rounds: their whole decision
+            # row masks to the apply's -1 no-op sentinel
+            latched = trip[0]
+            decisions = jnp.where(latched[:, None], -1, decisions)
+            hazard = jnp.where(latched[:, None], False, hazard)
         new_sts, landed, _moved = jax.vmap(
             lambda s, v, sv, t, h: apply_decision(s, v, sv, t, h, pinned=pinned)
         )(
@@ -216,9 +292,26 @@ def _fleet_scan_rounds(
             )
             matrix = jnp.concatenate([metrics, flags], axis=1)
             outs = outs + (rollup_matrix(matrix, top_k=rollup_k),)
+        if tripwire:
+            trip, bits = fleet_tripwire_step(
+                trip, new_sts, metrics, decisions[:, ROW_MOST], trip_cfg
+            )
+            return (new_sts, trip), outs + (bits.astype(jnp.float32),)
         return new_sts, outs
 
     rnds = start_round + jnp.arange(rounds, dtype=jnp.int32)
+    if tripwire:
+        base = _fleet_metrics(states, graphs)  # per-tenant block-start
+        carry0 = (states, tripwire_init(base[:, 0], base[:, 1]))
+        final, outs = lax.scan(body, carry0, rnds)
+        trip = final[1]
+        return jnp.concatenate(
+            [jnp.ravel(p) for p in outs]
+            + [
+                trip[1].astype(jnp.float32),
+                trip[2].astype(jnp.float32),
+            ]
+        )
     _final, outs = lax.scan(body, states, rnds)
     return jnp.concatenate([jnp.ravel(p) for p in outs])
 
@@ -226,7 +319,7 @@ def _fleet_scan_rounds(
 fleet_scan_rounds = instrument_jit(
     _fleet_scan_rounds,
     name="fleet_scan_rounds",
-    static_argnames=("rounds", "pinned", "rollup_k"),
+    static_argnames=("rounds", "pinned", "rollup_k", "tripwire"),
 )
 
 

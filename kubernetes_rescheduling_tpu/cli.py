@@ -196,6 +196,47 @@ def _add_pipeline_flags(parser: argparse.ArgumentParser) -> None:
              "algorithm with one move per round on the sim backend; "
              "mutually exclusive with --pipeline. 0 = off",
     )
+    parser.add_argument(
+        "--no-scan-tripwires", action="store_true",
+        help="disable the in-block tripwire plane (device-side health "
+             "predicates inside the scan body: non-finite state/cost "
+             "always armed, plus the threshold rules below; a trip "
+             "latches the rest of the block to no-move rounds in-trace, "
+             "truncates the replay at the trip round, and drains under "
+             "scan_drains_total{reason=\"tripwire\"})",
+    )
+    parser.add_argument(
+        "--tripwire-cost-frac", type=float, default=0.0,
+        help="tripwire cost_regression rule: communication cost rising "
+             "more than this fraction above the block-start baseline "
+             "trips the block (0 = rule off)",
+    )
+    parser.add_argument(
+        "--tripwire-load-factor", type=float, default=0.0,
+        help="tripwire load_std_spike rule: node-load std exceeding "
+             "this factor of the block-start baseline trips the block "
+             "(0 = rule off)",
+    )
+    parser.add_argument(
+        "--tripwire-hazard-streak", type=int, default=0,
+        help="tripwire hazard_streak rule: the same node detected "
+             "most-hazardous this many consecutive rounds trips the "
+             "block (0 = rule off)",
+    )
+
+
+def _obs_config(args, **overrides):
+    """The ObsConfig a run command builds from its flags (currently the
+    tripwire knobs; callers pass fleet overrides like the label budget)."""
+    from kubernetes_rescheduling_tpu.config import ObsConfig
+
+    return ObsConfig(
+        scan_tripwires=not args.no_scan_tripwires,
+        tripwire_cost_frac=args.tripwire_cost_frac,
+        tripwire_load_factor=args.tripwire_load_factor,
+        tripwire_hazard_streak=args.tripwire_hazard_streak,
+        **overrides,
+    )
 
 
 def _pipeline_config(args):
@@ -683,7 +724,6 @@ def cmd_fleet_reschedule(args, algo: str) -> dict:
         ChaosConfig,
         ElasticConfig,
         FleetConfig,
-        ObsConfig,
         RescheduleConfig,
     )
 
@@ -730,9 +770,9 @@ def cmd_fleet_reschedule(args, algo: str) -> dict:
             chaos_tenants=_parse_tenant_list(args.fleet_chaos_tenants),
         ),
         obs=(
-            ObsConfig(tenant_label_budget=args.tenant_label_budget)
+            _obs_config(args, tenant_label_budget=args.tenant_label_budget)
             if args.tenant_label_budget is not None
-            else ObsConfig()
+            else _obs_config(args)
         ),
     )
     try:
@@ -887,6 +927,7 @@ def cmd_reschedule(args) -> dict:
             enabled=bool(args.shadow), win_margin=args.shadow_win_margin
         ),
         perf=PerfConfig(ledger_path=args.perf_ledger),
+        obs=_obs_config(args),
     )
     ops, logger = _build_ops_plane(args, cfg)
     try:

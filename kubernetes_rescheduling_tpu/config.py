@@ -465,6 +465,30 @@ class ObsConfig:
                                            # off; only rounds carrying
                                            # shadow data are judged, so
                                            # live runs never trip it)
+    # in-block tripwires (telemetry.tripwire): device-side health
+    # predicates inside the scanned schedules' lax.scan body — a trip
+    # latches the rest of the block to no-move identity rounds in-trace
+    # and drains the block (reason "tripwire")
+    scan_tripwires: bool = True          # the plane itself; the always-armed
+                                         # non_finite rule never fires on a
+                                         # healthy sim, so on-by-default
+                                         # keeps trip-free runs bit-identical
+    tripwire_cost_frac: float = 0.0      # cost_regression rule: comm cost
+                                         # rising more than this fraction
+                                         # above the block-start baseline
+                                         # trips (0 = rule off)
+    tripwire_load_factor: float = 0.0    # load_std_spike rule: load std
+                                         # exceeding this factor of the
+                                         # block-start baseline trips
+                                         # (0 = rule off)
+    tripwire_hazard_streak: int = 0      # hazard_streak rule: the same node
+                                         # most-hazardous this many rounds
+                                         # in a row trips (0 = rule off)
+    slo_scan_tripwire: bool = True       # scan_tripwire SLO rule: a tripped
+                                         # block flips /healthz until a
+                                         # clean block lands (only scan runs
+                                         # carry the data, so the per-round
+                                         # path never trips it)
 
     def validate(self) -> "ObsConfig":
         if self.serve_port is not None and not (0 <= self.serve_port <= 65535):
@@ -518,6 +542,21 @@ class ObsConfig:
             raise ValueError(
                 "slo_shadow_min_win_rate must be in [0, 1] (a win-rate "
                 "fraction; 0 disables the shadow_win_rate rule)"
+            )
+        if self.tripwire_cost_frac < 0:
+            raise ValueError(
+                "tripwire_cost_frac must be >= 0 (0 disables the "
+                "cost_regression tripwire rule)"
+            )
+        if self.tripwire_load_factor < 0:
+            raise ValueError(
+                "tripwire_load_factor must be >= 0 (0 disables the "
+                "load_std_spike tripwire rule)"
+            )
+        if self.tripwire_hazard_streak < 0:
+            raise ValueError(
+                "tripwire_hazard_streak must be >= 0 (0 disables the "
+                "hazard_streak tripwire rule)"
             )
         return self
 

@@ -40,6 +40,42 @@ def _labels_str(labels: dict[str, str]) -> str:
     return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
 
 
+def _scan_plane_lines(latest: dict[tuple, dict[str, Any]]) -> list[str]:
+    """The scan-plane digest an operator reads before the raw series: block
+    size, blocks dispatched, and the drain/tripwire breakdowns — only when
+    the run actually scanned (``scan_blocks_total`` present)."""
+    blocks = block = None
+    drains: dict[str, float] = {}
+    trips: dict[str, float] = {}
+    for (metric, _), rec in latest.items():
+        labels = rec.get("labels") or {}
+        if metric == "scan_blocks_total":
+            blocks = rec.get("value")
+        elif metric == "scan_rounds_per_dispatch":
+            block = rec.get("value")
+        elif metric == "scan_drains_total":
+            drains[str(labels.get("reason"))] = rec.get("value", 0)
+        elif metric in ("scan_tripwires_total", "fleet_scan_tripwires_total"):
+            key = str(labels.get("rule") or labels.get("tenant"))
+            trips[key] = trips.get(key, 0) + (rec.get("value") or 0)
+    if blocks is None:
+        return []
+    out = [f"  scan plane: blocks={blocks:g}" + (
+        f" block_rounds={block:g}" if block is not None else ""
+    )]
+    if drains:
+        out.append(
+            "    drains: "
+            + ", ".join(f"{k}×{v:g}" for k, v in sorted(drains.items()))
+        )
+    if trips:
+        out.append(
+            "    tripwires: "
+            + ", ".join(f"{k}×{v:g}" for k, v in sorted(trips.items()))
+        )
+    return out
+
+
 def summarize_metrics(records: list[dict[str, Any]]) -> list[str]:
     """Registry-dump JSONL (``MetricsRegistry.dump_jsonl``) → text lines.
     When a run appended several snapshots, the LAST sample per series
@@ -48,7 +84,7 @@ def summarize_metrics(records: list[dict[str, Any]]) -> list[str]:
     for rec in records:
         key = (rec["metric"], tuple(sorted((rec.get("labels") or {}).items())))
         latest[key] = rec
-    lines = []
+    lines = _scan_plane_lines(latest)
     for (metric, _), rec in sorted(latest.items()):
         labels = _labels_str(rec.get("labels") or {})
         if rec.get("type") == "histogram":
@@ -111,6 +147,16 @@ def summarize_events(records: list[dict[str, Any]]) -> list[str]:
         lines.append(
             f"  resilience: skipped={skipped} degraded={degraded} "
             f"boundary_failures={failures}"
+        )
+    trips = [r for r in records if r.get("event") == "scan_tripwire"]
+    if trips:
+        lines.append(
+            "  scan tripwires: "
+            + ", ".join(
+                f"r{t.get('round', '?')} "
+                f"({'+'.join(t.get('rules') or ()) or '?'})"
+                for t in trips
+            )
         )
     return lines
 

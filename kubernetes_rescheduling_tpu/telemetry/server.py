@@ -86,11 +86,32 @@ class HealthState:
         # service, not a dead plane — it shows here without flipping the
         # endpoint to 503 (per-tenant isolation extends to the probe).
         self.fleet: dict[str, dict] | None = None
+        # scan-plane summary (OpsPlane.observe_scan_block/observe_scan_
+        # drain): block size, blocks dispatched, drain breakdown, latest
+        # trip — rendered on /healthz when a scanned schedule runs
+        self.scan: dict[str, Any] | None = None
+        # a dispatched scan block is K rounds of healthy silence:
+        # mark_round only fires as the replay flushes, so while a block
+        # is in flight the staleness budget scales by its expected
+        # rounds instead of spuriously 503ing a healthy loop
+        self._inflight_rounds = 0
 
     def mark_round(self) -> None:
         """Stamp 'a round just finished' on both clocks."""
         self.last_round_ts = time.time()
         self._last_round_mono = time.monotonic()
+        self._inflight_rounds = 0
+
+    def mark_block_inflight(self, rounds: int) -> None:
+        """A scan block of ``rounds`` rounds just dispatched: scale the
+        staleness budget until its replay flushes (any mark_round or
+        :meth:`mark_block_done` clears the scaling)."""
+        self._inflight_rounds = max(int(rounds), 1)
+
+    def mark_block_done(self) -> None:
+        """The block's replay finished (however many rounds committed):
+        back to the per-round staleness budget."""
+        self._inflight_rounds = 0
 
     def snapshot(self) -> tuple[dict[str, Any], bool]:
         breaker_state = getattr(self.breaker, "state", None)
@@ -99,10 +120,11 @@ class HealthState:
             if self._last_round_mono is not None
             else None
         )
+        age_budget = self.max_round_age_s * max(self._inflight_rounds, 1)
         stale = (
-            self.max_round_age_s > 0
+            age_budget > 0
             and age is not None
-            and age > self.max_round_age_s
+            and age > age_budget
         )
         slo = self.watchdog.status() if self.watchdog is not None else None
         healthy = (
@@ -124,6 +146,7 @@ class HealthState:
                 "uptime_s": time.monotonic() - self._started_mono,
                 "slo": slo,
                 "perf": self.perf,
+                **({"scan": self.scan} if self.scan is not None else {}),
                 **({"fleet": self.fleet} if self.fleet is not None else {}),
             },
             healthy,
@@ -366,6 +389,7 @@ class OpsPlane:
                     obs, "slo_shadow_min_win_rate", 0.0
                 ),
                 fleet_tail_frac=getattr(obs, "slo_fleet_tail_frac", 0.0),
+                scan_tripwire=getattr(obs, "slo_scan_tripwire", True),
             ),
             registry=registry,
             logger=logger,
@@ -497,6 +521,52 @@ class OpsPlane:
                 events=list(events),
                 spans=spans,
             )
+
+    def observe_scan_block(
+        self, *, rounds: int, trip: dict | None = None
+    ) -> None:
+        """One scan block's replay finished: update the /healthz scan
+        summary, clear the in-flight staleness scaling, and feed the
+        watchdog's ``scan_tripwire`` rule (a clean block — ``trip=None``
+        — clears it). A tripped block additionally dumps a
+        flight-recorder bundle scoped to the partial block: the trip
+        dict carries the trip round and decoded rule bitmask, and the
+        ring holds exactly the rounds the replay committed."""
+        scan = self.health.scan
+        if scan is None:
+            scan = self.health.scan = {
+                "block": int(rounds),
+                "blocks": 0,
+                "tripped_blocks": 0,
+                "last_trip": None,
+                "drains": {},
+            }
+        scan["block"] = int(rounds)
+        scan["blocks"] += 1
+        self.health.mark_block_done()
+        if trip is not None:
+            scan["tripped_blocks"] += 1
+            scan["last_trip"] = dict(trip)
+            if self.recorder is not None:
+                self.recorder.dump("scan_tripwire", trip=dict(trip))
+        if self.watchdog is not None:
+            self.watchdog.observe_scan_block(trip)
+
+    def observe_scan_drain(self, reason: str) -> None:
+        """One round drained from the scanned schedule to the per-round
+        path: the /healthz scan summary's reason breakdown (the metric
+        twin is ``scan_drains_total{reason}``)."""
+        scan = self.health.scan
+        if scan is None:
+            scan = self.health.scan = {
+                "block": None,
+                "blocks": 0,
+                "tripped_blocks": 0,
+                "last_trip": None,
+                "drains": {},
+            }
+        drains = scan["drains"]
+        drains[reason] = drains.get(reason, 0) + 1
 
     def observe_perf(self, verdicts: dict) -> None:
         """Feed a perf-ledger verdict set (``perf_ledger.detect``): arms/

@@ -1,0 +1,294 @@
+"""In-block tripwires: device-side health detection for the scanned
+schedules.
+
+The scanned schedule (``bench/scan.py``) is the repo's fastest path and
+its blindest: every health signal the control loop reacts to — hazard
+persistence, cost regressions, corrupted readings — is only visible to
+the watchdog and breaker AFTER the block's single pull, so a fault in
+round 1 of a 64-round block is detected K rounds late with its decisions
+already committed. This module moves detection INTO the trace:
+
+- **Device half** — :func:`tripwire_step` (solo) /
+  :func:`fleet_tripwire_step` (per-tenant, vmapped): per-round rule
+  predicates evaluated inside the ``lax.scan`` body, POST-apply, against
+  the round's new state and metrics. Four rules, each a bit in the
+  round's rule mask:
+
+  - ``non_finite`` (bit 1) — any non-finite value in the VALID slots of
+    the evolving sim state, or a non-finite cost/load reading (always
+    armed while the plane is on: a NaN is never policy);
+  - ``cost_regression`` (bit 2) — communication cost rising more than a
+    configured fraction above the BLOCK-START baseline (carried in the
+    scan carry, so the comparison is in-trace and free);
+  - ``load_std_spike`` (bit 4) — node-load std exceeding a configured
+    factor of the block-start baseline;
+  - ``hazard_streak`` (bit 8) — the SAME node detected most-hazardous
+    for a configured number of consecutive rounds (the decide loop is
+    stuck on a hazard it cannot drain).
+
+  Thresholds ride a TRACED f32 config vector (:func:`trip_config_array`)
+  — re-tuning a threshold never retraces the block kernel. Once any rule
+  trips, the carry LATCHES: every remaining round in the block becomes a
+  no-move identity round in-trace (the scan kernels mask the decide
+  outputs to the apply's ``-1`` no-op sentinel), so a poisoned lane
+  freezes instead of compounding. The fleet variant latches PER TENANT —
+  one bad tenant freezes only its own lane.
+
+- **The bundle ride** — the per-round rule bitmasks plus the final
+  carry's (trip round, trip mask) append to the EXISTING block bundle:
+  zero new transfers (the block's one counted ``round_end`` pull is
+  test-pinned unchanged). :func:`split_tripwire` /
+  :func:`split_fleet_tripwire` strip the appended block host-side and
+  hand the untouched core bundle to the existing decoders.
+
+- **Host half** — :class:`TripReport` (what tripped, where),
+  :func:`count_tripwire` (``scan_tripwires_total{rule}``). The
+  controller truncates the replay at the trip round (post-trip identity
+  rounds are never replayed into the backend), drains under the counted
+  ``tripwire`` reason, and feeds the ops plane
+  (``OpsPlane.observe_scan_block`` → the ``scan_tripwire`` SLO rule on
+  /healthz plus a flight-recorder dump scoped to the partial block).
+
+With the plane off — and on every trip-free block — the scan kernels'
+outputs are bit-identical to the pre-tripwire path (golden-pinned in
+tests/test_tripwire.py). Module import stays jax-free (the fleet_rollup
+convention); the device functions import jax lazily at trace time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+# rule bits, in mask order (TRIPWIRE_RULES[i] <-> bit 1 << i)
+TRIP_NON_FINITE = 1
+TRIP_COST_REGRESSION = 2
+TRIP_LOAD_STD_SPIKE = 4
+TRIP_HAZARD_STREAK = 8
+TRIPWIRE_RULES: tuple[str, ...] = (
+    "non_finite",
+    "cost_regression",
+    "load_std_spike",
+    "hazard_streak",
+)
+# traced config vector layout (f32[3]): a zero disables its rule
+CFG_COST_FRAC, CFG_LOAD_FACTOR, CFG_HAZARD_STREAK = range(3)
+
+
+def rules_from_mask(mask: int) -> tuple[str, ...]:
+    """Decode a rule bitmask into rule names, bit order."""
+    return tuple(
+        name for i, name in enumerate(TRIPWIRE_RULES) if mask & (1 << i)
+    )
+
+
+def trip_config_array(obs):
+    """The traced threshold vector from an ``ObsConfig`` block — traced,
+    not static, so tuning a threshold reuses the compiled block kernel
+    (the 1-steady-state-trace invariant survives re-tuning)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(
+        [
+            float(getattr(obs, "tripwire_cost_frac", 0.0)),
+            float(getattr(obs, "tripwire_load_factor", 0.0)),
+            float(getattr(obs, "tripwire_hazard_streak", 0)),
+        ],
+        jnp.float32,
+    )
+
+
+def _finite_state(st):
+    """True while every VALID slot of the sim state is finite — masked
+    exactly like the decision kernels (``pod_valid`` / ``node_valid``
+    gate the reads), so padded slots can never trip the wire."""
+    import jax.numpy as jnp
+
+    pod = jnp.where(st.pod_valid, st.pod_cpu + st.pod_mem, 0.0)
+    node = jnp.where(
+        st.node_valid,
+        st.node_cpu_cap + st.node_mem_cap + st.node_base_cpu
+        + st.node_base_mem,
+        0.0,
+    )
+    return jnp.all(jnp.isfinite(pod)) & jnp.all(jnp.isfinite(node))
+
+
+def tripwire_init(cost0, load0):
+    """The scan-carry tripwire slot at block start: unlatched, no trip
+    recorded, the block-start (cost, load) baselines, no hazard streak.
+    Shape-generic — scalars for the solo scan, ``[T]`` vectors for the
+    fleet's per-tenant latches."""
+    import jax.numpy as jnp
+
+    cost0 = jnp.asarray(cost0, jnp.float32)
+    z = jnp.zeros(jnp.shape(cost0), jnp.int32)
+    return (
+        jnp.zeros(jnp.shape(cost0), bool),        # latched
+        z - 1,                                    # trip round (block-rel)
+        z,                                        # trip rule mask
+        cost0,                                    # baseline cost
+        jnp.asarray(load0, jnp.float32),          # baseline load std
+        z - 1,                                    # previous most-hazard
+        z,                                        # hazard streak length
+        z,                                        # block-relative index
+    )
+
+
+def tripwire_step(carry, st, cost, load_std, most, cfg):
+    """One round's tripwire evaluation, POST-apply: the new state ``st``
+    and its metrics judge; a newly tripped round records its
+    block-relative index and rule mask in the carry and sets the latch
+    the scan body reads NEXT round (the trip round itself executed — the
+    replay truncation is the host's job). Latched rounds evaluate
+    nothing (bits 0): their lane is frozen identity rounds. Returns
+    ``(new_carry, bits)`` — ``bits`` is the round's i32 rule mask."""
+    import jax.numpy as jnp
+
+    latched, trip_rnd, trip_mask, base_cost, base_load, prev, streak, idx = (
+        carry
+    )
+    finite = (
+        _finite_state(st) & jnp.isfinite(cost) & jnp.isfinite(load_std)
+    )
+    bits = jnp.where(finite, 0, TRIP_NON_FINITE).astype(jnp.int32)
+    cost_frac = cfg[CFG_COST_FRAC]
+    bits = bits | jnp.where(
+        (cost_frac > 0)
+        & (base_cost > 0)
+        & (cost > (1.0 + cost_frac) * base_cost),
+        TRIP_COST_REGRESSION,
+        0,
+    ).astype(jnp.int32)
+    load_factor = cfg[CFG_LOAD_FACTOR]
+    bits = bits | jnp.where(
+        (load_factor > 0)
+        & (base_load > 0)
+        & (load_std > load_factor * base_load),
+        TRIP_LOAD_STD_SPIKE,
+        0,
+    ).astype(jnp.int32)
+    # same-hazard-node persistence: a valid most-hazard equal to last
+    # round's extends the streak, a different one restarts it, none
+    # clears it
+    new_streak = jnp.where(
+        most >= 0,
+        jnp.where(most == prev, streak + 1, 1),
+        0,
+    ).astype(jnp.int32)
+    hz = cfg[CFG_HAZARD_STREAK]
+    bits = bits | jnp.where(
+        (hz > 0) & (new_streak >= hz.astype(jnp.int32)),
+        TRIP_HAZARD_STREAK,
+        0,
+    ).astype(jnp.int32)
+    bits = jnp.where(latched, 0, bits).astype(jnp.int32)
+    tripped = bits != 0
+    return (
+        (
+            latched | tripped,
+            jnp.where(tripped, idx, trip_rnd),
+            jnp.where(tripped, bits, trip_mask),
+            base_cost,
+            base_load,
+            jnp.asarray(most, jnp.int32),
+            new_streak,
+            idx + 1,
+        ),
+        bits,
+    )
+
+
+def fleet_tripwire_step(carry, states, metrics, most, cfg):
+    """The fleet composition: :func:`tripwire_step` vmapped over the
+    leading tenant axis — per-tenant latches, baselines, and streaks
+    (``metrics`` is the fleet round's ``f32[T, 2]`` (cost, load_std)
+    pair). One bad tenant freezes only its own lane."""
+    import jax
+
+    return jax.vmap(
+        lambda c, s, co, ld, m: tripwire_step(c, s, co, ld, m, cfg)
+    )(carry, states, metrics[:, 0], metrics[:, 1], most)
+
+
+# ---------------- host half: decode + accounting ----------------
+
+
+@dataclass(frozen=True)
+class TripReport:
+    """One block's decoded tripwire verdict. ``trip_round`` is
+    BLOCK-relative (-1 = the block ran clean); in the fleet variant the
+    fields are per-tenant arrays and :attr:`tripped` means ANY tenant
+    tripped."""
+
+    bits: np.ndarray          # i64[K] (solo) / i64[K, T] (fleet)
+    trip_round: int | np.ndarray
+    trip_mask: int | np.ndarray
+
+    @property
+    def tripped(self) -> bool:
+        return bool(np.any(np.asarray(self.trip_round) >= 0))
+
+    @property
+    def rules(self) -> tuple[str, ...]:
+        """Rule names in the (union, for fleet) trip mask."""
+        mask = int(np.bitwise_or.reduce(
+            np.atleast_1d(np.asarray(self.trip_mask, np.int64))
+        ))
+        return rules_from_mask(mask)
+
+
+def split_tripwire(
+    flat: np.ndarray, *, rounds: int
+) -> tuple[np.ndarray, TripReport]:
+    """Strip the appended tripwire block — per-round bits ``[K]`` plus
+    the final carry's ``(trip_round, trip_mask)`` — off a solo scan
+    bundle, returning the untouched core for ``decode_block``."""
+    flat = np.asarray(flat, dtype=np.float32)
+    tail = rounds + 2
+    if flat.size <= tail:
+        raise ValueError(
+            f"scan bundle of {flat.size} values has no tripwire block at "
+            f"rounds={rounds}"
+        )
+    trail = flat[-tail:]
+    return flat[:-tail], TripReport(
+        bits=trail[:rounds].astype(np.int64),
+        trip_round=int(trail[rounds]),
+        trip_mask=int(trail[rounds + 1]),
+    )
+
+
+def split_fleet_tripwire(
+    flat: np.ndarray, *, rounds: int, tenants: int
+) -> tuple[np.ndarray, TripReport]:
+    """The fleet twin: bits ``[K, T]`` plus per-tenant
+    ``trip_round[T]`` / ``trip_mask[T]`` trail the fleet bundle."""
+    flat = np.asarray(flat, dtype=np.float32)
+    tail = rounds * tenants + 2 * tenants
+    if flat.size <= tail:
+        raise ValueError(
+            f"fleet scan bundle of {flat.size} values has no tripwire "
+            f"block at rounds={rounds}, tenants={tenants}"
+        )
+    trail = flat[-tail:]
+    n_bits = rounds * tenants
+    return flat[:-tail], TripReport(
+        bits=trail[:n_bits].reshape(rounds, tenants).astype(np.int64),
+        trip_round=trail[n_bits : n_bits + tenants].astype(np.int64),
+        trip_mask=trail[n_bits + tenants :].astype(np.int64),
+    )
+
+
+def count_tripwire(registry, rules) -> None:
+    """One tripped block's rule accounting: each rule in the trip mask
+    counts once in ``scan_tripwires_total{rule}``."""
+    fam = registry.counter(
+        "scan_tripwires_total",
+        "scan blocks tripped by the in-block tripwire plane, by rule "
+        "(a block tripping on multiple rules counts once per rule)",
+        labelnames=("rule",),
+    )
+    for rule in rules:
+        fam.labels(rule=rule).inc()

@@ -60,6 +60,7 @@ RULE_PIPELINE = "pipeline_overlap"
 RULE_RECONCILE = "reconcile_divergence"
 RULE_SHADOW = "shadow_win_rate"
 RULE_FLEET_TAIL = "fleet_tail_cost"
+RULE_SCAN_TRIPWIRE = "scan_tripwire"
 
 
 @dataclass(frozen=True)
@@ -124,6 +125,13 @@ class SLORules:
     # disables; only rounds carrying shadow data are judged, so live
     # runs can never trip it; min_samples scored rounds before judging).
     shadow_min_win_rate: float = 0.0
+    # scan tripwire: a scan block whose in-trace tripwire plane tripped
+    # (telemetry.tripwire — the controller feeds the decoded trip via
+    # observe_scan_block) is an active violation until a CLEAN block
+    # lands — the device itself judged the block unhealthy, so /healthz
+    # must say so (False disables; only scan runs feed blocks, so the
+    # per-round path can never trip it)
+    scan_tripwire: bool = True
 
     def validate(self) -> "SLORules":
         if self.window < 2:
@@ -214,6 +222,9 @@ class Watchdog:
         self._tenant_seen: dict[str, int] = {}
         self._last_round: int = 0
         self._shadow: dict[str, Any] | None = None  # latest shadow block
+        # latest scan block's decoded trip (None = last block was clean
+        # or no scan block observed yet) — observe_scan_block feeds it
+        self._scan_trip: dict[str, Any] | None = None
         # fleet cost-rollup tail (p99 per fleet round) — rolling window
         self._fleet_tail: collections.deque[float] = collections.deque(
             maxlen=self.rules.window
@@ -245,6 +256,7 @@ class Watchdog:
         self._tenant_seen = {}
         self._last_round = 0
         self._shadow = None
+        self._scan_trip = None
         self._overlap.clear()
         self._fleet_tail.clear()
         self.active = (
@@ -340,6 +352,18 @@ class Watchdog:
         except (KeyError, TypeError):
             return []
         self._fleet_tail.append(p99)
+        return self.check()
+
+    def observe_scan_block(
+        self, trip: dict[str, Any] | None
+    ) -> list[dict[str, Any]]:
+        """Feed one scan block's tripwire verdict (the controller's
+        decoded trip dict, or None for a clean block). A tripped block
+        arms the ``scan_tripwire`` rule; the next clean block clears it
+        — the device's own health verdict, surfaced on /healthz.
+        Returns the newly raised violations, like
+        :meth:`observe_round`."""
+        self._scan_trip = dict(trip) if trip is not None else None
         return self.check()
 
     def observe_perf(self, verdicts: dict[str, dict[str, Any]]) -> list[dict[str, Any]]:
@@ -501,6 +525,12 @@ class Watchdog:
                     "scored": int(self._shadow.get("scored") or 0),
                     "cost_delta": self._shadow.get("cost_delta"),
                 }
+        if r.scan_tripwire and self._scan_trip is not None:
+            # the LATEST scan block judges: its in-trace tripwire
+            # latched, the replay was truncated at the trip round, and
+            # the block drained — an active violation until a clean
+            # block lands (observe_scan_block(None) clears)
+            now[RULE_SCAN_TRIPWIRE] = dict(self._scan_trip)
         if self._perf_active:
             now[RULE_PERF] = {
                 "metrics": {

@@ -79,6 +79,7 @@ ENTRY_POINTS: dict[str, tuple[str, ...]] = {
         "sim_step",
     ),
     "bench/scan.py": ("_scan_rounds", "_fleet_scan_rounds"),
+    "telemetry/tripwire.py": ("tripwire_step", "fleet_tripwire_step"),
     "policies/hazard.py": ("detect_hazard",),
     "policies/scoring.py": ("node_features", "policy_scores", "choose_node"),
     "policies/victim.py": ("pick_victim", "deployment_group"),

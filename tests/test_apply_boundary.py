@@ -60,10 +60,16 @@ def test_scan_module_is_checked_with_its_own_allowlist(tmp_path):
     leak: the fleet helper's name does not legalize a sync in
     controller.py, and vice versa."""
     checker = _load_checker()
-    by_name = {p.name: allowed for p, allowed in checker.CHECKED.items()}
-    assert by_name["scan.py"] == frozenset({"pull_block"})
-    assert by_name["fleet.py"] == frozenset({"_pull_round_bundle"})
-    assert by_name["controller.py"] == frozenset()
+    # key by package-relative path: PR 15 added forecast/fleet.py to
+    # CHECKED, so bare basenames collide (two fleet.py entries)
+    by_name = {
+        p.relative_to(checker.PACKAGE).as_posix(): allowed
+        for p, allowed in checker.CHECKED.items()
+    }
+    assert by_name["bench/scan.py"] == frozenset({"pull_block"})
+    assert by_name["bench/fleet.py"] == frozenset({"_pull_round_bundle"})
+    assert by_name["bench/controller.py"] == frozenset()
+    assert by_name["forecast/fleet.py"] == frozenset()
     # a pull anywhere else in a scan-shaped module is flagged
     f = tmp_path / "scan.py"
     f.write_text(
@@ -72,9 +78,9 @@ def test_scan_module_is_checked_with_its_own_allowlist(tmp_path):
         "def decode_block(flat):\n"
         "    return pull(flat, site='oops')\n"        # stray: flagged
     )
-    hits = checker.find_raw_syncs(f, by_name["scan.py"])
+    hits = checker.find_raw_syncs(f, by_name["bench/scan.py"])
     assert [line for line, _ in hits] == [4]
     # the fleet allowlist does NOT legalize scan.py's site (and the
     # union default would — per-file scoping is the point)
-    hits_fleet = checker.find_raw_syncs(f, by_name["fleet.py"])
+    hits_fleet = checker.find_raw_syncs(f, by_name["bench/fleet.py"])
     assert [line for line, _ in hits_fleet] == [2, 4]

@@ -614,6 +614,10 @@ def test_remask_debt_survives_a_skipped_churn_round(registry):
     assert checked == len(res.rounds) and bad == []
 
 
+@pytest.mark.slow  # churn trace accounting stays pinned fast by
+# test_steady_churn_one_trace and test_acceptance_diurnal_autoscale_soak
+# above; this is the global-path repro variant (the code-review
+# regression) with its own ~30 s global_assign compile
 def test_global_rounds_under_churn_stay_trace_stable(registry):
     """The global solver path threads the same name-stripped device
     views as the greedy path: churn that renames pods/services must not

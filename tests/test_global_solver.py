@@ -147,6 +147,11 @@ def test_balance_weight_tradeoff():
     assert float(load_std(balanced)) <= float(load_std(packed)) + 1e-4
 
 
+@pytest.mark.slow  # masked-slot inertness through the global solver keeps
+# two fast pins: the static mask-threading gate (global_assign is an
+# ENTRY_POINT held by test_mask_threading's checker twin) and the
+# masked-tenant no-moves assert in test_fleet_global_solve_bit_exact_vs_solo;
+# this is the direct solo dynamic variant with its own ~20 s compile
 def test_invalid_pods_untouched():
     wm = mubench_workmodel_c()
     state = state_from_workmodel(wm, seed=2, pod_capacity=40)

@@ -1,0 +1,700 @@
+"""ISSUE 16: in-block tripwires — device-side health detection for the
+scanned schedules.
+
+The contracts under test:
+
+- **Trip-free bit-identity** — with the tripwire plane armed (the
+  default) and no rule firing, scanned records AND event streams stay
+  bit-identical to the sequential loop and to the tripwires-compiled-out
+  scanned run, at ONE counted ``round_end`` transfer per block, one
+  steady-state compile per tripwire variant.
+- **In-trace detection** — a cost blowup / a non-finite state / a
+  same-hazard-node streak trips INSIDE the ``lax.scan`` at the round the
+  host-side simulation of the rules predicts; the replay commits exactly
+  the rounds BEFORE the trip; the tripped round drains to the per-round
+  path under ``scan_drains_total{reason="tripwire"}``; and the FULL
+  record stream is still bit-identical to the sequential loop (the
+  drained round re-decides identically by per-round key parity).
+- **Ops surface** — ``scan_tripwires_total{rule}``, the ``scan_tripwire``
+  watchdog rule flipping /healthz, the flight-recorder bundle scoped to
+  the partial block, and the /healthz ``scan`` stanza.
+- **Satellites** — block-scaled /healthz staleness (no spurious 503
+  mid-block), burst-vs-paced watchdog judging, the ``telemetry report``
+  scan-plane lines, fleet composition (per-tenant latch, earliest-trip
+  shared commit prefix).
+
+Node counts in this file stay in the 24-31 range (prefix ``tw``) —
+test_scan.py owns 16-23 — so this file's trace pins compile fresh and
+cannot be satisfied by another file's cache entries.
+"""
+
+import json
+import time as time_mod
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends.sim import LoadModel, SimBackend
+from kubernetes_rescheduling_tpu.backends.sim_device import twin_of
+from kubernetes_rescheduling_tpu.bench.controller import run_controller
+from kubernetes_rescheduling_tpu.bench.round_end import (
+    METRIC_COST,
+    round_end_metrics,
+)
+from kubernetes_rescheduling_tpu.config import (
+    ControllerConfig,
+    ObsConfig,
+    ReconcileConfig,
+    RescheduleConfig,
+)
+from kubernetes_rescheduling_tpu.core.workmodel import mubench_workmodel_c
+from kubernetes_rescheduling_tpu.telemetry import get_registry
+from kubernetes_rescheduling_tpu.telemetry import tripwire as tw
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MetricsRegistry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.telemetry.server import (
+    HealthState,
+    OpsPlane,
+)
+from kubernetes_rescheduling_tpu.telemetry.watchdog import (
+    RULE_SCAN_TRIPWIRE,
+    SLORules,
+    Watchdog,
+)
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+
+
+@pytest.fixture()
+def registry():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+def _backend(n_nodes: int, seed: int = 0, cap_m: float = 20_000.0) -> SimBackend:
+    backend = SimBackend(
+        workmodel=mubench_workmodel_c(),
+        node_names=[f"tw{i}" for i in range(n_nodes)],
+        node_cpu_cap_m=cap_m,
+        seed=seed,
+        load=LoadModel(entry_rps=100.0, cost_per_req_m=8.0, idle_m=50.0),
+    )
+    backend.inject_imbalance(backend.node_names[0])
+    return backend
+
+
+TIMING_FIELDS = {
+    "decision_latencies_s", "decision_latency_s", "wall_s", "pipeline",
+}
+
+
+def _strip(rec) -> dict:
+    return {k: v for k, v in rec.as_dict().items() if k not in TIMING_FIELDS}
+
+
+def _events(log):
+    out = []
+    for r in log.records:
+        if r["event"] in ("decision", "round"):
+            out.append({
+                k: v for k, v in r.items()
+                if k not in ("ts", "decision_latency_s")
+            })
+    return out
+
+
+def _run(
+    *, scan_block: int, n_nodes: int, rounds: int, obs: ObsConfig = None,
+    algo: str = "communication", seed: int = 0, backend=None,
+    reconcile: ReconcileConfig = None, ops=None, with_logger: bool = True,
+):
+    cfg = RescheduleConfig(
+        algorithm=algo,
+        max_rounds=rounds,
+        sleep_after_action_s=0.0,
+        seed=seed,
+        controller=ControllerConfig(scan_block=scan_block),
+        obs=obs if obs is not None else ObsConfig(),
+        reconcile=reconcile if reconcile is not None else ReconcileConfig(),
+    )
+    logger = StructuredLogger(name="tw") if with_logger else None
+    result = run_controller(
+        backend if backend is not None else _backend(n_nodes, seed=seed),
+        cfg, key=jax.random.PRNGKey(seed), logger=logger, ops=ops,
+    )
+    return result, logger
+
+
+# ---------------- device half: the rule kernel itself --------------------
+
+
+def test_tripwire_step_rule_semantics(registry):
+    """Unit pins on the carry machine: cost/streak rules fire with the
+    right bits, the latch freezes later bits at 0, and the recorded
+    (trip_round, trip_mask) never move after the trip."""
+    state, _ = twin_of(_backend(24))
+    cfg = jnp.asarray([0.1, 0.0, 2.0], jnp.float32)
+    carry = tw.tripwire_init(10.0, 1.0)
+    # round 0: healthy — cost within 10%, first hazard sighting
+    carry, bits = tw.tripwire_step(
+        carry, state, jnp.asarray(10.5), jnp.asarray(1.0),
+        jnp.asarray(3), cfg,
+    )
+    assert int(bits) == 0 and not bool(carry[0])
+    # round 1: cost 12 > 1.1 * 10 AND node 3 repeats (streak 2)
+    carry, bits = tw.tripwire_step(
+        carry, state, jnp.asarray(12.0), jnp.asarray(1.0),
+        jnp.asarray(3), cfg,
+    )
+    assert int(bits) == tw.TRIP_COST_REGRESSION | tw.TRIP_HAZARD_STREAK
+    assert bool(carry[0]) and int(carry[1]) == 1 and int(carry[2]) == 10
+    # round 2: latched — bits 0 whatever the inputs, trip record frozen
+    carry, bits = tw.tripwire_step(
+        carry, state, jnp.asarray(99.0), jnp.asarray(9.0),
+        jnp.asarray(3), cfg,
+    )
+    assert int(bits) == 0
+    assert int(carry[1]) == 1 and int(carry[2]) == 10
+    assert tw.rules_from_mask(int(carry[2])) == (
+        "cost_regression", "hazard_streak",
+    )
+
+
+def test_split_tripwire_roundtrip_and_guard():
+    """The bundle tail strips exactly (K + 2 values) and a bundle too
+    small to carry one is a loud error, not a silent mis-slice."""
+    core = np.arange(7, dtype=np.float32)
+    tail = np.asarray([0, 1, 0, 2.0, 8.0], np.float32)  # K=3 bits + (r, m)
+    flat, report = tw.split_tripwire(
+        np.concatenate([core, tail]), rounds=3
+    )
+    np.testing.assert_array_equal(flat, core)
+    assert report.tripped and report.trip_round == 2
+    assert report.rules == ("hazard_streak",)
+    np.testing.assert_array_equal(report.bits, [0, 1, 0])
+    with pytest.raises(ValueError):
+        tw.split_tripwire(tail, rounds=3)
+    with pytest.raises(ValueError):
+        tw.split_fleet_tripwire(tail, rounds=3, tenants=2)
+
+
+def test_tripwire_config_validation():
+    cfg = RescheduleConfig(
+        algorithm="communication",
+        obs=ObsConfig(tripwire_cost_frac=0.2, tripwire_hazard_streak=3),
+    ).validate()
+    assert cfg.obs.scan_tripwires and cfg.obs.slo_scan_tripwire
+    for bad in (
+        dict(tripwire_cost_frac=-0.1),
+        dict(tripwire_load_factor=-1.0),
+        dict(tripwire_hazard_streak=-2),
+    ):
+        with pytest.raises(ValueError):
+            ObsConfig(**bad).validate()
+
+
+# ---------------- trip-free bit-identity (THE golden pin) ----------------
+
+
+def test_tripfree_bit_identical_on_off_sequential(registry):
+    """The golden pin: tripwires armed but silent, scanned records and
+    events bit-identical to BOTH the sequential loop and the
+    tripwires-compiled-out scanned run; one counted round_end transfer
+    per block; one steady-state compile per tripwire variant; zero
+    tripwire counters touched."""
+    rounds, block = 8, 3
+    fam = registry.counter("device_transfers_total", labelnames=("site",))
+    seq, seq_log = _run(scan_block=0, n_nodes=24, rounds=rounds)
+    assert fam.labels(site="round_end").value == rounds
+    on, on_log = _run(scan_block=block, n_nodes=24, rounds=rounds)
+    # 2 full blocks (1 pull each) + 2 drained tail rounds (1 each)
+    assert fam.labels(site="round_end").value == rounds + 4
+    off, off_log = _run(
+        scan_block=block, n_nodes=24, rounds=rounds,
+        obs=ObsConfig(scan_tripwires=False),
+    )
+    assert fam.labels(site="round_end").value == rounds + 8
+    for a, b, c in zip(seq.rounds, on.rounds, off.rounds):
+        assert _strip(a) == _strip(b) == _strip(c)
+    assert _events(seq_log) == _events(on_log) == _events(off_log)
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="scan_rounds").value == 2  # one per variant
+    trips = registry.counter("scan_tripwires_total", labelnames=("rule",))
+    assert all(trips.labels(rule=r).value == 0 for r in tw.TRIPWIRE_RULES)
+    drains = registry.counter("scan_drains_total", labelnames=("reason",))
+    assert drains.labels(reason="tripwire").value == 0
+    assert drains.labels(reason="tail").value == 4
+
+
+def _simulate_trips(costs, hazards, *, rounds, block, cost0, frac=0.0,
+                    streak_n=0):
+    """Host-side twin of the scan loop's trip schedule: which blocks
+    dispatch, where each trips, which rounds drain. Mirrors
+    ``_scanned_loop`` (block while >= k rounds remain, +1 drained round
+    after a trip, tail drained per round) and ``tripwire_step`` (f32
+    compare against the block-start baseline; streak reset at block
+    start)."""
+    f32 = np.float32
+    pos, trips, blocks = 0, [], 0
+    while rounds - pos >= block:
+        blocks += 1
+        base = f32(cost0 if pos == 0 else costs[pos - 1])
+        prev, streak, trip = None, 0, None
+        for i in range(block):
+            if frac > 0 and base > 0 and (
+                f32(costs[pos + i]) > f32(1.0 + f32(frac)) * base
+            ):
+                trip = (i, tw.TRIP_COST_REGRESSION)
+            name = hazards[pos + i]
+            if name is None:
+                prev, streak = None, 0
+            else:
+                streak = streak + 1 if name == prev else 1
+                prev = name
+                if streak_n > 0 and streak >= streak_n and trip is None:
+                    trip = (i, tw.TRIP_HAZARD_STREAK)
+            if trip is not None:
+                break
+        if trip is None:
+            pos += block
+        else:
+            trips.append((pos + trip[0], trip[1]))
+            pos += trip[0] + 1
+    return trips, blocks, rounds - pos  # trips, dispatches, tail rounds
+
+
+def _initial_cost(n_nodes: int, seed: int = 0) -> float:
+    state, graph = twin_of(_backend(n_nodes, seed=seed))
+    return float(round_end_metrics(state, graph, top_k=0)[METRIC_COST])
+
+
+# ---------------- in-trace detection: the acceptance soaks ----------------
+
+
+def test_cost_blowup_trips_in_trace_acceptance(registry):
+    """ISSUE 16 acceptance (cost half): the random policy inflates cost;
+    with a 5% regression wire the block trips IN-TRACE at exactly the
+    round the host-side rule simulation predicts, commits exactly the
+    pre-trip rounds, drains the tripped round under reason="tripwire" —
+    and the full record stream is STILL bit-identical to the sequential
+    loop (per-round key parity re-decides drained rounds identically)."""
+    rounds, block, frac = 12, 4, 0.05
+    seq, seq_log = _run(scan_block=0, n_nodes=25, rounds=rounds,
+                        algo="random")
+    costs = [r.communication_cost for r in seq.rounds]
+    hazards = [r.most_hazard for r in seq.rounds]
+    trips, blocks, tail = _simulate_trips(
+        costs, hazards, rounds=rounds, block=block,
+        cost0=_initial_cost(25), frac=frac,
+    )
+    assert trips, "seed must produce at least one cost trip"
+    fam = registry.counter("device_transfers_total", labelnames=("site",))
+    pulls0 = fam.labels(site="round_end").value
+    sc, sc_log = _run(
+        scan_block=block, n_nodes=25, rounds=rounds, algo="random",
+        obs=ObsConfig(tripwire_cost_frac=frac),
+    )
+    # the whole stream — committed scanned rounds AND drained trip
+    # rounds — matches the sequential loop bit-for-bit
+    assert len(sc.rounds) == rounds
+    for a, b in zip(seq.rounds, sc.rounds):
+        assert _strip(a) == _strip(b)
+    assert _events(seq_log) == _events(sc_log)
+    # one pull per dispatch + one per drained round, nothing else
+    assert fam.labels(site="round_end").value - pulls0 == (
+        blocks + len(trips) + tail
+    )
+    fam_t = registry.counter("scan_tripwires_total", labelnames=("rule",))
+    assert fam_t.labels(rule="cost_regression").value == len(trips)
+    drains = registry.counter("scan_drains_total", labelnames=("reason",))
+    assert drains.labels(reason="tripwire").value == len(trips)
+    # the logged trip events carry the absolute round + decoded rule
+    logged = [r for r in sc_log.records if r["event"] == "scan_tripwire"]
+    # controller rounds are 1-based; the simulation counts from 0
+    assert [(e["round"], e["rules"]) for e in logged] == [
+        (rnd + 1, ["cost_regression"]) for rnd, _ in trips
+    ]
+    assert all(e["mask"] == tw.TRIP_COST_REGRESSION for e in logged)
+
+
+def test_hazard_streak_trips_in_trace(registry):
+    """The persistence rule: a most-hazard node repeating N consecutive
+    rounds inside one block trips at the round the host simulation of
+    the streak carry predicts; the stream stays sequential-identical."""
+    rounds, block, streak_n = 10, 5, 2
+    seq, _ = _run(scan_block=0, n_nodes=26, rounds=rounds)
+    hazards = [r.most_hazard for r in seq.rounds]
+    trips, blocks, tail = _simulate_trips(
+        [r.communication_cost for r in seq.rounds], hazards,
+        rounds=rounds, block=block, cost0=0.0, streak_n=streak_n,
+    )
+    assert trips, "seed must produce a hazard streak"
+    sc, sc_log = _run(
+        scan_block=block, n_nodes=26, rounds=rounds,
+        obs=ObsConfig(tripwire_hazard_streak=streak_n),
+    )
+    assert len(sc.rounds) == rounds
+    for a, b in zip(seq.rounds, sc.rounds):
+        assert _strip(a) == _strip(b)
+    fam_t = registry.counter("scan_tripwires_total", labelnames=("rule",))
+    assert fam_t.labels(rule="hazard_streak").value == len(trips)
+    logged = [r for r in sc_log.records if r["event"] == "scan_tripwire"]
+    assert [(e["round"], tuple(e["rules"])) for e in logged] == [
+        (rnd + 1, ("hazard_streak",)) for rnd, _ in trips  # 1-based rounds
+    ]
+
+
+def test_nonfinite_detection_latency_acceptance(registry, tmp_path):
+    """ISSUE 16 acceptance (corruption half): a NaN injected into the
+    monitor stream (admission guard off — the tripwire is the in-trace
+    backstop when host-side guards cannot see device-resident state)
+    trips every block at round 0. The replay commits ZERO rounds, the
+    loop still makes one round of progress per block attempt (the
+    drained round), and the whole ops surface reflects it: counters,
+    /healthz scan stanza, the scan_tripwire watchdog rule (503), and a
+    flight-recorder bundle carrying the trip bitmask."""
+    rounds, block = 4, 2
+    backend = _backend(27)
+    real_monitor = backend.monitor
+
+    def poisoned():
+        snap = real_monitor()
+        pod_cpu = np.asarray(snap.pod_cpu).copy()
+        pod_cpu[int(np.flatnonzero(np.asarray(snap.pod_valid))[0])] = np.nan
+        return snap.replace(pod_cpu=jnp.asarray(pod_cpu))
+
+    backend.monitor = poisoned
+    ops = OpsPlane.from_config(
+        ObsConfig(flight_recorder_rounds=8),
+        registry=registry,
+        bundle_dir=str(tmp_path),
+    )
+    res, log = _run(
+        scan_block=block, n_nodes=27, rounds=rounds, backend=backend,
+        reconcile=ReconcileConfig(admission=False), ops=ops,
+    )
+    # progress guarantee: every block attempt commits 0 scanned rounds
+    # and drains exactly 1 — the run still completes all its rounds.
+    # Blocks dispatch while >= block rounds remain, each consuming one
+    # drained round, so rounds - block + 1 attempts trip; the rest is a
+    # plain tail drain.
+    trips_n = rounds - block + 1
+    assert len(res.rounds) == rounds
+    fam_t = registry.counter("scan_tripwires_total", labelnames=("rule",))
+    assert fam_t.labels(rule="non_finite").value == trips_n
+    drains = registry.counter("scan_drains_total", labelnames=("reason",))
+    assert drains.labels(reason="tripwire").value == trips_n
+    logged = [r for r in log.records if r["event"] == "scan_tripwire"]
+    assert len(logged) == trips_n
+    assert all(
+        e["block_round"] == 0 and e["rules"] == ["non_finite"]
+        and e["mask"] == tw.TRIP_NON_FINITE
+        for e in logged
+    )
+    # detection latency: the trip is recorded AT the poisoned round, not
+    # K rounds later — each block's trip round IS its start round
+    assert [e["round"] for e in logged] == [e["block_start"] for e in logged]
+    # /healthz: the scan stanza and the active watchdog rule → 503
+    payload, healthy = ops.health.snapshot()
+    assert not healthy
+    scan = payload["scan"]
+    assert scan["blocks"] == trips_n and scan["tripped_blocks"] == trips_n
+    assert scan["drains"] == {"tripwire": trips_n, "tail": rounds - trips_n}
+    assert scan["last_trip"]["block_round"] == 0
+    assert RULE_SCAN_TRIPWIRE in ops.watchdog.active
+    assert payload["slo"]["healthy"] is False
+    # the flight-recorder bundle is scoped to the partial block and
+    # carries the decoded trip
+    bundles = sorted(tmp_path.glob("flight_*_scan_tripwire.json"))
+    assert len(bundles) == trips_n
+    dumped = json.loads(bundles[0].read_text())
+    assert dumped["trip"]["rules"] == ["non_finite"]
+    assert dumped["trip"]["mask"] == tw.TRIP_NON_FINITE
+    assert dumped["trip"]["block_round"] == 0
+
+
+def test_clean_block_clears_watchdog_rule(registry, tmp_path):
+    """Recovery: a tripped block flips the scan_tripwire rule, the next
+    clean block clears it — /healthz goes 503 and back without a
+    restart."""
+    ops = OpsPlane.from_config(
+        ObsConfig(), registry=registry, bundle_dir=str(tmp_path)
+    )
+    ops.bind(algorithm="communication")  # wires health.watchdog, as a run does
+    ops.observe_scan_block(
+        rounds=4, trip={"round": 7, "block_round": 3, "rules": ["non_finite"]}
+    )
+    assert RULE_SCAN_TRIPWIRE in ops.watchdog.active
+    _, healthy = ops.health.snapshot()
+    assert not healthy
+    ops.observe_scan_block(rounds=4, trip=None)
+    assert RULE_SCAN_TRIPWIRE not in ops.watchdog.active
+    _, healthy = ops.health.snapshot()
+    assert healthy
+    # opt-out: with the rule disabled a trip never flips health
+    ops2 = OpsPlane.from_config(
+        ObsConfig(slo_scan_tripwire=False), registry=registry,
+        bundle_dir=str(tmp_path),
+    )
+    ops2.bind(algorithm="communication")
+    ops2.observe_scan_block(
+        rounds=4, trip={"round": 1, "block_round": 1, "rules": ["non_finite"]}
+    )
+    assert RULE_SCAN_TRIPWIRE not in ops2.watchdog.active
+
+
+# ---------------- satellite 1: block-scaled staleness ---------------------
+
+
+def test_healthz_staleness_scales_with_inflight_block(registry, monkeypatch):
+    """A dispatched K-round block is K rounds of healthy silence: the
+    staleness budget scales to K * max_round_age_s while the block is in
+    flight (no spurious 503 — pinned), genuine hangs past the scaled
+    budget still 503, and the next committed round restores the
+    per-round budget."""
+    health = HealthState(max_round_age_s=60.0)
+    health.mark_round()
+    real_mono = time_mod.monotonic
+    monkeypatch.setattr(time_mod, "monotonic", lambda: real_mono() + 120.0)
+    payload, healthy = health.snapshot()
+    assert payload["stale"] and not healthy  # per-round budget: stale
+    health.mark_block_inflight(4)  # budget now 240s
+    payload, healthy = health.snapshot()
+    assert not payload["stale"] and healthy, "mid-block 503 must not fire"
+    monkeypatch.setattr(time_mod, "monotonic", lambda: real_mono() + 300.0)
+    payload, healthy = health.snapshot()
+    assert payload["stale"] and not healthy  # a genuinely hung block
+    monkeypatch.setattr(time_mod, "monotonic", lambda: real_mono() + 330.0)
+    health.mark_round()  # block committed: back to the per-round budget
+    payload, healthy = health.snapshot()
+    assert not payload["stale"] and healthy
+    monkeypatch.setattr(time_mod, "monotonic", lambda: real_mono() + 420.0)
+    assert not health.snapshot()[1]  # 90s > 60s: per-round budget again
+
+
+# ---------------- satellite 3: burst-vs-paced watchdog judging ------------
+
+
+def test_watchdog_burst_flush_matches_paced(registry):
+    """A scan block flushes K records through observe_round back-to-back;
+    the watchdog must return the SAME verdicts as the paced sequential
+    loop — pinned on the cost-regression and reconcile rules, with wall
+    time advancing between paced observations (rules judge on values and
+    round indices, never inter-arrival time)."""
+    def record(rnd, cost, drift=None):
+        rec = types.SimpleNamespace(
+            round=rnd, decision_latency_s=0.01, communication_cost=cost,
+        )
+        if drift is not None:
+            rec.reconcile = {"drift_pods": drift}
+        return rec
+
+    stream = [
+        record(1, 10.0), record(2, 9.0), record(3, 8.0),
+        record(4, 20.0, drift=3), record(5, 21.0, drift=3),
+    ]
+    rules = dict(
+        window=8, min_samples=2, cost_regression_frac=0.5,
+        max_retraces=0, reconcile_max_drift_pods=1,
+    )
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    paced = Watchdog(SLORules(**rules), registry=reg_a)
+    real_time = time_mod.time
+    for i, rec in enumerate(stream):
+        # paced: seconds elapse between rounds (monkeypatch-free: the
+        # watchdog never reads the clock to judge, only to timestamp)
+        time_mod.time = lambda off=i: real_time() + 10.0 * off
+        try:
+            paced.observe_round(rec)
+        finally:
+            time_mod.time = real_time
+    burst = Watchdog(SLORules(**rules), registry=reg_b)
+    for rec in stream:  # the scan replay: K observations, zero gaps
+        burst.observe_round(rec)
+    assert set(paced.active) == set(burst.active) == {
+        "comm_cost_regression", "reconcile_divergence",
+    }
+    fam = "slo_violations_total"
+    for rule in ("comm_cost_regression", "reconcile_divergence"):
+        assert (
+            reg_a.counter(fam, labelnames=("rule",)).labels(rule=rule).value
+            == reg_b.counter(fam, labelnames=("rule",)).labels(rule=rule).value
+            == 1
+        )
+    assert paced.healthy == burst.healthy is False
+
+
+# ---------------- satellite 2: report + /healthz scan surface -------------
+
+
+def test_report_surfaces_scan_plane(registry, tmp_path):
+    """``telemetry report`` leads the metrics dump with the scan-plane
+    digest (block size, blocks, drain + tripwire breakdowns) and
+    renders scan_tripwire events in the event summary."""
+    from kubernetes_rescheduling_tpu.telemetry.report import summarize_file
+
+    registry.counter("scan_blocks_total", "t").inc(3)
+    registry.gauge("scan_rounds_per_dispatch", "t").set(8)
+    drains = registry.counter(
+        "scan_drains_total", "t", labelnames=("reason",)
+    )
+    drains.labels(reason="tail").inc(2)
+    drains.labels(reason="tripwire").inc()
+    registry.counter(
+        "scan_tripwires_total", "t", labelnames=("rule",)
+    ).labels(rule="cost_regression").inc()
+    metrics_path = tmp_path / "metrics.jsonl"
+    registry.dump_jsonl(metrics_path)
+    out = summarize_file(metrics_path)
+    assert "scan plane: blocks=3 block_rounds=8" in out
+    assert "drains: tail×2, tripwire×1" in out
+    assert "tripwires: cost_regression×1" in out
+
+    events_path = tmp_path / "events.jsonl"
+    events_path.write_text(
+        json.dumps({"event": "scan_tripwire", "round": 9,
+                    "rules": ["non_finite"]}) + "\n"
+        + json.dumps({"event": "round", "round": 9}) + "\n"
+    )
+    out = summarize_file(events_path)
+    assert "scan tripwires: r9 (non_finite)" in out
+
+
+def test_cli_tripwire_flags_smoke(registry, capsys):
+    """The CLI knobs thread into the run config: a scanned run with a
+    tripwire threshold set completes, and --no-scan-tripwires runs the
+    compiled-out variant."""
+    from kubernetes_rescheduling_tpu.cli import main as cli_main
+
+    rc = cli_main([
+        "reschedule", "--scan-block", "2", "--rounds", "2",
+        "--scenario", "mubench", "--imbalance",
+        "--tripwire-hazard-streak", "3",
+    ])
+    assert rc == 0
+    assert len(json.loads(capsys.readouterr().out)["rounds"]) == 2
+    rc = cli_main([
+        "reschedule", "--scan-block", "2", "--rounds", "2",
+        "--scenario", "mubench", "--imbalance", "--no-scan-tripwires",
+    ])
+    assert rc == 0
+    assert len(json.loads(capsys.readouterr().out)["rounds"]) == 2
+
+
+# ---------------- fleet composition ---------------------------------------
+
+
+def _fleet_run(scan_block: int, obs: ObsConfig = None, *, rounds: int = 6,
+               algo: str = "communication"):
+    from kubernetes_rescheduling_tpu.backends.fleet import make_fleet
+    from kubernetes_rescheduling_tpu.bench.fleet import run_fleet_controller
+    from kubernetes_rescheduling_tpu.config import FleetConfig
+
+    fleet = make_fleet("mubench", 4, seed=5)
+    fleet.inject_imbalance()
+    cfg = RescheduleConfig(
+        algorithm=algo,
+        max_rounds=rounds,
+        sleep_after_action_s=0.0,
+        fleet=FleetConfig(tenants=4),
+        controller=ControllerConfig(scan_block=scan_block),
+        obs=obs if obs is not None else ObsConfig(),
+    )
+    return run_fleet_controller(fleet, cfg, key=jax.random.PRNGKey(5))
+
+
+def test_fleet_tripfree_bit_identical(registry):
+    """Fleet golden pin: tripwires armed and silent, per-tenant streams
+    bit-identical to the sequential fleet loop AND the compiled-out
+    scanned fleet, one pull per block, one compile per variant."""
+    seq = _fleet_run(0)
+    fam = registry.counter("device_transfers_total", labelnames=("site",))
+    on = _fleet_run(3)
+    assert fam.labels(site="round_end").value == 2  # 6 rounds / block of 3
+    off = _fleet_run(3, ObsConfig(scan_tripwires=False))
+    assert fam.labels(site="round_end").value == 4
+    assert seq.tenants == on.tenants == off.tenants
+    for name in seq.tenants:
+        a, b, c = seq.results[name], on.results[name], off.results[name]
+        assert len(a.rounds) == len(b.rounds) == len(c.rounds) == 6
+        for ra, rb, rc in zip(a.rounds, b.rounds, c.rounds):
+            assert _strip(ra) == _strip(rb) == _strip(rc)
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="fleet_scan_rounds").value == 2
+    trips = registry.counter("scan_tripwires_total", labelnames=("rule",))
+    assert all(trips.labels(rule=r).value == 0 for r in tw.TRIPWIRE_RULES)
+
+
+def test_fleet_trip_truncates_to_shared_prefix(registry):
+    """A tripped fleet block commits the EARLIEST trip round across
+    tenants (one shared prefix — max_rounds accounting holds for every
+    tenant), counts the per-tenant budget-gated twin, and the full
+    per-tenant streams are still bit-identical to the sequential fleet
+    loop (discarded healthy-tenant rounds re-run under key parity)."""
+    rounds = 6
+    seq = _fleet_run(0, rounds=rounds, algo="random")
+    obs = ObsConfig(tripwire_cost_frac=0.05)
+    sc = _fleet_run(3, obs, rounds=rounds, algo="random")
+    fam_t = registry.counter("scan_tripwires_total", labelnames=("rule",))
+    n_trips = fam_t.labels(rule="cost_regression").value
+    assert n_trips >= 1, "seeded random fleet must trip the cost wire"
+    drains = registry.counter("scan_drains_total", labelnames=("reason",))
+    assert drains.labels(reason="tripwire").value >= 1
+    # per-tenant twin counted through the budget gate
+    fleet_fam = registry.counter(
+        "fleet_scan_tripwires_total", labelnames=("tenant",)
+    )
+    per_tenant = sum(
+        fleet_fam.labels(tenant=name).value for name in seq.tenants
+    )
+    assert per_tenant == n_trips
+    # every tenant still completes every round, bit-identical
+    assert seq.tenants == sc.tenants
+    for name in seq.tenants:
+        a, b = seq.results[name], sc.results[name]
+        assert len(a.rounds) == len(b.rounds) == rounds
+        for ra, rb in zip(a.rounds, b.rounds):
+            assert _strip(ra) == _strip(rb)
+
+
+# ---------------- slow soaks ----------------------------------------------
+
+
+@pytest.mark.slow  # long-horizon trip-free parity: the on/off/sequential bit-identity stays pinned fast by test_tripfree_bit_identical_on_off_sequential above — this is the 40-round redundant variant
+def test_tripfree_long_soak_bit_identical(registry):
+    rounds, block = 40, 8
+    seq, seq_log = _run(scan_block=0, n_nodes=28, rounds=rounds)
+    on, on_log = _run(scan_block=block, n_nodes=28, rounds=rounds)
+    for a, b in zip(seq.rounds, on.rounds):
+        assert _strip(a) == _strip(b)
+    assert _events(seq_log) == _events(on_log)
+    trips = registry.counter("scan_tripwires_total", labelnames=("rule",))
+    assert all(trips.labels(rule=r).value == 0 for r in tw.TRIPWIRE_RULES)
+
+
+@pytest.mark.slow  # repeated-trip soak: single-trip detection latency + stream identity stay pinned fast by test_cost_blowup_trips_in_trace_acceptance above — this drives many trips through one run
+def test_cost_blowup_many_trips_soak(registry):
+    rounds, block, frac = 24, 4, 0.02
+    seq, _ = _run(scan_block=0, n_nodes=29, rounds=rounds, algo="random")
+    costs = [r.communication_cost for r in seq.rounds]
+    hazards = [r.most_hazard for r in seq.rounds]
+    trips, _, _ = _simulate_trips(
+        costs, hazards, rounds=rounds, block=block,
+        cost0=_initial_cost(29), frac=frac,
+    )
+    assert len(trips) >= 2
+    sc, _ = _run(
+        scan_block=block, n_nodes=29, rounds=rounds, algo="random",
+        obs=ObsConfig(tripwire_cost_frac=frac),
+    )
+    for a, b in zip(seq.rounds, sc.rounds):
+        assert _strip(a) == _strip(b)
+    fam_t = registry.counter("scan_tripwires_total", labelnames=("rule",))
+    assert fam_t.labels(rule="cost_regression").value == len(trips)

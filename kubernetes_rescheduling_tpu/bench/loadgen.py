@@ -718,6 +718,29 @@ def service_rate_series(
     )
 
 
+def open_loop_arrivals(
+    rate_rps: float, n: int, seed: int = 0
+) -> np.ndarray:
+    """Seeded open-loop arrival offsets: ``n`` cumulative exponential
+    inter-arrival gaps at mean rate ``rate_rps`` — a Poisson arrival
+    process, f64[n] seconds from stream start.
+
+    Open-loop means arrival times are drawn INDEPENDENTLY of service
+    completions (the reference's curl fleet fires on its own clock,
+    release1.sh:29-42): a slow server faces a growing queue instead of a
+    politely backing-off client, which is exactly the regime where
+    coordinated-omission-free tail latency and counted shedding are
+    measured. The serving bench cell and the concurrency soak both drive
+    :class:`~kubernetes_rescheduling_tpu.serving.ServingEngine` with
+    this schedule."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / float(rate_rps), size=int(n)))
+
+
 def new_samples() -> _Samples:
     """Fresh accumulator for a multi-segment phase (reference release2.sh)."""
     return _Samples()

@@ -235,8 +235,30 @@ def _obs_config(args, **overrides):
         tripwire_cost_frac=args.tripwire_cost_frac,
         tripwire_load_factor=args.tripwire_load_factor,
         tripwire_hazard_streak=args.tripwire_hazard_streak,
+        slo_serving_p99_ms=getattr(args, "slo_serving_p99_ms", 0.0),
         **overrides,
     )
+
+
+def _serving_config(args):
+    """The ServingConfig a run command builds from its --place* flags
+    (None flags fall through to the frozen block's defaults)."""
+    from kubernetes_rescheduling_tpu.config import ServingConfig
+
+    base = ServingConfig(enabled=bool(getattr(args, "place", False)))
+    overrides = {
+        k: v
+        for k, v in (
+            ("max_batch", getattr(args, "place_max_batch", None)),
+            ("queue_depth", getattr(args, "place_queue_depth", None)),
+            ("batch_window_ms", getattr(args, "place_window_ms", None)),
+            ("deadline_ms", getattr(args, "place_deadline_ms", None)),
+        )
+        if v is not None
+    }
+    import dataclasses as _dc
+
+    return _dc.replace(base, **overrides) if overrides else base
 
 
 def _pipeline_config(args):
@@ -280,14 +302,53 @@ def _add_serve_flags(parser: argparse.ArgumentParser) -> None:
         help="serve the live ops plane on 127.0.0.1:PORT while the run "
              "executes: /metrics (Prometheus exposition from the live "
              "registry), /healthz (breaker + SLO + staleness; 503 when "
-             "unhealthy), /events (recent structured events). 0 picks an "
-             "ephemeral port. Also arms the flight recorder (bundle on "
-             "breaker-open/crash/SIGUSR1) and the SLO watchdog",
+             "unhealthy), /events (recent structured events), POST /place "
+             "(with --place). 0 picks an ephemeral port. Also arms the "
+             "flight recorder (bundle on breaker-open/crash/SIGUSR1) and "
+             "the SLO watchdog",
     )
     parser.add_argument(
         "--bundle-dir", default=None, metavar="DIR",
         help="where flight-recorder bundles land (default: the obs "
              "config's bundle_dir, ./flight_recorder)",
+    )
+    parser.add_argument(
+        "--place", action="store_true",
+        help="serving mode: attach the request-grain placement service "
+             "(serving/) behind POST /place on the ops server — admit one "
+             "pod/deployment spec per request, score it against the "
+             "device-resident state with the run's greedy policy, answer "
+             "with placement + explain bundle + per-stage timings. "
+             "Requires --serve and a greedy algorithm",
+    )
+    parser.add_argument(
+        "--place-max-batch", type=int, default=None, metavar="B",
+        help="serving batcher: static batch shape coalesced dispatches "
+             "pad to (default: the [serving] block's max_batch, 8)",
+    )
+    parser.add_argument(
+        "--place-queue-depth", type=int, default=None, metavar="N",
+        help="serving admission queue bound; arrivals beyond it shed "
+             "immediately (default: the [serving] block's queue_depth, 64)",
+    )
+    parser.add_argument(
+        "--place-window-ms", type=float, default=None, metavar="MS",
+        help="serving batch-formation window: how long the batcher holds "
+             "the first dequeued request open for company (default: the "
+             "[serving] block's batch_window_ms, 2.0)",
+    )
+    parser.add_argument(
+        "--place-deadline-ms", type=float, default=None, metavar="MS",
+        help="default per-request deadline; requests still queued past "
+             "it complete 'timeout' without occupying a batch slot "
+             "(default: the [serving] block's deadline_ms, 250; 0 = none)",
+    )
+    parser.add_argument(
+        "--slo-serving-p99-ms", type=float, default=0.0, metavar="MS",
+        help="serving_p99 watchdog rule: rolling-window p99 request "
+             "latency above this many ms flips /healthz to 503 and dumps "
+             "a flight-recorder bundle with the in-flight request ring "
+             "(0 = rule off)",
     )
 
 
@@ -855,6 +916,16 @@ def cmd_reschedule(args) -> dict:
         ):
             if flag:
                 raise SystemExit(f"--shadow is incompatible with {why}")
+    if args.place and args.fleet:
+        raise SystemExit(
+            "--place is a solo-loop plane: serving scores against ONE "
+            "backend's snapshot (per-tenant serving is future work)"
+        )
+    if args.place and args.shadow:
+        raise SystemExit(
+            "--place is incompatible with --shadow: the replay backend's "
+            "fresh-snapshot contract cannot feed a second consumer"
+        )
     if args.fleet:
         return cmd_fleet_reschedule(args, algo)
     if args.backend == "k8s" and args.churn_profile != "none":
@@ -928,8 +999,40 @@ def cmd_reschedule(args) -> dict:
         ),
         perf=PerfConfig(ledger_path=args.perf_ledger),
         obs=_obs_config(args),
+        serving=_serving_config(args),
     )
     ops, logger = _build_ops_plane(args, cfg)
+    engine = None
+    if args.place:
+        # config.validate() rejects the same compositions; surface them
+        # as clean CLI exits before any engine work
+        if args.serve is None:
+            raise SystemExit(
+                "--place requires --serve PORT: the ops plane's HTTP "
+                "server is the serving front (POST /place)"
+            )
+        from kubernetes_rescheduling_tpu.config import POLICIES
+        from kubernetes_rescheduling_tpu.serving import ServingEngine
+
+        if algo not in POLICIES:
+            raise SystemExit(
+                "--place requires a greedy algorithm (the serving plane "
+                f"scores requests with the greedy machinery): got {algo!r}"
+            )
+        engine = ServingEngine(
+            backend,
+            config=cfg.serving,
+            policy=algo,
+            threshold=cfg.hazard_threshold_pct,
+            seed=cfg.seed,
+            top_k=cfg.obs.explain_top_k,
+            ops=ops,
+        ).start()
+        ops.bind_serving(engine)
+        sys.stderr.write(
+            f"serving: POST http://127.0.0.1:{ops.server.port}/place "
+            f"{{\"service\": <name>}}\n"
+        )
     try:
         result = run_controller(
             backend, cfg, key=jax.random.PRNGKey(args.seed),
@@ -937,6 +1040,8 @@ def cmd_reschedule(args) -> dict:
         )
         perf = _reschedule_perf(args, cfg, result, ops, algo)
     finally:
+        if engine is not None:
+            engine.stop()
         if ops is not None:
             ops.close()
     out = {

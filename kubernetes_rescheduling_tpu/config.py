@@ -489,6 +489,14 @@ class ObsConfig:
                                          # clean block lands (only scan runs
                                          # carry the data, so the per-round
                                          # path never trips it)
+    slo_serving_p99_ms: float = 0.0      # serving_p99 SLO rule: the serving
+                                         # plane's rolling-window p99
+                                         # request latency exceeding this
+                                         # many ms is a violation (0 = off;
+                                         # only judged once the window holds
+                                         # slo_min_samples completed
+                                         # requests, so idle serving never
+                                         # trips it)
 
     def validate(self) -> "ObsConfig":
         if self.serve_port is not None and not (0 <= self.serve_port <= 65535):
@@ -558,6 +566,11 @@ class ObsConfig:
                 "tripwire_hazard_streak must be >= 0 (0 disables the "
                 "hazard_streak tripwire rule)"
             )
+        if self.slo_serving_p99_ms < 0:
+            raise ValueError(
+                "slo_serving_p99_ms must be >= 0 (0 disables the "
+                "serving_p99 rule)"
+            )
         return self
 
 
@@ -589,6 +602,62 @@ class PerfConfig:
             )
         if self.min_history < 1:
             raise ValueError("perf min_history must be >= 1")
+        return self
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Serving-plane block (``[serving]`` in TOML): the request-grain
+    placement service (``serving/``) behind ``POST /place``. jax-free,
+    like the other blocks, so config import stays light.
+
+    ``enabled`` turns the plane on under the CLI (the engine itself can
+    always be built programmatically). ``max_batch`` is the static batch
+    shape every coalesced dispatch pads to — the one-compiled-trace
+    invariant; ``batch_window_ms`` how long the batcher holds the first
+    dequeued request open for company; ``queue_depth`` the bounded
+    admission queue (arrivals beyond it shed immediately, counted
+    ``serving_shed_total{reason="queue_full"}``); ``deadline_ms`` the
+    default per-request deadline (requests still queued past it complete
+    ``timeout`` without occupying a batch slot; 0 = no deadline);
+    ``window`` the rolling completed-request window behind the /healthz
+    percentiles and the ``serving_p99`` watchdog rule; ``ring`` the
+    bounded recent-request ring flight-recorder bundles capture."""
+
+    enabled: bool = False
+    max_batch: int = 8
+    batch_window_ms: float = 2.0
+    queue_depth: int = 64
+    deadline_ms: float = 250.0
+    window: int = 256
+    ring: int = 32
+
+    def validate(self) -> "ServingConfig":
+        if self.max_batch < 1:
+            raise ValueError(
+                f"serving max_batch must be >= 1, got {self.max_batch}"
+            )
+        if self.batch_window_ms < 0:
+            raise ValueError(
+                f"serving batch_window_ms must be >= 0 (0 = dispatch "
+                f"whatever is queued immediately), got {self.batch_window_ms}"
+            )
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"serving queue_depth must be >= 1, got {self.queue_depth}"
+            )
+        if self.deadline_ms < 0:
+            raise ValueError(
+                f"serving deadline_ms must be >= 0 (0 = no deadline), "
+                f"got {self.deadline_ms}"
+            )
+        if self.window < 2:
+            raise ValueError(
+                f"serving window must be >= 2 (percentiles over one "
+                f"sample judge nothing), got {self.window}"
+            )
+        if self.ring < 1:
+            raise ValueError(f"serving ring must be >= 1, got {self.ring}")
         return self
 
 
@@ -700,6 +769,10 @@ class RescheduleConfig:
     # Performance ledger: append-only perf history + rolling-window
     # regression detection — see PerfConfig.
     perf: PerfConfig = field(default_factory=PerfConfig)
+    # Serving plane: the request-grain placement service behind
+    # POST /place (bounded batcher, per-request deadlines, stage-span
+    # telemetry) — see ServingConfig.
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
     def validate(self) -> "RescheduleConfig":
         valid = set(POLICIES) | {"global", "proactive"}
@@ -809,6 +882,13 @@ class RescheduleConfig:
                 )
         self.obs.validate()
         self.perf.validate()
+        self.serving.validate()
+        if self.serving.enabled and self.algorithm not in POLICIES:
+            raise ValueError(
+                "the serving plane scores requests with the greedy "
+                f"machinery: serving.enabled requires a greedy algorithm "
+                f"{sorted(POLICIES)}, got {self.algorithm!r}"
+            )
         self.reconcile.validate()
         self.shadow.validate()
         if self.shadow.enabled:
@@ -946,4 +1026,6 @@ class RescheduleConfig:
             data["obs"] = ObsConfig(**data["obs"])
         if isinstance(data.get("perf"), dict):
             data["perf"] = PerfConfig(**data["perf"])
+        if isinstance(data.get("serving"), dict):
+            data["serving"] = ServingConfig(**data["serving"])
         return cls(**data).validate()

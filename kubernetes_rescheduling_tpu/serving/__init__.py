@@ -1,0 +1,34 @@
+"""The serving plane: request-grain placement as a query service.
+
+The round loop answers "which pod should move" once per round; this
+package answers "where should THIS pod go, now" at pod-creation rate —
+the placements/sec + p99-latency axis of ROADMAP item 3. One solo kernel
+(:func:`serving.kernel.place_one`) scores a single admitted request
+against the device-resident cluster state with the existing greedy
+machinery (one dispatch, no solve); a bounded batcher
+(:class:`serving.engine.ServingEngine`) coalesces concurrent arrivals
+into ONE vmapped dispatch (:func:`serving.kernel.place_batch`), with
+per-request decisions bit-identical to the solo kernel. The ops plane's
+``POST /place`` endpoint (``telemetry.server``) is the HTTP front.
+"""
+
+from kubernetes_rescheduling_tpu.serving.engine import (
+    OUTCOME_NO_CANDIDATE,
+    OUTCOME_PLACED,
+    OUTCOME_SHED,
+    OUTCOME_TIMEOUT,
+    PlaceResult,
+    ServingEngine,
+)
+from kubernetes_rescheduling_tpu.serving.kernel import place_batch, place_one
+
+__all__ = [
+    "OUTCOME_NO_CANDIDATE",
+    "OUTCOME_PLACED",
+    "OUTCOME_SHED",
+    "OUTCOME_TIMEOUT",
+    "PlaceResult",
+    "ServingEngine",
+    "place_batch",
+    "place_one",
+]

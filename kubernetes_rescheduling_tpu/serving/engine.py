@@ -1,0 +1,600 @@
+"""The serving engine: bounded queue → batcher → one vmapped dispatch.
+
+Host-side request plumbing around :mod:`serving.kernel`, instrumented
+end to end from day one:
+
+- **Bounded admission**: ``place()`` sheds immediately (counted
+  ``serving_shed_total{reason="queue_full"}``) when the queue is at
+  ``queue_depth``, and rejects unknown services with ``ValueError``
+  before a request object exists — the HTTP front maps that to 400.
+- **Coalescing batcher**: one daemon thread collects up to ``max_batch``
+  requests within ``batch_window_ms`` of the first dequeue and issues
+  ONE vmapped ``place_batch`` dispatch, padded to the static
+  ``max_batch`` shape so steady state holds exactly one compiled trace
+  (``jax_traces_total{fn="serving_place"} == 1`` — the soak's pin).
+- **Per-request deadline**: a request whose deadline passed by dequeue
+  time is completed ``timeout`` without occupying a batch slot.
+- **Exact accounting**: outcomes are single-owner — ``place()`` decides
+  sheds at admission, the batcher decides everything it dequeued — so
+  ``placed + no_candidate + shed + timed_out == submitted`` holds under
+  any interleaving (the seeded concurrency soak asserts it).
+- **Stage spans**: every completed request carries queue-wait /
+  batch-formation / device-dispatch / decode / total, published to the
+  micro-bucket ``serving_request_seconds{stage}`` families
+  (``registry.MICRO_BUCKETS`` — request latencies live orders of
+  magnitude below the round-scale default buckets).
+- **Snapshot admission**: cluster state enters ONLY through
+  :meth:`ServingEngine._admitted_snapshot` — ``backend.monitor()``
+  routed through the admission guard, statically enforced by
+  ``scripts/check_snapshot_admission.py`` like the controller's monitor
+  path.
+
+The rolling summary (rate, p50/p95/p99 over the last ``window``
+requests, batch-size distribution, shed counts) feeds
+``OpsPlane.observe_serving`` after every dispatched batch: the
+``serving`` stanza on ``/healthz``, the ``serving_p99`` watchdog rule,
+and — on rule entry — a flight-recorder bundle carrying the bounded
+recent-request ring.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from kubernetes_rescheduling_tpu.policies.scoring import POLICY_IDS
+from kubernetes_rescheduling_tpu.serving.kernel import place_batch, place_one
+from kubernetes_rescheduling_tpu.telemetry.explain import greedy_explanation
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MICRO_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+)
+
+OUTCOME_PLACED = "placed"
+OUTCOME_NO_CANDIDATE = "no_candidate"
+OUTCOME_SHED = "shed"
+OUTCOME_TIMEOUT = "timeout"
+
+SHED_QUEUE_FULL = "queue_full"
+SHED_SHUTDOWN = "shutdown"
+
+STAGES = (
+    "queue_wait", "batch_formation", "device_dispatch", "decode", "total",
+)
+
+# batch-size buckets: powers of two up to the largest supported max_batch
+# — the distribution /healthz renders and the bench cell reads
+_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+@dataclass(frozen=True)
+class PlaceResult:
+    """One request's outcome, JSON-safe via :meth:`as_dict` (the
+    ``POST /place`` response body)."""
+
+    request_id: int
+    service: str
+    outcome: str                       # placed|no_candidate|shed|timeout
+    node: str | None = None
+    node_index: int = -1
+    shed_reason: str | None = None
+    batch_size: int = 0
+    timings_ms: dict[str, float] = field(default_factory=dict)
+    explain: dict[str, Any] | None = None
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "request_id": self.request_id,
+            "service": self.service,
+            "outcome": self.outcome,
+            "node": self.node,
+            "node_index": self.node_index,
+            **(
+                {"shed_reason": self.shed_reason}
+                if self.shed_reason is not None
+                else {}
+            ),
+            "batch_size": self.batch_size,
+            "timings_ms": dict(self.timings_ms),
+            **({"explain": self.explain} if self.explain is not None else {}),
+        }
+
+
+class _Request:
+    """Internal queue item; ``done`` gates the submitting thread."""
+
+    __slots__ = (
+        "seq", "service", "svc_idx", "deadline", "t_submit", "t_dequeue",
+        "result", "done", "ring_entry",
+    )
+
+    def __init__(self, seq, service, svc_idx, deadline, ring_entry):
+        self.seq = seq
+        self.service = service
+        self.svc_idx = svc_idx
+        self.deadline = deadline          # absolute perf_counter, or None
+        self.t_submit = time.perf_counter()
+        self.t_dequeue: float | None = None
+        self.result: PlaceResult | None = None
+        self.done = threading.Event()
+        self.ring_entry = ring_entry
+
+
+class ServingEngine:
+    """Request-grain placement over one backend's admitted snapshots.
+
+    ``policy`` is a greedy policy name (``policies.scoring.POLICY_IDS``);
+    decisions use the snapshot captured at construction (or the latest
+    :meth:`refresh_snapshot`) — serving scores against device-resident
+    state, it does not monitor per request. Call :meth:`start` before
+    submitting and :meth:`stop` when done (``with engine:`` does both).
+    """
+
+    def __init__(
+        self,
+        backend,
+        *,
+        config=None,
+        policy: str = "communication",
+        threshold: float = 30.0,
+        seed: int = 0,
+        top_k: int = 3,
+        registry: MetricsRegistry | None = None,
+        ops=None,
+        guard=None,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from kubernetes_rescheduling_tpu.config import ServingConfig
+
+        self.config = (config or ServingConfig()).validate()
+        if policy not in POLICY_IDS:
+            raise ValueError(
+                f"unknown serving policy {policy!r}; expected one of "
+                f"{sorted(POLICY_IDS)}"
+            )
+        self.policy = policy
+        self.registry = registry
+        self.ops = ops
+        self._backend = backend
+        if guard is None:
+            from kubernetes_rescheduling_tpu.bench.admission import (
+                AdmissionGuard,
+            )
+            from kubernetes_rescheduling_tpu.config import ReconcileConfig
+
+            guard = AdmissionGuard(ReconcileConfig(), registry=registry)
+        self._guard = guard
+        self._policy_id = jnp.asarray(POLICY_IDS[policy], jnp.int32)
+        self._threshold = jnp.asarray(threshold, jnp.float32)
+        self._base_key = jax.random.PRNGKey(seed)
+        self._top_k = int(top_k)
+        self.graph = backend.comm_graph()
+        self._svc_index = {n: i for i, n in enumerate(self.graph.names)}
+        self.state = self._admitted_snapshot(backend)
+        self._node_names = list(self.state.node_names)
+
+        self._cond = threading.Condition()
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._seq = 0
+        self._inflight = 0                 # queued + in the current batch
+        # exact-accounting counters (single-owner writes under _cond)
+        self.submitted = 0
+        self.outcomes: dict[str, int] = {}
+        self.shed_reasons: dict[str, int] = {}
+        self.dispatches = 0
+        self._batch_sizes: dict[int, int] = {}
+        # rolling window of completed-request totals (seconds) — the
+        # p50/p95/p99 the /healthz stanza and the serving_p99 rule judge
+        self._recent: collections.deque[float] = collections.deque(
+            maxlen=self.config.window
+        )
+        # bounded recent-request ring (newest last): entries are written
+        # at submit and mutated in place at completion, so an in-flight
+        # request shows outcome "inflight" — the flight-recorder payload
+        self._ring: collections.deque[dict[str, Any]] = collections.deque(
+            maxlen=self.config.ring
+        )
+        self._started_mono = time.perf_counter()
+        self._completed = 0
+        self._ops_lock = threading.Lock()
+
+    # ---- snapshot admission ----
+
+    def _admitted_snapshot(self, backend):
+        """The serving plane's ONLY cluster-state ingest: a fresh monitor
+        snapshot routed through the admission guard
+        (``check_snapshot_admission.py`` statically enforces that no
+        other ``.monitor()`` call exists under ``serving/``). A rejected
+        snapshot keeps serving on the last admitted state; rejection at
+        construction (no last-good yet) raises."""
+        admitted = self._guard.admit(backend.monitor())
+        if admitted is None:
+            if getattr(self, "state", None) is None:
+                raise RuntimeError(
+                    "serving: the first monitor snapshot was rejected by "
+                    "the admission guard — no admitted state to serve from"
+                )
+            return self.state
+        return admitted
+
+    def refresh_snapshot(self) -> None:
+        """Re-pull an admitted snapshot (between soak phases; the engine
+        never monitors per request)."""
+        self.state = self._admitted_snapshot(self._backend)
+        self._node_names = list(self.state.node_names)
+
+    # ---- lifecycle ----
+
+    def start(self) -> "ServingEngine":
+        if self._thread is not None:
+            return self
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._run, name="krt-serving-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- metrics plumbing ----
+
+    def _reg(self) -> MetricsRegistry:
+        return self.registry if self.registry is not None else get_registry()
+
+    def _count_outcome(self, outcome: str) -> None:
+        self._reg().counter(
+            "serving_placements_total",
+            "serving requests completed, by outcome",
+            labelnames=("outcome",),
+        ).labels(outcome=outcome).inc()
+
+    def _count_shed(self, reason: str) -> None:
+        self._reg().counter(
+            "serving_shed_total",
+            "serving requests shed under overload, by reason",
+            labelnames=("reason",),
+        ).labels(reason=reason).inc()
+
+    def _observe_stage(self, stage: str, seconds: float) -> None:
+        self._reg().histogram(
+            "serving_request_seconds",
+            "per-request serving latency decomposed by stage "
+            "(queue_wait/batch_formation/device_dispatch/decode/total)",
+            labelnames=("stage",),
+            buckets=MICRO_BUCKETS,
+        ).labels(stage=stage).observe(max(seconds, 0.0))
+
+    def _set_inflight(self, n: int) -> None:
+        self._inflight = n
+        self._reg().gauge(
+            "serving_inflight",
+            "serving requests currently queued or in the forming batch",
+        ).set(n)
+
+    # ---- submission ----
+
+    def place(
+        self, service: str, *, deadline_ms: float | None = None
+    ) -> PlaceResult:
+        """Submit one request and block until its outcome. Raises
+        ``ValueError`` for an unknown service (nothing is submitted —
+        the HTTP front's 400 path); every submitted request resolves to
+        exactly one counted outcome."""
+        svc_idx = self._svc_index.get(service)
+        if svc_idx is None:
+            raise ValueError(
+                f"unknown service {service!r} (not in the snapshot's "
+                f"communication graph)"
+            )
+        if deadline_ms is None:
+            deadline_ms = self.config.deadline_ms
+        deadline = (
+            time.perf_counter() + float(deadline_ms) / 1e3
+            if deadline_ms and deadline_ms > 0
+            else None
+        )
+        with self._cond:
+            self.submitted += 1
+            seq = self._seq
+            self._seq += 1
+            ring_entry = {
+                "request_id": seq,
+                "service": service,
+                "outcome": "inflight",
+                "submitted_ts": time.time(),
+            }
+            self._ring.append(ring_entry)
+            req = _Request(seq, service, svc_idx, deadline, ring_entry)
+            if not self._running:
+                return self._shed_locked(req, SHED_SHUTDOWN)
+            if len(self._queue) >= self.config.queue_depth:
+                return self._shed_locked(req, SHED_QUEUE_FULL)
+            self._queue.append(req)
+            self._set_inflight(self._inflight + 1)
+            self._cond.notify()
+        req.done.wait()
+        assert req.result is not None
+        return req.result
+
+    def _shed_locked(self, req: _Request, reason: str) -> PlaceResult:
+        """Complete a request as shed at admission (caller holds _cond)."""
+        now = time.perf_counter()
+        timings = {
+            "queue_wait": 0.0,
+            "batch_formation": 0.0,
+            "device_dispatch": 0.0,
+            "decode": 0.0,
+            "total": (now - req.t_submit) * 1e3,
+        }
+        result = PlaceResult(
+            request_id=req.seq,
+            service=req.service,
+            outcome=OUTCOME_SHED,
+            shed_reason=reason,
+            timings_ms=timings,
+        )
+        self.outcomes[OUTCOME_SHED] = self.outcomes.get(OUTCOME_SHED, 0) + 1
+        self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        self._count_outcome(OUTCOME_SHED)
+        self._count_shed(reason)
+        req.ring_entry.update(outcome=OUTCOME_SHED, shed_reason=reason)
+        req.result = result
+        req.done.set()
+        self._feed_ops()
+        return result
+
+    # ---- the batcher ----
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._running and not self._queue:
+                    self._cond.wait()
+                if not self._queue:
+                    break  # stopped and drained
+                batch = [self._queue.popleft()]
+                batch[0].t_dequeue = time.perf_counter()
+                window_end = batch[0].t_dequeue + (
+                    self.config.batch_window_ms / 1e3
+                )
+                while len(batch) < self.config.max_batch:
+                    if self._queue:
+                        req = self._queue.popleft()
+                        req.t_dequeue = time.perf_counter()
+                        batch.append(req)
+                        continue
+                    remaining = window_end - time.perf_counter()
+                    if remaining <= 0 or not self._running:
+                        break
+                    self._cond.wait(remaining)
+            self._process_batch(batch)
+            with self._cond:
+                self._set_inflight(len(self._queue))
+
+    def _process_batch(self, batch: list[_Request]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        t_closed = time.perf_counter()
+        live: list[_Request] = []
+        for req in batch:
+            if req.deadline is not None and t_closed > req.deadline:
+                self._complete_timeout(req, t_closed)
+            else:
+                live.append(req)
+        if not live:
+            return
+        # pad to the static max_batch shape: ONE compiled signature for
+        # every batch size (the 1-steady-state-trace invariant); padded
+        # slots score service 0 under a folded key and are discarded
+        B = self.config.max_batch
+        svcs = np.zeros(B, dtype=np.int32)
+        seqs = np.zeros(B, dtype=np.int64)
+        for i, req in enumerate(live):
+            svcs[i] = req.svc_idx
+            seqs[i] = req.seq
+        keys = jnp.stack(
+            [
+                jax.random.fold_in(self._base_key, int(s))
+                for s in seqs
+            ]
+        )
+        t0 = time.perf_counter()
+        most, target, bundle = place_batch(
+            self.state,
+            self.graph,
+            self._policy_id,
+            self._threshold,
+            jnp.asarray(svcs),
+            keys,
+            top_k=self._top_k,
+        )
+        jax.block_until_ready(target)
+        t1 = time.perf_counter()
+        most_h, target_h, bundle_h = jax.device_get((most, target, bundle))
+        with self._cond:
+            self.dispatches += 1
+            n = len(live)
+            self._batch_sizes[n] = self._batch_sizes.get(n, 0) + 1
+        self._reg().histogram(
+            "serving_batch_size",
+            "live requests per coalesced serving dispatch",
+            buckets=_BATCH_BUCKETS,
+        ).observe(len(live))
+        for i, req in enumerate(live):
+            self._complete_placed(
+                req,
+                int(target_h[i]),
+                int(most_h[i]),
+                bundle_h[i],
+                batch_size=len(live),
+                t_closed=t_closed,
+                t_dispatch=(t0, t1),
+            )
+        self._feed_ops()
+
+    def _complete_timeout(self, req: _Request, now: float) -> None:
+        timings = {
+            "queue_wait": ((req.t_dequeue or now) - req.t_submit) * 1e3,
+            "batch_formation": 0.0,
+            "device_dispatch": 0.0,
+            "decode": 0.0,
+            "total": (now - req.t_submit) * 1e3,
+        }
+        result = PlaceResult(
+            request_id=req.seq,
+            service=req.service,
+            outcome=OUTCOME_TIMEOUT,
+            timings_ms=timings,
+        )
+        with self._cond:
+            self.outcomes[OUTCOME_TIMEOUT] = (
+                self.outcomes.get(OUTCOME_TIMEOUT, 0) + 1
+            )
+        self._count_outcome(OUTCOME_TIMEOUT)
+        self._count_shed("deadline")
+        self._finish(req, result, timings)
+
+    def _complete_placed(
+        self,
+        req: _Request,
+        target: int,
+        most: int,
+        bundle,
+        *,
+        batch_size: int,
+        t_closed: float,
+        t_dispatch: tuple[float, float],
+    ) -> None:
+        t0, t1 = t_dispatch
+        outcome = OUTCOME_PLACED if target >= 0 else OUTCOME_NO_CANDIDATE
+        node = (
+            self._node_names[target]
+            if 0 <= target < len(self._node_names)
+            else None
+        )
+        hazard = (
+            self._node_names[most]
+            if 0 <= most < len(self._node_names)
+            else None
+        )
+        explain = greedy_explanation(
+            bundle,
+            self._node_names,
+            round=0,
+            seq=req.seq,
+            policy=self.policy,
+            service=req.service,
+            hazard_node=hazard,
+            chosen=node,
+        )
+        now = time.perf_counter()
+        timings = {
+            "queue_wait": ((req.t_dequeue or t_closed) - req.t_submit) * 1e3,
+            "batch_formation": (t_closed - (req.t_dequeue or t_closed)) * 1e3,
+            "device_dispatch": (t1 - t0) * 1e3,
+            "decode": (now - t1) * 1e3,
+            "total": (now - req.t_submit) * 1e3,
+        }
+        result = PlaceResult(
+            request_id=req.seq,
+            service=req.service,
+            outcome=outcome,
+            node=node,
+            node_index=target,
+            batch_size=batch_size,
+            timings_ms=timings,
+            explain=explain,
+        )
+        with self._cond:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        self._count_outcome(outcome)
+        req.ring_entry.update(node=node, batch_size=batch_size)
+        self._finish(req, result, timings)
+
+    def _finish(
+        self, req: _Request, result: PlaceResult, timings: dict[str, float]
+    ) -> None:
+        for stage in STAGES:
+            self._observe_stage(stage, timings.get(stage, 0.0) / 1e3)
+        with self._cond:
+            self._recent.append(timings["total"] / 1e3)
+            self._completed += 1
+        req.ring_entry.update(
+            outcome=result.outcome, total_ms=timings["total"]
+        )
+        req.result = result
+        req.done.set()
+
+    # ---- observability feeds ----
+
+    def summary(self) -> dict[str, Any]:
+        """The rolling serving summary: /healthz's ``serving`` stanza and
+        the ``serving_p99`` watchdog rule's input."""
+        with self._cond:
+            recent = list(self._recent)
+            outcomes = dict(self.outcomes)
+            sheds = dict(self.shed_reasons)
+            batch_sizes = {str(k): v for k, v in sorted(self._batch_sizes.items())}
+            submitted = self.submitted
+            completed = self._completed
+            dispatches = self.dispatches
+            inflight = self._inflight
+        uptime = max(time.perf_counter() - self._started_mono, 1e-9)
+        q = (
+            np.percentile(np.asarray(recent) * 1e3, [50, 95, 99])
+            if recent
+            else (0.0, 0.0, 0.0)
+        )
+        return {
+            "submitted": submitted,
+            "completed": completed,
+            "count": len(recent),
+            "rate_rps": completed / uptime,
+            "p50_ms": float(q[0]),
+            "p95_ms": float(q[1]),
+            "p99_ms": float(q[2]),
+            "batch_sizes": batch_sizes,
+            "dispatches": dispatches,
+            "outcomes": outcomes,
+            "shed": sheds,
+            "inflight": inflight,
+        }
+
+    def ring(self) -> list[dict[str, Any]]:
+        """The bounded recent-request ring (newest last) — the payload
+        breaker-open and serving_p99 flight-recorder bundles ship."""
+        with self._cond:
+            return [dict(e) for e in self._ring]
+
+    def _feed_ops(self) -> None:
+        if self.ops is None:
+            return
+        # serialize the watchdog/health feed: batcher completions and
+        # admission-time sheds race here, and Watchdog.check is not
+        # itself thread-safe
+        with self._ops_lock:
+            self.ops.observe_serving(self.summary(), requests=self.ring())

@@ -1,0 +1,123 @@
+"""The serving plane's device kernels: score ONE requested service.
+
+:func:`place_one` is the request-grain sibling of
+``solver.round_loop.decide_explain``: the same finite guard, the same
+hazard detection, the same ``policy_scores`` rows and masked
+lexicographic argmax, and the same f32[6, k] explain bundle — but the
+service being placed comes from the REQUEST, not from victim selection,
+and nothing is removed from the snapshot (the pod does not exist yet;
+serving places NEW work, the round loop moves existing work). Because
+the scoring half is literally ``policies.scoring.choose_node``'s rows,
+the served decision is test-pinned bit-identical to the round kernel's
+placement on the same state.
+
+:func:`place_batch` is the vmapped twin (the fleet kernels'
+``stack → vmap → one dispatch`` template, ``solver.fleet``): B coalesced
+requests score against ONE shared snapshot under one
+``instrument_jit``-counted dispatch. The batch shape is padded static by
+the engine (``jax_traces_total{fn="serving_place"} == 1`` in steady
+state — the trace-count invariant the soak pins), padded slots compute
+inert garbage the host discards, and each row is bit-identical to
+:func:`place_one` on the same ``(svc, key)`` — the serve-vs-solo parity
+pin.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubernetes_rescheduling_tpu.core.state import ClusterState, CommGraph
+from kubernetes_rescheduling_tpu.objectives.metrics import node_cpu_pct_rounded
+from kubernetes_rescheduling_tpu.policies.hazard import detect_hazard
+from kubernetes_rescheduling_tpu.policies.scoring import (
+    lex_argmax,
+    policy_scores,
+)
+from kubernetes_rescheduling_tpu.solver.round_loop import finite_guard
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
+
+
+def _place_core(
+    state: ClusterState,
+    graph: CommGraph,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    svc: jax.Array,
+    key: jax.Array,
+    top_k: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared trace body of the solo and vmapped kernels (one definition,
+    so the parity pin cannot drift). Returns ``(most_hazard, target,
+    bundle)`` — target is -1 when every valid node is hazardous, and the
+    bundle is ``decide_explain``'s f32[6, k] layout so
+    ``telemetry.explain.greedy_explanation`` decodes it unchanged."""
+    state = finite_guard(state)
+    most, hazard_mask = detect_hazard(state, threshold)
+    k1, k2, cand = policy_scores(
+        policy_id, state, graph, svc, hazard_mask, key
+    )
+    target = lex_argmax([k1, k2], cand)
+
+    k = min(int(top_k), state.num_nodes)
+    pct = node_cpu_pct_rounded(state).astype(jnp.float32)
+    hz_v, hz_i = lax.top_k(jnp.where(state.node_valid, pct, -jnp.inf), k)
+    c_v, c_i = lax.top_k(jnp.where(cand, k1, -jnp.inf), k)
+    # top-k by k1 alone can exclude the lex winner when >k nodes tie on
+    # the primary key — force the chosen node into the last slot so the
+    # recorded candidates always contain the argmax (the
+    # explain-consistency invariant, same as decide_explain)
+    missing = (target >= 0) & ~jnp.any(c_i == target)
+    c_i = c_i.at[-1].set(jnp.where(missing, target, c_i[-1]))
+    bundle = jnp.stack(
+        [
+            hz_i.astype(jnp.float32),
+            hz_v,
+            c_i.astype(jnp.float32),
+            k1[c_i],
+            k2[c_i],
+            cand[c_i].astype(jnp.float32),
+        ]
+    )
+    return most, target, bundle
+
+
+@partial(instrument_jit, name="serving_place_one", static_argnames=("top_k",))
+def place_one(
+    state: ClusterState,
+    graph: CommGraph,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    svc: jax.Array,
+    key: jax.Array,
+    *,
+    top_k: int = 3,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Place one requested service (i32 scalar ``svc``) against the
+    current state: ``(most_hazard, target, bundle)``."""
+    return _place_core(state, graph, policy_id, threshold, svc, key, top_k)
+
+
+@partial(instrument_jit, name="serving_place", static_argnames=("top_k",))
+def place_batch(
+    state: ClusterState,
+    graph: CommGraph,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    svcs: jax.Array,
+    keys: jax.Array,
+    *,
+    top_k: int = 3,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """B coalesced requests against ONE shared snapshot: ``svcs`` is
+    i32[B], ``keys`` the per-request PRNG keys [B, ...]. Returns
+    ``(most_hazard[B], target[B], bundle[B, 6, k])``, each row
+    bit-identical to :func:`place_one` on that row's inputs."""
+
+    def one(svc, key):
+        return _place_core(state, graph, policy_id, threshold, svc, key, top_k)
+
+    return jax.vmap(one)(svcs, keys)

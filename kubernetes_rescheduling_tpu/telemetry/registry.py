@@ -37,6 +37,21 @@ DEFAULT_BUCKETS: tuple[float, ...] = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
 )
 
+# Request-scale micro buckets (seconds), 50µs–250ms: the serving plane's
+# per-request stage spans live one to three orders of magnitude below the
+# round-scale DEFAULT_BUCKETS — queue-wait and decode are tens of
+# microseconds, a coalesced device dispatch single-digit milliseconds —
+# and under the default preset every stage would collapse into the two
+# bottom buckets, making the interpolated p50/p99 meaningless. Selectable
+# at registration (``registry.histogram(..., buckets=MICRO_BUCKETS)``);
+# the registry's bucket-mismatch check guarantees a family can never mix
+# presets across call sites. Used by all serving_request_seconds{stage}
+# families.
+MICRO_BUCKETS: tuple[float, ...] = (
+    50e-6, 100e-6, 250e-6, 500e-6,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+)
+
 
 def _escape_label_value(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")

@@ -22,6 +22,14 @@ Endpoints (``--serve PORT`` on ``reschedule``/``bench``):
   the cardinality budget keeps OUT of ``/metrics`` label space (last
   round, breaker, drift, a capped cost window). 404s when no fleet run
   is attached or the tenant is unknown/evicted.
+- ``POST /place`` — the serving plane's front (``serving/``): admit one
+  pod/deployment spec (``{"service": name, "deadline_ms"?: float}``),
+  score it against the device-resident state through the bounded
+  batcher, answer with the placement + explain bundle + per-stage
+  timings. 400 on bad JSON / unknown service, 200 on
+  placed/no_candidate, 503 on shed/timeout (back off) or when no engine
+  is attached. Slow scrapes cannot head-of-line-block it: the heavy
+  read paths share a lock, /place does not take it.
 
 The server runs daemon threads and binds 127.0.0.1 by default; port 0
 picks an ephemeral port (tests). Handlers never write to stdout/stderr —
@@ -90,6 +98,11 @@ class HealthState:
         # drain): block size, blocks dispatched, drain breakdown, latest
         # trip — rendered on /healthz when a scanned schedule runs
         self.scan: dict[str, Any] | None = None
+        # serving-plane summary (OpsPlane.observe_serving): request rate,
+        # rolling p50/p95/p99, batch-size distribution, shed counts —
+        # rendered on /healthz when a serving engine is attached; the
+        # serving_p99 watchdog rule flips the endpoint itself
+        self.serving: dict[str, Any] | None = None
         # a dispatched scan block is K rounds of healthy silence:
         # mark_round only fires as the replay flushes, so while a block
         # is in flight the staleness budget scales by its expected
@@ -147,6 +160,11 @@ class HealthState:
                 "slo": slo,
                 "perf": self.perf,
                 **({"scan": self.scan} if self.scan is not None else {}),
+                **(
+                    {"serving": self.serving}
+                    if self.serving is not None
+                    else {}
+                ),
                 **({"fleet": self.fleet} if self.fleet is not None else {}),
             },
             healthy,
@@ -165,6 +183,7 @@ class OpsServer:
         health: HealthState | None = None,
         events_source=None,  # zero-arg callable -> list[dict]
         tenants_source=None,  # zero-arg callable -> TenantSummaryRing | None
+        serving_source=None,  # zero-arg callable -> ServingEngine | None
     ) -> None:
         self._port = port
         self.host = host
@@ -172,8 +191,16 @@ class OpsServer:
         self.health = health
         self.events_source = events_source
         self.tenants_source = tenants_source
+        self.serving_source = serving_source
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        # serializes the SLOW read paths (full-registry exposition, event/
+        # tenant ring walks) against each other so a scrape storm degrades
+        # scrapes, not serving: POST /place and /healthz deliberately do
+        # NOT take it — each ThreadingHTTPServer request has its own
+        # thread, so a multi-ms /metrics render can never head-of-line-
+        # block an in-flight placement request
+        self._read_lock = threading.Lock()
 
     @property
     def port(self) -> int:
@@ -226,17 +253,17 @@ def _make_handler(ops: OpsServer):
             self.end_headers()
             self.wfile.write(body)
 
-        def do_GET(self) -> None:  # noqa: N802 — stdlib signature
-            url = urlsplit(self.path)
-            endpoint = url.path.rstrip("/") or "/"
+        def _count(self, endpoint: str) -> None:
             # request accounting must stay cardinality-bounded: the
             # drill-down's tenant name is a PATH, never a label value —
             # and arbitrary 404 paths (favicon probes, port scanners)
-            # must not mint one memoized series each
+            # must not mint one memoized series each. /place joins the
+            # allowlist (GET and POST count into the same series: the
+            # endpoint IS the cardinality unit, not the method).
             if endpoint.startswith("/tenants/"):
                 counted = "/tenants/<name>"
             elif endpoint in ("/", "/metrics", "/healthz", "/events",
-                              "/tenants"):
+                              "/tenants", "/place"):
                 counted = endpoint
             else:
                 counted = "<other>"
@@ -245,8 +272,14 @@ def _make_handler(ops: OpsServer):
                 "requests served by the live ops endpoint",
                 labelnames=("endpoint",),
             ).labels(endpoint=counted).inc()
+
+        def do_GET(self) -> None:  # noqa: N802 — stdlib signature
+            url = urlsplit(self.path)
+            endpoint = url.path.rstrip("/") or "/"
+            self._count(endpoint)
             if endpoint == "/metrics":
-                body = ops._reg().expose().encode()
+                with ops._read_lock:
+                    body = ops._reg().expose().encode()
                 self._respond(
                     200, body, "text/plain; version=0.0.4; charset=utf-8"
                 )
@@ -260,11 +293,12 @@ def _make_handler(ops: OpsServer):
                     200 if healthy else 503, body, "application/json"
                 )
             elif endpoint == "/events":
-                events = (
-                    list(ops.events_source() or [])
-                    if ops.events_source is not None
-                    else []
-                )
+                with ops._read_lock:
+                    events = (
+                        list(ops.events_source() or [])
+                        if ops.events_source is not None
+                        else []
+                    )
                 # ?n= tail-limits the response (cheap polling of the last
                 # few events); default is the FULL ring — which is itself
                 # bounded (StructuredLogger's in-memory view is a ring
@@ -279,56 +313,124 @@ def _make_handler(ops: OpsServer):
                 ).encode()
                 self._respond(200, body, "application/json")
             elif endpoint == "/tenants" or endpoint.startswith("/tenants/"):
-                ring = (
-                    ops.tenants_source()
-                    if ops.tenants_source is not None
-                    else None
-                )
-                if ring is None:
-                    self._respond(
-                        404,
-                        json.dumps(
-                            {"error": "no fleet run attached"}
-                        ).encode(),
-                        "application/json",
+                with ops._read_lock:
+                    ring = (
+                        ops.tenants_source()
+                        if ops.tenants_source is not None
+                        else None
                     )
-                elif endpoint == "/tenants":
-                    self._respond(
-                        200,
-                        json.dumps(
-                            ring.overview(), default=float
-                        ).encode(),
-                        "application/json",
-                    )
-                else:
-                    name = endpoint[len("/tenants/"):]
-                    detail = ring.detail(name)
-                    if detail is None:
-                        self._respond(
-                            404,
-                            json.dumps(
-                                {"error": f"unknown tenant {name!r} "
-                                          "(never seen, or evicted from "
-                                          "the bounded summary ring)"}
-                            ).encode(),
-                            "application/json",
-                        )
+                    if ring is None:
+                        payload, code = {"error": "no fleet run attached"}, 404
+                    elif endpoint == "/tenants":
+                        payload, code = ring.overview(), 200
                     else:
-                        self._respond(
-                            200,
-                            json.dumps(detail, default=float).encode(),
-                            "application/json",
-                        )
+                        name = endpoint[len("/tenants/"):]
+                        detail = ring.detail(name)
+                        if detail is None:
+                            payload, code = {
+                                "error": f"unknown tenant {name!r} "
+                                         "(never seen, or evicted from "
+                                         "the bounded summary ring)"
+                            }, 404
+                        else:
+                            payload, code = detail, 200
+                self._respond(
+                    code,
+                    json.dumps(payload, default=float).encode(),
+                    "application/json",
+                )
+            elif endpoint == "/place":
+                body = json.dumps(
+                    {"error": "method not allowed: POST a placement "
+                              "request to /place"}
+                ).encode()
+                self.send_response(405)
+                self.send_header("Allow", "POST")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._respond(
                     404,
                     json.dumps(
                         {"error": "not found",
                          "endpoints": ["/metrics", "/healthz", "/events",
-                                       "/tenants", "/tenants/<name>"]}
+                                       "/tenants", "/tenants/<name>",
+                                       "/place"]}
                     ).encode(),
                     "application/json",
                 )
+
+        def do_POST(self) -> None:  # noqa: N802 — stdlib signature
+            url = urlsplit(self.path)
+            endpoint = url.path.rstrip("/") or "/"
+            self._count(endpoint)
+            if endpoint != "/place":
+                self._respond(
+                    404,
+                    json.dumps(
+                        {"error": "not found", "endpoints": ["/place"]}
+                    ).encode(),
+                    "application/json",
+                )
+                return
+            engine = (
+                ops.serving_source()
+                if ops.serving_source is not None
+                else None
+            )
+            if engine is None:
+                self._respond(
+                    503,
+                    json.dumps(
+                        {"error": "no serving engine attached "
+                                  "(start with serving enabled)"}
+                    ).encode(),
+                    "application/json",
+                )
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length > 0 else b""
+                payload = json.loads(raw.decode() or "{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("request body must be a JSON object")
+                service = payload.get("service")
+                if not isinstance(service, str) or not service:
+                    raise ValueError(
+                        "missing required string field 'service'"
+                    )
+                deadline_ms = payload.get("deadline_ms")
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)
+            except (ValueError, UnicodeDecodeError) as exc:
+                self._respond(
+                    400,
+                    json.dumps({"error": str(exc)}).encode(),
+                    "application/json",
+                )
+                return
+            try:
+                result = engine.place(service, deadline_ms=deadline_ms)
+            except (ValueError, KeyError) as exc:
+                # unknown service: a client error, nothing was submitted
+                self._respond(
+                    400,
+                    json.dumps({"error": str(exc)}).encode(),
+                    "application/json",
+                )
+                return
+            # placed and no_candidate are both successful ANSWERS (the
+            # latter a true "every valid node is hazardous" verdict);
+            # shed/timeout mean the plane could not answer in time — 503
+            # so open-loop clients and load balancers back off
+            code = 200 if result.outcome in ("placed", "no_candidate") else 503
+            self._respond(
+                code,
+                json.dumps(result.as_dict(), default=float).encode(),
+                "application/json",
+            )
 
     return Handler
 
@@ -351,6 +453,10 @@ class OpsPlane:
     # rollup — breaker-open bundles ship both, scoped to the offender
     tenant_ring: Any = None
     latest_fleet_rollup: Any = field(default=None, repr=False)
+    # serving mode: the engine behind POST /place (bind_serving attaches
+    # it); its bounded recent-request ring rides breaker-open and
+    # serving_p99 flight-recorder bundles
+    serving_engine: Any = field(default=None, repr=False)
     span_tail: int = 12
     _prev_sigusr1: Any = field(default=None, repr=False)
     _sig_installed: bool = field(default=False, repr=False)
@@ -390,6 +496,7 @@ class OpsPlane:
                 ),
                 fleet_tail_frac=getattr(obs, "slo_fleet_tail_frac", 0.0),
                 scan_tripwire=getattr(obs, "slo_scan_tripwire", True),
+                serving_p99_ms=getattr(obs, "slo_serving_p99_ms", 0.0),
             ),
             registry=registry,
             logger=logger,
@@ -419,11 +526,16 @@ class OpsPlane:
                 health=health,
                 events_source=plane._events,
                 tenants_source=plane._tenants,
+                serving_source=plane._serving,
             )
         return plane
 
     def _events(self) -> list[dict]:
         return self.logger.records if self.logger is not None else []
+
+    def _serving(self):
+        """The POST /place source: the bound serving engine, if any."""
+        return self.serving_engine
 
     def _tenants(self):
         """The /tenants source: the ring once a fleet run has fed it
@@ -442,6 +554,8 @@ class OpsPlane:
                 self.server.events_source = self._events
             if self.server.tenants_source is None:
                 self.server.tenants_source = self._tenants
+            if self.server.serving_source is None:
+                self.server.serving_source = self._serving
             self.server.start()
         if (
             self.recorder is not None
@@ -568,6 +682,39 @@ class OpsPlane:
         drains = scan["drains"]
         drains[reason] = drains.get(reason, 0) + 1
 
+    def bind_serving(self, engine) -> None:
+        """Attach a serving engine: it becomes the POST /place source,
+        its summaries flow to /healthz and the ``serving_p99`` watchdog
+        rule via :meth:`observe_serving`, and its recent-request ring
+        rides breaker-open bundles."""
+        self.serving_engine = engine
+        engine.ops = self
+
+    def observe_serving(
+        self, summary: dict | None, requests: list | None = None
+    ) -> None:
+        """Feed the serving plane's rolling summary (the engine calls
+        this after every dispatched batch and admission-time shed):
+        updates the /healthz ``serving`` stanza, judges the
+        ``serving_p99`` rule, and — the moment the rule ENTERS violation
+        — dumps a flight-recorder bundle carrying the summary plus the
+        in-flight request ring (the evidence an operator needs while the
+        tail spike is still in memory)."""
+        self.health.serving = dict(summary) if summary is not None else None
+        if self.watchdog is None:
+            return
+        newly = self.watchdog.observe_serving(summary)
+        for violation in newly:
+            if (
+                violation.get("rule") == "serving_p99"
+                and self.recorder is not None
+            ):
+                self.recorder.dump(
+                    "serving_p99",
+                    serving=dict(summary or {}),
+                    requests=list(requests or []),
+                )
+
     def observe_perf(self, verdicts: dict) -> None:
         """Feed a perf-ledger verdict set (``perf_ledger.detect``): arms/
         clears the watchdog's ``perf_regression`` rule and records the
@@ -640,6 +787,10 @@ class OpsPlane:
                     summary = self.tenant_ring.detail(tenant)
                     if summary is not None:
                         extra["tenant_summary"] = summary
+            if self.serving_engine is not None:
+                # an open breaker starves the serving snapshot too —
+                # capture what the plane had in flight at that moment
+                extra["serving_requests"] = self.serving_engine.ring()
             self.recorder.dump("breaker_open", transition=rec, **extra)
 
     def on_crash(self, exc: BaseException) -> None:

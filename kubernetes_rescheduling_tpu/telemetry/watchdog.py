@@ -61,6 +61,7 @@ RULE_RECONCILE = "reconcile_divergence"
 RULE_SHADOW = "shadow_win_rate"
 RULE_FLEET_TAIL = "fleet_tail_cost"
 RULE_SCAN_TRIPWIRE = "scan_tripwire"
+RULE_SERVING = "serving_p99"
 
 
 @dataclass(frozen=True)
@@ -132,6 +133,15 @@ class SLORules:
     # must say so (False disables; only scan runs feed blocks, so the
     # per-round path can never trip it)
     scan_tripwire: bool = True
+    # serving p99: the serving plane's rolling-window p99 request latency
+    # (ms, end-to-end: queue-wait through decode — ServingEngine feeds a
+    # summary after every dispatched batch via observe_serving) exceeding
+    # this threshold is a violation; the window draining back under it
+    # recovers. Judged only once the rolling window holds min_samples
+    # completed requests, so a cold-start compile spike on the first
+    # request cannot flip /healthz on its own (0 disables; only serving
+    # runs feed summaries, so round-only runs can never trip it).
+    serving_p99_ms: float = 0.0
 
     def validate(self) -> "SLORules":
         if self.window < 2:
@@ -174,6 +184,11 @@ class SLORules:
             raise ValueError(
                 "tenant_ttl_rounds must be >= 0 (0 disables per-tenant "
                 "state pruning)"
+            )
+        if self.serving_p99_ms < 0:
+            raise ValueError(
+                "serving_p99_ms must be >= 0 (0 disables the serving_p99 "
+                "rule)"
             )
         return self
 
@@ -225,6 +240,9 @@ class Watchdog:
         # latest scan block's decoded trip (None = last block was clean
         # or no scan block observed yet) — observe_scan_block feeds it
         self._scan_trip: dict[str, Any] | None = None
+        # latest serving-plane summary (observe_serving feeds it after
+        # every dispatched batch; its p99_ms/count judge the serving rule)
+        self._serving: dict[str, Any] | None = None
         # fleet cost-rollup tail (p99 per fleet round) — rolling window
         self._fleet_tail: collections.deque[float] = collections.deque(
             maxlen=self.rules.window
@@ -257,6 +275,7 @@ class Watchdog:
         self._last_round = 0
         self._shadow = None
         self._scan_trip = None
+        self._serving = None
         self._overlap.clear()
         self._fleet_tail.clear()
         self.active = (
@@ -364,6 +383,19 @@ class Watchdog:
         Returns the newly raised violations, like
         :meth:`observe_round`."""
         self._scan_trip = dict(trip) if trip is not None else None
+        return self.check()
+
+    def observe_serving(
+        self, summary: dict[str, Any] | None
+    ) -> list[dict[str, Any]]:
+        """Feed the serving plane's latest rolling-window summary
+        (``ServingEngine.summary()`` — the engine calls this through
+        ``OpsPlane.observe_serving`` after every dispatched batch). The
+        summary's ``p99_ms`` over ``count`` completed requests judges the
+        ``serving_p99`` rule; a later summary whose window has drained
+        back under the threshold recovers it. Returns the newly raised
+        violations, like :meth:`observe_round`."""
+        self._serving = dict(summary) if summary is not None else None
         return self.check()
 
     def observe_perf(self, verdicts: dict[str, dict[str, Any]]) -> list[dict[str, Any]]:
@@ -524,6 +556,21 @@ class Watchdog:
                     "threshold": r.shadow_min_win_rate,
                     "scored": int(self._shadow.get("scored") or 0),
                     "cost_delta": self._shadow.get("cost_delta"),
+                }
+        if r.serving_p99_ms > 0 and self._serving is not None:
+            # the latest serving summary judges: its p99 is already a
+            # rolling-window statistic (the engine's bounded recent-total
+            # deque), so fast requests pushing slow ones out of the
+            # window IS the recovery path — no second window here
+            count = int(self._serving.get("count") or 0)
+            p99 = float(self._serving.get("p99_ms") or 0.0)
+            if count >= r.min_samples and p99 > r.serving_p99_ms:
+                now[RULE_SERVING] = {
+                    "p99_ms": p99,
+                    "threshold_ms": r.serving_p99_ms,
+                    "count": count,
+                    "p50_ms": self._serving.get("p50_ms"),
+                    "rate_rps": self._serving.get("rate_rps"),
                 }
         if r.scan_tripwire and self._scan_trip is not None:
             # the LATEST scan block judges: its in-trace tripwire

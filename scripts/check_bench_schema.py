@@ -65,6 +65,31 @@ def check_parsed(parsed, where: str) -> list[str]:
         parsed["vs_baseline"]
     ):
         out.append(f"{where}: parsed.vs_baseline must be a finite number")
+    # the serving plane's paired series: the throughput headline must
+    # trend up and CARRY its latency sibling (a placements/sec reading
+    # without its p99 is half a story — the ledger would trend the rate
+    # while the tail silently regressed), and the p99 series must trend
+    # down in ms
+    if metric == "serving_placements_per_sec":
+        if parsed.get("better") != "higher":
+            out.append(
+                f"{where}: serving_placements_per_sec must declare "
+                "better='higher' (a throughput series)"
+            )
+        if not isinstance(parsed.get("p99_reading"), dict):
+            out.append(
+                f"{where}: serving_placements_per_sec must nest its "
+                "p99_reading sibling (the serving ledger is a PAIR of "
+                "series: placements/sec AND p99 ms)"
+            )
+    if metric == "serving_p99_ms":
+        if parsed.get("better") != "lower":
+            out.append(
+                f"{where}: serving_p99_ms must declare better='lower' "
+                "(a latency series)"
+            )
+        if parsed.get("unit") != "ms":
+            out.append(f"{where}: serving_p99_ms must carry unit='ms'")
     # nested ledger readings (``*_reading`` — the fleet cell's rollup and
     # global-amortization series, and any future sibling): each is
     # appended to the perf ledger as its OWN series, so each must carry

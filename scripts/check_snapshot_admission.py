@@ -8,13 +8,14 @@ reaches device state without passing ``AdmissionGuard.admit``
 NaN/Inf/negative load silently corrupts the solver score, the forecast
 RLS state, the attribution sums, and the perf ledger.
 
-AST-based, like its siblings: inside ``bench/controller.py`` and
-``bench/fleet.py``, a ``.monitor(...)`` call is only legal inside the
-designated admitted-monitor wrappers — ``_Runtime.monitor_admitted``
-(the solo loop) and ``_admitted_monitor`` (the fleet loop) — and each
-wrapper must itself contain an ``.admit(...)`` call, so the wrapper
-cannot quietly stop guarding. Every other control-loop code path gets
-its snapshots from a wrapper and therefore admitted.
+AST-based, like its siblings: inside ``bench/controller.py``,
+``bench/fleet.py``, and ``serving/engine.py``, a ``.monitor(...)`` call
+is only legal inside the designated admitted-monitor wrappers —
+``_Runtime.monitor_admitted`` (the solo loop), ``_admitted_monitor``
+(the fleet loop), and ``ServingEngine._admitted_snapshot`` (the serving
+plane) — and each wrapper must itself contain an ``.admit(...)`` call,
+so the wrapper cannot quietly stop guarding. Every other control-loop
+code path gets its snapshots from a wrapper and therefore admitted.
 
 Run directly (exit 1 on violation) or through its test twin
 (tests/test_snapshot_admission.py).
@@ -34,9 +35,10 @@ PACKAGE = Path(__file__).resolve().parent.parent / "kubernetes_rescheduling_tpu"
 CHECKED = (
     PACKAGE / "bench" / "controller.py",
     PACKAGE / "bench" / "fleet.py",
+    PACKAGE / "serving" / "engine.py",
 )
 # the designated wrappers: the ONLY functions allowed to call .monitor()
-WRAPPERS = {"monitor_admitted", "_admitted_monitor"}
+WRAPPERS = {"monitor_admitted", "_admitted_monitor", "_admitted_snapshot"}
 
 
 def _functions(tree: ast.AST):

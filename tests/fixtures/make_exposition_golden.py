@@ -13,7 +13,10 @@ from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
     publish_rollup,
     rollup_numpy,
 )
-from kubernetes_rescheduling_tpu.telemetry.registry import MetricsRegistry
+from kubernetes_rescheduling_tpu.telemetry.registry import (
+    MICRO_BUCKETS,
+    MetricsRegistry,
+)
 
 
 def build_registry() -> MetricsRegistry:
@@ -60,6 +63,21 @@ def build_registry() -> MetricsRegistry:
         registry,
         decode_rollup(rollup_numpy(matrix, top_k=2), top_k=2),
     )
+    # the serving plane's documented micro-bucket preset renders through
+    # the same histogram path (MICRO_BUCKETS, 50µs–250ms — the preset
+    # every serving_request_seconds{stage} family selects at
+    # registration); samples straddle below/inside/above the preset
+    sr = registry.histogram(
+        "serving_request_seconds",
+        "per-request serving latency by stage",
+        labelnames=("stage",),
+        buckets=MICRO_BUCKETS,
+    )
+    for v, stage in (
+        (20e-6, "total"), (300e-6, "total"), (0.004, "total"),
+        (0.5, "total"), (120e-6, "queue_wait"),
+    ):
+        sr.labels(stage=stage).observe(v)
     return registry
 
 

@@ -187,3 +187,57 @@ def test_scan_headline_conforms():
         },
     }
     assert checker.check_parsed(scan_like, "scan") == []
+
+
+def _serve_like():
+    """bench.bench_serve's paired shape: the placements/sec headline
+    nesting its p99 latency sibling."""
+    return {
+        "metric": "serving_placements_per_sec",
+        "value": 355.3,
+        "unit": "req/s",
+        "better": "higher",
+        "vs_baseline": 0.888,
+        "extra": {"scenario": "serve", "requests": 64, "max_batch": 8},
+        "p99_reading": {
+            "metric": "serving_p99_ms",
+            "value": 15.4,
+            "unit": "ms",
+            "better": "lower",
+            "vs_baseline": 16.2,
+            "extra": {"scenario": "serve"},
+        },
+    }
+
+
+def test_serve_headline_pair_conforms():
+    """The serve cell's result dict (bench.bench_serve's shape — the
+    repo's first request-latency pair: placements/sec with its nested
+    p99-ms sibling) satisfies the parsed-record schema."""
+    checker = _load_checker()
+    assert checker.check_parsed(_serve_like(), "serve") == []
+
+
+def test_serve_pair_corruptions_are_caught():
+    """The serve-specific rules actually bite: a throughput series that
+    forgets its direction, loses its p99 sibling, or a p99 series with
+    the wrong direction or unit is flagged, not silently ingested."""
+    checker = _load_checker()
+
+    def corrupt(mutate):
+        doc = json.loads(json.dumps(_serve_like()))
+        mutate(doc)
+        return checker.check_parsed(doc, "serve")
+
+    bad = corrupt(lambda d: d.pop("better"))
+    assert any("better='higher'" in v for v in bad)
+    bad = corrupt(lambda d: d.pop("p99_reading"))
+    assert any("p99_reading" in v for v in bad)
+    bad = corrupt(lambda d: d["p99_reading"].__setitem__("better", "higher"))
+    assert any("better='lower'" in v for v in bad)
+    bad = corrupt(lambda d: d["p99_reading"].__setitem__("unit", "s"))
+    assert any("unit='ms'" in v for v in bad)
+    # the nested sibling is itself a ledger record: a non-finite value
+    # inside it must be caught by the recursive *_reading walk
+    bad = corrupt(lambda d: d["p99_reading"].__setitem__("value", None))
+    assert any("p99_reading" in v and "finite" in v for v in bad)

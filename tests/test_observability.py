@@ -229,6 +229,23 @@ def test_exposition_golden_file(registry):
         registry,
         decode_rollup(rollup_numpy(matrix, top_k=2), top_k=2),
     )
+    # the serving plane's documented micro-bucket preset renders through
+    # the same histogram path (MICRO_BUCKETS, 50µs–250ms — the preset
+    # every serving_request_seconds{stage} family selects at
+    # registration); samples straddle below/inside/above the preset
+    from kubernetes_rescheduling_tpu.telemetry.registry import MICRO_BUCKETS
+
+    sr = registry.histogram(
+        "serving_request_seconds",
+        "per-request serving latency by stage",
+        labelnames=("stage",),
+        buckets=MICRO_BUCKETS,
+    )
+    for v, stage in (
+        (20e-6, "total"), (300e-6, "total"), (0.004, "total"),
+        (0.5, "total"), (120e-6, "queue_wait"),
+    ):
+        sr.labels(stage=stage).observe(v)
     assert registry.expose() == golden.read_text()
 
 

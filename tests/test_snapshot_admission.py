@@ -53,3 +53,27 @@ def test_checker_catches_a_wrapper_that_stops_admitting(tmp_path):
     )
     bad = checker.find_violations(f)
     assert len(bad) == 1 and "admit" in bad[0][1]
+
+
+def test_serving_engine_is_checked_and_wrapper_designated():
+    """PR 18 wires the serving plane into the same admission discipline:
+    ``serving/engine.py`` is a CHECKED control loop and its
+    ``_admitted_snapshot`` is a designated wrapper — the checker config
+    itself is pinned so neither can silently fall out."""
+    checker = _load_checker()
+    assert any(p.name == "engine.py" and p.parent.name == "serving"
+               for p in checker.CHECKED)
+    assert "_admitted_snapshot" in checker.WRAPPERS
+
+
+def test_checker_catches_a_raw_monitor_in_a_serving_helper(tmp_path):
+    checker = _load_checker()
+    f = tmp_path / "engine.py"
+    f.write_text(
+        "def _admitted_snapshot(self, backend):\n"
+        "    return self._guard.admit(backend.monitor())\n"  # legal ingest
+        "def refresh_snapshot(self):\n"
+        "    self.state = self._backend.monitor()\n"         # flagged: raw
+    )
+    lines = [line for line, _ in checker.find_violations(f)]
+    assert lines == [4]

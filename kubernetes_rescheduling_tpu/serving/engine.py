@@ -63,6 +63,7 @@ OUTCOME_TIMEOUT = "timeout"
 
 SHED_QUEUE_FULL = "queue_full"
 SHED_SHUTDOWN = "shutdown"
+SHED_DEADLINE = "deadline"
 
 STAGES = (
     "queue_wait", "batch_formation", "device_dispatch", "decode", "total",
@@ -206,7 +207,13 @@ class ServingEngine:
         )
         self._started_mono = time.perf_counter()
         self._completed = 0
-        self._ops_lock = threading.Lock()
+        # makes {compute summary → publish to ops} atomic: downstream
+        # state is last-write-wins, so without this a request thread's
+        # stale summary could overwrite the batcher's fresher one (e.g.
+        # /healthz losing the final deadline sheds after traffic stops).
+        # Ordering only — watchdog THREAD-SAFETY lives in OpsPlane's own
+        # lock, which also covers the round-grain feeds racing these
+        self._feed_lock = threading.Lock()
 
     # ---- snapshot admission ----
 
@@ -316,6 +323,7 @@ class ServingEngine:
             if deadline_ms and deadline_ms > 0
             else None
         )
+        shed: PlaceResult | None = None
         with self._cond:
             self.submitted += 1
             seq = self._seq
@@ -329,18 +337,27 @@ class ServingEngine:
             self._ring.append(ring_entry)
             req = _Request(seq, service, svc_idx, deadline, ring_entry)
             if not self._running:
-                return self._shed_locked(req, SHED_SHUTDOWN)
-            if len(self._queue) >= self.config.queue_depth:
-                return self._shed_locked(req, SHED_QUEUE_FULL)
-            self._queue.append(req)
-            self._set_inflight(self._inflight + 1)
-            self._cond.notify()
+                shed = self._shed_locked(req, SHED_SHUTDOWN)
+            elif len(self._queue) >= self.config.queue_depth:
+                shed = self._shed_locked(req, SHED_QUEUE_FULL)
+            else:
+                self._queue.append(req)
+                self._set_inflight(self._inflight + 1)
+                self._cond.notify()
+        if shed is not None:
+            # feed ops only AFTER _cond is released: _feed_ops re-enters
+            # _cond via summary()/ring(), and the batcher calls it without
+            # holding _cond — feeding while holding _cond would invert the
+            # lock order against the batcher's path (ABBA deadlock)
+            self._feed_ops()
+            return shed
         req.done.wait()
         assert req.result is not None
         return req.result
 
     def _shed_locked(self, req: _Request, reason: str) -> PlaceResult:
-        """Complete a request as shed at admission (caller holds _cond)."""
+        """Complete a request as shed at admission. Caller holds _cond and
+        must call :meth:`_feed_ops` after releasing it — never under it."""
         now = time.perf_counter()
         timings = {
             "queue_wait": 0.0,
@@ -363,7 +380,6 @@ class ServingEngine:
         req.ring_entry.update(outcome=OUTCOME_SHED, shed_reason=reason)
         req.result = result
         req.done.set()
-        self._feed_ops()
         return result
 
     # ---- the batcher ----
@@ -468,14 +484,22 @@ class ServingEngine:
             request_id=req.seq,
             service=req.service,
             outcome=OUTCOME_TIMEOUT,
+            shed_reason=SHED_DEADLINE,
             timings_ms=timings,
         )
+        # a timeout counts BOTH as outcome `timeout` and shed reason
+        # `deadline`, in the metric AND the summary/healthz/ring views —
+        # the two views must agree (OBSERVABILITY.md pins this)
         with self._cond:
             self.outcomes[OUTCOME_TIMEOUT] = (
                 self.outcomes.get(OUTCOME_TIMEOUT, 0) + 1
             )
+            self.shed_reasons[SHED_DEADLINE] = (
+                self.shed_reasons.get(SHED_DEADLINE, 0) + 1
+            )
         self._count_outcome(OUTCOME_TIMEOUT)
-        self._count_shed("deadline")
+        self._count_shed(SHED_DEADLINE)
+        req.ring_entry.update(shed_reason=SHED_DEADLINE)
         self._finish(req, result, timings)
 
     def _complete_placed(
@@ -593,8 +617,10 @@ class ServingEngine:
     def _feed_ops(self) -> None:
         if self.ops is None:
             return
-        # serialize the watchdog/health feed: batcher completions and
-        # admission-time sheds race here, and Watchdog.check is not
-        # itself thread-safe
-        with self._ops_lock:
+        # never called while holding _cond — summary()/ring() re-enter it
+        # briefly, and OpsPlane takes its own watchdog lock inside, so
+        # the only legal order is _feed_lock → _cond / _feed_lock →
+        # plane lock (the batcher and the admission-shed path both come
+        # through here lock-free, which is what buries the old ABBA)
+        with self._feed_lock:
             self.ops.observe_serving(self.summary(), requests=self.ring())

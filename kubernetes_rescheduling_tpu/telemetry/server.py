@@ -403,8 +403,16 @@ def _make_handler(ops: OpsServer):
                     )
                 deadline_ms = payload.get("deadline_ms")
                 if deadline_ms is not None:
+                    if isinstance(deadline_ms, bool) or not isinstance(
+                        deadline_ms, (int, float)
+                    ):
+                        raise ValueError(
+                            "'deadline_ms' must be a JSON number"
+                        )
                     deadline_ms = float(deadline_ms)
-            except (ValueError, UnicodeDecodeError) as exc:
+            # TypeError joins the tuple as a backstop: the documented
+            # contract is 400 on ANY malformed body, never a handler crash
+            except (TypeError, ValueError, UnicodeDecodeError) as exc:
                 self._respond(
                     400,
                     json.dumps({"error": str(exc)}).encode(),
@@ -440,7 +448,14 @@ class OpsPlane:
     """Everything the live ops plane needs, in one handle the controller
     consumes: per-round observation fans out to the watchdog, the flight
     recorder, and the health state; breaker-open and crashes trigger
-    bundle dumps."""
+    bundle dumps.
+
+    Feeds arrive from more than one thread once a serving engine is
+    bound (the controller's round loop plus request-grain serving
+    threads), and :class:`~.watchdog.Watchdog` is not itself
+    thread-safe, so ONE plane-level lock serializes every
+    ``watchdog.observe_*``/``rebase`` call — round-vs-serving as well as
+    serving-vs-serving."""
 
     registry: MetricsRegistry | None = None
     logger: Any = None
@@ -460,6 +475,11 @@ class OpsPlane:
     span_tail: int = 12
     _prev_sigusr1: Any = field(default=None, repr=False)
     _sig_installed: bool = field(default=False, repr=False)
+    # serializes every watchdog feed across the threads that issue them
+    # (controller round loop, serving request threads, the bench harness)
+    _watchdog_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
 
     @classmethod
     def from_config(
@@ -609,7 +629,8 @@ class OpsPlane:
             # a new run binding = a fresh observation window: another
             # cell's cost scale or a new shape's first compile must not
             # read as an SLO violation
-            self.watchdog.rebase()
+            with self._watchdog_lock:
+                self.watchdog.rebase()
 
     def observe_round(self, record, state=None, events=(), tenant=None) -> None:
         self.health.rounds += 1
@@ -617,7 +638,8 @@ class OpsPlane:
         if record.degraded:
             self.health.degraded_rounds += 1
         if self.watchdog is not None:
-            self.watchdog.observe_round(record, tenant=tenant)
+            with self._watchdog_lock:
+                self.watchdog.observe_round(record, tenant=tenant)
         if self.recorder is not None:
             spans = [
                 {
@@ -664,7 +686,8 @@ class OpsPlane:
             if self.recorder is not None:
                 self.recorder.dump("scan_tripwire", trip=dict(trip))
         if self.watchdog is not None:
-            self.watchdog.observe_scan_block(trip)
+            with self._watchdog_lock:
+                self.watchdog.observe_scan_block(trip)
 
     def observe_scan_drain(self, reason: str) -> None:
         """One round drained from the scanned schedule to the per-round
@@ -700,10 +723,16 @@ class OpsPlane:
         — dumps a flight-recorder bundle carrying the summary plus the
         in-flight request ring (the evidence an operator needs while the
         tail spike is still in memory)."""
-        self.health.serving = dict(summary) if summary is not None else None
-        if self.watchdog is None:
-            return
-        newly = self.watchdog.observe_serving(summary)
+        with self._watchdog_lock:
+            self.health.serving = (
+                dict(summary) if summary is not None else None
+            )
+            if self.watchdog is None:
+                return
+            newly = self.watchdog.observe_serving(summary)
+        # the bundle dump (file I/O) happens outside the lock: `newly`
+        # reports rule ENTRY exactly once, so concurrent feeders cannot
+        # double-dump
         for violation in newly:
             if (
                 violation.get("rule") == "serving_p99"
@@ -730,7 +759,8 @@ class OpsPlane:
             "series": dict(statuses),
         }
         if self.watchdog is not None:
-            self.watchdog.observe_perf(verdicts)
+            with self._watchdog_lock:
+                self.watchdog.observe_perf(verdicts)
 
     def observe_fleet_rollup(self, rollup: dict, event: dict | None = None) -> None:
         """Feed one fleet round's decoded tenant rollup
@@ -740,7 +770,8 @@ class OpsPlane:
         ``/healthz`` fleet summary."""
         self.latest_fleet_rollup = event if event is not None else rollup
         if self.watchdog is not None:
-            self.watchdog.observe_fleet_rollup(rollup)
+            with self._watchdog_lock:
+                self.watchdog.observe_fleet_rollup(rollup)
 
     def observe_tenant(
         self,

@@ -7,6 +7,7 @@ import json
 import math
 import threading
 import time
+import types
 import urllib.error
 import urllib.request
 
@@ -38,6 +39,7 @@ from kubernetes_rescheduling_tpu.serving import (
     place_one,
 )
 from kubernetes_rescheduling_tpu.serving.engine import (
+    SHED_DEADLINE,
     SHED_QUEUE_FULL,
     SHED_SHUTDOWN,
     STAGES,
@@ -405,6 +407,14 @@ def test_expired_deadlines_complete_timeout_without_dispatch(registry):
         == 3
     )
     assert _metric(registry, "serving_shed_total", reason="deadline") == 3
+    # the summary/healthz view must AGREE with the metric: deadline sheds
+    # show in shed_reasons too, not only in serving_shed_total
+    assert engine.shed_reasons.get(SHED_DEADLINE, 0) == 3
+    summary = engine.summary()
+    assert summary["shed"].get(SHED_DEADLINE) == 3
+    for entry in engine.ring():
+        assert entry["outcome"] == OUTCOME_TIMEOUT
+        assert entry["shed_reason"] == SHED_DEADLINE
 
 
 def test_place_on_stopped_engine_sheds_shutdown(registry):
@@ -412,6 +422,62 @@ def test_place_on_stopped_engine_sheds_shutdown(registry):
     result = engine.place(engine.graph.names[0])
     assert result.outcome == OUTCOME_SHED
     assert result.shed_reason == SHED_SHUTDOWN
+
+
+class _CondProbeOps:
+    """An ops stub that checks, from ANOTHER thread, whether the engine's
+    _cond is held while observe_serving runs — the admission-shed path
+    feeding ops under _cond is the ABBA half of a deadlock against the
+    batcher (which takes the ops feed first and _cond second)."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self.cond_held_during_feed: list[bool] = []
+
+    def observe_serving(self, summary, requests=None):
+        got: list[bool] = []
+
+        def probe():
+            acquired = self._engine._cond.acquire(timeout=2)
+            if acquired:
+                self._engine._cond.release()
+            got.append(acquired)
+
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+        self.cond_held_during_feed.append(not got[0])
+
+
+def test_admission_shed_feeds_ops_after_releasing_cond(registry):
+    """The shed paths (shutdown + queue_full) must publish their ops feed
+    only AFTER _cond is released: feeding under _cond inverts the lock
+    order against the batcher's feed path and deadlocks the plane."""
+    engine = _engine(
+        registry, config=ServingConfig(max_batch=8, queue_depth=1)
+    )
+    probe = engine.ops = _CondProbeOps(engine)
+    svc = engine.graph.names[0]
+    # shutdown shed: the engine was never started
+    assert engine.place(svc).shed_reason == SHED_SHUTDOWN
+    # queue_full shed: fill the bounded queue with the batcher off, then
+    # overflow it synchronously from this thread
+    engine._running = True
+    t = threading.Thread(target=engine.place, args=(svc,), daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with engine._cond:
+            if len(engine._queue) == 1:
+                break
+        time.sleep(0.005)
+    else:
+        raise AssertionError("the queued request never landed")
+    assert engine.place(svc).shed_reason == SHED_QUEUE_FULL
+    assert probe.cond_held_during_feed == [False, False]
+    engine.start()
+    t.join(timeout=30)
+    engine.stop()
 
 
 def test_place_unknown_service_raises_before_submit(registry):
@@ -622,6 +688,61 @@ def test_healthz_serving_p99_flip_and_recover(registry, tmp_path):
         ops.close()
 
 
+def test_round_and_serving_watchdog_feeds_are_serialized(registry):
+    """--place mode feeds the ONE watchdog from two planes at once: the
+    controller's round loop and the serving threads. OpsPlane owns the
+    serialization (a plane-level lock over EVERY watchdog feed), so a
+    mixed concurrent soak must neither corrupt the rolling windows nor
+    raise from mid-mutation deque/dict iteration."""
+    from kubernetes_rescheduling_tpu.telemetry.watchdog import (
+        SLORules,
+        Watchdog,
+    )
+
+    wd = Watchdog(
+        SLORules(
+            window=8, min_samples=2, latency_p95_s=10.0, max_retraces=0,
+            serving_p99_ms=1000.0,
+        ),
+        registry=registry,
+    )
+    ops = OpsPlane(registry=registry, watchdog=wd)
+    rounds_n = serve_n = 150
+    errors = []
+
+    def round_feeder():
+        rec = types.SimpleNamespace(
+            decision_latency_s=0.01, communication_cost=10.0,
+            degraded=False, round=1,
+        )
+        for _ in range(rounds_n):
+            try:
+                ops.observe_round(rec)
+            except Exception as e:  # noqa: BLE001 — the test's verdict
+                errors.append(e)
+
+    def serve_feeder():
+        for _ in range(serve_n):
+            try:
+                ops.observe_serving(_summary(count=8, p99_ms=5.0))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    threads = [
+        threading.Thread(target=round_feeder),
+        threading.Thread(target=serve_feeder),
+        threading.Thread(target=serve_feeder),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert errors == []
+    assert ops.health.rounds == rounds_n
+    assert ops.health.serving["p99_ms"] == 5.0
+    assert wd.healthy
+
+
 def test_breaker_bundle_carries_serving_ring(registry, tmp_path):
     obs = ObsConfig(serve_port=None).validate()
     ops = OpsPlane.from_config(obs, registry=registry, bundle_dir=str(tmp_path))
@@ -671,6 +792,16 @@ def test_post_place_endpoint_roundtrip(registry):
         assert status == 400
         assert "unknown service" in json.loads(body)["error"]
         status, body, _ = _post(port, "/place", {"deadline_ms": 5})
+        assert status == 400
+        # non-numeric deadline_ms is a 400, not a handler crash
+        status, body, _ = _post(
+            port, "/place", {"service": svc, "deadline_ms": [1]}
+        )
+        assert status == 400
+        assert "deadline_ms" in json.loads(body)["error"]
+        status, body, _ = _post(
+            port, "/place", {"service": svc, "deadline_ms": "soon"}
+        )
         assert status == 400
         status, body, _ = _post(port, "/place", payload=[1, 2])
         assert status == 400

@@ -1050,6 +1050,34 @@ def bench_serve(requests: int, rate_rps: int, max_batch: int) -> dict:
             "vs_baseline": round(250.0 / max(p99, 1e-9), 3),
             "extra": extra_common,
         },
+        "slo_reading": _slo_reading(soak, extra_common),
+    }
+
+
+def _slo_reading(soak: dict, extra_common: dict) -> dict:
+    """The serve cell's third ledger series: the fraction of a 99%
+    availability SLO's error budget this soak burned (answered = good,
+    shed/timeout = bad; 1.0 = budget exactly spent, >1 = SLO violated)."""
+    from kubernetes_rescheduling_tpu.telemetry.slo import budget_burn_frac
+
+    objective = 0.99
+    good = soak["answered"]
+    bad = soak["shed"] + soak["timed_out"]
+    burn = budget_burn_frac(good, bad, objective)
+    return {
+        "metric": "slo_budget_burn_frac",
+        "value": round(min(burn, 1e9), 4),
+        "unit": "frac",
+        "better": "lower",
+        # vs a full budget: the headroom multiple (capped; 0 burn means
+        # the whole budget is headroom)
+        "vs_baseline": round(1.0 / max(burn, 1e-9), 3) if burn > 0 else 1e9,
+        "extra": {
+            **extra_common,
+            "objective": objective,
+            "good": good,
+            "bad": bad,
+        },
     }
 
 
@@ -1117,10 +1145,13 @@ def main() -> int:
             _env_int("BENCH_SERVE_BATCH", 8),
         )
         _ledger_append(result)
-        # the p99 latency is its own ledger series, paired with the
-        # throughput headline (the schema checker enforces the nesting)
+        # the p99 latency and the SLO budget burn are their own ledger
+        # series, paired with the throughput headline (the schema
+        # checker enforces both nestings)
         if isinstance(result.get("p99_reading"), dict):
             _ledger_append(result["p99_reading"])
+        if isinstance(result.get("slo_reading"), dict):
+            _ledger_append(result["slo_reading"])
         print(json.dumps(result))
         return 0
 

@@ -411,6 +411,11 @@ def run_fleet_controller(
     tseries = TenantSeries(
         registry, tenants=len(backends), budget=obs.tenant_label_budget
     )
+    if ops is not None:
+        # per-tenant SLO budgets publish through the same gate, so an
+        # over-budget fleet suppresses them (counted) instead of forking
+        # a second cardinality policy
+        ops.bind_tenant_series(tseries)
     tenants = [
         _Tenant(
             name,

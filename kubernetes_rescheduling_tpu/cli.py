@@ -350,6 +350,96 @@ def _add_serve_flags(parser: argparse.ArgumentParser) -> None:
              "a flight-recorder bundle with the in-flight request ring "
              "(0 = rule off)",
     )
+    _add_slo_flags(parser)
+
+
+def _add_slo_flags(parser: argparse.ArgumentParser) -> None:
+    """SLO v2: the history plane + error-budget engine ([slo] block)."""
+    parser.add_argument(
+        "--slo", action="store_true",
+        help="enable the SLO v2 plane: sample selected registry families "
+             "into the bounded history store each round/batch, account "
+             "per-SLO error budgets, and page/ticket on multi-window "
+             "burn rates (slo_fast_burn / slo_slow_burn watchdog rules, "
+             "/slo and /query endpoints with --serve)",
+    )
+    parser.add_argument(
+        "--slo-objective", type=float, default=None, metavar="FRAC",
+        help="success-fraction objective every default SLO targets "
+             "(default: the [slo] block's objective, 0.99 = 1%% budget)",
+    )
+    parser.add_argument(
+        "--slo-latency-ms", type=float, default=None, metavar="MS",
+        help="additionally compile a serving-latency SLO: requests over "
+             "this end-to-end threshold burn budget (default: the [slo] "
+             "block's latency_threshold_ms, 0 = off)",
+    )
+    parser.add_argument(
+        "--slo-budget-window", type=int, default=None, metavar="TICKS",
+        help="error-budget accounting window in ticks — rounds/batches, "
+             "not wall time (default: the [slo] block's budget_window, "
+             "512)",
+    )
+    parser.add_argument(
+        "--slo-fast-window", type=int, default=None, metavar="TICKS",
+        help="fast (page) burn window in ticks; an implicit 1/12 "
+             "confirm window rides along (default: the [slo] block's "
+             "fast_window, 48)",
+    )
+    parser.add_argument(
+        "--slo-fast-burn", type=float, default=None, metavar="X",
+        help="fast burn-rate threshold in budget multiples; both fast "
+             "windows over it fire slo_fast_burn (default: the [slo] "
+             "block's fast_burn, 14.4; 0 = rule off)",
+    )
+    parser.add_argument(
+        "--slo-slow-window", type=int, default=None, metavar="TICKS",
+        help="slow (ticket) burn window in ticks (default: the [slo] "
+             "block's slow_window, 288)",
+    )
+    parser.add_argument(
+        "--slo-slow-burn", type=float, default=None, metavar="X",
+        help="slow burn-rate threshold; both slow windows over it fire "
+             "slo_slow_burn (default: the [slo] block's slow_burn, 6.0; "
+             "0 = rule off)",
+    )
+    parser.add_argument(
+        "--slo-series-capacity", type=int, default=None, metavar="N",
+        help="history-plane ring points per series (default: the [slo] "
+             "block's series_capacity, 512)",
+    )
+    parser.add_argument(
+        "--slo-max-series", type=int, default=None, metavar="N",
+        help="history-plane hard global series budget; beyond it the "
+             "least-recently-updated ring is evicted and counted "
+             "(default: the [slo] block's max_series, 256)",
+    )
+
+
+def _slo_config(args):
+    """The SloConfig a run command builds from its --slo* flags (None
+    flags fall through to the frozen block's defaults)."""
+    from kubernetes_rescheduling_tpu.config import SloConfig
+
+    base = SloConfig(enabled=bool(getattr(args, "slo", False)))
+    overrides = {
+        k: v
+        for k, v in (
+            ("objective", getattr(args, "slo_objective", None)),
+            ("latency_threshold_ms", getattr(args, "slo_latency_ms", None)),
+            ("budget_window", getattr(args, "slo_budget_window", None)),
+            ("fast_window", getattr(args, "slo_fast_window", None)),
+            ("fast_burn", getattr(args, "slo_fast_burn", None)),
+            ("slow_window", getattr(args, "slo_slow_window", None)),
+            ("slow_burn", getattr(args, "slo_slow_burn", None)),
+            ("series_capacity", getattr(args, "slo_series_capacity", None)),
+            ("max_series", getattr(args, "slo_max_series", None)),
+        )
+        if v is not None
+    }
+    import dataclasses as _dc
+
+    return _dc.replace(base, **overrides) if overrides else base
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -663,11 +753,15 @@ def cmd_telemetry(args) -> str:
     mode, paths = "report", list(args.paths)
     if paths and paths[0] in (
         "report", "explain", "bundle", "perf", "topo", "dataset", "shadow",
-        "fleet",
+        "fleet", "slo",
     ):
         mode, paths = paths[0], paths[1:]
     if not paths:
         raise SystemExit(f"telemetry {mode}: no artifact paths given")
+    if mode == "slo":
+        from kubernetes_rescheduling_tpu.telemetry.report import report_slo
+
+        return report_slo(paths)
     if mode == "shadow":
         from kubernetes_rescheduling_tpu.telemetry.report import report_shadow
 
@@ -714,7 +808,7 @@ def _build_ops_plane(args, config):
     obs = _dc.replace(config.obs, serve_port=args.serve)
     logger = get_logger()
     ops = OpsPlane.from_config(
-        obs, logger=logger, bundle_dir=args.bundle_dir
+        obs, slo=config.slo, logger=logger, bundle_dir=args.bundle_dir
     ).start()
     port = ops.server.port if ops.server is not None else None
     if port is not None:
@@ -835,6 +929,7 @@ def cmd_fleet_reschedule(args, algo: str) -> dict:
             if args.tenant_label_budget is not None
             else _obs_config(args)
         ),
+        slo=_slo_config(args),
     )
     try:
         cfg.validate()
@@ -1000,6 +1095,7 @@ def cmd_reschedule(args) -> dict:
         perf=PerfConfig(ledger_path=args.perf_ledger),
         obs=_obs_config(args),
         serving=_serving_config(args),
+        slo=_slo_config(args),
     )
     ops, logger = _build_ops_plane(args, cfg)
     engine = None

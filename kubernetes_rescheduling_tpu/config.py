@@ -662,6 +662,80 @@ class ServingConfig:
 
 
 @dataclass(frozen=True)
+class SloConfig:
+    """SLO v2 block (``[slo]`` in TOML): error budgets and multi-window
+    burn-rate alerting over the in-process history plane
+    (``telemetry/timeseries.py`` + ``telemetry/slo.py``). jax-free.
+
+    ``enabled`` turns the plane on (off by default: disabled runs must
+    stay bit-identical to pre-SLO output). ``objective`` is the success
+    fraction every default SLO targets (0.99 = 1% error budget);
+    ``latency_threshold_ms`` additionally compiles a serving-latency SLO
+    over the ``serving_request_seconds{stage="total"}`` histogram (0
+    disables it). All windows are in *ticks* (rounds/batches — the sim
+    clock is not wall time): ``budget_window`` is the long accounting
+    window behind ``slo_budget_remaining_frac``; the
+    ``fast_window``/``fast_burn`` pair is the page
+    (``slo_fast_burn``, the 5m-of-1h analogue with a 14.4x default
+    threshold), ``slow_window``/``slow_burn`` the ticket
+    (``slo_slow_burn``, 6x); each long window carries an implicit 1/12
+    confirm window, and a burn of 0 disables that rule.
+    ``series_capacity``/``max_series`` bound the history plane: points
+    per ring and the hard global series budget (LRU-evicted, counted
+    ``timeseries_evictions_total``)."""
+
+    enabled: bool = False
+    objective: float = 0.99
+    latency_threshold_ms: float = 0.0
+    budget_window: int = 512
+    fast_window: int = 48
+    fast_burn: float = 14.4
+    slow_window: int = 288
+    slow_burn: float = 6.0
+    series_capacity: int = 512
+    max_series: int = 256
+
+    def validate(self) -> "SloConfig":
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"slo objective must be in (0, 1), got {self.objective}"
+            )
+        if self.latency_threshold_ms < 0:
+            raise ValueError(
+                f"slo latency_threshold_ms must be >= 0 (0 disables the "
+                f"latency SLO), got {self.latency_threshold_ms}"
+            )
+        for name in ("budget_window", "fast_window", "slow_window"):
+            if getattr(self, name) < 2:
+                raise ValueError(
+                    f"slo {name} must be >= 2, got {getattr(self, name)}"
+                )
+        if self.fast_window >= self.slow_window:
+            raise ValueError(
+                f"slo fast_window ({self.fast_window}) must be shorter "
+                f"than slow_window ({self.slow_window})"
+            )
+        if self.budget_window < self.slow_window:
+            raise ValueError(
+                f"slo budget_window ({self.budget_window}) must cover "
+                f"slow_window ({self.slow_window})"
+            )
+        if self.fast_burn < 0 or self.slow_burn < 0:
+            raise ValueError(
+                "slo burn thresholds must be >= 0 (0 disables the rule)"
+            )
+        if self.series_capacity < 2:
+            raise ValueError(
+                f"slo series_capacity must be >= 2, got {self.series_capacity}"
+            )
+        if self.max_series < 1:
+            raise ValueError(
+                f"slo max_series must be >= 1, got {self.max_series}"
+            )
+        return self
+
+
+@dataclass(frozen=True)
 class RescheduleConfig:
     """One config object for a rescheduling run."""
 
@@ -773,6 +847,9 @@ class RescheduleConfig:
     # POST /place (bounded batcher, per-request deadlines, stage-span
     # telemetry) — see ServingConfig.
     serving: ServingConfig = field(default_factory=ServingConfig)
+    # SLO v2: error budgets + multi-window burn-rate alerting over the
+    # in-process history plane — see SloConfig.
+    slo: SloConfig = field(default_factory=SloConfig)
 
     def validate(self) -> "RescheduleConfig":
         valid = set(POLICIES) | {"global", "proactive"}
@@ -883,6 +960,7 @@ class RescheduleConfig:
         self.obs.validate()
         self.perf.validate()
         self.serving.validate()
+        self.slo.validate()
         if self.serving.enabled and self.algorithm not in POLICIES:
             raise ValueError(
                 "the serving plane scores requests with the greedy "
@@ -1028,4 +1106,6 @@ class RescheduleConfig:
             data["perf"] = PerfConfig(**data["perf"])
         if isinstance(data.get("serving"), dict):
             data["serving"] = ServingConfig(**data["serving"])
+        if isinstance(data.get("slo"), dict):
+            data["slo"] = SloConfig(**data["slo"])
         return cls(**data).validate()

@@ -76,6 +76,106 @@ def _scan_plane_lines(latest: dict[tuple, dict[str, Any]]) -> list[str]:
     return out
 
 
+def _hist_quantile(rec: dict[str, Any], q: float) -> float | None:
+    """Approximate a quantile from a snapshot histogram record's
+    per-bucket counts (linear interpolation inside the covering bucket;
+    the +Inf bucket answers with the recorded max)."""
+    count = rec.get("count") or 0
+    if not count:
+        return None
+    target = q * count
+    buckets = rec.get("buckets") or {}
+    edges = sorted((float(ub), n) for ub, n in buckets.items())
+    cum = 0.0
+    lo = rec.get("min") or 0.0
+    for ub, n in edges:
+        if cum + n >= target and n > 0:
+            frac = (target - cum) / n
+            return lo + frac * (ub - lo)
+        cum += n
+        lo = ub
+    return rec.get("max")
+
+
+def _serving_plane_lines(
+    latest: dict[tuple, dict[str, Any]], records: list[dict[str, Any]]
+) -> list[str]:
+    """The serving-plane digest: outcome totals, end-to-end latency
+    percentiles interpolated from the total-stage histogram, the shed
+    breakdown, and the batch-size distribution — only when the run
+    actually served (``serving_placements_total`` present). Placement
+    rate needs a time axis, so it renders only when the dump appended
+    >= 2 snapshots (their ``ts`` stamps are the axis)."""
+    outcomes: dict[str, float] = {}
+    shed: dict[str, float] = {}
+    total_hist = None
+    batch_hist = None
+    inflight = None
+    for (metric, _), rec in latest.items():
+        labels = rec.get("labels") or {}
+        if metric == "serving_placements_total":
+            outcomes[str(labels.get("outcome"))] = rec.get("value", 0)
+        elif metric == "serving_shed_total":
+            shed[str(labels.get("reason"))] = rec.get("value", 0)
+        elif (
+            metric == "serving_request_seconds"
+            and labels.get("stage") == "total"
+        ):
+            total_hist = rec
+        elif metric == "serving_batch_size":
+            batch_hist = rec
+        elif metric == "serving_inflight":
+            inflight = rec.get("value")
+    if not outcomes:
+        return []
+    out = [
+        "  serving plane: "
+        + " ".join(f"{k}={v:g}" for k, v in sorted(outcomes.items()))
+        + (f" inflight={inflight:g}" if inflight is not None else "")
+    ]
+    if total_hist is not None and total_hist.get("count"):
+        p50 = _hist_quantile(total_hist, 0.50)
+        p95 = _hist_quantile(total_hist, 0.95)
+        p99 = _hist_quantile(total_hist, 0.99)
+        mean = total_hist["sum"] / total_hist["count"]
+        out.append(
+            f"    latency(total): p50={p50 * 1e3:.2f}ms "
+            f"p95={p95 * 1e3:.2f}ms p99={p99 * 1e3:.2f}ms "
+            f"mean={mean * 1e3:.2f}ms count={total_hist['count']}"
+        )
+    # rate needs a time axis: diff the first/last appended snapshot of
+    # the total-stage count over their dump timestamps
+    snaps = [
+        r
+        for r in records
+        if r.get("metric") == "serving_request_seconds"
+        and (r.get("labels") or {}).get("stage") == "total"
+    ]
+    if len(snaps) >= 2:
+        dt = (snaps[-1].get("ts") or 0) - (snaps[0].get("ts") or 0)
+        dc = (snaps[-1].get("count") or 0) - (snaps[0].get("count") or 0)
+        if dt > 0 and dc >= 0:
+            out.append(f"    placements/sec: {dc / dt:.2f} (over {dt:.2f}s)")
+    if shed:
+        out.append(
+            "    shed: "
+            + ", ".join(f"{k}×{v:g}" for k, v in sorted(shed.items()))
+        )
+    if batch_hist is not None and batch_hist.get("count"):
+        dist = ", ".join(
+            f"≤{float(ub):g}×{n:g}"
+            for ub, n in sorted(
+                (batch_hist.get("buckets") or {}).items(),
+                key=lambda kv: float(kv[0]),
+            )
+            if n
+        )
+        if batch_hist.get("inf"):
+            dist += f", +Inf×{batch_hist['inf']:g}"
+        out.append(f"    batch sizes: {dist} (count={batch_hist['count']})")
+    return out
+
+
 def summarize_metrics(records: list[dict[str, Any]]) -> list[str]:
     """Registry-dump JSONL (``MetricsRegistry.dump_jsonl``) → text lines.
     When a run appended several snapshots, the LAST sample per series
@@ -85,6 +185,7 @@ def summarize_metrics(records: list[dict[str, Any]]) -> list[str]:
         key = (rec["metric"], tuple(sorted((rec.get("labels") or {}).items())))
         latest[key] = rec
     lines = _scan_plane_lines(latest)
+    lines += _serving_plane_lines(latest, records)
     for (metric, _), rec in sorted(latest.items()):
         labels = _labels_str(rec.get("labels") or {})
         if rec.get("type") == "histogram":
@@ -566,6 +667,96 @@ def report_fleet(paths: list[str]) -> str:
                     for dim, vals in sorted(dims.items())
                 )
                 out.append(f"    {tenant:<16} {cells}")
+    return "\n".join(out)
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list[float]) -> str:
+    """Unicode sparkline scaled to the series' own max (a flat zero
+    series renders all-low — exactly what a clean soak should show)."""
+    if not values:
+        return ""
+    top = max(values)
+    if top <= 0:
+        return _SPARK[0] * len(values)
+    return "".join(
+        _SPARK[min(int(v / top * (len(_SPARK) - 1) + 0.5), len(_SPARK) - 1)]
+        for v in values
+    )
+
+
+def report_slo(paths: list[str]) -> str:
+    """The ``telemetry slo`` report: the error-budget table plus burn
+    sparklines. Feeds on either artifact kind — a metrics dump JSONL
+    (``slo_budget_remaining_frac``/``slo_burn_rate`` samples, sparklines
+    over the appended snapshots in file order) or an events JSONL
+    (burn-rule ``slo_violation``/``slo_recovered`` entries)."""
+    out = []
+    for p in paths:
+        out.append(f"== {p} ==")
+        path = Path(p)
+        if not path.is_file():
+            out.append("  not a file")
+            continue
+        try:
+            records = _read_jsonl(path)
+        except (OSError, json.JSONDecodeError) as e:
+            out.append(f"  unreadable: {e}")
+            continue
+        # metrics-dump shape: budget gauges + burn-rate history
+        budgets: dict[str, float] = {}
+        burns: dict[tuple[str, str], list[float]] = {}
+        for rec in records:
+            metric = rec.get("metric")
+            labels = rec.get("labels") or {}
+            if metric == "slo_budget_remaining_frac":
+                budgets[str(labels.get("slo"))] = rec.get("value", 0.0)
+            elif metric == "slo_burn_rate":
+                burns.setdefault(
+                    (str(labels.get("slo")), str(labels.get("window"))), []
+                ).append(rec.get("value", 0.0))
+        if budgets:
+            out.append(
+                "  slo                      budget     burn(fast)  burn(slow)"
+            )
+            for slo in sorted(budgets):
+                fast = burns.get((slo, "fast")) or [0.0]
+                slow = burns.get((slo, "slow")) or [0.0]
+                out.append(
+                    f"  {slo:<24} {budgets[slo] * 100:>7.2f}%  "
+                    f"{fast[-1]:>9.2f}  {slow[-1]:>9.2f}"
+                )
+            for (slo, window), vals in sorted(burns.items()):
+                out.append(
+                    f"    burn {slo}/{window}: {_sparkline(vals[-64:])} "
+                    f"(last {vals[-1]:.2f})"
+                )
+            continue
+        # events shape: the burn rules' violation/recovery trail
+        burn_events = [
+            r
+            for r in records
+            if r.get("event") in ("slo_violation", "slo_recovered")
+            and str(r.get("rule", "")).startswith("slo_")
+        ]
+        if not burn_events:
+            out.append(
+                "  no slo samples or burn events (was this run started "
+                "with --slo?)"
+            )
+            continue
+        for ev in burn_events:
+            if ev.get("event") == "slo_violation":
+                out.append(
+                    f"  VIOLATION {ev.get('rule')} slo={ev.get('slo', '?')} "
+                    f"burn={ev.get('burn_rate', '?')} over "
+                    f"{ev.get('window', '?')}t "
+                    f"(budget {float(ev.get('budget_remaining_frac', 0)) * 100:.1f}% left)"
+                )
+            else:
+                out.append(f"  recovered {ev.get('rule')}")
     return "\n".join(out)
 
 

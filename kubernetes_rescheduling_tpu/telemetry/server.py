@@ -30,6 +30,12 @@ Endpoints (``--serve PORT`` on ``reschedule``/``bench``):
   placed/no_candidate, 503 on shed/timeout (back off) or when no engine
   is attached. Slow scrapes cannot head-of-line-block it: the heavy
   read paths share a lock, /place does not take it.
+- ``GET /slo`` — the SLO v2 budget/burn table (``telemetry.slo``): per
+  SLO the objective, error-budget remaining, fast/slow burn rates, and
+  time-to-exhaustion. 404 when the slo plane is disabled.
+- ``GET /query?series=&n=`` — bounded raw readout of one history-plane
+  ring (``telemetry.timeseries.SeriesStore``); a bare /query lists the
+  retained series names. 404 when disabled or the series is unknown.
 
 The server runs daemon threads and binds 127.0.0.1 by default; port 0
 picks an ephemeral port (tests). Handlers never write to stdout/stderr —
@@ -61,6 +67,7 @@ from kubernetes_rescheduling_tpu.telemetry.registry import (
     MetricsRegistry,
     get_registry,
 )
+from kubernetes_rescheduling_tpu.telemetry.slo import RULE_FAST_BURN
 from kubernetes_rescheduling_tpu.telemetry.spans import get_tracer
 from kubernetes_rescheduling_tpu.telemetry.watchdog import SLORules, Watchdog
 
@@ -184,6 +191,8 @@ class OpsServer:
         events_source=None,  # zero-arg callable -> list[dict]
         tenants_source=None,  # zero-arg callable -> TenantSummaryRing | None
         serving_source=None,  # zero-arg callable -> ServingEngine | None
+        slo_source=None,  # zero-arg callable -> budget/burn table | None
+        query_source=None,  # callable(series, n) -> (payload, code)
     ) -> None:
         self._port = port
         self.host = host
@@ -192,6 +201,8 @@ class OpsServer:
         self.events_source = events_source
         self.tenants_source = tenants_source
         self.serving_source = serving_source
+        self.slo_source = slo_source
+        self.query_source = query_source
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         # serializes the SLOW read paths (full-registry exposition, event/
@@ -263,7 +274,7 @@ def _make_handler(ops: OpsServer):
             if endpoint.startswith("/tenants/"):
                 counted = "/tenants/<name>"
             elif endpoint in ("/", "/metrics", "/healthz", "/events",
-                              "/tenants", "/place"):
+                              "/tenants", "/place", "/slo", "/query"):
                 counted = endpoint
             else:
                 counted = "<other>"
@@ -339,6 +350,46 @@ def _make_handler(ops: OpsServer):
                     json.dumps(payload, default=float).encode(),
                     "application/json",
                 )
+            elif endpoint == "/slo":
+                with ops._read_lock:
+                    table = (
+                        ops.slo_source()
+                        if ops.slo_source is not None
+                        else None
+                    )
+                if table is None:
+                    payload, code = {
+                        "error": "slo plane disabled (start with --slo / "
+                                 "an enabled [slo] block)"
+                    }, 404
+                else:
+                    payload, code = {"slos": table}, 200
+                self._respond(
+                    code,
+                    json.dumps(payload, default=float).encode(),
+                    "application/json",
+                )
+            elif endpoint == "/query":
+                if ops.query_source is None:
+                    payload, code = {
+                        "error": "slo plane disabled (start with --slo / "
+                                 "an enabled [slo] block)"
+                    }, 404
+                else:
+                    qs = parse_qs(url.query)
+                    series = (qs.get("series") or [None])[0]
+                    raw = qs.get("n")
+                    try:
+                        n = max(int(raw[0]), 0) if raw else None
+                    except ValueError:
+                        n = None
+                    with ops._read_lock:
+                        payload, code = ops.query_source(series, n)
+                self._respond(
+                    code,
+                    json.dumps(payload, default=float).encode(),
+                    "application/json",
+                )
             elif endpoint == "/place":
                 body = json.dumps(
                     {"error": "method not allowed: POST a placement "
@@ -357,7 +408,7 @@ def _make_handler(ops: OpsServer):
                         {"error": "not found",
                          "endpoints": ["/metrics", "/healthz", "/events",
                                        "/tenants", "/tenants/<name>",
-                                       "/place"]}
+                                       "/place", "/slo", "/query"]}
                     ).encode(),
                     "application/json",
                 )
@@ -472,6 +523,13 @@ class OpsPlane:
     # it); its bounded recent-request ring rides breaker-open and
     # serving_p99 flight-recorder bundles
     serving_engine: Any = field(default=None, repr=False)
+    # SLO v2: the bounded history plane (telemetry.timeseries.SeriesStore)
+    # and the error-budget engine (telemetry.slo.SloEngine) — both None
+    # unless [slo] is enabled; every observe_* tick samples the registry
+    # host-side into the store and re-evaluates burn under the lock
+    series_store: Any = field(default=None, repr=False)
+    slo_engine: Any = field(default=None, repr=False)
+    _slo_ticks: int = field(default=0, repr=False)
     span_tail: int = 12
     _prev_sigusr1: Any = field(default=None, repr=False)
     _sig_installed: bool = field(default=False, repr=False)
@@ -486,11 +544,14 @@ class OpsPlane:
         cls,
         obs,
         *,
+        slo=None,
         registry: MetricsRegistry | None = None,
         logger=None,
         bundle_dir: str | None = None,
     ) -> "OpsPlane":
-        """Build from a ``config.ObsConfig`` block (the CLI/harness path)."""
+        """Build from a ``config.ObsConfig`` block (the CLI/harness
+        path). ``slo`` optionally passes a ``config.SloConfig`` — an
+        enabled one attaches the history plane + error-budget engine."""
         health = HealthState(max_round_age_s=obs.max_round_age_s)
         watchdog = Watchdog(
             SLORules(
@@ -531,6 +592,34 @@ class OpsPlane:
             TenantSummaryRing,
         )
 
+        series_store = slo_engine = None
+        if slo is not None and getattr(slo, "enabled", False):
+            from kubernetes_rescheduling_tpu.telemetry.slo import (
+                SloEngine,
+                default_specs,
+            )
+            from kubernetes_rescheduling_tpu.telemetry.timeseries import (
+                SeriesStore,
+            )
+
+            series_store = SeriesStore(
+                capacity=slo.series_capacity,
+                max_series=slo.max_series,
+                registry=registry,
+            )
+            slo_engine = SloEngine(
+                default_specs(
+                    objective=slo.objective,
+                    latency_threshold_ms=slo.latency_threshold_ms,
+                ),
+                series_store,
+                registry=registry,
+                budget_window=slo.budget_window,
+                fast_window=slo.fast_window,
+                fast_burn=slo.fast_burn,
+                slow_window=slo.slow_window,
+                slow_burn=slo.slow_burn,
+            )
         plane = cls(
             registry=registry,
             logger=logger,
@@ -538,6 +627,8 @@ class OpsPlane:
             recorder=recorder,
             health=health,
             tenant_ring=TenantSummaryRing(),
+            series_store=series_store,
+            slo_engine=slo_engine,
         )
         if obs.serve_port is not None:
             plane.server = OpsServer(
@@ -547,6 +638,8 @@ class OpsPlane:
                 events_source=plane._events,
                 tenants_source=plane._tenants,
                 serving_source=plane._serving,
+                slo_source=plane._slo_table,
+                query_source=plane._series_query,
             )
         return plane
 
@@ -563,6 +656,79 @@ class OpsPlane:
         ring = self.tenant_ring
         return ring if ring is not None and len(ring) else None
 
+    def _slo_table(self):
+        """The /slo source: the engine's last budget/burn evaluation
+        (None when the slo plane is off, which the handler maps to 404)."""
+        if self.slo_engine is None:
+            return None
+        with self._watchdog_lock:
+            return self.slo_engine.table()
+
+    def _series_query(self, series, n):
+        """The /query source: (payload, http code). A bare /query lists
+        the retained series names (bounded by max_series); naming one
+        returns its last ``n`` ring points. Reads under the watchdog
+        lock — the same lock every sampling tick holds — so an HTTP
+        walk never races a concurrent eviction."""
+        store = self.series_store
+        if store is None:
+            return {
+                "error": "slo plane disabled (start with --slo / an "
+                         "enabled [slo] block)"
+            }, 404
+        with self._watchdog_lock:
+            if not series:
+                return {"series": store.names()}, 200
+            try:
+                pts = store.query(series, n)
+            except KeyError:
+                return {
+                    "error": f"unknown series {series!r} (never sampled, "
+                             "or evicted by the series budget)"
+                }, 404
+            return {
+                "series": series,
+                "points": [[t, v] for t, v in pts],
+            }, 200
+
+    def _slo_tick_locked(self) -> list[dict]:
+        """One history-plane tick — caller MUST hold ``_watchdog_lock``.
+        Samples the registry snapshot (host-side values only: zero
+        device transfers by construction) into the store, re-evaluates
+        every SLO's budget/burn, and feeds the firing burn rules to the
+        watchdog. Returns the newly raised violations so the caller can
+        dump page bundles OUTSIDE the lock."""
+        if self.slo_engine is None or self.series_store is None:
+            return []
+        self._slo_ticks += 1
+        tick = self._slo_ticks
+        reg = (
+            self.registry
+            if self.registry is not None
+            else get_registry()
+        )
+        self.series_store.sample(reg.snapshot(), tick)
+        entries = self.slo_engine.evaluate(tick)
+        if self.watchdog is None:
+            return []
+        return self.watchdog.observe_slo_burn(entries)
+
+    def _dump_burn_pages(self, newly: list[dict]) -> None:
+        """Page-level burn entry dumps a flight-recorder bundle — file
+        I/O, so called outside the lock with the exactly-once ``newly``
+        list (the serving_p99 dump's no-double-dump discipline)."""
+        if self.recorder is None:
+            return
+        for violation in newly:
+            if violation.get("rule") == RULE_FAST_BURN:
+                self.recorder.dump(
+                    "slo_burn_page",
+                    slo=dict(violation),
+                    table=(
+                        self._slo_table() or []
+                    ),
+                )
+
     # ---- lifecycle ----
 
     def start(self) -> "OpsPlane":
@@ -576,6 +742,10 @@ class OpsPlane:
                 self.server.tenants_source = self._tenants
             if self.server.serving_source is None:
                 self.server.serving_source = self._serving
+            if self.server.slo_source is None:
+                self.server.slo_source = self._slo_table
+            if self.server.query_source is None:
+                self.server.query_source = self._series_query
             self.server.start()
         if (
             self.recorder is not None
@@ -637,9 +807,12 @@ class OpsPlane:
         self.health.mark_round()
         if record.degraded:
             self.health.degraded_rounds += 1
-        if self.watchdog is not None:
-            with self._watchdog_lock:
+        newly_burn: list[dict] = []
+        with self._watchdog_lock:
+            if self.watchdog is not None:
                 self.watchdog.observe_round(record, tenant=tenant)
+            newly_burn = self._slo_tick_locked()
+        self._dump_burn_pages(newly_burn)
         if self.recorder is not None:
             spans = [
                 {
@@ -713,6 +886,15 @@ class OpsPlane:
         self.serving_engine = engine
         engine.ops = self
 
+    def bind_tenant_series(self, tseries) -> None:
+        """Fleet mode: attach the run's ``TenantSeries`` cardinality
+        gate so per-tenant SLO budget gauges publish through it —
+        bit-identical at or under the label budget, suppressed and
+        counted over it. No-op when the slo plane is off."""
+        if self.slo_engine is not None:
+            with self._watchdog_lock:
+                self.slo_engine.tenant_series = tseries
+
     def observe_serving(
         self, summary: dict | None, requests: list | None = None
     ) -> None:
@@ -730,6 +912,10 @@ class OpsPlane:
             if self.watchdog is None:
                 return
             newly = self.watchdog.observe_serving(summary)
+            # the history-plane tick rides the SAME lock hold: burn is
+            # judged on the state that includes this batch's counters,
+            # so a fast burn can page on the very feed that crossed it
+            newly += self._slo_tick_locked()
         # the bundle dump (file I/O) happens outside the lock: `newly`
         # reports rule ENTRY exactly once, so concurrent feeders cannot
         # double-dump
@@ -743,6 +929,7 @@ class OpsPlane:
                     serving=dict(summary or {}),
                     requests=list(requests or []),
                 )
+        self._dump_burn_pages(newly)
 
     def observe_perf(self, verdicts: dict) -> None:
         """Feed a perf-ledger verdict set (``perf_ledger.detect``): arms/
@@ -769,9 +956,12 @@ class OpsPlane:
         payload for breaker-open bundles and the over-budget
         ``/healthz`` fleet summary."""
         self.latest_fleet_rollup = event if event is not None else rollup
-        if self.watchdog is not None:
-            with self._watchdog_lock:
+        newly_burn: list[dict] = []
+        with self._watchdog_lock:
+            if self.watchdog is not None:
                 self.watchdog.observe_fleet_rollup(rollup)
+            newly_burn = self._slo_tick_locked()
+        self._dump_burn_pages(newly_burn)
 
     def observe_tenant(
         self,
@@ -784,7 +974,9 @@ class OpsPlane:
     ) -> None:
         """Update one tenant's row in the bounded summary ring (the
         /tenants drill-down source). No-op when the plane has no ring
-        (a hand-built plane)."""
+        (a hand-built plane). With the slo plane attached, the round
+        also accounts against the tenant's per-tenant error budget
+        (published through the TenantSeries cardinality gate)."""
         if self.tenant_ring is not None:
             self.tenant_ring.observe(
                 tenant,
@@ -793,6 +985,10 @@ class OpsPlane:
                 drift=drift,
                 skipped=skipped,
             )
+        if self.slo_engine is not None and (record is not None or skipped):
+            ok = not skipped and not bool((record or {}).get("degraded"))
+            with self._watchdog_lock:
+                self.slo_engine.observe_tenant_round(tenant, ok)
 
     def observe_skip(self, rnd: int, breaker_state: str | None = None) -> None:
         self.health.skipped_rounds += 1

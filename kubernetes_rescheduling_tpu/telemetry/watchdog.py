@@ -30,10 +30,20 @@ rule stays active until a later verdict set clears the metric. Unlike the
 per-round windows, perf state survives :meth:`rebase` — it describes the
 ledger's cross-run history, not the current run's window.
 
+A fifth rule kind is the SLO v2 burn pair (``slo_fast_burn`` /
+``slo_slow_burn``): the error-budget engine (:mod:`telemetry.slo`)
+evaluates multi-window burn rates over the history plane and feeds the
+currently-firing entries via :meth:`Watchdog.observe_slo_burn` — the
+watchdog just does the entry/recovery bookkeeping, so burn alerts count,
+log, and flip /healthz exactly like the native rules.
+
 Entering violation increments ``slo_violations_total{rule}`` and logs an
 ``slo_violation`` event; leaving logs ``slo_recovered``. The set of
 currently-active violations (:attr:`Watchdog.active`) is what flips
-``/healthz`` unhealthy — a rule that recovers un-flips it.
+``/healthz`` unhealthy — a rule that recovers un-flips it. Every active
+entry carries the uniform ``{rule, value, threshold, since}`` quartet on
+top of its rule-specific detail, so /healthz consumers render legacy
+threshold rules and burn-rate rules identically.
 
 jax-free by design, like the registry it reads.
 """
@@ -42,6 +52,7 @@ from __future__ import annotations
 
 import collections
 import math
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -243,6 +254,13 @@ class Watchdog:
         # latest serving-plane summary (observe_serving feeds it after
         # every dispatched batch; its p99_ms/count judge the serving rule)
         self._serving: dict[str, Any] | None = None
+        # latest SLO-engine burn entries (observe_slo_burn feeds them
+        # each history-plane tick; merged into `now` verbatim so burn
+        # rules ride the same entry/recovery bookkeeping)
+        self._slo_burn: dict[str, dict[str, Any]] = {}
+        # rule -> wall time it entered violation (the structured
+        # /healthz verdicts' `since` field; cleared on recovery)
+        self._since: dict[str, float] = {}
         # fleet cost-rollup tail (p99 per fleet round) — rolling window
         self._fleet_tail: collections.deque[float] = collections.deque(
             maxlen=self.rules.window
@@ -276,6 +294,7 @@ class Watchdog:
         self._shadow = None
         self._scan_trip = None
         self._serving = None
+        self._slo_burn = {}
         self._overlap.clear()
         self._fleet_tail.clear()
         self.active = (
@@ -283,6 +302,9 @@ class Watchdog:
             if RULE_PERF in self.active
             else {}
         )
+        self._since = {
+            rule: t for rule, t in self._since.items() if rule in self.active
+        }
 
     def _reg(self) -> MetricsRegistry:
         return self.registry if self.registry is not None else get_registry()
@@ -398,6 +420,21 @@ class Watchdog:
         self._serving = dict(summary) if summary is not None else None
         return self.check()
 
+    def observe_slo_burn(
+        self, entries: dict[str, dict[str, Any]]
+    ) -> list[dict[str, Any]]:
+        """Feed the SLO engine's burn-rule entries for this tick
+        (``telemetry.slo.SloEngine.evaluate`` — rule name to detail dict,
+        empty when nothing burns). Burn rules ride the same
+        entry/recovery bookkeeping as every other rule: newly burning
+        counts ``slo_violations_total{rule}``, the burn dropping back
+        under threshold recovers. Returns the newly raised violations,
+        like :meth:`observe_round`."""
+        self._slo_burn = {
+            rule: dict(detail) for rule, detail in (entries or {}).items()
+        }
+        return self.check()
+
     def observe_perf(self, verdicts: dict[str, dict[str, Any]]) -> list[dict[str, Any]]:
         """Feed one perf-ledger verdict set (``perf_ledger.detect``).
         Metrics whose status is ``regressed`` arm the ``perf_regression``
@@ -418,6 +455,55 @@ class Watchdog:
                 ).labels(metric=key).inc()
         self._perf_active = regressed
         return self.check()
+
+    def _uniform(
+        self, rule: str, detail: dict[str, Any]
+    ) -> tuple[float, float]:
+        """(value, threshold) for the uniform verdict shape — the
+        measured quantity that tripped the rule and the boundary it
+        crossed. Rules whose detail already carries the pair (the burn
+        rules) are left alone by the setdefault in :meth:`check`."""
+        r = self.rules
+        if rule == RULE_LATENCY:
+            return detail.get("p95_s", 0.0), detail.get("threshold_s", 0.0)
+        if rule == RULE_COST:
+            base = detail.get("baseline", 0.0)
+            return (
+                detail.get("cost", 0.0),
+                base * (1.0 + detail.get("threshold_frac", 0.0)),
+            )
+        if rule == RULE_RETRACE:
+            return float(len(detail.get("fns") or ())), float(
+                detail.get("max_retraces", r.max_retraces)
+            )
+        if rule == RULE_ATTRIBUTION:
+            return detail.get("share", 0.0), detail.get("threshold_frac", 0.0)
+        if rule == RULE_FORECAST:
+            return detail.get("skill", 0.0), detail.get("threshold", 0.0)
+        if rule == RULE_PIPELINE:
+            return (
+                detail.get("overlap_ratio_mean", 0.0),
+                detail.get("threshold", 0.0),
+            )
+        if rule == RULE_RECONCILE:
+            return float(detail.get("drift_pods", 0)), float(
+                detail.get("threshold", 0)
+            )
+        if rule == RULE_FLEET_TAIL:
+            base = detail.get("baseline", 0.0)
+            return (
+                detail.get("p99_cost", 0.0),
+                base * (1.0 + detail.get("threshold_frac", 0.0)),
+            )
+        if rule == RULE_SHADOW:
+            return detail.get("win_rate", 0.0), detail.get("threshold", 0.0)
+        if rule == RULE_SERVING:
+            return detail.get("p99_ms", 0.0), detail.get("threshold_ms", 0.0)
+        if rule == RULE_PERF:
+            return float(detail.get("count", 0)), 0.0
+        # scan_tripwire and anything without a numeric axis: the device
+        # latched a boolean verdict — 1 over a 0 threshold
+        return 1.0, 0.0
 
     def check(self) -> list[dict[str, Any]]:
         r = self.rules
@@ -590,6 +676,22 @@ class Watchdog:
                 },
                 "count": len(self._perf_active),
             }
+        for rule, detail in self._slo_burn.items():
+            now[rule] = dict(detail)
+
+        # uniform verdict shape: every active rule carries value /
+        # threshold / since alongside its rule-specific detail, so the
+        # /healthz consumer renders burn-rate and legacy threshold rules
+        # identically without knowing either's keys
+        t_now = time.time()
+        for rule, detail in now.items():
+            value, threshold = self._uniform(rule, detail)
+            detail.setdefault("value", value)
+            detail.setdefault("threshold", threshold)
+            detail["since"] = self._since.setdefault(rule, t_now)
+        for rule in list(self._since):
+            if rule not in now:
+                del self._since[rule]
 
         raised = []
         for rule, detail in now.items():

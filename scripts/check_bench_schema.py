@@ -82,6 +82,13 @@ def check_parsed(parsed, where: str) -> list[str]:
                 "p99_reading sibling (the serving ledger is a PAIR of "
                 "series: placements/sec AND p99 ms)"
             )
+        if not isinstance(parsed.get("slo_reading"), dict):
+            out.append(
+                f"{where}: serving_placements_per_sec must nest its "
+                "slo_reading sibling (the serve cell's error-budget "
+                "burn — rate and tail without budget accounting is "
+                "still half a story)"
+            )
     if metric == "serving_p99_ms":
         if parsed.get("better") != "lower":
             out.append(
@@ -90,6 +97,16 @@ def check_parsed(parsed, where: str) -> list[str]:
             )
         if parsed.get("unit") != "ms":
             out.append(f"{where}: serving_p99_ms must carry unit='ms'")
+    if metric == "slo_budget_burn_frac":
+        if parsed.get("better") != "lower":
+            out.append(
+                f"{where}: slo_budget_burn_frac must declare "
+                "better='lower' (budget burned, not budget left)"
+            )
+        if parsed.get("unit") != "frac":
+            out.append(
+                f"{where}: slo_budget_burn_frac must carry unit='frac'"
+            )
     # nested ledger readings (``*_reading`` — the fleet cell's rollup and
     # global-amortization series, and any future sibling): each is
     # appended to the perf ledger as its OWN series, so each must carry

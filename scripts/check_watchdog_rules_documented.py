@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Static check: every watchdog/SLO rule name has a doc-table row.
+
+The rule inventory (`RULE_*` constants in ``telemetry/watchdog.py`` and
+``telemetry/slo.py``) is the vocabulary of every /healthz verdict,
+``slo_violations_total{rule}`` label, and flight-recorder trigger — an
+operator reading an alert looks the rule up in OBSERVABILITY.md's "SLO
+watchdog" table. Both directions drift silently: a new rule shipped
+without a row is an undocumented page, and a renamed rule leaves a
+ghost row describing nothing. This checker pins both, in the style of
+``check_metrics_documented.py``.
+
+Usage:
+    python scripts/check_watchdog_rules_documented.py
+
+Exits 1 listing undocumented rules and ghost rows. The test twin
+(tests/test_watchdog_rules_documented.py) runs the same ``violations()``
+no-args self-check plus synthetic drift cases through the text-taking
+helpers.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+RULE_SOURCES = (
+    ROOT / "kubernetes_rescheduling_tpu" / "telemetry" / "watchdog.py",
+    ROOT / "kubernetes_rescheduling_tpu" / "telemetry" / "slo.py",
+)
+DOC = ROOT / "OBSERVABILITY.md"
+
+# module-level RULE_* constants bound to a string literal — the one
+# registration idiom both modules use
+_RULE_DEF = re.compile(r'^RULE_[A-Z0-9_]+\s*=\s*"([a-z0-9_]+)"', re.M)
+_BACKTICKED = re.compile(r"`([a-z0-9_]+)`")
+
+
+def registered_rules(sources: list[str]) -> set[str]:
+    """Rule names bound to ``RULE_*`` constants in the given sources."""
+    out: set[str] = set()
+    for text in sources:
+        out.update(_RULE_DEF.findall(text))
+    return out
+
+
+def documented_rules(doc_text: str) -> set[str]:
+    """Backticked names in the FIRST column of the "SLO watchdog"
+    section's table rows (header/divider rows carry no backticks)."""
+    out: set[str] = set()
+    in_section = False
+    for line in doc_text.splitlines():
+        if line.startswith("## "):
+            in_section = line.strip() == "## SLO watchdog"
+            continue
+        if not in_section or not line.startswith("|"):
+            continue
+        cells = line.split("|")
+        if len(cells) < 2:
+            continue
+        m = _BACKTICKED.search(cells[1])
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def violations(
+    sources: list[str] | None = None, doc_text: str | None = None
+) -> list[str]:
+    if sources is None:
+        sources = [p.read_text() for p in RULE_SOURCES]
+    if doc_text is None:
+        doc_text = DOC.read_text()
+    rules = registered_rules(sources)
+    documented = documented_rules(doc_text)
+    out = [
+        f"rule {name!r} is registered but has no row in OBSERVABILITY.md's "
+        "SLO watchdog table"
+        for name in sorted(rules - documented)
+    ]
+    out += [
+        f"OBSERVABILITY.md documents rule {name!r} but no RULE_* constant "
+        "registers it (ghost row — renamed or removed rule?)"
+        for name in sorted(documented - rules)
+    ]
+    if not rules:
+        out.append("no RULE_* constants found (checker regex drifted?)")
+    return out
+
+
+def main() -> int:
+    bad = violations()
+    if bad:
+        sys.stderr.write(
+            "watchdog rule inventory drift:\n"
+            + "".join(f"  {v}\n" for v in bad)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -78,6 +78,43 @@ def build_registry() -> MetricsRegistry:
         (0.5, "total"), (120e-6, "queue_wait"),
     ):
         sr.labels(stage=stage).observe(v)
+    # the SLO v2 families render through the real history plane + budget
+    # engine: a 2-series budget store fed synthetic counters over 4
+    # ticks, one evaluation (publishes the budget/burn gauges), then a
+    # third family past the hard series budget — exactly one counted LRU
+    # eviction (timeseries_evictions_total 1, timeseries_series 2)
+    from kubernetes_rescheduling_tpu.telemetry.slo import SloEngine, SloSpec
+    from kubernetes_rescheduling_tpu.telemetry.timeseries import SeriesStore
+
+    store = SeriesStore(
+        capacity=8, max_series=2, registry=registry,
+        families=("ok_total", "bad_total", "spill_total"),
+    )
+    for tick, (ok, bad) in enumerate(
+        ((10, 0), (20, 1), (30, 3), (40, 6)), start=1
+    ):
+        store.sample(
+            [
+                {"metric": "ok_total", "type": "counter", "labels": {},
+                 "value": float(ok)},
+                {"metric": "bad_total", "type": "counter", "labels": {},
+                 "value": float(bad)},
+            ],
+            tick,
+        )
+    engine = SloEngine(
+        (SloSpec(name="golden", objective=0.9,
+                 good=(("ok_total", ()),), bad=(("bad_total", ()),)),),
+        store, registry=registry,
+        budget_window=8, fast_window=4, fast_burn=2.0,
+        slow_window=6, slow_burn=1.5,
+    )
+    engine.evaluate(4)
+    store.sample(
+        [{"metric": "spill_total", "type": "counter", "labels": {},
+          "value": 1.0}],
+        5,
+    )
     return registry
 
 

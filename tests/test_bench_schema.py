@@ -191,7 +191,7 @@ def test_scan_headline_conforms():
 
 def _serve_like():
     """bench.bench_serve's paired shape: the placements/sec headline
-    nesting its p99 latency sibling."""
+    nesting its p99 latency and error-budget-burn siblings."""
     return {
         "metric": "serving_placements_per_sec",
         "value": 355.3,
@@ -206,6 +206,15 @@ def _serve_like():
             "better": "lower",
             "vs_baseline": 16.2,
             "extra": {"scenario": "serve"},
+        },
+        "slo_reading": {
+            "metric": "slo_budget_burn_frac",
+            "value": 0.31,
+            "unit": "frac",
+            "better": "lower",
+            "vs_baseline": 3.2,
+            "extra": {"scenario": "serve", "objective": 0.99,
+                      "good": 62, "bad": 2},
         },
     }
 
@@ -241,3 +250,12 @@ def test_serve_pair_corruptions_are_caught():
     # inside it must be caught by the recursive *_reading walk
     bad = corrupt(lambda d: d["p99_reading"].__setitem__("value", None))
     assert any("p99_reading" in v and "finite" in v for v in bad)
+    # the error-budget sibling has its own pinned corruption classes: a
+    # serve cell that drops budget accounting, flips the direction, or
+    # drifts the unit must be flagged, not silently ingested
+    bad = corrupt(lambda d: d.pop("slo_reading"))
+    assert any("slo_reading" in v for v in bad)
+    bad = corrupt(lambda d: d["slo_reading"].__setitem__("better", "higher"))
+    assert any("better='lower'" in v and "budget" in v for v in bad)
+    bad = corrupt(lambda d: d["slo_reading"].__setitem__("unit", "pct"))
+    assert any("unit='frac'" in v for v in bad)

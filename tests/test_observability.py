@@ -246,6 +246,43 @@ def test_exposition_golden_file(registry):
         (0.5, "total"), (120e-6, "queue_wait"),
     ):
         sr.labels(stage=stage).observe(v)
+    # the SLO v2 families render through the real history plane + budget
+    # engine (keep the feed IDENTICAL to make_exposition_golden.py's):
+    # a 2-series budget store, 4 ticks of synthetic counters, one
+    # evaluation, then a third family forcing exactly one counted LRU
+    # eviction
+    from kubernetes_rescheduling_tpu.telemetry.slo import SloEngine, SloSpec
+    from kubernetes_rescheduling_tpu.telemetry.timeseries import SeriesStore
+
+    store = SeriesStore(
+        capacity=8, max_series=2, registry=registry,
+        families=("ok_total", "bad_total", "spill_total"),
+    )
+    for tick, (ok, bad) in enumerate(
+        ((10, 0), (20, 1), (30, 3), (40, 6)), start=1
+    ):
+        store.sample(
+            [
+                {"metric": "ok_total", "type": "counter", "labels": {},
+                 "value": float(ok)},
+                {"metric": "bad_total", "type": "counter", "labels": {},
+                 "value": float(bad)},
+            ],
+            tick,
+        )
+    engine = SloEngine(
+        (SloSpec(name="golden", objective=0.9,
+                 good=(("ok_total", ()),), bad=(("bad_total", ()),)),),
+        store, registry=registry,
+        budget_window=8, fast_window=4, fast_burn=2.0,
+        slow_window=6, slow_burn=1.5,
+    )
+    engine.evaluate(4)
+    store.sample(
+        [{"metric": "spill_total", "type": "counter", "labels": {},
+          "value": 1.0}],
+        5,
+    )
     assert registry.expose() == golden.read_text()
 
 
@@ -290,6 +327,51 @@ def test_exposition_conformance_attribution_families(registry):
     assert samples[
         ("comm_cost_node_pair", frozenset([("src", "n1"), ("dst", "n2")]))
     ] == 0.0
+
+
+def test_exposition_conformance_slo_families(registry):
+    """Strict-parser pass over the SLO v2 families as a LIVE engine
+    emits them: budget/burn gauges every tick, the store's bound
+    gauge/eviction counter once the series budget trips."""
+    from kubernetes_rescheduling_tpu.telemetry.slo import SloEngine, SloSpec
+    from kubernetes_rescheduling_tpu.telemetry.timeseries import SeriesStore
+
+    store = SeriesStore(capacity=4, max_series=2, registry=registry,
+                        families=None)
+    engine = SloEngine(
+        (SloSpec(name="avail", objective=0.95,
+                 good=(("ok_total", ()),), bad=(("bad_total", ()),)),),
+        store, registry=registry,
+        budget_window=8, fast_window=4, slow_window=6,
+    )
+    for tick in range(1, 6):
+        store.sample(
+            [
+                {"metric": "ok_total", "type": "counter", "labels": {},
+                 "value": 10.0 * tick},
+                {"metric": "bad_total", "type": "counter", "labels": {},
+                 "value": 1.0 * tick},
+            ],
+            tick,
+        )
+        engine.evaluate(tick)
+    # a third family past max_series=2: eviction counted, bound holds
+    store.sample(
+        [{"metric": "spill_total", "type": "counter", "labels": {},
+          "value": 1.0}],
+        6,
+    )
+    families, samples = assert_exposition_conformant(registry.expose())
+    assert families["slo_budget_remaining_frac"]["type"] == "gauge"
+    assert families["slo_burn_rate"]["type"] == "gauge"
+    assert families["timeseries_series"]["type"] == "gauge"
+    assert families["timeseries_evictions_total"]["type"] == "counter"
+    assert samples[("timeseries_series", frozenset())] == 2.0
+    assert samples[("timeseries_evictions_total", frozenset())] == 1.0
+    assert (
+        ("slo_burn_rate", frozenset([("slo", "avail"), ("window", "fast")]))
+        in samples
+    )
 
 
 # ---------------- ops server ----------------

@@ -1,0 +1,938 @@
+"""SLO v2: the bounded history plane (``telemetry.timeseries``), the
+error-budget / burn-rate engine (``telemetry.slo``), their watchdog and
+ops-plane wiring, and the ``/slo`` + ``/query`` surfaces.
+
+The acceptance pins live here too: the fast burn rule flips /healthz
+strictly EARLIER than the PR-18 ``serving_p99`` threshold rule on a
+seeded overload; clean soaks finish with zero burn alerts, a full
+budget, and registry output bit-identical (modulo the new families) to
+an ``[slo]``-disabled run; and the store stays T-independent across a
+1k-tenant feed with counted evictions."""
+
+import json
+import math
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_rescheduling_tpu.bench.harness import make_backend
+from kubernetes_rescheduling_tpu.bench.loadgen import open_loop_arrivals
+from kubernetes_rescheduling_tpu.bench.serve import run_serve_soak
+from kubernetes_rescheduling_tpu.config import (
+    ObsConfig,
+    RescheduleConfig,
+    ServingConfig,
+    SloConfig,
+)
+from kubernetes_rescheduling_tpu.serving import ServingEngine
+from kubernetes_rescheduling_tpu.telemetry import (
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import TenantSeries
+from kubernetes_rescheduling_tpu.telemetry.server import OpsPlane
+from kubernetes_rescheduling_tpu.telemetry.slo import (
+    RULE_FAST_BURN,
+    RULE_SLOW_BURN,
+    SloEngine,
+    SloSpec,
+    budget_burn_frac,
+    default_specs,
+)
+from kubernetes_rescheduling_tpu.telemetry.timeseries import (
+    SeriesStore,
+    series_key,
+)
+from kubernetes_rescheduling_tpu.telemetry.watchdog import SLORules, Watchdog
+
+
+@pytest.fixture()
+def registry():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _metric(registry, name, **labels):
+    for rec in registry.snapshot():
+        if rec["metric"] == name and (rec.get("labels") or {}) == labels:
+            return rec.get("value")
+    return None
+
+
+def _counter_rec(metric, value, **labels):
+    return {
+        "metric": metric, "type": "counter", "labels": labels,
+        "value": float(value),
+    }
+
+
+# ---------------- config surface ----------------
+
+
+def test_slo_config_validation():
+    SloConfig().validate()
+    SloConfig(enabled=True).validate()
+    with pytest.raises(ValueError):
+        SloConfig(objective=1.0).validate()
+    with pytest.raises(ValueError):
+        SloConfig(objective=0.0).validate()
+    with pytest.raises(ValueError):
+        SloConfig(fast_window=1).validate()
+    with pytest.raises(ValueError):
+        SloConfig(fast_window=300, slow_window=288).validate()
+    with pytest.raises(ValueError):
+        SloConfig(budget_window=100, slow_window=288).validate()
+    with pytest.raises(ValueError):
+        SloConfig(fast_burn=-1.0).validate()
+    with pytest.raises(ValueError):
+        SloConfig(series_capacity=1).validate()
+    with pytest.raises(ValueError):
+        SloConfig(max_series=0).validate()
+
+
+def test_slo_config_from_toml(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        "max_rounds = 2\n"
+        "[slo]\n"
+        "enabled = true\n"
+        "objective = 0.95\n"
+        "latency_threshold_ms = 25.0\n"
+        "fast_window = 24\n"
+        "fast_burn = 10.0\n"
+        "slow_window = 96\n"
+        "budget_window = 256\n"
+        "max_series = 64\n"
+    )
+    cfg = RescheduleConfig.from_toml(p)
+    assert cfg.slo.enabled
+    assert cfg.slo.objective == 0.95
+    assert cfg.slo.latency_threshold_ms == 25.0
+    assert cfg.slo.fast_window == 24
+    assert cfg.slo.fast_burn == 10.0
+    assert cfg.slo.slow_window == 96
+    assert cfg.slo.budget_window == 256
+    assert cfg.slo.max_series == 64
+    cfg.validate()
+
+
+# ---------------- SeriesStore ----------------
+
+
+def test_series_key_sorts_labels():
+    assert series_key("m", {"b": "2", "a": "1"}) == 'm{a="1",b="2"}'
+    assert series_key("m", None) == "m"
+    assert series_key("m", {"a": "1"}, part="sum") == 'm:sum{a="1"}'
+
+
+def test_ring_capacity_bounds_points(registry):
+    store = SeriesStore(capacity=4, max_series=8, families=None)
+    for t in range(1, 11):
+        store.record("m", {}, t, float(t))
+    pts = store.query("m")
+    assert len(pts) == 4
+    assert pts == [(7, 7.0), (8, 8.0), (9, 9.0), (10, 10.0)]
+
+
+def test_series_budget_evicts_lru_counted(registry):
+    store = SeriesStore(
+        capacity=8, max_series=2, families=None, registry=registry
+    )
+    store.sample([_counter_rec("a_total", 1)], 1)
+    store.sample([_counter_rec("b_total", 1)], 2)
+    # touching a_total makes b_total the LRU victim
+    store.sample([_counter_rec("a_total", 2)], 3)
+    store.sample([_counter_rec("c_total", 1)], 4)
+    assert store.evictions == 1
+    assert set(store.names()) == {"a_total", "c_total"}
+    assert _metric(registry, "timeseries_evictions_total") == 1
+    assert _metric(registry, "timeseries_series") == 2
+    with pytest.raises(KeyError):
+        store.query("b_total")
+
+
+def test_delta_is_reset_tolerant(registry):
+    store = SeriesStore(capacity=8, max_series=4, families=None)
+    for t, v in ((1, 10.0), (2, 20.0), (3, 5.0)):
+        store.record("m", {}, t, v)
+    # 10 -> 20 is +10; the drop to 5 is a restart, so 5 IS the delta
+    assert store.delta("m", 100, now=3) == pytest.approx(15.0)
+    assert store.delta("missing", 100) == 0.0
+
+
+def test_delta_window_predating_ring_attributes_first_point(registry):
+    store = SeriesStore(capacity=2, max_series=4, families=None)
+    for t in range(1, 6):
+        store.record("m", {}, t, 10.0 * t)
+    # the ring holds (4, 40), (5, 50); a window reaching the ring's edge
+    # attributes the first retained point's full value (capacity-bounded
+    # honesty) plus the observed increase
+    assert store.delta("m", 2, now=5) == pytest.approx(50.0)
+    # a window inside the ring sees only the observed increase
+    assert store.delta("m", 1, now=5) == pytest.approx(10.0)
+
+
+def test_family_allowlist_filters(registry):
+    store = SeriesStore(capacity=4, max_series=8, families=("kept_total",))
+    store.sample(
+        [_counter_rec("kept_total", 1), _counter_rec("dropped_total", 1)], 1
+    )
+    assert store.names() == ["kept_total"]
+
+
+def test_histogram_sampling_parts(registry):
+    store = SeriesStore(
+        capacity=4, max_series=16, families=("h",), bucket_families=("h",)
+    )
+    store.sample(
+        [{
+            "metric": "h", "type": "histogram", "labels": {"stage": "total"},
+            "count": 10, "sum": 0.5,
+            "buckets": {"0.001": 4, "0.01": 3, "0.1": 2}, "inf": 1,
+        }],
+        1,
+    )
+    key = 'h{stage="total"}'
+    assert store.value(key) == 10.0  # bare name carries the count
+    assert store.value('h:sum{stage="total"}') == 0.5
+    # bucket series are CUMULATIVE counts per upper bound
+    assert store.value('h:le:0.001{stage="total"}') == 4.0
+    assert store.value('h:le:0.01{stage="total"}') == 7.0
+    assert store.value('h:le:0.1{stage="total"}') == 9.0
+
+
+def test_store_is_T_independent_across_1k_tenants(registry):
+    """The acceptance memory pin: a 1k-tenant feed holds the same bytes
+    as a solo run — series and points bounded by the configured budgets,
+    the overflow counted as evictions."""
+    store = SeriesStore(
+        capacity=32, max_series=16, families=None, registry=registry
+    )
+    for tick in range(1, 4):
+        store.sample(
+            [
+                _counter_rec("fleet_moves_total", tick, tenant=f"t{i}")
+                for i in range(1000)
+            ],
+            tick,
+        )
+    assert len(store) == 16
+    assert store.points() <= 16 * 32
+    assert store.evictions >= 1000 - 16
+    assert _metric(registry, "timeseries_evictions_total") == store.evictions
+    assert _metric(registry, "timeseries_series") == 16.0
+
+
+def test_query_last_n_and_bare_listing(registry):
+    store = SeriesStore(capacity=8, max_series=4, families=None)
+    for t in range(1, 6):
+        store.record("m", {}, t, float(t))
+    assert store.query("m", n=2) == [(4, 4.0), (5, 5.0)]
+    assert store.query("m", n=0) == []
+
+
+# ---------------- SloSpec / SloEngine ----------------
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        SloSpec(name="").validate()
+    with pytest.raises(ValueError):
+        SloSpec(name="x", objective=1.5).validate()
+    with pytest.raises(ValueError):
+        SloSpec(name="x", kind="events").validate()  # no selectors
+    with pytest.raises(ValueError):
+        SloSpec(name="x", kind="latency", family="h").validate()  # no thresh
+    with pytest.raises(ValueError):
+        SloSpec(name="x", kind="nope", good=(("a", ()),)).validate()
+
+
+def test_default_specs_latency_spec_is_gated():
+    names = {s.name for s in default_specs()}
+    assert names == {"serving_availability", "rounds_success"}
+    names = {s.name for s in default_specs(latency_threshold_ms=20.0)}
+    assert "serving_latency" in names
+
+
+def test_budget_burn_frac_math():
+    assert budget_burn_frac(970, 30, 0.99) == pytest.approx(3.0)
+    assert budget_burn_frac(100, 0, 0.99) == 0.0
+    assert budget_burn_frac(0, 0, 0.99) == 0.0
+    assert budget_burn_frac(0, 5, 0.99) == pytest.approx(100.0)
+
+
+def _events_engine(registry, **kw):
+    store = SeriesStore(
+        capacity=64, max_series=16, families=None, registry=registry
+    )
+    spec = SloSpec(
+        name="t", objective=kw.pop("objective", 0.9),
+        good=(("ok_total", ()),), bad=(("bad_total", ()),),
+    )
+    engine = SloEngine((spec,), store, registry=registry, **kw)
+    return store, engine
+
+
+def test_burn_rate_and_budget_math(registry):
+    store, engine = _events_engine(
+        registry, budget_window=16, fast_window=4, slow_window=8
+    )
+    # steady 20% bad: burn = 0.2 / (1 - 0.9) = 2.0
+    for tick in range(1, 9):
+        store.sample(
+            [
+                _counter_rec("ok_total", 8 * tick),
+                _counter_rec("bad_total", 2 * tick),
+            ],
+            tick,
+        )
+    spec = engine.specs[0]
+    assert engine.burn_rate(spec, 4) == pytest.approx(2.0)
+    entries = engine.evaluate(8)
+    # default thresholds (14.4 / 6.0) are above a 2.0 burn: no entries,
+    # but the table and gauges carry the budget state
+    assert entries == {}
+    row = engine.table()[0]
+    assert row["slo"] == "t"
+    assert row["burn_fast"] == pytest.approx(2.0)
+    assert row["budget_remaining_frac"] == 0.0  # 20% bad vs 10% allowed
+    assert _metric(registry, "slo_budget_remaining_frac", slo="t") == 0.0
+    assert _metric(
+        registry, "slo_burn_rate", slo="t", window="fast"
+    ) == pytest.approx(2.0)
+    assert _metric(
+        registry, "slo_burn_rate", slo="t", window="slow"
+    ) == pytest.approx(2.0)
+
+
+def test_burn_entries_fire_over_threshold(registry):
+    store, engine = _events_engine(
+        registry, budget_window=16, fast_window=4, fast_burn=1.5,
+        slow_window=8, slow_burn=1.2,
+    )
+    for tick in range(1, 9):
+        store.sample(
+            [
+                _counter_rec("ok_total", 8 * tick),
+                _counter_rec("bad_total", 2 * tick),
+            ],
+            tick,
+        )
+    entries = engine.evaluate(8)
+    assert set(entries) == {RULE_FAST_BURN, RULE_SLOW_BURN}
+    fast = entries[RULE_FAST_BURN]
+    assert fast["slo"] == "t"
+    assert fast["burn_rate"] == pytest.approx(2.0)
+    assert fast["window"] == 4
+    assert fast["short_window"] == 1
+    assert fast["threshold"] == 1.5
+    assert fast["value"] == fast["burn_rate"]
+    assert 0.0 <= fast["budget_remaining_frac"] <= 1.0
+    assert fast["time_to_exhaustion"] is not None
+
+
+def test_multi_window_confirm_kills_stale_spike(registry):
+    """The multi-window trick: a burn that already drained must not
+    page. Bad events through tick 11, a clean tick 12 — the long fast
+    window still reads hot, but the 1-tick confirm window is clean."""
+    store, engine = _events_engine(
+        registry, budget_window=24, fast_window=12, fast_burn=1.5,
+        slow_window=20, slow_burn=1e9,  # isolate the fast pair
+    )
+    for tick in range(1, 12):
+        store.sample(
+            [
+                _counter_rec("ok_total", 5 * tick),
+                _counter_rec("bad_total", 5 * tick),
+            ],
+            tick,
+        )
+    store.sample(
+        [_counter_rec("ok_total", 75), _counter_rec("bad_total", 55)], 12
+    )
+    spec = engine.specs[0]
+    assert engine.burn_rate(spec, 12) > 1.5  # long window still hot
+    assert engine.burn_rate(spec, 1) == 0.0  # confirm window clean
+    assert engine.evaluate(12) == {}
+
+
+def test_latency_mode_events_from_histogram(registry):
+    store = SeriesStore(
+        capacity=16, max_series=16, families=("h",), bucket_families=("h",),
+        registry=registry,
+    )
+    spec = SloSpec(
+        name="lat", objective=0.9, kind="latency", family="h",
+        labels=(("stage", "total"),), threshold_s=0.01,
+    )
+    engine = SloEngine(
+        (spec,), store, registry=registry,
+        budget_window=8, fast_window=4, fast_burn=1.5, slow_window=6,
+        slow_burn=1e9,
+    )
+    # tick 1: 10 requests, 9 under 10ms; tick 2: +10, only 2 more under
+    # -> window-2 events: good 11, bad 9 (burn = 0.45 / 0.1 = 4.5)
+    for tick, (c, under) in enumerate(((10, 9), (20, 11)), start=1):
+        store.sample(
+            [{
+                "metric": "h", "type": "histogram",
+                "labels": {"stage": "total"}, "count": c, "sum": 0.1,
+                "buckets": {"0.001": under // 2, "0.01": under - under // 2,
+                            "0.1": c - under},
+                "inf": 0,
+            }],
+            tick,
+        )
+    good, bad = engine._events(spec, 2)
+    assert good == pytest.approx(11.0)
+    assert bad == pytest.approx(9.0)
+    entries = engine.evaluate(2)
+    assert RULE_FAST_BURN in entries
+
+
+def test_tenant_gate_enabled_accumulates_and_publishes(registry):
+    store, engine = _events_engine(registry)
+    engine.tenant_series = TenantSeries(registry, tenants=2, budget=4)
+    engine.observe_tenant_round("a", ok=True)
+    engine.observe_tenant_round("a", ok=False)
+    engine.observe_tenant_round("b", ok=True)
+    budgets = engine.tenant_budgets()
+    # objective 0.9: 1 bad of 2 rounds is 5x the allowance -> exhausted
+    assert budgets["a"] == 0.0
+    assert budgets["b"] == 1.0
+    assert _metric(
+        registry, "slo_tenant_budget_remaining_frac", tenant="a"
+    ) == 0.0
+    assert _metric(
+        registry, "slo_tenant_budget_remaining_frac", tenant="b"
+    ) == 1.0
+
+
+def test_tenant_gate_over_budget_suppresses_counted(registry):
+    store, engine = _events_engine(registry)
+    engine.tenant_series = TenantSeries(registry, tenants=5, budget=2)
+    for i in range(5):
+        engine.observe_tenant_round(f"t{i}", ok=False)
+    # nothing stored, nothing labeled — the gate counts each suppression
+    assert engine._tenant_events == {}
+    assert (
+        _metric(registry, "slo_tenant_budget_remaining_frac", tenant="t0")
+        is None
+    )
+    assert _metric(
+        registry,
+        "tenant_series_suppressed_total",
+        family="slo_tenant_budget_remaining_frac",
+    ) == 5.0
+
+
+# ---------------- watchdog integration ----------------
+
+
+def _burn_detail(**over):
+    detail = {
+        "slo": "t", "burn_rate": 20.0, "burn_rate_short": 20.0,
+        "window": 12, "short_window": 1, "threshold": 14.4,
+        "budget_remaining_frac": 0.4, "time_to_exhaustion": 9.0,
+        "value": 20.0,
+    }
+    detail.update(over)
+    return detail
+
+
+def test_watchdog_burn_entry_recovery_and_rebase(registry):
+    wd = Watchdog(SLORules(), registry=registry)
+    raised = wd.observe_slo_burn({RULE_FAST_BURN: _burn_detail()})
+    assert [v["rule"] for v in raised] == [RULE_FAST_BURN]
+    assert _metric(
+        registry, "slo_violations_total", rule=RULE_FAST_BURN
+    ) == 1.0
+    assert not wd.healthy
+    # re-feeding the same entry is NOT a new violation
+    assert wd.observe_slo_burn({RULE_FAST_BURN: _burn_detail()}) == []
+    # the burn draining recovers the rule
+    assert wd.observe_slo_burn({}) == []
+    assert wd.healthy
+    # rebase clears latched burn state: a new run starts clean
+    wd.observe_slo_burn({RULE_FAST_BURN: _burn_detail()})
+    wd.rebase()
+    wd.check()
+    assert wd.healthy
+
+
+def test_uniform_verdict_shape_across_rule_kinds(registry):
+    """Satellite pin: every active /healthz verdict — burn-rate and
+    legacy threshold rules alike — carries the uniform
+    {rule, value, threshold, since} quartet, while rule-specific detail
+    keys (the old test pins) survive."""
+    wd = Watchdog(
+        SLORules(serving_p99_ms=50.0, min_samples=2), registry=registry
+    )
+    wd.observe_serving(
+        {"count": 8, "p99_ms": 120.0, "p50_ms": 60.0, "rate_rps": 10.0}
+    )
+    wd.observe_slo_burn({RULE_FAST_BURN: _burn_detail()})
+    status = wd.status()
+    assert not status["healthy"]
+    active = {v["rule"]: v for v in status["active"]}
+    assert set(active) == {"serving_p99", RULE_FAST_BURN}
+    for verdict in active.values():
+        assert isinstance(verdict["value"], float)
+        assert isinstance(verdict["threshold"], float)
+        assert verdict["since"] > 0
+    # legacy detail keys retained alongside the quartet
+    assert active["serving_p99"]["threshold_ms"] == 50.0
+    assert active["serving_p99"]["value"] == 120.0
+    assert active["serving_p99"]["threshold"] == 50.0
+    assert active[RULE_FAST_BURN]["value"] == 20.0
+    assert active[RULE_FAST_BURN]["threshold"] == 14.4
+    # `since` is stable while the violation persists...
+    first_since = active[RULE_FAST_BURN]["since"]
+    wd.observe_slo_burn({RULE_FAST_BURN: _burn_detail(burn_rate=21.0)})
+    again = {v["rule"]: v for v in wd.status()["active"]}
+    assert again[RULE_FAST_BURN]["since"] == first_since
+    # ...and resets across a recovery
+    wd.observe_slo_burn({})
+    wd.observe_slo_burn({RULE_FAST_BURN: _burn_detail()})
+    final = {v["rule"]: v for v in wd.status()["active"]}
+    assert final[RULE_FAST_BURN]["since"] >= first_since
+
+
+# ---------------- ops plane + endpoints ----------------
+
+
+def _summary(count, p99_ms):
+    return {
+        "submitted": count, "completed": count, "count": count,
+        "rate_rps": 10.0, "p50_ms": p99_ms / 2, "p95_ms": p99_ms,
+        "p99_ms": p99_ms, "batch_sizes": {"1": count}, "dispatches": count,
+        "outcomes": {"placed": count}, "shed": {}, "inflight": 0,
+    }
+
+
+def _feed_outcomes(registry, placed=0, shed=0):
+    c = registry.counter(
+        "serving_placements_total",
+        "serving requests completed by outcome",
+        labelnames=("outcome",),
+    )
+    if placed:
+        c.labels(outcome="placed").inc(placed)
+    if shed:
+        c.labels(outcome="shed").inc(shed)
+
+
+def test_slo_and_query_endpoints_roundtrip(registry):
+    obs = ObsConfig(serve_port=0).validate()
+    slo = SloConfig(
+        enabled=True, fast_window=12, slow_window=24, budget_window=48
+    ).validate()
+    ops = OpsPlane.from_config(obs, slo=slo, registry=registry).start()
+    try:
+        port = ops.server.port
+        for tick in range(1, 4):
+            _feed_outcomes(registry, placed=10)
+            ops.observe_serving(_summary(count=10, p99_ms=5.0))
+        status, body = _get(port, "/slo")
+        assert status == 200
+        table = {row["slo"]: row for row in json.loads(body)["slos"]}
+        assert table["serving_availability"]["budget_remaining_frac"] == 1.0
+        assert table["serving_availability"]["burn_fast"] == 0.0
+        status, body = _get(port, "/query")
+        assert status == 200
+        names = json.loads(body)["series"]
+        assert 'serving_placements_total{outcome="placed"}' in names
+        status, body = _get(
+            port, '/query?series=serving_placements_total'
+            '%7Boutcome%3D%22placed%22%7D&n=2'
+        )
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["points"] == [[2, 20.0], [3, 30.0]]
+        status, body = _get(port, "/query?series=nope_total")
+        assert status == 404
+        assert "unknown series" in json.loads(body)["error"]
+    finally:
+        ops.close()
+
+
+def test_slo_endpoints_404_when_plane_disabled(registry):
+    obs = ObsConfig(serve_port=0).validate()
+    ops = OpsPlane.from_config(obs, registry=registry).start()
+    try:
+        port = ops.server.port
+        for path in ("/slo", "/query"):
+            status, body = _get(port, path)
+            assert status == 404
+            assert "slo plane disabled" in json.loads(body)["error"]
+    finally:
+        ops.close()
+
+
+def test_fast_burn_flips_healthz_before_serving_p99(registry, tmp_path):
+    """THE acceptance ordering pin: on a seeded overload the fast burn
+    rule pages (503 + structured slo stanza + slo_burn_page bundle)
+    strictly earlier than the PR-18 serving_p99 threshold rule — budget
+    math detects 'the tail will be blown' before the tail is blown."""
+    obs = ObsConfig(
+        serve_port=0, slo_serving_p99_ms=50.0, slo_min_samples=5
+    ).validate()
+    slo = SloConfig(
+        enabled=True, fast_window=12, slow_window=24, budget_window=48
+    ).validate()
+    ops = OpsPlane.from_config(
+        obs, slo=slo, registry=registry, bundle_dir=str(tmp_path)
+    ).start()
+    first_burn = first_p99 = None
+    try:
+        port = ops.server.port
+        for tick in range(1, 11):
+            # a steady 20% shed rate from the first tick; p99 ramps and
+            # crosses the 50 ms threshold only at tick 6
+            _feed_outcomes(registry, placed=8, shed=2)
+            ops.observe_serving(_summary(count=20, p99_ms=10.0 * tick))
+            status, body = _get(port, "/healthz")
+            active = {
+                v["rule"]: v
+                for v in (json.loads(body)["slo"] or {}).get("active", [])
+            }
+            if first_burn is None and RULE_FAST_BURN in active:
+                first_burn = tick
+                assert status == 503
+                # the structured stanza: budget remaining, burn rate,
+                # window, time-to-exhaustion, and the uniform quartet
+                stanza = active[RULE_FAST_BURN]
+                assert stanza["slo"] == "serving_availability"
+                assert stanza["burn_rate"] >= 14.4
+                assert stanza["window"] == 12
+                assert "budget_remaining_frac" in stanza
+                assert "time_to_exhaustion" in stanza
+                assert stanza["value"] == stanza["burn_rate"]
+                assert stanza["threshold"] == 14.4
+                assert stanza["since"] > 0
+            if first_p99 is None and "serving_p99" in active:
+                first_p99 = tick
+        assert first_burn is not None, "fast burn never fired"
+        assert first_p99 is not None, "serving_p99 never fired"
+        assert first_burn < first_p99, (
+            f"burn paged at tick {first_burn}, not strictly before "
+            f"serving_p99 at tick {first_p99}"
+        )
+        # page-level entry dumped a flight-recorder bundle, exactly once
+        bundles = list(tmp_path.glob("*slo_burn_page*"))
+        assert len(bundles) == 1
+        payload = json.loads(bundles[0].read_text())
+        assert payload["slo"]["rule"] == RULE_FAST_BURN
+        assert any(
+            row["slo"] == "serving_availability" for row in payload["table"]
+        )
+    finally:
+        ops.close()
+
+
+def _strip_slo_families(text):
+    """Drop the SLO v2 families (gauge samples + HELP/TYPE) from an
+    exposition — what's left must be bit-identical to a run with the
+    [slo] block disabled."""
+    out = []
+    for line in text.splitlines(keepends=True):
+        name = line.split()[2] if line.startswith("#") else line
+        if name.startswith(("slo_", "timeseries_")):
+            continue
+        out.append(line)
+    return "".join(out)
+
+
+def test_clean_soak_full_budget_and_bit_identical_registry():
+    """Acceptance: a clean soak finishes with zero burn alerts, a full
+    budget on every SLO, and — modulo the new slo_*/timeseries_*
+    families — registry output bit-identical to an [slo]-disabled run."""
+    obs = ObsConfig(serve_port=None).validate()
+    reg_on, reg_off = MetricsRegistry(), MetricsRegistry()
+    slo = SloConfig(
+        enabled=True, fast_window=12, slow_window=24, budget_window=48
+    ).validate()
+    ops_on = OpsPlane.from_config(obs, slo=slo, registry=reg_on)
+    ops_off = OpsPlane.from_config(obs, registry=reg_off)
+    for tick in range(1, 21):
+        for reg, ops in ((reg_on, ops_on), (reg_off, ops_off)):
+            _feed_outcomes(reg, placed=5)
+            ops.observe_serving(_summary(count=10, p99_ms=3.0))
+    assert ops_on.watchdog.active == {}
+    assert ops_off.watchdog.active == {}
+    for row in ops_on.slo_engine.table():
+        assert row["budget_remaining_frac"] == 1.0
+        assert row["burn_fast"] == 0.0
+        assert row["burn_slow"] == 0.0
+    assert _strip_slo_families(reg_on.expose()) == reg_off.expose()
+
+
+def test_plane_ticks_on_round_and_rollup_feeds(registry):
+    obs = ObsConfig(serve_port=None).validate()
+    slo = SloConfig(enabled=True).validate()
+    ops = OpsPlane.from_config(obs, slo=slo, registry=registry)
+
+    class _Rec:
+        degraded = False
+        round = 1
+        decision_latency_s = 0.01
+        communication_cost = 5.0
+
+        def as_dict(self):
+            return {"round": 1}
+
+    ops.observe_round(_Rec())
+    assert ops.series_store.last_tick == 1
+    ops.observe_fleet_rollup(
+        {"dims": {"cost": {"quantiles": {"p99": 10.0}}}}
+    )
+    assert ops.series_store.last_tick == 2
+    assert len(ops.slo_engine.table()) == len(ops.slo_engine.specs)
+
+
+def test_bind_tenant_series_routes_per_tenant_budgets(registry):
+    obs = ObsConfig(serve_port=None).validate()
+    slo = SloConfig(enabled=True).validate()
+    ops = OpsPlane.from_config(obs, slo=slo, registry=registry)
+    ops.bind_tenant_series(TenantSeries(registry, tenants=2, budget=4))
+    ops.observe_tenant("a", record={"degraded": False})
+    ops.observe_tenant("a", skipped=True)
+    ops.observe_tenant("b", record={"degraded": True})
+    budgets = ops.slo_engine.tenant_budgets()
+    assert budgets["a"] < 1.0  # the skip burned budget
+    assert budgets["b"] == 0.0  # degraded round counts as bad
+    assert (
+        _metric(registry, "slo_tenant_budget_remaining_frac", tenant="a")
+        is not None
+    )
+    # slo plane off: bind is a silent no-op
+    ops_off = OpsPlane.from_config(obs, registry=registry)
+    ops_off.bind_tenant_series(TenantSeries(registry, tenants=2, budget=4))
+    ops_off.observe_tenant("a", record={})
+
+
+# ---------------- real-engine burn soak ----------------
+
+
+def _overload_soak(registry, ops, n, rate):
+    backend = make_backend("mubench", 0)
+    engine = ServingEngine(
+        backend,
+        registry=registry,
+        config=ServingConfig(max_batch=2, queue_depth=2, deadline_ms=2.0),
+    )
+    ops.bind_serving(engine)
+    services = list(engine.graph.names)
+    with engine:
+        report = run_serve_soak(
+            engine,
+            services,
+            open_loop_arrivals(rate, n, seed=1),
+            deadline_ms=2.0,
+        )
+    return report
+
+
+def test_acceptance_burn_soak_fast(registry, tmp_path):
+    """Tier-1 burn-detection soak: a REAL serving engine under seeded
+    overload (tiny queue, tight deadline, hot open-loop rate) drives the
+    history plane through its own ops feeds and trips the fast burn
+    page — counted violation, slo_burn_page bundle, live /slo table."""
+    obs = ObsConfig(serve_port=0).validate()
+    slo = SloConfig(
+        enabled=True, objective=0.9, fast_window=12, fast_burn=2.0,
+        slow_window=24, slow_burn=1.5, budget_window=48,
+    ).validate()
+    ops = OpsPlane.from_config(
+        obs, slo=slo, registry=registry, bundle_dir=str(tmp_path)
+    ).start()
+    try:
+        report = _overload_soak(registry, ops, n=80, rate=3000.0)
+        assert report["shed"] + report["timed_out"] > 0
+        assert (
+            _metric(registry, "slo_violations_total", rule=RULE_FAST_BURN)
+            >= 1.0
+        )
+        assert list(tmp_path.glob("*slo_burn_page*"))
+        status, body = _get(ops.server.port, "/slo")
+        assert status == 200
+        table = {row["slo"]: row for row in json.loads(body)["slos"]}
+        # the budget may have RECOVERED by the end (the burst slides out
+        # of the rolling window) — the live table just has to be there,
+        # current, and honest about the window it read
+        row = table["serving_availability"]
+        assert row["tick"] == ops.series_store.last_tick
+        assert row["budget_window"] == 48
+        # the page itself carried the hot budget state: the bundle's
+        # frozen table saw a drained budget even if the live one healed
+        payload = json.loads(
+            list(tmp_path.glob("*slo_burn_page*"))[0].read_text()
+        )
+        frozen = {r["slo"]: r for r in payload["table"]}
+        assert frozen["serving_availability"]["budget_remaining_frac"] < 1.0
+    finally:
+        ops.close()
+
+
+@pytest.mark.slow  # 300-request high-rate variant; burn detection stays pinned fast in tier-1 by test_acceptance_burn_soak_fast above
+def test_burn_soak_long(registry, tmp_path):
+    obs = ObsConfig(serve_port=None).validate()
+    slo = SloConfig(
+        enabled=True, objective=0.9, fast_window=12, fast_burn=2.0,
+        slow_window=24, slow_burn=1.5, budget_window=96,
+    ).validate()
+    ops = OpsPlane.from_config(
+        obs, slo=slo, registry=registry, bundle_dir=str(tmp_path)
+    )
+    report = _overload_soak(registry, ops, n=300, rate=4000.0)
+    assert report["answered"] + report["shed"] + report["timed_out"] == 300
+    assert (
+        _metric(registry, "slo_violations_total", rule=RULE_FAST_BURN) >= 1.0
+    )
+    # the slow ticket rule catches the sustained leak too
+    assert (
+        _metric(registry, "slo_violations_total", rule=RULE_SLOW_BURN) >= 1.0
+    )
+
+
+@pytest.mark.slow  # clean-soak long variant; the zero-alert + bit-identical invariant stays pinned fast in tier-1 by test_clean_soak_full_budget_and_bit_identical_registry above
+def test_clean_soak_long_zero_burn(registry):
+    obs = ObsConfig(serve_port=None).validate()
+    slo = SloConfig(enabled=True).validate()
+    ops = OpsPlane.from_config(obs, slo=slo, registry=registry)
+    for tick in range(1, 601):
+        _feed_outcomes(registry, placed=5)
+        ops.observe_serving(_summary(count=10, p99_ms=3.0))
+    assert ops.watchdog.active == {}
+    assert _metric(registry, "slo_violations_total", rule=RULE_FAST_BURN) is None
+    for row in ops.slo_engine.table():
+        assert row["budget_remaining_frac"] == 1.0
+
+
+# ---------------- report + CLI surface ----------------
+
+
+def test_report_slo_budget_table_and_sparklines(registry, tmp_path):
+    from kubernetes_rescheduling_tpu.telemetry.report import report_slo
+
+    obs = ObsConfig(serve_port=None).validate()
+    slo = SloConfig(
+        enabled=True, fast_window=12, slow_window=24, budget_window=48
+    ).validate()
+    ops = OpsPlane.from_config(obs, slo=slo, registry=registry)
+    dump = tmp_path / "metrics.jsonl"
+    for tick in range(1, 6):
+        _feed_outcomes(registry, placed=8, shed=2)
+        ops.observe_serving(_summary(count=20, p99_ms=5.0))
+        registry.dump_jsonl(dump)
+    out = report_slo([str(dump)])
+    assert "slo                      budget" in out
+    assert "serving_availability" in out
+    assert "rounds_success" in out
+    assert "burn serving_availability/fast:" in out
+    spark_line = next(
+        line for line in out.splitlines()
+        if line.startswith("    burn serving_availability/fast:")
+    )
+    # a hot burn renders high glyphs, and the latest reading is printed
+    assert "█" in spark_line
+    assert "(last " in spark_line
+
+
+def test_report_slo_events_and_empty_shapes(tmp_path):
+    from kubernetes_rescheduling_tpu.telemetry.report import report_slo
+
+    events = tmp_path / "events.jsonl"
+    events.write_text(
+        json.dumps({
+            "event": "slo_violation", "rule": RULE_FAST_BURN,
+            "slo": "serving_availability", "burn_rate": 20.0, "window": 12,
+            "budget_remaining_frac": 0.4,
+        }) + "\n"
+        + json.dumps({"event": "slo_recovered", "rule": RULE_FAST_BURN})
+        + "\n"
+    )
+    out = report_slo([str(events)])
+    assert (
+        "VIOLATION slo_fast_burn slo=serving_availability "
+        "burn=20.0 over 12t (budget 40.0% left)" in out
+    )
+    assert "recovered slo_fast_burn" in out
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text(json.dumps({"event": "round"}) + "\n")
+    assert "was this run started with --slo?" in report_slo([str(bare)])
+    assert "not a file" in report_slo([str(tmp_path / "missing.jsonl")])
+
+
+def test_cli_telemetry_slo_mode(registry, tmp_path, capsys):
+    from kubernetes_rescheduling_tpu.cli import main as cli_main
+
+    obs = ObsConfig(serve_port=None).validate()
+    slo = SloConfig(enabled=True).validate()
+    ops = OpsPlane.from_config(obs, slo=slo, registry=registry)
+    _feed_outcomes(registry, placed=10)
+    ops.observe_serving(_summary(count=10, p99_ms=3.0))
+    dump = tmp_path / "metrics.jsonl"
+    registry.dump_jsonl(dump)
+    rc = cli_main(["telemetry", "slo", str(dump)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "serving_availability" in out
+    assert "100.00%" in out  # clean feed: full budget
+
+
+def test_telemetry_report_serving_stanza(registry, tmp_path, capsys):
+    """Satellite pin: `telemetry report` on a dump from a served run
+    renders the serving stanza — outcome totals, latency percentiles,
+    placements/sec (needs >= 2 ts-stamped snapshots), shed breakdown,
+    and the batch-size distribution."""
+    from kubernetes_rescheduling_tpu.cli import main as cli_main
+    from kubernetes_rescheduling_tpu.telemetry.registry import MICRO_BUCKETS
+
+    c = registry.counter(
+        "serving_placements_total", "outcomes", labelnames=("outcome",)
+    )
+    c.labels(outcome="placed").inc(18)
+    c.labels(outcome="shed").inc(2)
+    registry.counter(
+        "serving_shed_total", "sheds", labelnames=("reason",)
+    ).labels(reason="queue_full").inc(2)
+    h = registry.histogram(
+        "serving_request_seconds", "latency", labelnames=("stage",),
+        buckets=MICRO_BUCKETS,
+    )
+    for v in (0.001, 0.002, 0.004, 0.008):
+        h.labels(stage="total").observe(v)
+    registry.histogram(
+        "serving_batch_size", "batch", buckets=(1.0, 2.0, 4.0, 8.0)
+    ).observe(3)
+    dump = tmp_path / "metrics.jsonl"
+    registry.dump_jsonl(dump)
+    import time
+
+    time.sleep(0.05)
+    h.labels(stage="total").observe(0.002)
+    registry.dump_jsonl(dump)
+    rc = cli_main(["telemetry", str(dump)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "serving plane: placed=18 shed=2" in out
+    assert "latency(total): p50=" in out
+    assert "placements/sec: " in out
+    assert "shed: queue_full×2" in out
+    assert "batch sizes: " in out

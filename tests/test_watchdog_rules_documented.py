@@ -1,0 +1,93 @@
+"""CI twin of ``scripts/check_watchdog_rules_documented.py``: every
+``RULE_*`` constant in the watchdog/SLO modules has a row in
+OBSERVABILITY.md's "SLO watchdog" table, and every documented rule is
+still registered — plus synthetic drift cases proving the checker bites
+in both directions."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+
+def _load_checker():
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_watchdog_rules_documented.py"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "check_watchdog_rules_documented", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_watchdog_rules_documented", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+DOC = """
+## SLO watchdog
+
+| rule | what |
+| --- | --- |
+| `round_latency_p95` | p95 over threshold |
+| `slo_fast_burn` | burn page |
+
+## other section
+
+| `ghost_rule_elsewhere` | rows outside the section never count |
+"""
+
+SRC = '''
+RULE_LATENCY = "round_latency_p95"
+RULE_FAST_BURN = "slo_fast_burn"
+NOT_A_RULE = "lowercase_binding_ignored"
+'''
+
+
+def test_checked_in_inventory_is_clean():
+    checker = _load_checker()
+    assert checker.violations() == []
+
+
+def test_registered_rules_regex():
+    checker = _load_checker()
+    assert checker.registered_rules([SRC]) == {
+        "round_latency_p95",
+        "slo_fast_burn",
+    }
+
+
+def test_documented_rules_scoped_to_section():
+    checker = _load_checker()
+    assert checker.documented_rules(DOC) == {
+        "round_latency_p95",
+        "slo_fast_burn",
+    }
+
+
+def test_synthetic_inventory_is_clean():
+    checker = _load_checker()
+    assert checker.violations(sources=[SRC], doc_text=DOC) == []
+
+
+def test_undocumented_rule_is_caught():
+    checker = _load_checker()
+    src = SRC + '\nRULE_NEW = "brand_new_rule"\n'
+    bad = checker.violations(sources=[src], doc_text=DOC)
+    assert any("brand_new_rule" in v and "no row" in v for v in bad)
+
+
+def test_ghost_row_is_caught():
+    checker = _load_checker()
+    doc = DOC.replace(
+        "| `slo_fast_burn` | burn page |",
+        "| `slo_fast_burn` | burn page |\n| `renamed_away` | ghost |",
+    )
+    bad = checker.violations(sources=[SRC], doc_text=doc)
+    assert any("renamed_away" in v and "ghost row" in v for v in bad)
+
+
+def test_empty_rule_set_is_a_violation():
+    checker = _load_checker()
+    bad = checker.violations(sources=["# no constants"], doc_text=DOC)
+    assert any("no RULE_* constants" in v for v in bad)

@@ -63,6 +63,16 @@ Environment knobs:
                   emitting the median device ms/round with forecast_skill
                   vs the persistence baseline and both kernels'
                   trace counts pinned at 1 + promotions) |
+                  multichip (the measured multichip cell: fleet scan
+                  blocks sharded over the dp mesh — one dispatch
+                  advances every tenant BENCH_SCAN_BLOCK rounds with
+                  each device scanning its own tenant block — emitting
+                  fleet_scan_rounds_per_sec (better: higher) with the
+                  per-device step rollup nested as its own ledger
+                  series multichip_device_step_ms_p99 (better: lower)
+                  and writing the measured MULTICHIP_rNN.json record;
+                  forces BENCH_DEVICES virtual host-CPU devices on a
+                  dev box, a no-op on a slice with real chips) |
                   serve (the serving plane: BENCH_SERVE_REQUESTS open-loop
                   arrivals at BENCH_SERVE_RPS through the bounded batcher
                   — the repo's first request-grain perf pair, emitting
@@ -71,14 +81,22 @@ Environment knobs:
                   serving_p99_ms (better: lower), exact shed/timeout
                   accounting, and the vmapped serve kernel's steady-state
                   trace count pinned at 1)
-  BENCH_TENANTS   fleet scenario only: tenant count (default 16)
+  BENCH_TENANTS   fleet/multichip scenarios: tenant count (default 16)
   BENCH_FLEET_SERVICES / BENCH_FLEET_NODES
-                  fleet scenario only: per-tenant cluster shape
+                  fleet/multichip scenarios: per-tenant cluster shape
                   (defaults 2000 / 256 — the fleet-matrix cell shape)
+  BENCH_DEVICES   multichip scenario only: dp mesh size to force on a
+                  host without enough real devices (default 8; no-op
+                  when real devices suffice)
+  BENCH_MULTICHIP_OUT
+                  multichip scenario only: path for the measured
+                  MULTICHIP record (default: next free repo-root
+                  MULTICHIP_rNN.json, NN >= 06)
   BENCH_ROUNDS    elastic/forecast scenarios: soak rounds (default 30);
                   scan scenario: timed rounds (default 48)
-  BENCH_SCAN_BLOCK scan scenario only: rounds fused per scan dispatch
-                  (default 16)
+  BENCH_SCAN_BLOCK scan scenario: rounds fused per scan dispatch
+                  (default 16); multichip scenario: rounds per sharded
+                  scan block (default 8)
   BENCH_SERVE_REQUESTS / BENCH_SERVE_RPS / BENCH_SERVE_BATCH
                   serve scenario only: soak size (default 256), open-loop
                   arrival rate (default 200 req/s), batcher max_batch
@@ -103,6 +121,21 @@ import os
 import sys
 import time
 from functools import partial
+
+# the multichip scenario needs its dp devices provisioned BEFORE jax
+# initializes a backend (XLA parses the host-device-count flag once per
+# process) — so the env hook must sit above the jax import. Purely
+# additive: on a slice whose real device count already covers
+# BENCH_DEVICES the forced CPU count is never selected.
+if os.environ.get("BENCH_SCENARIO") == "multichip":
+    _n_dev = int(os.environ.get("BENCH_DEVICES", "8") or 8)
+    _xla_flags = [
+        _f
+        for _f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in _f
+    ]
+    _xla_flags.append(f"--xla_force_host_platform_device_count={_n_dev}")
+    os.environ["XLA_FLAGS"] = " ".join(_xla_flags)
 
 import jax
 import jax.numpy as jnp
@@ -138,13 +171,53 @@ def _ledger_append(result: dict) -> None:
         value=result["value"],
         unit=result.get("unit", "ms"),
         scenario=str(extra.get("scenario", "bench")),
-        device_kind=str(devices[0]) if devices else "unknown",
+        # multichip cells stamp an explicit platform×count identity
+        # ("cpux8" vs "tpux8") so forced-host and real-slice runs can
+        # never share a trend series; other cells key by first device
+        device_kind=str(
+            extra.get("device_kind")
+            or (devices[0] if devices else "unknown")
+        ),
         digest="bench-history",
         # latency cells trend down, throughput cells (the scan
         # scenario's rounds/sec) trend up — the record says which
         better=result.get("better", "lower"),
         vs_baseline=result.get("vs_baseline"),
     )
+
+
+def _write_multichip_record(result: dict) -> None:
+    """BENCH_SCENARIO=multichip: persist the measured MULTICHIP record.
+
+    The r01–r05 records were dryrun receipts (``{ok, rc, n_devices}`` —
+    "the dp plane dispatched somewhere"); from r06 the record is the
+    MEASURED shape ``scripts/check_bench_schema.py`` validates: the
+    ``fleet_scan_rounds_per_sec`` reading with its nested per-device
+    rollup, keyed by an explicit ``device_kind`` so a forced-host CPU
+    record can never be read as slice perf. ``BENCH_MULTICHIP_OUT``
+    overrides the path; by default the next free repo-root
+    ``MULTICHIP_rNN.json`` (NN >= 06) is taken."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = os.environ.get("BENCH_MULTICHIP_OUT")
+    if not out:
+        n = 6
+        while os.path.exists(os.path.join(root, f"MULTICHIP_r{n:02d}.json")):
+            n += 1
+        out = os.path.join(root, f"MULTICHIP_r{n:02d}.json")
+    extra = result.get("extra", {})
+    record = {
+        "n_devices": int(extra.get("n_devices", 0)),
+        "device_kind": str(extra.get("device_kind", "unknown")),
+        "rc": 0,
+        "ok": True,
+        "measured": True,
+        "cmd": "BENCH_SCENARIO=multichip python bench.py",
+        "tail": json.dumps(result),
+        "parsed": result,
+    }
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
 
 
 def measure_rtt_ms(reps: int = 7) -> float:
@@ -1089,6 +1162,35 @@ def main() -> int:
     solver_kind = os.environ.get("BENCH_SOLVER", "dense")
 
     baseline_ms = 100.0  # BASELINE.md: <100 ms/round at 10k x 1k
+
+    if scenario == "multichip":
+        # force the dp mesh BEFORE any jax device use: on a dev box this
+        # virtualizes BENCH_DEVICES host-CPU devices (the tier-1 shape);
+        # on a slice with enough real chips it is a no-op, so the same
+        # cell measures real hardware unchanged
+        import __graft_entry__ as graft
+
+        graft._force_virtual_devices(_env_int("BENCH_DEVICES", 8))
+        from kubernetes_rescheduling_tpu.bench.multichip import (
+            bench_multichip,
+        )
+
+        result = bench_multichip(
+            tenants=_env_int("BENCH_TENANTS", 16),
+            n_services=_env_int("BENCH_FLEET_SERVICES", 2000),
+            n_nodes=_env_int("BENCH_FLEET_NODES", 256),
+            rounds=_env_int("BENCH_SCAN_BLOCK", 8),
+            reps=reps,
+            rtt_ms=measure_rtt_ms(),
+        )
+        _ledger_append(result)
+        # the per-device step rollup is its own ledger series (better:
+        # lower) — a device-imbalance regression trends independently
+        if isinstance(result.get("device_step_reading"), dict):
+            _ledger_append(result["device_step_reading"])
+        _write_multichip_record(result)
+        print(json.dumps(result))
+        return 0
 
     if scenario == "fleet":
         result = bench_fleet(

@@ -60,3 +60,22 @@ class Backend(Protocol):
     def advance(self, seconds: float) -> None:
         """Let time pass (pacing between rounds, reference main.py:27,100)."""
         ...
+
+
+def device_kind(n_devices: int | None = None) -> str:
+    """The accelerator identity a measured multichip record is keyed
+    by: ``"<platform>x<count>"`` (``cpu x8`` forced-host runs vs a real
+    ``tpu x8`` slice get DIFFERENT perf-ledger series keys, so their
+    baselines can never be compared). Reads the already-initialised jax
+    backend; ``"unknown"`` kind when jax is absent so host-only tools
+    can still stamp records."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        kind = devices[0].platform
+        n = int(n_devices) if n_devices is not None else len(devices)
+    except Exception:  # jax missing/uninitialisable: stamp, don't crash
+        kind = "unknown"
+        n = int(n_devices) if n_devices is not None else 0
+    return f"{kind}x{n}"

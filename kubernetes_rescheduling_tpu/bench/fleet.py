@@ -580,6 +580,51 @@ def run_fleet_controller(
         else:
             solve_fn = fleet_solve
 
+    # the device plane (telemetry.mesh): dp runs attribute each block's
+    # host-measured dispatch wall and pulled bytes across the dp devices
+    # and publish the bounded rollup + /devices overview. Reads ride the
+    # decision/metrics bundles already pulled — zero extra transfers —
+    # so turning it off changes observability only (decision parity is
+    # test-pinned)
+    mesh_plane = None
+    if config.fleet.plane == "dp" and getattr(obs, "device_rollup", True):
+        from kubernetes_rescheduling_tpu.parallel.fleet import (
+            dp_device_names,
+        )
+        from kubernetes_rescheduling_tpu.telemetry.mesh import MeshPlane
+
+        mesh_plane = MeshPlane(
+            registry,
+            device_names=dp_device_names(tenants=T),
+            budget=getattr(obs, "device_label_budget", 64),
+        )
+        if ops is not None:
+            ops.bind_mesh(mesh_plane)
+    # the profiler gate (POST /profile / --profile-rounds): armed
+    # captures open just before a dispatch and close after the block's
+    # rounds have committed
+    prof = getattr(ops, "profiler", None) if ops is not None else None
+
+    def observe_mesh(
+        *, dispatch_s, transfer_bytes, weights, rounds, rnd
+    ) -> None:
+        """One block's device-axis accounting lands everywhere at once:
+        the bounded mesh families, the named device_rollup event, the
+        /healthz mesh stanza, and the mesh_imbalance watchdog window."""
+        if mesh_plane is None:
+            return
+        summary, ev = mesh_plane.observe_block(
+            dispatch_s=dispatch_s,
+            transfer_bytes=transfer_bytes,
+            weights=weights,
+            rounds=rounds,
+            round=rnd,
+        )
+        if logger is not None:
+            logger.info("device_rollup", **ev)
+        if ops is not None:
+            ops.observe_device_rollup(summary, event=ev)
+
     # pipelined fleet ([controller] pipeline): the per-tenant boundary
     # phases (apply → pace → post-move monitor) run concurrently — each
     # tenant owns its backend/boundary/breaker, so N sequential
@@ -959,6 +1004,10 @@ def run_fleet_controller(
         mask[active] = True
         fc_rows = None
         g_moves = g_objs = None
+        if prof is not None:
+            # an armed capture opens HERE — just before the round's
+            # dispatch — so the trace holds exactly the rounds asked for
+            prof.maybe_start(label="fleet_rounds", round=rnd)
         t0 = time.perf_counter()
         if fleet_mode == "global":
             # ONE batched global solve re-places every service in every
@@ -1035,6 +1084,10 @@ def run_fleet_controller(
             hazard = flat[T * 4: T * 4 + T * n_nodes].reshape(T, n_nodes) > 0.5
             if diag_dev is not None:
                 fc_rows = flat[T * 4 + T * n_nodes:].reshape(T, DIAG_SIZE)
+        # device-plane byte accounting rides the bundles ALREADY pulled:
+        # the decision bundle here, the metrics bundle below — never a
+        # new transfer (check_apply_boundary keeps it that way)
+        mesh_bytes = int(flat.nbytes) if mesh_plane is not None else 0
         result.batched_solves += 1
         result.device_solve_s += solve_s
         # the shared dispatch's cost, attributed evenly to the tenants
@@ -1231,11 +1284,15 @@ def run_fleet_controller(
             metrics, rollup = decode_fleet_bundle(
                 flat, tenants=T, top_k=rollup_k
             )
+            if mesh_plane is not None:
+                mesh_bytes += int(flat.nbytes)
         else:
             metrics = _pull_round_bundle(
                 fleet_metrics(stacked_after, stacked_graphs),
                 "fleet_metrics",
             )
+            if mesh_plane is not None:
+                mesh_bytes += int(metrics.nbytes)
         observe_wall_round(registry, "fleet", time.perf_counter() - t0)
         for i in range(T):
             if i in active_set:
@@ -1252,9 +1309,22 @@ def run_fleet_controller(
             last_pair[i] = metrics[i]
             ever_good[i] = True
             emit_tenant_round(t, rec, rnd)
+        observe_mesh(
+            dispatch_s=solve_s,
+            transfer_bytes=mesh_bytes,
+            # attribution weights: this round's per-tenant comm cost —
+            # tenant block i's share of the dispatch lands on device i
+            weights=metrics[:, 0],
+            rounds=1,
+            rnd=rnd,
+        )
         if rollup is not None:
             emit_rollup(rollup, rnd)
         update_fleet_health()
+        if prof is not None:
+            # one fleet round committed — an open capture burns one of
+            # its budgeted rounds and closes at zero
+            prof.advance(1)
 
     scan_k = config.controller.scan_block
     if scan_k:
@@ -1317,6 +1387,10 @@ def run_fleet_controller(
         )
         if ops is not None:
             ops.health.mark_block_inflight(k)
+        if prof is not None:
+            # an armed capture wraps EXACTLY this block's dispatch: the
+            # trace opens here and closes after the block's k rounds
+            prof.maybe_start(label="fleet_scan_block", rounds=k, round=start)
         t0 = time.perf_counter()
         with span("fleet/scan_block", round=start, rounds=k, tenants=T):
             flat = _pull_round_bundle(
@@ -1344,6 +1418,10 @@ def run_fleet_controller(
         scan_mod.count_scan_block(registry, k)
         result.batched_solves += 1
         result.device_solve_s += fence_s
+        # the WHOLE block bundle's bytes, read before the tripwire split
+        # reassigns `flat` — the device plane attributes what actually
+        # crossed the fence, tripwire lanes included
+        block_bytes = int(flat.nbytes) if mesh_plane is not None else 0
         trip = None
         if trip_on:
             flat, trip = tripwire_mod.split_fleet_tripwire(
@@ -1358,6 +1436,15 @@ def run_fleet_controller(
         else:
             decisions, hazard, landed_idx, metrics = decoded
             rollups = None
+        observe_mesh(
+            dispatch_s=fence_s,
+            transfer_bytes=block_bytes,
+            # per-tenant comm cost summed over the block's rounds —
+            # tenant block i's share of the fence lands on device i
+            weights=metrics[..., 0].sum(axis=0),
+            rounds=k,
+            rnd=start,
+        )
         commit = k
         trip_info = None
         if trip is not None and trip.tripped:
@@ -1498,6 +1585,11 @@ def run_fleet_controller(
             # SLO rule and the in-flight staleness scaling; a tripped
             # one flips /healthz and dumps a partial-block bundle
             ops.observe_scan_block(rounds=k, trip=trip_info)
+        if prof is not None:
+            # the dispatch ran all k rounds device-side (tripwire lanes
+            # freeze in-trace, the program shape is fixed) — the capture
+            # armed for this block closes with it
+            prof.advance(k)
         return commit
 
     def _run_rounds() -> None:

@@ -1,0 +1,320 @@
+"""The measured multichip harness: ``fleet_scan_rounds`` × the dp mesh.
+
+PR 9's scan fused K rounds of every tenant into one compiled dispatch
+(``bench.scan.fleet_scan_rounds``); PR 14's dp plane sharded the
+per-round fleet kernels one-tenant-block-per-device
+(``parallel.fleet``). This module composes the two — the scan body runs
+UNDER ``shard_map``, so one dispatch advances every tenant K rounds
+with each dp device scanning only its own tenant block — and measures
+the composition as the repo's first *measured* MULTICHIP record:
+
+- :func:`fleet_scan_rounds_dp` — the composed kernel. The shard body IS
+  ``bench.scan._fleet_scan_rounds`` over the shard's tenant block (no
+  collectives: tenants are independent clusters), so the dp plane is
+  decision-identical to the single-device scan by construction — and
+  test-pinned bit-exact, telemetry on or off.
+- :func:`decode_fleet_block_dp` — the dp bundle decode.
+  ``out_specs=P("dp")`` concatenates each shard's flat bundle along the
+  leading axis, so the global bundle is dp per-block bundles
+  back-to-back: re-split per shard, decode each with the single-device
+  ``decode_fleet_block``, merge on the tenant axis.
+- :func:`bench_multichip` — the MULTICHIP_r06+ harness
+  (``BENCH_SCENARIO=multichip``): timed scan blocks over the dp mesh,
+  ONE counted ``round_end`` pull per block (zero new per-round
+  transfers — ``scripts/check_apply_boundary.py`` pins this module
+  sync-free), per-device step attribution through
+  ``telemetry.mesh.MeshPlane``, and the
+  ``fleet_scan_rounds_per_sec`` headline. On a dev box the same cell
+  runs under ``--xla_force_host_platform_device_count=8`` (the bench
+  driver forces it via ``__graft_entry__._force_virtual_devices``); on
+  a real slice it runs unchanged — the perf ledger keys the two apart
+  by ``device_kind`` (``cpux8`` vs ``tpux8``) so their baselines never
+  compare.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubernetes_rescheduling_tpu.bench import scan as scan_mod
+from kubernetes_rescheduling_tpu.parallel.compat import shard_map
+from kubernetes_rescheduling_tpu.parallel.fleet import (
+    _fleet_mesh,
+    dp_device_names,
+)
+from kubernetes_rescheduling_tpu.telemetry.accounting import instrument_jit
+from kubernetes_rescheduling_tpu.telemetry.registry import get_registry
+
+# jitted shard-mapped scan kernels keyed by (mesh, rounds, pinned) — the
+# scan twin of parallel.fleet._FLEET_SHARD_CACHE (rounds/pinned are
+# static in the scan body, so they belong in the cache key, not in a
+# fresh closure per call)
+_FLEET_SCAN_SHARD_CACHE: dict = {}
+
+
+def _fleet_scan_shard(mesh: Mesh, rounds: int, pinned: bool):
+    key = (mesh, rounds, pinned)
+    fn = _FLEET_SCAN_SHARD_CACHE.get(key)
+    if fn is None:
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P("dp"), P("dp"), P(), P(), P("dp"), P()),
+            out_specs=P("dp"),
+            check_vma=False,
+        )
+        def run_shard(states, graphs, policy_id, threshold, keys, start):
+            # the shard body IS the single-device fleet scan over this
+            # shard's tenant block — decide, sim-twin apply, metrics,
+            # all K rounds inside one lax.scan, no collectives
+            return scan_mod._fleet_scan_rounds(
+                states,
+                graphs,
+                policy_id,
+                threshold,
+                keys,
+                start,
+                rounds=rounds,
+                pinned=pinned,
+            )
+
+        fn = instrument_jit(run_shard, name="fleet_scan_rounds_dp")
+        _FLEET_SCAN_SHARD_CACHE[key] = fn
+    return fn
+
+
+def fleet_scan_rounds_dp(
+    states,
+    graphs,
+    policy_id: jax.Array,
+    threshold: jax.Array,
+    tenant_keys: jax.Array,
+    start_round: jax.Array,
+    *,
+    rounds: int,
+    pinned: bool = True,
+    mesh: Mesh | None = None,
+):
+    """:func:`bench.scan.fleet_scan_rounds` with the tenant axis sharded
+    over the mesh's ``dp`` dimension — ONE dispatch advances every
+    tenant ``rounds`` rounds, each device scanning its own tenant block.
+
+    ``states``/``graphs`` are the stacked tenant pytrees
+    (:func:`solver.fleet.stack_tenants`); the tenant count must divide
+    the mesh's dp extent (:func:`parallel.fleet._fleet_mesh` auto-shapes
+    one when none is given, degenerating to the single-device scan on
+    one chip). Returns the flat device bundle —
+    :func:`decode_fleet_block_dp` unpacks it."""
+    mesh = _fleet_mesh(int(tenant_keys.shape[0]), mesh)
+    return _fleet_scan_shard(mesh, int(rounds), bool(pinned))(
+        states, graphs, policy_id, threshold, tenant_keys, start_round
+    )
+
+
+def decode_fleet_block_dp(
+    flat,
+    *,
+    rounds: int,
+    tenants: int,
+    num_nodes: int,
+    dp: int,
+):
+    """Decode the dp plane's bundle: each dp shard emitted the
+    single-device fleet-scan layout over ITS tenant block
+    (rounds-leading), concatenated along the flat axis by
+    ``out_specs=P("dp")`` — re-split per shard, decode each, merge on
+    the tenant axis. Same return shape as
+    :func:`bench.scan.decode_fleet_block`: ``(decisions i64[K,T,4],
+    hazard bool[K,T,N], landed i64[K,T], metrics f32[K,T,2])``."""
+    flat = np.asarray(flat, dtype=np.float32)
+    if tenants % dp:
+        raise ValueError(f"tenants {tenants} not divisible by dp={dp}")
+    per = tenants // dp
+    block = flat.reshape(dp, -1)
+    parts = [
+        scan_mod.decode_fleet_block(
+            block[d], rounds=rounds, tenants=per, num_nodes=num_nodes
+        )
+        for d in range(dp)
+    ]
+    return tuple(
+        np.concatenate([p[i] for p in parts], axis=1) for i in range(4)
+    )
+
+
+def _rtt_ms(reps: int = 7) -> float:
+    """Host↔device round-trip floor (bench.py's measure_rtt_ms, local so
+    the harness is importable without the top-level script)."""
+
+    @jax.jit
+    def tick(x):
+        return x + 1.0
+
+    float(tick(jnp.float32(0)))  # compile
+    times = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        float(tick(jnp.float32(i)))
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2] * 1e3
+
+
+def bench_multichip(
+    tenants: int = 16,
+    n_services: int = 2000,
+    n_nodes: int = 256,
+    rounds: int = 8,
+    reps: int = 3,
+    *,
+    registry=None,
+    rtt_ms: float | None = None,
+) -> dict:
+    """The measured MULTICHIP cell: ``fleet_scan_rounds`` composed with
+    the dp mesh over ``tenants`` same-shaped power-law tenants, timed as
+    whole fenced blocks (dispatch → K scanned rounds on every device →
+    ONE ``round_end`` pull). Headline: ``fleet_scan_rounds_per_sec`` —
+    fleet rounds committed per wall second, median over ``reps`` blocks.
+
+    Every block feeds ``telemetry.mesh.MeshPlane`` (dispatch-wall
+    attribution weighted by each shard's pulled comm-cost column — an
+    attribution, not a per-device clock), so the record carries the
+    per-device step-time rollup and the imbalance ratio alongside the
+    throughput. The nested ``device_step_reading`` is its own ledger
+    series (``multichip_device_step_ms_p99``, better: lower)."""
+    from kubernetes_rescheduling_tpu.backends.base import device_kind
+    from kubernetes_rescheduling_tpu.bench.harness import make_fleet_problem
+    from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+    from kubernetes_rescheduling_tpu.solver.fleet import stack_tenants
+    from kubernetes_rescheduling_tpu.telemetry.mesh import MeshPlane
+
+    registry = registry if registry is not None else get_registry()
+    reps = max(1, int(reps))
+    mesh = _fleet_mesh(int(tenants), None)
+    dp = mesh.shape["dp"]
+    names = dp_device_names(mesh)
+    plane = MeshPlane(registry, device_names=names)
+    if rtt_ms is None:
+        rtt_ms = _rtt_ms()
+
+    states, graphs = make_fleet_problem(
+        tenants=tenants, n_services=n_services, n_nodes=n_nodes
+    )
+    st, gr = stack_tenants(states), stack_tenants(graphs)
+    pid = jnp.asarray(POLICY_IDS["communication"])
+    thr = jnp.asarray(30.0)
+    tenant_keys = jnp.stack(
+        [
+            jax.random.fold_in(jax.random.PRNGKey(0), t)
+            for t in range(tenants)
+        ]
+    )
+
+    def block(start: int):
+        flat = scan_mod.pull_block(
+            fleet_scan_rounds_dp(
+                st,
+                gr,
+                pid,
+                thr,
+                tenant_keys,
+                jnp.asarray(start, jnp.int32),
+                rounds=rounds,
+                mesh=mesh,
+            ),
+            registry=registry,
+        )
+        return flat
+
+    flat = block(0)  # compile outside the timed blocks
+    times = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        flat = block((i + 1) * rounds)
+        elapsed = time.perf_counter() - t0
+        times.append(elapsed)
+        _dec, _hz, _landed, metrics = decode_fleet_block_dp(
+            flat, rounds=rounds, tenants=tenants, num_nodes=n_nodes, dp=dp
+        )
+        # per-tenant comm cost summed over the block's rounds — tenant
+        # block i's share of the fence lands on device i (the same
+        # weights the live fleet loop feeds observe_mesh)
+        summary, _event = plane.observe_block(
+            dispatch_s=elapsed,
+            transfer_bytes=int(flat.nbytes),
+            weights=metrics[..., 0].sum(axis=0),
+            rounds=rounds,
+            round=(i + 1) * rounds,
+        )
+        scan_mod.count_scan_block(registry, rounds)
+
+    block_s = sorted(times)[len(times) // 2]
+    rounds_per_sec = rounds / max(block_s, 1e-9)
+    # trace accounting lives in the default registry (instrument_jit
+    # wraps at module import, before any injected registry exists)
+    traces = int(
+        get_registry()
+        .counter("jax_traces_total", labelnames=("fn",))
+        .labels(fn="fleet_scan_rounds_dp")
+        .value
+    )
+    step = plane.health_block()["step_ms"]
+    kind = device_kind(dp)
+    base_extra = {
+        "scenario": "multichip",
+        "tenants": tenants,
+        "n_devices": dp,
+        "device_kind": kind,
+        "devices": list(names),
+    }
+    return {
+        "metric": "fleet_scan_rounds_per_sec",
+        "value": round(rounds_per_sec, 3),
+        "unit": "rounds/s",
+        "better": "higher",
+        "extra": {
+            **base_extra,
+            "services_per_tenant": n_services,
+            "nodes_per_tenant": n_nodes,
+            "dp": dp,
+            "rounds_per_block": rounds,
+            "reps": reps,
+            "block_ms": round(block_s * 1e3, 3),
+            # fenced ≈ rtt + device + dispatch: the attribution the
+            # measured record owes the reader (a tunneled rig's RTT can
+            # dominate the block wall)
+            "rtt_ms": round(rtt_ms, 3),
+            "dispatch_frac": round(
+                min(1.0, (rtt_ms / 1e3) / max(block_s, 1e-9)), 4
+            ),
+            "step_ms_p50": round(step["p50"], 4),
+            "step_ms_p99": round(step["p99"], 4),
+            "step_ms_max": round(step["max"], 4),
+            "imbalance_ratio": round(summary["ratio"], 4),
+            "worst_device": summary["worst_device"],
+            # one trace for the whole run — the composed kernel pays its
+            # compile once (the multichip trace pin)
+            "fleet_scan_rounds_dp_traces": traces,
+        },
+        # the per-device rollup as its own ledger series (better: lower)
+        # so a device-imbalance regression trends independently of the
+        # throughput headline
+        "device_step_reading": {
+            "metric": "multichip_device_step_ms_p99",
+            "value": round(step["p99"], 4),
+            "unit": "ms",
+            "better": "lower",
+            "extra": {
+                **base_extra,
+                "step_ms_p50": round(step["p50"], 4),
+                "step_ms_max": round(step["max"], 4),
+                "imbalance_ratio": round(summary["ratio"], 4),
+            },
+        },
+    }

@@ -236,6 +236,10 @@ def _obs_config(args, **overrides):
         tripwire_load_factor=args.tripwire_load_factor,
         tripwire_hazard_streak=args.tripwire_hazard_streak,
         slo_serving_p99_ms=getattr(args, "slo_serving_p99_ms", 0.0),
+        slo_mesh_imbalance_ratio=getattr(
+            args, "slo_mesh_imbalance_ratio", 0.0
+        ),
+        profile_rounds=getattr(args, "profile_rounds", 0),
         **overrides,
     )
 
@@ -349,6 +353,23 @@ def _add_serve_flags(parser: argparse.ArgumentParser) -> None:
              "latency above this many ms flips /healthz to 503 and dumps "
              "a flight-recorder bundle with the in-flight request ring "
              "(0 = rule off)",
+    )
+    parser.add_argument(
+        "--profile-rounds", type=int, default=0, metavar="N",
+        help="arm one on-demand jax.profiler capture covering the next "
+             "N committed rounds (a scan block rounds it up to the "
+             "block); the artifact lands as profile_NNN/ under the "
+             "flight-recorder bundle dir, hard-capped by the obs "
+             "config's profile_max_captures/profile_max_mb. POST "
+             "/profile on the ops server arms later captures (0 = none "
+             "armed at start)",
+    )
+    parser.add_argument(
+        "--slo-mesh-imbalance-ratio", type=float, default=0.0, metavar="R",
+        help="mesh_imbalance watchdog rule: worst/median attributed "
+             "device step time above this ratio flips /healthz to 503 "
+             "(needs the dp fleet plane's device rollup; >= 1.0, "
+             "0 = rule off)",
     )
     _add_slo_flags(parser)
 

@@ -497,6 +497,40 @@ class ObsConfig:
                                          # slo_min_samples completed
                                          # requests, so idle serving never
                                          # trips it)
+    # mesh & device plane (telemetry.mesh): the tenant rollup's sibling
+    # on the DEVICE axis — per-device attributed step-time/transfer
+    # rollups over the dp fleet planes, budget-gated per-device series,
+    # and on-demand jax.profiler capture
+    device_rollup: bool = True           # the device plane itself (its
+                                         # inputs are host-resident already,
+                                         # so on-by-default costs zero new
+                                         # device transfers)
+    device_label_budget: int = 64        # per-DEVICE series cardinality
+                                         # budget, the device-axis twin of
+                                         # tenant_label_budget: over it the
+                                         # mesh_device_* families suppress
+                                         # (counted) and the bounded mesh_*
+                                         # rollup families carry the plane
+    slo_mesh_imbalance_ratio: float = 0.0  # mesh_imbalance SLO rule: the
+                                           # worst/median attributed device
+                                           # step-time ratio exceeding this
+                                           # is a violation (0 = off; only
+                                           # meshes with >= 2 devices are
+                                           # judged, so single-chip runs
+                                           # never trip it)
+    profile_rounds: int = 0              # arm one on-demand jax.profiler
+                                         # capture spanning this many fleet
+                                         # rounds (or one scan block) at run
+                                         # start (0 = off; POST /profile
+                                         # arms the same gate mid-run)
+    profile_max_captures: int = 4        # hard per-process capture cap —
+                                         # POST /profile answers 409 once
+                                         # spent
+    profile_max_mb: float = 256.0        # hard per-artifact size cap: a
+                                         # capture larger than this is
+                                         # DELETED (counted status=oversize)
+                                         # so a runaway trace can never fill
+                                         # the bundle dir
 
     def validate(self) -> "ObsConfig":
         if self.serve_port is not None and not (0 <= self.serve_port <= 65535):
@@ -570,6 +604,29 @@ class ObsConfig:
             raise ValueError(
                 "slo_serving_p99_ms must be >= 0 (0 disables the "
                 "serving_p99 rule)"
+            )
+        if self.device_label_budget < 0:
+            raise ValueError(
+                "device_label_budget must be >= 0 (0 = per-device series "
+                "always suppressed; the bounded mesh rollups still emit)"
+            )
+        if self.slo_mesh_imbalance_ratio != 0.0 and (
+            self.slo_mesh_imbalance_ratio < 1.0
+        ):
+            raise ValueError(
+                "slo_mesh_imbalance_ratio must be 0 (rule off) or >= 1 "
+                "(worst/median device step time can never sit below 1)"
+            )
+        if self.profile_rounds < 0:
+            raise ValueError(
+                "profile_rounds must be >= 0 (0 = no capture armed at "
+                "run start)"
+            )
+        if self.profile_max_captures < 1:
+            raise ValueError("profile_max_captures must be >= 1")
+        if self.profile_max_mb <= 0:
+            raise ValueError(
+                "profile_max_mb must be > 0 (the per-artifact size cap)"
             )
         return self
 

@@ -137,6 +137,25 @@ def _fleet_mesh(t: int, mesh: Mesh | None) -> Mesh:
     return mesh
 
 
+def dp_device_names(
+    mesh: Mesh | None = None, *, tenants: int | None = None
+) -> tuple[str, ...]:
+    """Device *names* along the fleet dp axis, in dp order — what the
+    telemetry mesh plane labels its per-device readings with. Resolves
+    the mesh exactly the way the dp kernels do (:func:`_fleet_mesh`
+    auto-shaping when none is given), so name ``i`` is always the
+    device that runs tenant block ``i``. Names are event/endpoint data
+    only — the cardinality checker bans ``device`` as a raw metric
+    label outside the budget-gated families."""
+    from kubernetes_rescheduling_tpu.parallel.sharded import dp_devices
+
+    if mesh is None:
+        if tenants is None:
+            raise ValueError("need a mesh or a tenant count to shape one")
+        mesh = _fleet_mesh(int(tenants), None)
+    return tuple(str(d) for d in dp_devices(mesh))
+
+
 # dp twins of the proactive decide and the batched global solve — cached
 # like _FLEET_SHARD_CACHE (the controller re-dispatches per round and
 # must not retrace a fresh closure each time)

@@ -136,6 +136,19 @@ def _largest_divisor(r: int, cap: int) -> int:
     return max(d for d in range(1, min(cap, r) + 1) if r % d == 0)
 
 
+def dp_devices(mesh: Mesh) -> tuple:
+    """The devices along the mesh's ``dp`` axis, in dp order — the
+    device axis every dp-sharded output block maps onto (block ``i`` of
+    a ``P("dp")`` output lives on ``dp_devices(mesh)[i]``). The
+    telemetry mesh plane keys its per-device attribution on exactly
+    this ordering, so rollup index ``i`` always names the device that
+    ran tenant block ``i``."""
+    # index [:, 0]: the fleet meshes are (dp, 1)-shaped (make_mesh), and
+    # for a general (dp, tp) mesh the dp axis is the leading one
+    arr = mesh.devices
+    return tuple(arr[:, 0]) if arr.ndim == 2 else tuple(arr.ravel())
+
+
 def solve_with_restarts(
     state: ClusterState,
     graph: CommGraph,

@@ -37,6 +37,13 @@ that lives now:
   legal gateway for tenant-labeled families, statically enforced), and
   the bounded live-plane views behind ``/tenants`` and the over-budget
   ``/healthz`` fleet summary.
+- :mod:`mesh` — the device-axis sibling of :mod:`fleet_rollup`:
+  per-device step-time/transfer/HBM rollups for the dp fleet planes
+  (quantiles + worst-k, attributed from host-side dispatch wall — zero
+  extra transfers), the :class:`DeviceSeries` label budget, the
+  ``mesh_imbalance`` feed, and the :class:`ProfilerGate` behind
+  ``POST /profile`` / ``--profile-rounds`` (bounded on-demand
+  ``jax.profiler`` captures into the flight-recorder bundle dir).
 - :mod:`flight_recorder` — bounded ring of recent rounds, dumped as a
   self-contained diagnostics bundle on breaker-open / crash / SIGUSR1.
 - :mod:`watchdog` — rolling-window SLO rules (latency p95, comm-cost
@@ -101,6 +108,11 @@ from kubernetes_rescheduling_tpu.telemetry.fleet_rollup import (
     TenantSeries,
     TenantSummaryRing,
 )
+from kubernetes_rescheduling_tpu.telemetry.mesh import (
+    DeviceSeries,
+    MeshPlane,
+    ProfilerGate,
+)
 from kubernetes_rescheduling_tpu.telemetry.perf_ledger import PerfLedger
 from kubernetes_rescheduling_tpu.telemetry.flight_recorder import FlightRecorder
 from kubernetes_rescheduling_tpu.telemetry.server import (
@@ -133,6 +145,9 @@ __all__ = [
     "get_costbook",
     "sample_device_memory",
     "PerfLedger",
+    "DeviceSeries",
+    "MeshPlane",
+    "ProfilerGate",
     "TenantSeries",
     "TenantSummaryRing",
     "explanation_consistent",

@@ -158,7 +158,18 @@ def pull(
         "device->host pulls through telemetry.pull",
         labelnames=("site",),
     ).labels(site=site).inc()
-    return np.asarray(x)
+    out = np.asarray(x)
+    # byte twin of the count: the mesh plane attributes these bytes
+    # across devices per block, and the ledger's dispatch/RTT
+    # attribution reads the same series — counted AFTER the pull so the
+    # bytes reflect what actually crossed, and only host-side (no
+    # device work rides the accounting)
+    reg.counter(
+        "device_transfer_bytes_total",
+        "bytes pulled device->host through telemetry.pull",
+        labelnames=("site",),
+    ).labels(site=site).inc(float(out.nbytes))
+    return out
 
 
 @contextlib.contextmanager

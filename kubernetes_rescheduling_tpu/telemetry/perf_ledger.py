@@ -178,8 +178,12 @@ def load_entries(path: str | Path) -> list[dict[str, Any]]:
 def ingest_bench_file(path: str | Path) -> list[dict[str, Any]]:
     """One driver snapshot → ledger-shaped records (seq assigned by the
     caller/ledger). ``BENCH_r*.json`` carries a ``parsed`` headline
-    ``{metric, value, unit, extra}``; ``MULTICHIP_r*.json`` carries a
-    dry-run verdict. Anything else yields no records."""
+    ``{metric, value, unit, extra}``; ``MULTICHIP_r*.json`` is either a
+    legacy dry-run verdict (r01–r05) or, from r06, a measured record —
+    headline plus nested ``*_reading`` series, all keyed by the mesh
+    identity (``device_kind`` × ``n_devices``) so forced-host CPU and
+    real-slice runs never share a series. Anything else yields no
+    records."""
     p = Path(path)
     try:
         doc = json.loads(p.read_text())
@@ -187,6 +191,70 @@ def ingest_bench_file(path: str | Path) -> list[dict[str, Any]]:
         return []
     if not isinstance(doc, dict):
         return []
+    # MULTICHIP records FIRST: a measured record (r06+) carries both the
+    # driver's {n_devices, ok} envelope AND a parsed headline, and must
+    # NOT fall into the generic BENCH branch below — that branch keys by
+    # first device name and hardcodes better="lower", which would let a
+    # forced-host CPU run share a trend series with a real slice (and
+    # read a rounds/sec gain as a regression). The mesh identity
+    # (device_kind × n_devices) is part of the series key here.
+    n_devices = doc.get("n_devices")
+    if n_devices is not None and "ok" in doc:
+        parsed = doc.get("parsed")
+        if (
+            isinstance(parsed, dict)
+            and "metric" in parsed
+            and "value" in parsed
+        ):
+            kind = str(doc.get("device_kind") or f"unknownx{n_devices}")
+            digest = config_digest({"n_devices": n_devices})
+
+            def _rec(block: dict) -> dict[str, Any]:
+                extra = block.get("extra") or {}
+                return {
+                    "schema": LEDGER_SCHEMA,
+                    "seq": 0,
+                    "metric": str(block["metric"]),
+                    "value": float(block["value"]),
+                    "unit": str(block.get("unit", "")),
+                    "scenario": str(extra.get("scenario", "multichip")),
+                    "device_kind": kind,
+                    "config_digest": digest,
+                    "better": str(block.get("better", "higher")),
+                    "extra": {
+                        "source": p.name,
+                        "n_devices": n_devices,
+                        "vs_baseline": block.get("vs_baseline"),
+                    },
+                }
+
+            recs = [_rec(parsed)]
+            for k, v in parsed.items():
+                if (
+                    k.endswith("_reading")
+                    and isinstance(v, dict)
+                    and "metric" in v
+                    and "value" in v
+                ):
+                    recs.append(_rec(v))
+            return recs
+        # legacy dryrun receipt (r01–r05): unchanged shape
+        return [
+            {
+                "schema": LEDGER_SCHEMA,
+                "seq": 0,
+                "metric": "multichip_dryrun_ok",
+                "value": 1.0 if doc.get("ok") else 0.0,
+                "unit": "bool",
+                "scenario": f"n{doc.get('n_devices')}",
+                "device_kind": "mesh",
+                "config_digest": config_digest(
+                    {"n_devices": doc.get("n_devices")}
+                ),
+                "better": "higher",
+                "extra": {"source": p.name, "rc": doc.get("rc")},
+            }
+        ]
     parsed = doc.get("parsed")
     if isinstance(parsed, dict) and "metric" in parsed and "value" in parsed:
         extra = parsed.get("extra") or {}
@@ -206,21 +274,6 @@ def ingest_bench_file(path: str | Path) -> list[dict[str, Any]]:
                 "config_digest": "bench-history",
                 "better": "lower",  # headline benches are latencies (ms)
                 "extra": {"source": p.name, "vs_baseline": parsed.get("vs_baseline")},
-            }
-        ]
-    if "n_devices" in doc and "ok" in doc:
-        return [
-            {
-                "schema": LEDGER_SCHEMA,
-                "seq": 0,
-                "metric": "multichip_dryrun_ok",
-                "value": 1.0 if doc.get("ok") else 0.0,
-                "unit": "bool",
-                "scenario": f"n{doc.get('n_devices')}",
-                "device_kind": "mesh",
-                "config_digest": config_digest({"n_devices": doc.get("n_devices")}),
-                "better": "higher",
-                "extra": {"source": p.name, "rc": doc.get("rc")},
             }
         ]
     return []

@@ -36,6 +36,16 @@ Endpoints (``--serve PORT`` on ``reschedule``/``bench``):
 - ``GET /query?series=&n=`` — bounded raw readout of one history-plane
   ring (``telemetry.timeseries.SeriesStore``); a bare /query lists the
   retained series names. 404 when disabled or the series is unknown.
+- ``GET /devices`` — the mesh/device plane's per-device overview
+  (``telemetry.mesh.MeshPlane``): attributed step ms, cumulative
+  transfer MB, sampled HBM, and the latest device rollup — device
+  *names* live here and in events, never in metric label space. 404
+  until a dp fleet run binds a mesh plane.
+- ``POST /profile`` — arm one on-demand ``jax.profiler`` capture
+  (``{"rounds"?: int}``, default 1) around the next N fleet rounds or
+  the next scan block; the artifact lands in the flight-recorder
+  bundle dir. 400 on a bad body, 409 while a capture is pending/active
+  or the per-run budget is spent, 503 when no profiler is attached.
 
 The server runs daemon threads and binds 127.0.0.1 by default; port 0
 picks an ephemeral port (tests). Handlers never write to stdout/stderr —
@@ -62,6 +72,11 @@ from urllib.parse import parse_qs, urlsplit
 from kubernetes_rescheduling_tpu.telemetry.flight_recorder import (
     FlightRecorder,
     state_digest,
+)
+from kubernetes_rescheduling_tpu.telemetry.mesh import (
+    ProfilerBusy,
+    ProfilerExhausted,
+    ProfilerGate,
 )
 from kubernetes_rescheduling_tpu.telemetry.registry import (
     MetricsRegistry,
@@ -110,6 +125,12 @@ class HealthState:
         # rendered on /healthz when a serving engine is attached; the
         # serving_p99 watchdog rule flips the endpoint itself
         self.serving: dict[str, Any] | None = None
+        # mesh & device-plane summary (OpsPlane.observe_device_rollup):
+        # device count, rounds observed, the attributed step-time
+        # quantiles, and the worst/median imbalance ratio — rendered on
+        # /healthz when the device plane runs; the mesh_imbalance
+        # watchdog rule flips the endpoint itself
+        self.mesh: dict[str, Any] | None = None
         # a dispatched scan block is K rounds of healthy silence:
         # mark_round only fires as the replay flushes, so while a block
         # is in flight the staleness budget scales by its expected
@@ -173,6 +194,7 @@ class HealthState:
                     else {}
                 ),
                 **({"fleet": self.fleet} if self.fleet is not None else {}),
+                **({"mesh": self.mesh} if self.mesh is not None else {}),
             },
             healthy,
         )
@@ -193,6 +215,8 @@ class OpsServer:
         serving_source=None,  # zero-arg callable -> ServingEngine | None
         slo_source=None,  # zero-arg callable -> budget/burn table | None
         query_source=None,  # callable(series, n) -> (payload, code)
+        devices_source=None,  # zero-arg callable -> device overview | None
+        profile_sink=None,  # callable(rounds) -> (payload, code)
     ) -> None:
         self._port = port
         self.host = host
@@ -203,6 +227,8 @@ class OpsServer:
         self.serving_source = serving_source
         self.slo_source = slo_source
         self.query_source = query_source
+        self.devices_source = devices_source
+        self.profile_sink = profile_sink
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         # serializes the SLOW read paths (full-registry exposition, event/
@@ -274,7 +300,8 @@ def _make_handler(ops: OpsServer):
             if endpoint.startswith("/tenants/"):
                 counted = "/tenants/<name>"
             elif endpoint in ("/", "/metrics", "/healthz", "/events",
-                              "/tenants", "/place", "/slo", "/query"):
+                              "/tenants", "/place", "/slo", "/query",
+                              "/devices", "/profile"):
                 counted = endpoint
             else:
                 counted = "<other>"
@@ -390,10 +417,41 @@ def _make_handler(ops: OpsServer):
                     json.dumps(payload, default=float).encode(),
                     "application/json",
                 )
+            elif endpoint == "/devices":
+                with ops._read_lock:
+                    overview = (
+                        ops.devices_source()
+                        if ops.devices_source is not None
+                        else None
+                    )
+                if overview is None:
+                    payload, code = {
+                        "error": "no mesh plane attached (device "
+                                 "telemetry runs with the dp fleet "
+                                 "planes)"
+                    }, 404
+                else:
+                    payload, code = overview, 200
+                self._respond(
+                    code,
+                    json.dumps(payload, default=float).encode(),
+                    "application/json",
+                )
             elif endpoint == "/place":
                 body = json.dumps(
                     {"error": "method not allowed: POST a placement "
                               "request to /place"}
+                ).encode()
+                self.send_response(405)
+                self.send_header("Allow", "POST")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif endpoint == "/profile":
+                body = json.dumps(
+                    {"error": "method not allowed: POST a capture "
+                              "request to /profile"}
                 ).encode()
                 self.send_response(405)
                 self.send_header("Allow", "POST")
@@ -408,7 +466,8 @@ def _make_handler(ops: OpsServer):
                         {"error": "not found",
                          "endpoints": ["/metrics", "/healthz", "/events",
                                        "/tenants", "/tenants/<name>",
-                                       "/place", "/slo", "/query"]}
+                                       "/place", "/slo", "/query",
+                                       "/devices", "/profile"]}
                     ).encode(),
                     "application/json",
                 )
@@ -417,11 +476,15 @@ def _make_handler(ops: OpsServer):
             url = urlsplit(self.path)
             endpoint = url.path.rstrip("/") or "/"
             self._count(endpoint)
+            if endpoint == "/profile":
+                self._post_profile()
+                return
             if endpoint != "/place":
                 self._respond(
                     404,
                     json.dumps(
-                        {"error": "not found", "endpoints": ["/place"]}
+                        {"error": "not found",
+                         "endpoints": ["/place", "/profile"]}
                     ).encode(),
                     "application/json",
                 )
@@ -491,6 +554,42 @@ def _make_handler(ops: OpsServer):
                 "application/json",
             )
 
+        def _post_profile(self) -> None:
+            if ops.profile_sink is None:
+                self._respond(
+                    503,
+                    json.dumps(
+                        {"error": "no profiler attached (profiler "
+                                  "capture runs with the ops plane)"}
+                    ).encode(),
+                    "application/json",
+                )
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length > 0 else b""
+                payload = json.loads(raw.decode() or "{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("request body must be a JSON object")
+                rounds = payload.get("rounds", 1)
+                if isinstance(rounds, bool) or not isinstance(rounds, int):
+                    raise ValueError("'rounds' must be a JSON integer")
+            # TypeError joins the tuple as a backstop: the documented
+            # contract is 400 on ANY malformed body, never a handler crash
+            except (TypeError, ValueError, UnicodeDecodeError) as exc:
+                self._respond(
+                    400,
+                    json.dumps({"error": str(exc)}).encode(),
+                    "application/json",
+                )
+                return
+            result, code = ops.profile_sink(rounds)
+            self._respond(
+                code,
+                json.dumps(result, default=float).encode(),
+                "application/json",
+            )
+
     return Handler
 
 
@@ -523,6 +622,13 @@ class OpsPlane:
     # it); its bounded recent-request ring rides breaker-open and
     # serving_p99 flight-recorder bundles
     serving_engine: Any = field(default=None, repr=False)
+    # mesh mode: the device plane behind GET /devices (bind_mesh
+    # attaches it) and the profiler gate behind POST /profile — the
+    # gate is built by from_config whenever a flight-recorder bundle
+    # dir exists, so captures always land next to the bundles that
+    # reference them
+    mesh_plane: Any = field(default=None, repr=False)
+    profiler: Any = field(default=None, repr=False)
     # SLO v2: the bounded history plane (telemetry.timeseries.SeriesStore)
     # and the error-budget engine (telemetry.slo.SloEngine) — both None
     # unless [slo] is enabled; every observe_* tick samples the registry
@@ -578,6 +684,9 @@ class OpsPlane:
                 fleet_tail_frac=getattr(obs, "slo_fleet_tail_frac", 0.0),
                 scan_tripwire=getattr(obs, "slo_scan_tripwire", True),
                 serving_p99_ms=getattr(obs, "slo_serving_p99_ms", 0.0),
+                mesh_imbalance_ratio=getattr(
+                    obs, "slo_mesh_imbalance_ratio", 0.0
+                ),
             ),
             registry=registry,
             logger=logger,
@@ -630,6 +739,23 @@ class OpsPlane:
             series_store=series_store,
             slo_engine=slo_engine,
         )
+        # profiler captures land INSIDE the flight-recorder bundle dir:
+        # the capture summary rides a bundle dump, and the artifact it
+        # names sits next to the bundle that references it
+        plane.profiler = ProfilerGate(
+            registry,
+            artifact_dir=(
+                bundle_dir if bundle_dir is not None else obs.bundle_dir
+            ),
+            max_captures=getattr(obs, "profile_max_captures", 4),
+            max_mb=getattr(obs, "profile_max_mb", 256.0),
+            recorder=recorder,
+            logger=logger,
+        )
+        profile_rounds = int(getattr(obs, "profile_rounds", 0) or 0)
+        if profile_rounds > 0:
+            # --profile-rounds N arms one capture before the loop starts
+            plane.profiler.request(rounds=profile_rounds)
         if obs.serve_port is not None:
             plane.server = OpsServer(
                 port=obs.serve_port,
@@ -640,6 +766,8 @@ class OpsPlane:
                 serving_source=plane._serving,
                 slo_source=plane._slo_table,
                 query_source=plane._series_query,
+                devices_source=plane._devices,
+                profile_sink=plane._profile,
             )
         return plane
 
@@ -655,6 +783,31 @@ class OpsPlane:
         (a solo run's empty ring reads as 'no fleet attached')."""
         ring = self.tenant_ring
         return ring if ring is not None and len(ring) else None
+
+    def _devices(self):
+        """The /devices source: the bound mesh plane's per-device
+        overview (None — mapped to 404 — until a dp fleet run binds
+        one)."""
+        plane = self.mesh_plane
+        return plane.overview() if plane is not None else None
+
+    def _profile(self, rounds):
+        """The POST /profile sink: (payload, http code). Arms one
+        capture on the gate — 503 with no gate, 400 on a bad round
+        count, 409 (with the gate's status) when a capture is already
+        pending/active or the per-run budget is spent."""
+        gate = self.profiler
+        if gate is None:
+            return {
+                "error": "no profiler attached (profiler capture runs "
+                         "with the ops plane)"
+            }, 503
+        try:
+            return gate.request(rounds=rounds), 200
+        except ValueError as exc:
+            return {"error": str(exc)}, 400
+        except (ProfilerBusy, ProfilerExhausted) as exc:
+            return {"error": str(exc), "status": gate.status()}, 409
 
     def _slo_table(self):
         """The /slo source: the engine's last budget/burn evaluation
@@ -746,6 +899,10 @@ class OpsPlane:
                 self.server.slo_source = self._slo_table
             if self.server.query_source is None:
                 self.server.query_source = self._series_query
+            if self.server.devices_source is None:
+                self.server.devices_source = self._devices
+            if self.server.profile_sink is None:
+                self.server.profile_sink = self._profile
             self.server.start()
         if (
             self.recorder is not None
@@ -885,6 +1042,34 @@ class OpsPlane:
         rides breaker-open bundles."""
         self.serving_engine = engine
         engine.ops = self
+
+    def bind_mesh(self, mesh_plane) -> None:
+        """Attach the run's device plane (``telemetry.mesh.MeshPlane``):
+        it becomes the GET /devices source, and its per-block summaries
+        flow to the /healthz ``mesh`` stanza and the ``mesh_imbalance``
+        watchdog rule via :meth:`observe_device_rollup`."""
+        self.mesh_plane = mesh_plane
+
+    def observe_device_rollup(
+        self, summary: dict | None, event: dict | None = None
+    ) -> None:
+        """Feed one block's device-axis summary (the dp fleet loop calls
+        this after every decoded pull): updates the /healthz ``mesh``
+        stanza and judges the ``mesh_imbalance`` rule. The named-device
+        ``event`` payload stays out of the watchdog (names are event/
+        endpoint data, never label or rule state)."""
+        newly_burn: list[dict] = []
+        with self._watchdog_lock:
+            plane = self.mesh_plane
+            self.health.mesh = (
+                plane.health_block()
+                if plane is not None
+                else (dict(summary) if summary is not None else None)
+            )
+            if self.watchdog is not None:
+                self.watchdog.observe_mesh(summary)
+                newly_burn = self._slo_tick_locked()
+        self._dump_burn_pages(newly_burn)
 
     def bind_tenant_series(self, tseries) -> None:
         """Fleet mode: attach the run's ``TenantSeries`` cardinality

@@ -73,6 +73,7 @@ RULE_SHADOW = "shadow_win_rate"
 RULE_FLEET_TAIL = "fleet_tail_cost"
 RULE_SCAN_TRIPWIRE = "scan_tripwire"
 RULE_SERVING = "serving_p99"
+RULE_MESH = "mesh_imbalance"
 
 
 @dataclass(frozen=True)
@@ -153,6 +154,16 @@ class SLORules:
     # request cannot flip /healthz on its own (0 disables; only serving
     # runs feed summaries, so round-only runs can never trip it).
     serving_p99_ms: float = 0.0
+    # mesh imbalance: the device plane's worst/median attributed
+    # per-device step-time ratio (telemetry.mesh — the controller feeds
+    # the latest device-rollup summary via observe_mesh) exceeding this
+    # means one dp device is pacing the whole mesh — a straggler chip, a
+    # skewed tenant block, or a failing interconnect. Judged only on
+    # meshes with >= 2 devices, so single-chip runs (where the ratio is
+    # definitionally 1) can never trip it; a later balanced round
+    # recovers. 0 disables; thresholds below 1 are rejected (the ratio
+    # can never sit below 1).
+    mesh_imbalance_ratio: float = 0.0
 
     def validate(self) -> "SLORules":
         if self.window < 2:
@@ -200,6 +211,11 @@ class SLORules:
             raise ValueError(
                 "serving_p99_ms must be >= 0 (0 disables the serving_p99 "
                 "rule)"
+            )
+        if self.mesh_imbalance_ratio != 0.0 and self.mesh_imbalance_ratio < 1.0:
+            raise ValueError(
+                "mesh_imbalance_ratio must be 0 (rule off) or >= 1 "
+                "(worst/median step time can never sit below 1)"
             )
         return self
 
@@ -254,6 +270,10 @@ class Watchdog:
         # latest serving-plane summary (observe_serving feeds it after
         # every dispatched batch; its p99_ms/count judge the serving rule)
         self._serving: dict[str, Any] | None = None
+        # latest device-rollup summary (observe_mesh feeds it once per
+        # fleet round/scan block; its ratio/n_devices judge the
+        # mesh_imbalance rule)
+        self._mesh: dict[str, Any] | None = None
         # latest SLO-engine burn entries (observe_slo_burn feeds them
         # each history-plane tick; merged into `now` verbatim so burn
         # rules ride the same entry/recovery bookkeeping)
@@ -294,6 +314,7 @@ class Watchdog:
         self._shadow = None
         self._scan_trip = None
         self._serving = None
+        self._mesh = None
         self._slo_burn = {}
         self._overlap.clear()
         self._fleet_tail.clear()
@@ -420,6 +441,19 @@ class Watchdog:
         self._serving = dict(summary) if summary is not None else None
         return self.check()
 
+    def observe_mesh(
+        self, summary: dict[str, Any] | None
+    ) -> list[dict[str, Any]]:
+        """Feed the device plane's latest rollup summary
+        (``telemetry.mesh.MeshPlane.observe_block`` — the fleet loop
+        calls this through ``OpsPlane.observe_device_rollup`` once per
+        round/block). The summary's worst/median step-time ``ratio``
+        over ``n_devices`` judges the ``mesh_imbalance`` rule; a later
+        balanced round recovers it. Returns the newly raised
+        violations, like :meth:`observe_round`."""
+        self._mesh = dict(summary) if summary is not None else None
+        return self.check()
+
     def observe_slo_burn(
         self, entries: dict[str, dict[str, Any]]
     ) -> list[dict[str, Any]]:
@@ -499,6 +533,10 @@ class Watchdog:
             return detail.get("win_rate", 0.0), detail.get("threshold", 0.0)
         if rule == RULE_SERVING:
             return detail.get("p99_ms", 0.0), detail.get("threshold_ms", 0.0)
+        if rule == RULE_MESH:
+            return detail.get("ratio", 0.0), detail.get(
+                "threshold_ratio", 0.0
+            )
         if rule == RULE_PERF:
             return float(detail.get("count", 0)), 0.0
         # scan_tripwire and anything without a numeric axis: the device
@@ -657,6 +695,22 @@ class Watchdog:
                     "count": count,
                     "p50_ms": self._serving.get("p50_ms"),
                     "rate_rps": self._serving.get("rate_rps"),
+                }
+        if r.mesh_imbalance_ratio > 0 and self._mesh is not None:
+            # the LATEST device rollup judges: the ratio is already a
+            # whole-mesh statistic over the round's attributed step
+            # times, and a mesh of one device is definitionally balanced
+            # (ratio 1) — only real dp meshes are judged
+            n_devices = int(self._mesh.get("n_devices") or 0)
+            ratio = float(self._mesh.get("ratio") or 0.0)
+            if n_devices >= 2 and ratio > r.mesh_imbalance_ratio:
+                now[RULE_MESH] = {
+                    "ratio": ratio,
+                    "threshold_ratio": r.mesh_imbalance_ratio,
+                    "n_devices": n_devices,
+                    "worst_device": self._mesh.get("worst_device"),
+                    "step_ms_p50": self._mesh.get("step_ms_p50"),
+                    "step_ms_max": self._mesh.get("step_ms_max"),
                 }
         if r.scan_tripwire and self._scan_trip is not None:
             # the LATEST scan block judges: its in-trace tripwire

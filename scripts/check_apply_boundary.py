@@ -49,6 +49,10 @@ CHECKED: dict[Path, frozenset[str]] = {
     # the scan module's designated block-boundary transfer: ONE counted
     # round_end pull per K-round scan block
     PACKAGE / "bench" / "scan.py": frozenset({"pull_block"}),
+    # the multichip harness rides scan.pull_block for its one transfer
+    # per sharded block; the module itself must stay sync-free (the
+    # device plane's attribution inputs are host-resident by contract)
+    PACKAGE / "bench" / "multichip.py": frozenset(),
     # the batched fleet planes must stay sync-free end to end: the
     # forecast diag and the global solver's move bundle ride the fleet
     # loop's one counted pull, never their own

@@ -17,8 +17,17 @@ Schema enforced per ``BENCH_r*.json``:
   ``unit`` (str), ``extra`` (dict); ``vs_baseline``, when present, a
   finite number.
 
-Per ``MULTICHIP_r*.json``: ``n_devices`` (int), ``ok`` (bool), ``rc``
-(int).
+Per ``MULTICHIP_r*.json``: two generations share the prefix. The legacy
+dry-run receipts (r01–r05, no ``parsed`` block) keep their original
+3-key contract: ``n_devices`` (int), ``ok`` (bool), ``rc`` (int). A
+MEASURED record (r06+, ``parsed`` present) must additionally carry
+``device_kind`` (non-empty str — the platform×count series key that
+separates forced-host CPU runs from real slices) and a
+``fleet_scan_rounds_per_sec`` headline: ``better='higher'``,
+``unit='rounds/s'``, a finite value, ``extra.n_devices`` matching the
+envelope, and the nested per-device ``device_step_reading``
+(``better='lower'``, ``unit='ms'``) — a throughput record without its
+device rollup is half a story, exactly like serving's rate/p99 pair.
 
 Usage:
     python scripts/check_bench_schema.py [FILE.json ...]
@@ -107,6 +116,45 @@ def check_parsed(parsed, where: str) -> list[str]:
             out.append(
                 f"{where}: slo_budget_burn_frac must carry unit='frac'"
             )
+    # the multichip pair: the measured MULTICHIP record's throughput
+    # headline must trend up in rounds/s and carry its per-device
+    # rollup sibling; the device series must trend down in ms
+    if metric == "fleet_scan_rounds_per_sec":
+        if parsed.get("better") != "higher":
+            out.append(
+                f"{where}: fleet_scan_rounds_per_sec must declare "
+                "better='higher' (a throughput series)"
+            )
+        if parsed.get("unit") != "rounds/s":
+            out.append(
+                f"{where}: fleet_scan_rounds_per_sec must carry "
+                "unit='rounds/s'"
+            )
+        if not isinstance(parsed.get("device_step_reading"), dict):
+            out.append(
+                f"{where}: fleet_scan_rounds_per_sec must nest its "
+                "device_step_reading sibling (mesh throughput without "
+                "the per-device rollup is half a story)"
+            )
+        extra = parsed.get("extra")
+        if isinstance(extra, dict) and not isinstance(
+            extra.get("n_devices"), int
+        ):
+            out.append(
+                f"{where}: fleet_scan_rounds_per_sec extra.n_devices "
+                "must be an int (the mesh identity the ledger keys by)"
+            )
+    if metric == "multichip_device_step_ms_p99":
+        if parsed.get("better") != "lower":
+            out.append(
+                f"{where}: multichip_device_step_ms_p99 must declare "
+                "better='lower' (a latency series)"
+            )
+        if parsed.get("unit") != "ms":
+            out.append(
+                f"{where}: multichip_device_step_ms_p99 must carry "
+                "unit='ms'"
+            )
     # nested ledger readings (``*_reading`` — the fleet cell's rollup and
     # global-amortization series, and any future sibling): each is
     # appended to the perf ledger as its OWN series, so each must carry
@@ -136,6 +184,30 @@ def check_file(path: str | Path) -> list[str]:
             out.append(f"{p.name}: ok must be a bool")
         if not isinstance(doc.get("rc"), int):
             out.append(f"{p.name}: rc must be an int")
+        if "parsed" not in doc:
+            # legacy dry-run receipt (r01–r05): the 3-key contract above
+            # is the whole schema
+            return out
+        # measured record (r06+): the envelope must carry the mesh
+        # identity and the parsed block the ledger ingests
+        kind = doc.get("device_kind")
+        if not (isinstance(kind, str) and kind):
+            out.append(
+                f"{p.name}: measured MULTICHIP records must carry a "
+                "non-empty device_kind (the platform×count series key "
+                "that keeps forced-host CPU runs off real-slice trends)"
+            )
+        out.extend(check_parsed(doc["parsed"], p.name))
+        parsed = doc["parsed"]
+        if (
+            isinstance(parsed, dict)
+            and parsed.get("metric") != "fleet_scan_rounds_per_sec"
+        ):
+            out.append(
+                f"{p.name}: measured MULTICHIP headline must be "
+                "fleet_scan_rounds_per_sec, got "
+                f"{parsed.get('metric')!r}"
+            )
         return out
     for key, typ in (("n", int), ("cmd", str), ("rc", int), ("tail", str)):
         if not isinstance(doc.get(key), typ):

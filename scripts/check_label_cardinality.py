@@ -34,12 +34,21 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent.parent
 PACKAGE = ROOT / "kubernetes_rescheduling_tpu"
 
-# identity spaces that grow with the workload: tenants, services, pods
-UNBOUNDED_LABELS = ("tenant", "service", "pod")
+# identity spaces that grow with the workload: tenants, services, pods —
+# and devices, which are physically bounded per host but unbounded
+# across a fleet of meshes (a pod-scale dp mesh is exactly the blast
+# radius ObsConfig.device_label_budget exists for)
+UNBOUNDED_LABELS = ("tenant", "service", "pod", "device")
 
-# the budget-gated helpers — THE one legal home for tenant-labeled
-# registrations (telemetry.fleet_rollup.TenantSeries)
-ALLOWED_FILES = ("kubernetes_rescheduling_tpu/telemetry/fleet_rollup.py",)
+# the budget-gated helpers — THE legal homes for tenant-/device-labeled
+# registrations (telemetry.fleet_rollup.TenantSeries and
+# telemetry.mesh.DeviceSeries; costmodel's memory_stats sampler
+# predates the device budget and is bounded by jax.local_devices())
+ALLOWED_FILES = (
+    "kubernetes_rescheduling_tpu/telemetry/fleet_rollup.py",
+    "kubernetes_rescheduling_tpu/telemetry/mesh.py",
+    "kubernetes_rescheduling_tpu/telemetry/costmodel.py",
+)
 
 _REGISTER_METHODS = ("counter", "gauge", "histogram")
 

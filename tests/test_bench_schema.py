@@ -97,6 +97,143 @@ def test_multichip_shape(tmp_path):
     assert len(out) == 3
 
 
+def _multichip_like():
+    """bench.multichip.bench_multichip's measured envelope (r06+): the
+    driver keys plus device_kind and the parsed throughput headline
+    nesting its per-device rollup sibling."""
+    return {
+        "n_devices": 8,
+        "device_kind": "cpux8",
+        "rc": 0,
+        "ok": True,
+        "measured": True,
+        "cmd": "BENCH_SCENARIO=multichip python bench.py",
+        "tail": "{...}",
+        "parsed": {
+            "metric": "fleet_scan_rounds_per_sec",
+            "value": 163.9,
+            "unit": "rounds/s",
+            "better": "higher",
+            "extra": {
+                "scenario": "multichip",
+                "tenants": 16,
+                "n_devices": 8,
+                "device_kind": "cpux8",
+                "rounds_per_block": 8,
+            },
+            "device_step_reading": {
+                "metric": "multichip_device_step_ms_p99",
+                "value": 0.33,
+                "unit": "ms",
+                "better": "lower",
+                "extra": {"scenario": "multichip", "n_devices": 8},
+            },
+        },
+    }
+
+
+def test_multichip_measured_shape(tmp_path):
+    """The measured MULTICHIP record (r06+) passes, and each pinned
+    corruption class — a record the legacy 3-key check would wave
+    through — is flagged."""
+    checker = _load_checker()
+    ok = tmp_path / "MULTICHIP_r97.json"
+    ok.write_text(json.dumps(_multichip_like()))
+    assert checker.check_file(ok) == []
+
+    def corrupt(name, mutate):
+        doc = json.loads(json.dumps(_multichip_like()))
+        mutate(doc)
+        f = tmp_path / name
+        f.write_text(json.dumps(doc))
+        return checker.check_file(f)
+
+    # 1. missing device_kind — forced-host and real-slice runs would
+    # share a trend series
+    bad = corrupt("MULTICHIP_r96.json", lambda d: d.pop("device_kind"))
+    assert any("device_kind" in v for v in bad)
+    # 2. non-finite headline value
+    bad = corrupt(
+        "MULTICHIP_r95.json",
+        lambda d: d["parsed"].__setitem__("value", float("nan")),
+    )
+    assert any("finite" in v for v in bad)
+    # 3. throughput direction lost — a rounds/sec gain would trend as a
+    # regression
+    bad = corrupt(
+        "MULTICHIP_r94.json", lambda d: d["parsed"].pop("better")
+    )
+    assert any("better='higher'" in v for v in bad)
+    # 4. wrong unit on the headline
+    bad = corrupt(
+        "MULTICHIP_r93.json",
+        lambda d: d["parsed"].__setitem__("unit", "ms"),
+    )
+    assert any("unit='rounds/s'" in v for v in bad)
+    # 5. per-device rollup sibling dropped — throughput without the
+    # device axis is half the record
+    bad = corrupt(
+        "MULTICHIP_r92.json",
+        lambda d: d["parsed"].pop("device_step_reading"),
+    )
+    assert any("device_step_reading" in v for v in bad)
+    # 6. the nested device series with a flipped direction
+    bad = corrupt(
+        "MULTICHIP_r91.json",
+        lambda d: d["parsed"]["device_step_reading"].__setitem__(
+            "better", "higher"
+        ),
+    )
+    assert any("better='lower'" in v for v in bad)
+    # 7. extra.n_devices not an int — the ledger's mesh-identity key
+    bad = corrupt(
+        "MULTICHIP_r90.json",
+        lambda d: d["parsed"]["extra"].__setitem__("n_devices", "8"),
+    )
+    assert any("n_devices" in v for v in bad)
+    # 8. a measured record whose headline is some other metric
+    bad = corrupt(
+        "MULTICHIP_r89.json",
+        lambda d: d["parsed"].__setitem__("metric", "scan_rounds_per_sec"),
+    )
+    assert any("fleet_scan_rounds_per_sec" in v for v in bad)
+
+
+def test_multichip_measured_ledger_ingestion(tmp_path):
+    """A measured record ingests as TWO series (headline + device
+    rollup), both keyed by the mesh identity — never the legacy BENCH
+    branch's first-device-name key or hardcoded better='lower' — and
+    the legacy dryrun shape still ingests byte-identically."""
+    from kubernetes_rescheduling_tpu.telemetry.perf_ledger import (
+        config_digest,
+        ingest_bench_file,
+    )
+
+    f = tmp_path / "MULTICHIP_r06.json"
+    f.write_text(json.dumps(_multichip_like()))
+    recs = ingest_bench_file(f)
+    assert [r["metric"] for r in recs] == [
+        "fleet_scan_rounds_per_sec",
+        "multichip_device_step_ms_p99",
+    ]
+    for r in recs:
+        assert r["device_kind"] == "cpux8"
+        assert r["config_digest"] == config_digest({"n_devices": 8})
+        assert r["extra"]["n_devices"] == 8
+    assert recs[0]["better"] == "higher"
+    assert recs[1]["better"] == "lower"
+
+    legacy = tmp_path / "MULTICHIP_r05.json"
+    legacy.write_text(
+        json.dumps({"n_devices": 8, "rc": 0, "ok": True, "tail": "..."})
+    )
+    (rec,) = ingest_bench_file(legacy)
+    assert rec["metric"] == "multichip_dryrun_ok"
+    assert rec["device_kind"] == "mesh"
+    assert rec["value"] == 1.0
+    assert rec["better"] == "higher"
+
+
 def test_fleet_headline_conforms():
     """The new fleet cell's result dict (bench.bench_fleet's shape)
     satisfies the same parsed-record schema the history is held to —

@@ -1,0 +1,616 @@
+"""Mesh & device plane (``telemetry.mesh``): device-axis rollups
+re-derived against a numpy twin, the dispatch-attribution contract, the
+``DeviceSeries`` cardinality budget, the ``mesh_imbalance`` watchdog
+rule, the ``/devices`` + ``/profile`` ops endpoints, and the
+``ProfilerGate`` hard caps (capture count, one-in-flight, artifact
+size). The profiler's backend seams are monkeypatched — no real
+``jax.profiler`` trace is taken, so the file stays fast and
+device-independent."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.config import ObsConfig
+from kubernetes_rescheduling_tpu.telemetry import (
+    DeviceSeries,
+    MeshPlane,
+    MetricsRegistry,
+    OpsPlane,
+    OpsServer,
+    ProfilerGate,
+    SLORules,
+    Watchdog,
+    get_registry,
+    set_registry,
+)
+from kubernetes_rescheduling_tpu.telemetry.mesh import (
+    DEVICE_DIMS,
+    DEVICE_QUANTS,
+    ProfilerBusy,
+    ProfilerExhausted,
+    attribute_dispatch,
+    decode_device_rollup,
+    device_rollup_event,
+    device_rollup_matrix,
+    device_rollup_size,
+)
+from kubernetes_rescheduling_tpu.telemetry.watchdog import RULE_MESH
+from kubernetes_rescheduling_tpu.utils.logging import StructuredLogger
+
+
+@pytest.fixture()
+def registry():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post(port, path, body: bytes):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ---------------- rollup math vs a numpy twin ----------------
+
+
+def _nearest_rank(col, p):
+    """Independent nearest-rank quantile: value at ceil(p·n) in the
+    sorted column (1-indexed), clamped into range."""
+    s = np.sort(col)
+    n = len(s)
+    i = min(n - 1, max(0, int(np.ceil(p * n)) - 1))
+    return s[i]
+
+
+def test_device_rollup_matches_numpy_twin(registry):
+    rng = np.random.default_rng(7)
+    n, k = 8, 3
+    m = rng.uniform(0.1, 50.0, size=(n, len(DEVICE_DIMS))).astype(np.float32)
+    flat = device_rollup_matrix(m, worst_k=k)
+    assert flat.size == device_rollup_size(k)
+    roll = decode_device_rollup(flat, worst_k=k)
+    pcts = {"p50": 0.5, "p90": 0.9, "p99": 0.99, "max": 1.0}
+    for d, dim in enumerate(DEVICE_DIMS):
+        col = m[:, d]
+        got = roll["dims"][dim]
+        for q in DEVICE_QUANTS:
+            assert got["quantiles"][q] == pytest.approx(
+                float(_nearest_rank(col, pcts[q])), rel=1e-6
+            )
+        assert got["sum"] == pytest.approx(float(col.sum()), rel=1e-5)
+        # worst-k: the k largest values, descending, with the device
+        # index each came from
+        order = np.argsort(-col, kind="stable")[:k]
+        for rank, row in enumerate(got["worst"]):
+            assert row["device"] == int(order[rank])
+            assert row["value"] == pytest.approx(
+                float(col[order[rank]]), rel=1e-6
+            )
+
+
+def test_device_rollup_tie_order_is_stable(registry):
+    # ties resolve to the LOWER device index (stable argsort) — the
+    # worst-device name in events must not flap between equal devices
+    m = np.zeros((4, len(DEVICE_DIMS)), np.float32)
+    m[:, 0] = [5.0, 5.0, 1.0, 5.0]
+    roll = decode_device_rollup(
+        device_rollup_matrix(m, worst_k=3), worst_k=3
+    )
+    assert [r["device"] for r in roll["dims"]["step_ms"]["worst"]] == [0, 1, 3]
+
+
+def test_device_rollup_shape_errors(registry):
+    with pytest.raises(ValueError, match="n_devices"):
+        device_rollup_matrix(np.zeros((4, 2), np.float32), worst_k=2)
+    with pytest.raises(ValueError, match="worst_k"):
+        device_rollup_matrix(
+            np.zeros((4, len(DEVICE_DIMS)), np.float32), worst_k=5
+        )
+    with pytest.raises(ValueError, match="does not decode"):
+        decode_device_rollup(np.zeros(7, np.float32), worst_k=2)
+
+
+def test_attribute_dispatch_weighted_and_fallbacks():
+    # blockwise weighted split conserves the total: tenants map
+    # blockwise to shards, so per-tenant weights fold per shard
+    w = np.array([1.0, 1.0, 3.0, 3.0, 2.0, 2.0, 1.0, 1.0])  # T=8 over n=4
+    out = attribute_dispatch(16.0, w, n=4)
+    assert out.sum() == pytest.approx(16.0)
+    folded = w.reshape(4, -1).sum(axis=1)  # [2, 6, 4, 2]
+    assert out == pytest.approx(16.0 * folded / folded.sum())
+    # every degenerate weight column falls back to uniform, never raises
+    for bad in (
+        None,
+        np.ones(3),              # size < n
+        np.ones(9),              # size % n != 0
+        np.array([1.0, np.nan, 1.0, 1.0]),
+        np.array([-1.0, 1.0, 1.0, 1.0]),
+        np.zeros(4),
+    ):
+        out = attribute_dispatch(8.0, bad, n=4)
+        assert out == pytest.approx([2.0, 2.0, 2.0, 2.0])
+    with pytest.raises(ValueError):
+        attribute_dispatch(1.0, None, n=0)
+
+
+# ---------------- the DeviceSeries budget gate ----------------
+
+
+def test_device_series_budget_gates_and_counts(registry):
+    under = DeviceSeries(registry, devices=4, budget=8)
+    assert under.enabled
+    under.gauge_set("mesh_device_step_ms", "h", "cpu:0", 1.5)
+    under.counter_inc("mesh_device_transfer_mb_total", "h", "cpu:0", 2.0)
+    snap = registry.snapshot()
+    assert any(
+        r["metric"] == "mesh_device_step_ms"
+        and r.get("labels") == {"device": "cpu:0"}
+        for r in snap
+    )
+
+    over = DeviceSeries(registry, devices=16, budget=8)
+    assert not over.enabled
+    over.gauge_set("mesh_device_step_ms", "h", "cpu:9", 1.0)
+    over.gauge_set("mesh_device_step_ms", "h", "cpu:10", 1.0)
+    over.counter_inc("mesh_device_transfer_mb_total", "h", "cpu:9", 1.0)
+    sup = registry.counter(
+        "device_series_suppressed_total", labelnames=("family",)
+    )
+    assert sup.labels(family="mesh_device_step_ms").value == 2
+    assert sup.labels(family="mesh_device_transfer_mb_total").value == 1
+    # the suppressed devices created NO per-device series
+    snap = registry.snapshot()
+    assert not any(
+        (r.get("labels") or {}).get("device") in ("cpu:9", "cpu:10")
+        for r in snap
+    )
+
+
+# ---------------- MeshPlane ----------------
+
+
+def _feed(plane, *, dispatch_s=0.08, transfer_bytes=1 << 20, weights=None,
+          rounds=1, round=None):
+    return plane.observe_block(
+        dispatch_s=dispatch_s,
+        transfer_bytes=transfer_bytes,
+        weights=weights,
+        rounds=rounds,
+        round=round,
+    )
+
+
+def test_mesh_plane_publishes_bounded_rollup(registry):
+    names = [f"dev:{i}" for i in range(4)]
+    plane = MeshPlane(registry, device_names=names, sample_memory=False)
+    w = np.array([1.0, 1.0, 1.0, 5.0])  # device 3 is the straggler
+    summary, event = _feed(plane, dispatch_s=0.08, weights=w, round=7)
+    assert summary["n_devices"] == 4
+    assert summary["worst_device"] == "dev:3"
+    assert summary["ratio"] > 1.0
+    assert summary["round"] == 7
+    # per-round normalization: 80 ms over 4 devices, uniform would be
+    # 20 ms each; device 3 carries 5/8 of the weight = 50 ms
+    assert summary["step_ms_max"] == pytest.approx(50.0, rel=1e-4)
+    # the event carries device NAMES; worst rank 0 on step_ms is dev:3
+    worst0 = [
+        r for r in event["worst"] if r["dim"] == "step_ms" and r["rank"] == 0
+    ]
+    assert worst0[0]["device"] == "dev:3"
+    # bounded families published, ratio gauge matches the summary
+    g = registry.gauge("mesh_imbalance_ratio")
+    assert g.value == pytest.approx(summary["ratio"])
+    assert registry.gauge("mesh_devices").value == 4
+    q = registry.gauge("mesh_step_ms_quantile", labelnames=("q",))
+    assert q.labels(q="max").value == pytest.approx(50.0, rel=1e-4)
+    # under-budget mesh: the per-device series exist with names
+    s = registry.gauge("mesh_device_step_ms", labelnames=("device",))
+    assert s.labels(device="dev:3").value == pytest.approx(50.0, rel=1e-4)
+
+
+def test_mesh_plane_health_and_overview_accumulate(registry):
+    plane = MeshPlane(
+        registry, device_names=["a", "b"], sample_memory=False
+    )
+    _feed(plane, transfer_bytes=2 << 20, rounds=4, round=0)
+    _feed(plane, transfer_bytes=2 << 20, rounds=4, round=4)
+    hb = plane.health_block()
+    assert hb["devices"] == 2 and hb["rounds"] == 8 and hb["blocks"] == 2
+    assert set(hb["step_ms"]) == set(DEVICE_QUANTS)
+    ov = plane.overview()
+    assert [d["device"] for d in ov["devices"]] == ["a", "b"]
+    # transfers accumulate across blocks: 2 MiB/block uniform over 2
+    # devices = 1 MiB each, twice
+    assert ov["devices"][0]["transfer_mb_total"] == pytest.approx(2.0)
+    assert ov["rollup"]["worst_k"] == plane.worst_k
+
+
+def test_mesh_plane_over_budget_suppresses_device_series(registry):
+    plane = MeshPlane(
+        registry,
+        device_names=[f"d{i}" for i in range(8)],
+        budget=4,
+        sample_memory=False,
+    )
+    _feed(plane)
+    sup = registry.counter(
+        "device_series_suppressed_total", labelnames=("family",)
+    )
+    assert sup.labels(family="mesh_device_step_ms").value == 8
+    # the bounded rollup families still publish for the over-budget mesh
+    assert registry.gauge("mesh_devices").value == 8
+    snap = registry.snapshot()
+    assert not any(
+        (r.get("labels") or {}).get("device", "").startswith("d")
+        for r in snap
+        if r["metric"] == "mesh_device_step_ms"
+    )
+
+
+def test_event_payload_is_json_serializable(registry):
+    plane = MeshPlane(
+        registry, device_names=["x", "y", "z"], sample_memory=False
+    )
+    _, event = _feed(plane, weights=np.array([1.0, 2.0, 3.0]), round=3)
+    json.dumps(event)  # device names + floats only, no numpy scalars
+    rebuilt = device_rollup_event(
+        plane.overview()["rollup"] and decode_device_rollup(
+            device_rollup_matrix(
+                np.stack(
+                    [
+                        np.asarray(
+                            [d["step_ms"] for d in plane.overview()["devices"]]
+                        ),
+                        np.zeros(3),
+                        np.zeros(3),
+                    ],
+                    axis=1,
+                ),
+                worst_k=plane.worst_k,
+            ),
+            worst_k=plane.worst_k,
+        ),
+        plane.device_names,
+    )
+    json.dumps(rebuilt)
+
+
+# ---------------- the mesh_imbalance watchdog rule ----------------
+
+
+def _mesh_summary(ratio, n=4):
+    return {
+        "n_devices": n,
+        "ratio": ratio,
+        "worst_device": "dev:3",
+        "step_ms_p50": 10.0,
+        "step_ms_max": 10.0 * ratio,
+    }
+
+
+def test_mesh_imbalance_rule_fires_and_recovers(registry):
+    wd = Watchdog(
+        SLORules(min_samples=1, mesh_imbalance_ratio=2.0),
+        registry=registry,
+    )
+    assert wd.observe_mesh(_mesh_summary(1.5)) == []
+    raised = wd.observe_mesh(_mesh_summary(3.0))
+    assert [v["rule"] for v in raised] == [RULE_MESH]
+    v = raised[0]
+    assert v["ratio"] == pytest.approx(3.0)
+    assert v["threshold_ratio"] == pytest.approx(2.0)
+    assert v["worst_device"] == "dev:3"
+    assert v["n_devices"] == 4
+    assert not wd.status()["healthy"]
+    # a balanced round recovers the rule
+    assert wd.observe_mesh(_mesh_summary(1.2)) == []
+    assert wd.status()["healthy"]
+    viols = registry.counter("slo_violations_total", labelnames=("rule",))
+    assert viols.labels(rule=RULE_MESH).value == 1
+
+
+def test_mesh_imbalance_rule_ignores_single_device_and_off(registry):
+    wd = Watchdog(
+        SLORules(min_samples=1, mesh_imbalance_ratio=2.0),
+        registry=registry,
+    )
+    # a 1-device mesh has no imbalance to judge, whatever the ratio says
+    assert wd.observe_mesh(_mesh_summary(9.0, n=1)) == []
+    assert wd.status()["healthy"]
+    off = Watchdog(SLORules(min_samples=1), registry=registry)
+    assert off.observe_mesh(_mesh_summary(9.0)) == []
+    assert off.status()["healthy"]
+
+
+def test_mesh_imbalance_rule_clears_on_rebase(registry):
+    wd = Watchdog(
+        SLORules(min_samples=1, mesh_imbalance_ratio=2.0),
+        registry=registry,
+    )
+    wd.observe_mesh(_mesh_summary(5.0))
+    assert not wd.status()["healthy"]
+    wd.rebase()
+    assert wd.status()["healthy"]
+
+
+def test_mesh_imbalance_threshold_validates():
+    with pytest.raises(ValueError, match="mesh_imbalance_ratio"):
+        SLORules(mesh_imbalance_ratio=0.5).validate()
+    SLORules(mesh_imbalance_ratio=0.0).validate()
+    SLORules(mesh_imbalance_ratio=1.5).validate()
+    cfg = ObsConfig(slo_mesh_imbalance_ratio=0.5)
+    with pytest.raises(ValueError, match="mesh_imbalance"):
+        cfg.validate()
+
+
+# ---------------- /devices and /profile endpoints ----------------
+
+
+class TestMeshEndpoints:
+    def test_devices_404_until_mesh_bound_then_serves(self, registry):
+        plane = OpsPlane.from_config(
+            ObsConfig().validate(), registry=registry
+        )
+        srv = OpsServer(
+            port=0, registry=registry, devices_source=plane._devices
+        )
+        port = srv.start()
+        try:
+            code, body = _get(port, "/devices")
+            assert code == 404
+            assert b"no mesh plane" in body
+            mesh = MeshPlane(
+                registry, device_names=["a", "b"], sample_memory=False
+            )
+            _feed(mesh)
+            plane.bind_mesh(mesh)
+            code, body = _get(port, "/devices")
+            assert code == 200
+            doc = json.loads(body)
+            assert [d["device"] for d in doc["devices"]] == ["a", "b"]
+            assert doc["rounds"] == 1
+        finally:
+            srv.stop()
+
+    def test_profile_get_is_405_post_arms(self, registry, tmp_path):
+        plane = OpsPlane.from_config(
+            ObsConfig().validate(),
+            registry=registry,
+            bundle_dir=str(tmp_path),
+        )
+        srv = OpsServer(
+            port=0, registry=registry, profile_sink=plane._profile
+        )
+        port = srv.start()
+        try:
+            code, _ = _get(port, "/profile")
+            assert code == 405
+            code, body = _post(port, "/profile", b'{"rounds": 3}')
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["armed"] is True and doc["rounds"] == 3
+            # second arm while pending: 409 with the gate's status
+            code, body = _post(port, "/profile", b"{}")
+            assert code == 409
+            assert json.loads(body)["status"]["pending_rounds"] == 3
+        finally:
+            srv.stop()
+
+    def test_profile_post_validates_rounds(self, registry, tmp_path):
+        plane = OpsPlane.from_config(
+            ObsConfig().validate(),
+            registry=registry,
+            bundle_dir=str(tmp_path),
+        )
+        srv = OpsServer(
+            port=0, registry=registry, profile_sink=plane._profile
+        )
+        port = srv.start()
+        try:
+            for payload in (b'{"rounds": 0}', b'{"rounds": true}',
+                            b'{"rounds": "three"}', b"not json"):
+                code, _ = _post(port, "/profile", payload)
+                assert code == 400, payload
+            # defaults to one round on an empty body
+            code, body = _post(port, "/profile", b"")
+            assert code == 200
+            assert json.loads(body)["rounds"] == 1
+        finally:
+            srv.stop()
+
+    def test_profile_503_without_gate(self, registry):
+        srv = OpsServer(port=0, registry=registry)
+        port = srv.start()
+        try:
+            code, body = _post(port, "/profile", b"{}")
+            assert code == 503
+            assert b"no profiler" in body
+        finally:
+            srv.stop()
+
+
+# ---------------- healthz mesh stanza via the ops plane ----------------
+
+
+def test_observe_device_rollup_feeds_health_and_watchdog(registry):
+    obs = ObsConfig(slo_mesh_imbalance_ratio=2.0, slo_min_samples=1)
+    plane = OpsPlane.from_config(obs.validate(), registry=registry)
+    mesh = MeshPlane(
+        registry, device_names=["a", "b", "c", "d"], sample_memory=False
+    )
+    plane.bind_mesh(mesh)
+    summary, event = _feed(
+        mesh, weights=np.array([1.0, 1.0, 1.0, 9.0]), round=1
+    )
+    plane.observe_device_rollup(summary, event=event)
+    snap, _healthy = plane.health.snapshot()
+    assert snap["mesh"]["devices"] == 4
+    assert snap["mesh"]["worst_device"] == "d"
+    assert snap["mesh"]["imbalance_ratio"] == pytest.approx(
+        summary["ratio"], rel=1e-3
+    )
+    # ratio 3.0 > threshold 2.0: the rule is active on /healthz
+    assert not plane.watchdog.status()["healthy"]
+
+
+# ---------------- ProfilerGate ----------------
+
+
+class _FakeBackend:
+    """Monkeypatch seams: capture goes to a dir we fill ourselves."""
+
+    def __init__(self, gate, payload_bytes=16):
+        self.gate = gate
+        self.payload_bytes = payload_bytes
+        self.dirs = []
+        gate._start_backend = self.start
+        gate._stop_backend = self.stop
+
+    def start(self, log_dir):
+        self.dirs.append(log_dir)
+
+    def stop(self):
+        import os
+
+        d = self.dirs[-1]
+        with open(os.path.join(d, "trace.bin"), "wb") as f:
+            f.write(b"\0" * self.payload_bytes)
+
+
+def test_profiler_gate_lifecycle_and_caps(registry, tmp_path):
+    class Rec:
+        def __init__(self):
+            self.dumps = []
+
+        def dump(self, reason, **extra):
+            self.dumps.append((reason, extra))
+
+    rec = Rec()
+    logger = StructuredLogger(name="t")
+    gate = ProfilerGate(
+        registry,
+        artifact_dir=str(tmp_path),
+        max_captures=2,
+        max_mb=1.0,
+        recorder=rec,
+        logger=logger,
+    )
+    fake = _FakeBackend(gate)
+    # nothing armed: maybe_start is a no-op
+    assert gate.maybe_start(label="fleet_rounds") is False
+    out = gate.request(rounds=2)
+    assert out["armed"] and out["captures_left"] == 2
+    with pytest.raises(ProfilerBusy):
+        gate.request(rounds=1)
+    with pytest.raises(ValueError):
+        gate.request(rounds=0)
+    assert gate.maybe_start(label="fleet_rounds", round=5) is True
+    gate.advance(1)
+    assert gate.status()["active"]["rounds_left"] == 1
+    gate.advance(1)
+    st = gate.status()
+    assert st["active"] is None
+    (cap,) = st["captures"]
+    assert cap["status"] == "ok"
+    assert cap["rounds"] == 2 and cap["start_round"] == 5
+    assert cap["bytes"] == 16
+    assert (tmp_path / "profile_000" / "trace.bin").is_file()
+    ok = registry.counter(
+        "profile_captures_total", labelnames=("status",)
+    )
+    assert ok.labels(status="ok").value == 1
+    # the flight-recorder bundle references the capture
+    assert rec.dumps and rec.dumps[0][0] == "profile_capture"
+    assert rec.dumps[0][1]["profile"]["dir"] == str(tmp_path / "profile_000")
+    assert any(r["event"] == "profile_capture" for r in logger.records)
+
+    # second capture spends the budget; the third is exhausted
+    gate.request(rounds=1)
+    gate.maybe_start(label="fleet_rounds")
+    gate.advance(1)
+    with pytest.raises(ProfilerExhausted):
+        gate.request(rounds=1)
+
+
+def test_profiler_gate_oversize_artifact_is_deleted(registry, tmp_path):
+    gate = ProfilerGate(
+        registry, artifact_dir=str(tmp_path), max_captures=4, max_mb=1.0
+    )
+    _FakeBackend(gate, payload_bytes=2 << 20)  # 2 MiB > 1 MB cap
+    gate.request(rounds=1)
+    gate.maybe_start(label="fleet_scan_block", rounds=1)
+    gate.advance(1)
+    (cap,) = gate.status()["captures"]
+    assert cap["status"] == "oversize"
+    assert not (tmp_path / "profile_000").exists()
+    c = registry.counter("profile_captures_total", labelnames=("status",))
+    assert c.labels(status="oversize").value == 1
+    # the budget is still spent — a runaway trace must not retry free
+    assert gate.status()["max_captures"] - 1 == 3
+
+
+def test_profiler_gate_start_failure_counts_error(registry, tmp_path):
+    gate = ProfilerGate(
+        registry, artifact_dir=str(tmp_path), max_captures=4
+    )
+
+    def boom(log_dir):
+        raise RuntimeError("no profiler on this backend")
+
+    gate._start_backend = boom
+    gate.request(rounds=1)
+    assert gate.maybe_start(label="fleet_rounds") is False
+    (cap,) = gate.status()["captures"]
+    assert cap["status"] == "error" and "no profiler" in cap["error"]
+    c = registry.counter("profile_captures_total", labelnames=("status",))
+    assert c.labels(status="error").value == 1
+    # the failed slot is spent (seq advanced), the gate is idle again
+    assert gate.status()["active"] is None
+    assert gate.status()["pending_rounds"] == 0
+
+
+def test_scan_block_rounds_up_capture_span(registry, tmp_path):
+    # a scan block is atomic: maybe_start's rounds override widens the
+    # requested 1-round capture to the whole k-round block
+    gate = ProfilerGate(registry, artifact_dir=str(tmp_path))
+    _FakeBackend(gate)
+    gate.request(rounds=1)
+    assert gate.maybe_start(label="fleet_scan_block", rounds=16, round=0)
+    gate.advance(16)
+    (cap,) = gate.status()["captures"]
+    assert cap["status"] == "ok" and cap["rounds"] == 16
+
+
+def test_from_config_arms_profile_rounds(registry, tmp_path):
+    obs = ObsConfig(
+        profile_rounds=4, profile_max_captures=2, bundle_dir=str(tmp_path)
+    ).validate()
+    plane = OpsPlane.from_config(obs, registry=registry)
+    gate = plane.profiler
+    assert gate is not None
+    assert gate.status()["pending_rounds"] == 4
+    assert gate.max_captures == 2
+    # the artifact dir IS the flight-recorder bundle dir
+    assert gate.artifact_dir == str(tmp_path)

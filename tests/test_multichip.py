@@ -1,0 +1,235 @@
+"""The measured multichip harness (``bench.multichip``): the
+scan×dp composition is decision-identical to the single-device fleet
+scan (telemetry on or off), pays exactly ONE compile and ONE counted
+``round_end`` transfer per block, and its ``BENCH_SCENARIO=multichip``
+record passes the MULTICHIP schema checker that gates the checked-in
+``MULTICHIP_r06+`` snapshots.
+
+Problem sizes here stay in the 24-31 node range (prefix ``mc``) so the
+composed kernels compile fresh in this file — the trace pin cannot be
+satisfied by another test file's cache entries. All tests run on the 8
+forced host devices from conftest."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_rescheduling_tpu.backends.base import device_kind
+from kubernetes_rescheduling_tpu.bench import scan as scan_mod
+from kubernetes_rescheduling_tpu.bench.harness import make_fleet_problem
+from kubernetes_rescheduling_tpu.bench.multichip import (
+    bench_multichip,
+    decode_fleet_block_dp,
+    fleet_scan_rounds_dp,
+)
+from kubernetes_rescheduling_tpu.parallel.fleet import (
+    _fleet_mesh,
+    dp_device_names,
+)
+from kubernetes_rescheduling_tpu.policies import POLICY_IDS
+from kubernetes_rescheduling_tpu.solver.fleet import stack_tenants
+from kubernetes_rescheduling_tpu.telemetry import (
+    MeshPlane,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+
+
+@pytest.fixture()
+def registry():
+    prev = set_registry(MetricsRegistry())
+    try:
+        yield get_registry()
+    finally:
+        set_registry(prev)
+
+
+def _load_checker():
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "scripts"
+        / "check_bench_schema.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_bench_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_bench_schema", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _problem(tenants=8, n_services=40, n_nodes=26):
+    states, graphs = make_fleet_problem(
+        tenants=tenants, n_services=n_services, n_nodes=n_nodes
+    )
+    st, gr = stack_tenants(states), stack_tenants(graphs)
+    keys = jnp.stack(
+        [jax.random.fold_in(jax.random.PRNGKey(0), t) for t in range(tenants)]
+    )
+    return st, gr, keys
+
+
+def _run_dp(st, gr, keys, *, rounds, mesh=None, start=0):
+    return fleet_scan_rounds_dp(
+        st,
+        gr,
+        jnp.asarray(POLICY_IDS["communication"]),
+        jnp.asarray(30.0),
+        keys,
+        jnp.asarray(start, jnp.int32),
+        rounds=rounds,
+        mesh=mesh,
+    )
+
+
+def test_dp_scan_bit_identical_to_single_device(registry):
+    """The dp composition changes WHERE tenants run, never what they
+    decide: decisions/hazard/landed bit-exact vs the single-device
+    fleet scan, metrics to float tolerance (same ops, sharded layout)."""
+    n_nodes = 26
+    st, gr, keys = _problem(n_nodes=n_nodes)
+    rounds, tenants = 4, 8
+    mesh = _fleet_mesh(tenants, None)
+    dp = mesh.shape["dp"]
+    assert dp == 8  # conftest forces 8 host devices
+
+    flat_dp = np.asarray(_run_dp(st, gr, keys, rounds=rounds, mesh=mesh))
+    flat_1 = np.asarray(
+        scan_mod.fleet_scan_rounds(
+            st,
+            gr,
+            jnp.asarray(POLICY_IDS["communication"]),
+            jnp.asarray(30.0),
+            keys,
+            jnp.asarray(0, jnp.int32),
+            rounds=rounds,
+            pinned=True,
+        )
+    )
+    dec_dp, hz_dp, land_dp, met_dp = decode_fleet_block_dp(
+        flat_dp, rounds=rounds, tenants=tenants, num_nodes=n_nodes, dp=dp
+    )
+    dec_1, hz_1, land_1, met_1 = scan_mod.decode_fleet_block(
+        flat_1, rounds=rounds, tenants=tenants, num_nodes=n_nodes
+    )
+    np.testing.assert_array_equal(dec_dp, dec_1)
+    np.testing.assert_array_equal(hz_dp, hz_1)
+    np.testing.assert_array_equal(land_dp, land_1)
+    np.testing.assert_allclose(met_dp, met_1, rtol=1e-5)
+
+
+def test_dp_scan_identical_with_telemetry_on_and_off(registry):
+    """Feeding the device plane is host-side attribution only — the
+    SAME flat bundle bytes whether a MeshPlane observes the block or
+    nothing does."""
+    st, gr, keys = _problem(n_nodes=27)
+    rounds, tenants = 4, 8
+    mesh = _fleet_mesh(tenants, None)
+    bare = np.asarray(_run_dp(st, gr, keys, rounds=rounds, mesh=mesh))
+    plane = MeshPlane(
+        registry, device_names=dp_device_names(mesh), sample_memory=False
+    )
+    observed = np.asarray(_run_dp(st, gr, keys, rounds=rounds, mesh=mesh))
+    dec, _hz, _land, met = decode_fleet_block_dp(
+        observed, rounds=rounds, tenants=tenants, num_nodes=27, dp=8
+    )
+    plane.observe_block(
+        dispatch_s=0.01,
+        transfer_bytes=int(observed.nbytes),
+        weights=met[..., 0].sum(axis=0),
+        rounds=rounds,
+    )
+    np.testing.assert_array_equal(observed, bare)
+    assert plane.health_block()["devices"] == 8
+
+
+def test_dp_scan_one_trace_one_transfer_per_block(registry):
+    """Steady state: ONE ``fleet_scan_rounds_dp`` trace however many
+    blocks run, ONE counted ``round_end`` pull per block, and ZERO
+    per-round transfer sites (``fleet_decision``/``fleet_metrics`` stay
+    silent — the multichip loop has no per-round host reads)."""
+    st, gr, keys = _problem(n_nodes=28)
+    rounds, tenants = 5, 8  # rounds=5: a cache key unique to this test
+    mesh = _fleet_mesh(tenants, None)
+    fam = registry.counter("device_transfers_total", labelnames=("site",))
+    for i in range(3):
+        flat = scan_mod.pull_block(
+            _run_dp(
+                st, gr, keys, rounds=rounds, mesh=mesh, start=i * rounds
+            ),
+            registry=registry,
+        )
+    assert fam.labels(site="round_end").value == 3
+    assert fam.labels(site="fleet_decision").value == 0
+    assert fam.labels(site="fleet_metrics").value == 0
+    traces = registry.counter("jax_traces_total", labelnames=("fn",))
+    assert traces.labels(fn="fleet_scan_rounds_dp").value == 1
+    # the byte twin counted exactly the pulled bundles
+    by = registry.counter(
+        "device_transfer_bytes_total", labelnames=("site",)
+    )
+    assert by.labels(site="round_end").value == pytest.approx(
+        3 * np.asarray(flat).nbytes
+    )
+
+
+def test_decode_fleet_block_dp_validates_divisibility():
+    with pytest.raises(ValueError, match="not divisible"):
+        decode_fleet_block_dp(
+            np.zeros(8, np.float32), rounds=1, tenants=6, num_nodes=4, dp=4
+        )
+
+
+def test_bench_multichip_record_passes_schema(registry, tmp_path):
+    """The harness end to end on the forced 8-device mesh: finite
+    readings, the dp/device_kind attribution keys, a nested
+    device-rollup reading — and the written MULTICHIP_r06-shaped record
+    passes ``check_bench_schema.check_file`` (the gate the checked-in
+    snapshot must clear)."""
+    result = bench_multichip(
+        tenants=8,
+        n_services=40,
+        n_nodes=29,
+        rounds=3,
+        reps=2,
+        registry=registry,
+        rtt_ms=0.05,
+    )
+    assert result["metric"] == "fleet_scan_rounds_per_sec"
+    assert result["value"] > 0 and np.isfinite(result["value"])
+    ex = result["extra"]
+    assert ex["n_devices"] == 8
+    assert ex["device_kind"] == device_kind(8)  # cpux8 on the forced mesh
+    assert len(ex["devices"]) == 8
+    assert ex["rounds_per_block"] == 3
+    assert np.isfinite(ex["step_ms_p99"]) and ex["step_ms_p99"] >= 0
+    assert ex["imbalance_ratio"] >= 1.0
+    nested = result["device_step_reading"]
+    assert nested["metric"] == "multichip_device_step_ms_p99"
+    assert nested["better"] == "lower"
+    assert nested["value"] == ex["step_ms_p99"]
+    # the harness made 3 round_end pulls: 1 warm + 2 timed reps
+    fam = registry.counter("device_transfers_total", labelnames=("site",))
+    assert fam.labels(site="round_end").value == 3
+
+    checker = _load_checker()
+    assert checker.check_parsed(result, "r06") == []
+    record = {
+        "n_devices": ex["n_devices"],
+        "device_kind": ex["device_kind"],
+        "rc": 0,
+        "ok": True,
+        "measured": True,
+        "cmd": "BENCH_SCENARIO=multichip python bench.py",
+        "tail": json.dumps(result),
+        "parsed": result,
+    }
+    p = tmp_path / "MULTICHIP_r06.json"
+    p.write_text(json.dumps(record, indent=1) + "\n")
+    assert checker.check_file(p) == []
